@@ -1,0 +1,82 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Ray-class capabilities (tasks, actors, distributed objects, lease-based
+topology-aware scheduling) re-designed for TPU pods: the device plane is
+jax/XLA/pallas over ICI meshes (ray_tpu.parallel, ray_tpu.ops), the host plane
+is a shared-memory object store + socket control plane (ray_tpu._private).
+
+Public surface mirrors the reference (ref: python/ray/__init__.py):
+    ray_tpu.init / shutdown / remote / get / put / wait / kill / get_actor
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from . import exceptions
+from ._private.object_ref import ObjectRef
+from ._worker_api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    wait,
+)
+from .actor import ActorClass, ActorHandle
+from .remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+_OPTION_KEYS = {
+    "num_cpus", "num_tpus", "num_returns", "resources", "max_retries",
+    "retry_exceptions", "max_restarts", "max_task_retries", "max_concurrency",
+    "name", "namespace", "scheduling_strategy", "runtime_env", "lifetime",
+    "placement_group",
+}
+
+
+def remote(*args, **kwargs):
+    """Decorate a function into a RemoteFunction or a class into an ActorClass.
+
+    Usage: @ray_tpu.remote  or  @ray_tpu.remote(num_cpus=2, num_tpus=1)
+    """
+
+    def _make(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return _make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+    bad = set(kwargs) - _OPTION_KEYS
+    if bad:
+        raise ValueError(f"Unknown @remote options: {sorted(bad)}")
+    return _make
+
+
+def method(**kwargs):
+    """Decorator for actor methods carrying options (ref: ray.method)."""
+
+    def _wrap(fn):
+        fn.__ray_tpu_method_options__ = kwargs
+        return fn
+
+    return _wrap
+
+
+__all__ = [
+    "ObjectRef", "ActorClass", "ActorHandle", "RemoteFunction",
+    "init", "shutdown", "is_initialized", "remote", "method",
+    "get", "put", "wait", "kill", "cancel", "get_actor",
+    "cluster_resources", "available_resources", "nodes",
+    "exceptions", "__version__",
+]

@@ -1,0 +1,100 @@
+"""Global flag table, env-var overridable.
+
+TPU-native analog of the reference RAY_CONFIG system (ref:
+src/ray/common/ray_config_def.h — 224 flags, each overridable via a RAY_<name>
+env var and via the driver's _system_config). We keep the same contract:
+ * every flag has a typed default,
+ * `RAY_TPU_<NAME>` env vars override defaults at process start,
+ * a driver-supplied dict overrides both and is propagated to workers through
+   the control plane (workers call `apply_overrides` on connect).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _coerce(value: str, ty: type) -> Any:
+    if ty is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if ty is dict or ty is list:
+        return json.loads(value)
+    return ty(value)
+
+
+@dataclass
+class Config:
+    # --- scheduling ---
+    scheduler_spread_threshold: float = 0.5   # hybrid policy: pack below, spread above
+    scheduler_top_k_fraction: float = 0.2     # top-k random choice among best nodes
+    max_pending_lease_requests_per_scheduling_class: int = 10
+    worker_lease_timeout_ms: int = 500
+    # --- object store ---
+    object_store_memory_bytes: int = 2 * 1024**3
+    object_store_small_object_threshold: int = 100 * 1024  # inline below this
+    object_spilling_threshold: float = 0.8
+    object_store_eviction_fraction: float = 0.1
+    max_grpc_message_bytes: int = 512 * 1024**2
+    object_transfer_chunk_bytes: int = 8 * 1024**2
+    # --- workers ---
+    num_workers_soft_limit: int = -1          # -1: num_cpus
+    worker_startup_timeout_s: float = 60.0
+    worker_register_timeout_s: float = 30.0
+    idle_worker_killing_time_threshold_ms: int = 800
+    prestart_workers: bool = True
+    # --- fault tolerance ---
+    task_max_retries_default: int = 3
+    actor_max_restarts_default: int = 0
+    health_check_period_ms: int = 1000
+    health_check_failure_threshold: int = 5
+    lineage_pinning_enabled: bool = True
+    max_lineage_bytes: int = 1024**3
+    # --- chaos / testing (mirrors rpc_chaos.h fault injection) ---
+    testing_rpc_failure: str = ""             # "method=prob_req:prob_resp,..."
+    # --- logging / metrics ---
+    event_log_enabled: bool = True
+    metrics_report_interval_ms: int = 2000
+    # --- device plane ---
+    mesh_compile_cache_dir: str = ""
+    default_device_platform: str = ""         # "" = jax default
+    ici_mesh_auto_axis_order: bool = True
+
+    def apply_overrides(self, overrides: Dict[str, Any]) -> None:
+        valid = {f.name: f.type for f in fields(self)}
+        for key, value in overrides.items():
+            if key not in valid:
+                raise ValueError(f"Unknown config flag: {key}")
+            setattr(self, key, value)
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls()
+        for f in fields(cls):
+            env_key = _ENV_PREFIX + f.name.upper()
+            if env_key in os.environ:
+                ty = type(getattr(cfg, f.name))
+                setattr(cfg, f.name, _coerce(os.environ[env_key], ty))
+        return cfg
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+_global_config: Config | None = None
+
+
+def global_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.from_env()
+    return _global_config
+
+
+def reset_global_config() -> None:
+    global _global_config
+    _global_config = None

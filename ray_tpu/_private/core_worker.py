@@ -1,0 +1,745 @@
+"""Core worker: ownership, task submission, object access.
+
+TPU-native analog of the reference core worker (ref: src/ray/core_worker/
+core_worker.h:165, transport/normal_task_submitter.h, actor_task_submitter.h,
+reference_count.h:66, task_manager.h). One CoreWorker per process (driver or
+worker), bridging sync user code onto a dedicated asyncio IO thread.
+
+Submission paths:
+ * normal tasks — lease-based: acquire a worker lease from the raylet for the
+   task's SchedulingKey (scheduling class), then push the task directly to the
+   leased worker over its own socket (worker->worker direct push, the
+   steady-state hot path; ref: normal_task_submitter.h:227). Leases are pooled
+   per scheduling class and returned when the backlog drains.
+ * actor tasks — pushed directly to the actor's worker with per-caller
+   sequence numbers; the executing side replays them in order (ref:
+   transport/sequential_actor_submit_queue.h, actor_scheduling_queue.h).
+
+Ownership: this process owns every object its tasks return and everything it
+`put`s. Local+borrowed reference counts drive plasma frees; submitted-task
+argument deps pin refs until the task completes (ref: reference_count.h:66).
+Lineage-based reconstruction is recorded (resubmittable task specs are kept
+while their returns are referenced) — re-execution lands in the recovery
+manager in a later milestone.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from .config import global_config
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from .object_ref import ObjectRef, _set_ref_registry
+from .object_store import MemoryStore, SharedObjectStore
+from .rpc import ConnectionLost, EventLoopThread, RpcClient
+from . import serialization as ser
+from .task_spec import (
+    ArgKind,
+    DefaultSchedulingStrategy,
+    FunctionDescriptor,
+    PlacementGroupSchedulingStrategy,
+    ResourceSet,
+    TaskArg,
+    TaskSpec,
+)
+from .. import exceptions as exc
+
+_SMALL = None  # resolved from config at init
+
+
+@dataclass
+class _ActorState:
+    actor_id: ActorID
+    address: str = ""
+    state: str = "PENDING_CREATION"
+    seq_no: int = 0
+    client: Optional[RpcClient] = None
+    waiters: List[asyncio.Future] = field(default_factory=list)
+    death_cause: str = ""
+    owned: bool = False                 # this process registered the actor
+    creation_spec: Optional["TaskSpec"] = None
+    restart_in_flight: bool = False
+
+
+class _LeasePool:
+    """Pooled worker leases for one scheduling class (ref: SchedulingKey lease
+    pool, normal_task_submitter.h:58-65)."""
+
+    def __init__(self):
+        self.idle: List[dict] = []          # granted leases not executing
+        self.in_flight = 0                  # lease requests outstanding
+        self.waiters: List[asyncio.Future] = []
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        *,
+        mode: str,                      # "driver" | "worker"
+        session_name: str,
+        gcs_address: str,
+        raylet_address: str,
+        job_id: JobID,
+        node_id: NodeID,
+        store: SharedObjectStore,
+        io: Optional[EventLoopThread] = None,
+        worker_id: Optional[WorkerID] = None,
+    ):
+        self.mode = mode
+        self.session_name = session_name
+        self.job_id = job_id
+        self.node_id = node_id
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.store = store
+        self.memory_store = MemoryStore()
+        self.io = io or EventLoopThread(name=f"ray_tpu_io_{mode}")
+        self.cfg = global_config()
+        global _SMALL
+        _SMALL = self.cfg.object_store_small_object_threshold
+
+        self.gcs = RpcClient(gcs_address)
+        self.raylet = RpcClient(raylet_address)
+        self._worker_clients: Dict[str, RpcClient] = {}
+        self._worker_clients_lock = asyncio.Lock()
+
+        self._default_task_id = (TaskID.for_driver(job_id) if mode == "driver"
+                                 else TaskID.for_normal_task(job_id))
+        self._task_local = threading.local()  # per-execution-thread task context
+        self._put_index = 0
+        self._put_lock = threading.Lock()
+
+        # reference counting
+        self._local_refs: Dict[ObjectID, int] = {}
+        self._borrowed: Dict[ObjectID, str] = {}
+        self._task_deps: Dict[ObjectID, int] = {}
+        self._ref_lock = threading.Lock()
+        self._owned_in_plasma: set = set()
+
+        # submission state
+        self._lease_pools: Dict[int, _LeasePool] = {}
+        self._actors: Dict[ActorID, _ActorState] = {}
+        self._function_cache: Dict[str, Any] = {}
+        self._exported_blobs: set = set()
+        # lineage: resubmittable specs for owned objects (recorded, replayed by
+        # the recovery manager milestone)
+        self._lineage: Dict[TaskID, TaskSpec] = {}
+        self.address = ""  # worker-mode processes set their push address
+
+        _set_ref_registry(self)
+
+    # ------------------------------------------------------- task context
+    @property
+    def current_task_id(self) -> TaskID:
+        return getattr(self._task_local, "task_id", None) or self._default_task_id
+
+    @current_task_id.setter
+    def current_task_id(self, task_id: TaskID) -> None:
+        self._default_task_id = task_id
+
+    def set_task_context(self, task_id: TaskID) -> None:
+        """Bind the executing task to this thread (concurrent actor methods
+        each get their own context, so put-object lineage stays correct)."""
+        self._task_local.task_id = task_id
+
+    def clear_task_context(self) -> None:
+        self._task_local.task_id = None
+
+    # ------------------------------------------------------------- lifecycle
+    def connect(self):
+        self.io.run(self._connect())
+
+    async def _connect(self):
+        await self.gcs.connect()
+        await self.raylet.connect()
+        self.gcs.on_push("pubsub:actor", self._on_actor_update)
+        await self.gcs.call("subscribe", {"channels": ["actor"]})
+
+    def shutdown(self):
+        try:
+            self.io.run(self._shutdown(), timeout=5)
+        except Exception:
+            pass
+        self.io.stop()
+        _set_ref_registry(None)
+
+    async def _shutdown(self):
+        for task in list(self._worker_clients.values()):
+            try:
+                client = await asyncio.wait_for(asyncio.shield(task), 1.0)
+                await client.close()
+            except Exception:
+                pass
+        await self.gcs.close()
+        await self.raylet.close()
+
+    # -------------------------------------------------------- ref counting
+    def add_local_ref(self, oid: ObjectID):
+        with self._ref_lock:
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+
+    def remove_local_ref(self, oid: ObjectID):
+        with self._ref_lock:
+            count = self._local_refs.get(oid, 0) - 1
+            if count <= 0:
+                self._local_refs.pop(oid, None)
+                if self._task_deps.get(oid, 0) <= 0:
+                    self._maybe_free(oid)
+            else:
+                self._local_refs[oid] = count
+
+    def add_borrowed_ref(self, oid: ObjectID, owner_address: str):
+        with self._ref_lock:
+            self._borrowed[oid] = owner_address
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+
+    def _pin_task_dep(self, oid: ObjectID):
+        with self._ref_lock:
+            self._task_deps[oid] = self._task_deps.get(oid, 0) + 1
+
+    def _unpin_task_dep(self, oid: ObjectID):
+        with self._ref_lock:
+            count = self._task_deps.get(oid, 0) - 1
+            if count <= 0:
+                self._task_deps.pop(oid, None)
+                if self._local_refs.get(oid, 0) <= 0:
+                    self._maybe_free(oid)
+            else:
+                self._task_deps[oid] = count
+
+    def _maybe_free(self, oid: ObjectID):
+        # only the owner frees plasma copies; borrowers just drop local state
+        if oid in self._borrowed:
+            self._borrowed.pop(oid, None)
+            return
+        self.memory_store.delete(oid)
+        if oid in self._owned_in_plasma:
+            self._owned_in_plasma.discard(oid)
+            spec = self._lineage.pop(oid.task_id(), None)
+            del spec
+            if not self.gcs.closed:
+                self.io.spawn(self._free_remote([oid]))
+
+    async def _free_remote(self, oids: List[ObjectID]):
+        try:
+            await self.raylet.call("free_objects", {"object_ids": oids})
+        except Exception:
+            pass
+
+    # --------------------------------------------------------------- put/get
+    def put(self, value: Any) -> ObjectRef:
+        with self._put_lock:
+            self._put_index += 1
+            oid = ObjectID.for_put(self.current_task_id, self._put_index)
+        data = ser.serialize(value)
+        self._store_object(oid, data)
+        return ObjectRef(oid, self.address)
+
+    def _store_object(self, oid: ObjectID, data: bytes, memory_only: bool = False):
+        if len(data) <= _SMALL or memory_only:
+            self.memory_store.put(oid, data)
+            if not memory_only:
+                # small objects also become visible cluster-wide via plasma so
+                # other processes can fetch them (inline-on-reply covers the
+                # common path; this covers puts)
+                self.store.put(oid, data)
+                self._owned_in_plasma.add(oid)
+                self.io.spawn(self._notify_sealed(oid, len(data)))
+        else:
+            self.store.put(oid, data)
+            self._owned_in_plasma.add(oid)
+            self.io.spawn(self._notify_sealed(oid, len(data)))
+
+    async def _notify_sealed(self, oid: ObjectID, size: int):
+        try:
+            await self.raylet.call("object_sealed", {"object_id": oid, "size": size})
+        except Exception:
+            pass
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        oids = [r.id() for r in refs]
+        return self.io.run(self._get(oids, timeout),
+                           timeout=None if timeout is None else timeout + 30)
+
+    async def _get(self, oids: List[ObjectID], timeout: Optional[float]) -> List[Any]:
+        missing = [oid for oid in oids if not self.memory_store.contains(oid)
+                   and not self.store.contains(oid)]
+        if missing:
+            reply = await self.raylet.call("wait_objects", {
+                "object_ids": missing, "num_returns": len(missing), "timeout": timeout,
+            })
+            if len(reply["ready"]) < len(missing):
+                raise exc.GetTimeoutError(
+                    f"Get timed out: {len(missing) - len(reply['ready'])} object(s) not ready")
+        out = []
+        for oid in oids:
+            out.append(self._load_object(oid))
+        return out
+
+    def _load_object(self, oid: ObjectID) -> Any:
+        data = self.memory_store.get(oid)
+        if data is None:
+            view = self.store.get(oid)
+            if view is None:
+                raise exc.ObjectLostError(oid)
+            data = view
+        value, metadata = ser.deserialize(data)
+        if metadata == ser.META_ERROR:
+            err, tb = value
+            if isinstance(err, (exc.TaskCancelledError, exc.ActorDiedError,
+                                exc.WorkerCrashedError, exc.ObjectLostError)):
+                raise err
+            raise exc.TaskError(err, tb)
+        return value
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        oids = [r.id() for r in refs]
+        ready_ids = self.io.run(self._wait(oids, num_returns, timeout))
+        ready_set = set(ready_ids[:num_returns]) if len(ready_ids) > num_returns else set(ready_ids)
+        ready, not_ready = [], []
+        for ref in refs:
+            (ready if ref.id() in ready_set and len(ready) < num_returns else not_ready).append(ref)
+        return ready, not_ready
+
+    async def _wait(self, oids, num_returns, timeout):
+        local_ready = [oid for oid in oids if self.memory_store.contains(oid)]
+        if len(local_ready) >= num_returns:
+            return local_ready
+        remaining = [oid for oid in oids if oid not in set(local_ready)]
+        reply = await self.raylet.call("wait_objects", {
+            "object_ids": remaining,
+            "num_returns": num_returns - len(local_ready),
+            "timeout": timeout,
+        })
+        return local_ready + reply["ready"]
+
+    def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        async def _resolve():
+            try:
+                values = await self._get([ref.id()], None)
+                fut.set_result(values[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        self.io.spawn(_resolve())
+        return fut
+
+    # ------------------------------------------------------ function export
+    def export_function(self, func_or_class: Any) -> FunctionDescriptor:
+        pickled = cloudpickle.dumps(func_or_class)
+        blob_id = FunctionDescriptor.blob_id_for(pickled)
+        if blob_id not in self._exported_blobs:
+            self.io.run(self.gcs.call("kv_put", {
+                "ns": "functions", "key": blob_id, "value": pickled,
+            }))
+            self._exported_blobs.add(blob_id)
+        name = getattr(func_or_class, "__qualname__", repr(func_or_class))
+        return FunctionDescriptor(blob_id=blob_id, repr_name=name)
+
+    def load_function(self, blob_id: str) -> Any:
+        cached = self._function_cache.get(blob_id)
+        if cached is not None:
+            return cached
+        pickled = self.io.run(self.gcs.call("kv_get", {"ns": "functions", "key": blob_id}))
+        if pickled is None:
+            raise exc.RayTpuError(f"function blob {blob_id} not found in GCS")
+        func = cloudpickle.loads(pickled)
+        self._function_cache[blob_id] = func
+        return func
+
+    # ------------------------------------------------------- arg resolution
+    def _pack_args(self, args: tuple, kwargs: dict) -> Tuple[List[TaskArg], List[ObjectID]]:
+        packed: List[TaskArg] = []
+        dep_ids: List[ObjectID] = []
+        flat = list(args) + [("__kw__", k, v) for k, v in (kwargs or {}).items()]
+        for item in flat:
+            actual = item[2] if isinstance(item, tuple) and len(item) == 3 and item[0] == "__kw__" else item
+            kw = item[1] if actual is not item else None
+            if isinstance(actual, ObjectRef):
+                packed.append(TaskArg(ArgKind.OBJECT_REF, value=kw, object_id=actual.id()))
+                dep_ids.append(actual.id())
+                self._pin_task_dep(actual.id())
+            else:
+                data = ser.serialize(actual)
+                if len(data) > _SMALL:
+                    ref = self.put(actual)
+                    packed.append(TaskArg(ArgKind.OBJECT_REF, value=kw, object_id=ref.id()))
+                    dep_ids.append(ref.id())
+                    self._pin_task_dep(ref.id())
+                else:
+                    packed.append(TaskArg(ArgKind.VALUE, value=(kw, data)))
+        return packed, dep_ids
+
+    @staticmethod
+    def _build_resources(opts: dict) -> ResourceSet:
+        res = dict(opts.get("resources") or {})
+        if opts.get("num_cpus") is not None:
+            res["CPU"] = opts["num_cpus"]
+        elif "CPU" not in res:
+            res["CPU"] = 1
+        if opts.get("num_tpus"):
+            res["TPU"] = opts["num_tpus"]
+        return ResourceSet(res)
+
+    # ------------------------------------------------------ normal tasks
+    def submit_task(self, func: Any, args: tuple, kwargs: dict, opts: dict) -> List[ObjectRef]:
+        descriptor = self.export_function(func)
+        packed, deps = self._pack_args(args, kwargs)
+        num_returns = opts.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=TaskID.for_normal_task(self.job_id),
+            job_id=self.job_id,
+            function=descriptor,
+            args=packed,
+            num_returns=num_returns,
+            resources=self._build_resources(opts),
+            scheduling_strategy=opts.get("scheduling_strategy") or DefaultSchedulingStrategy(),
+            max_retries=opts.get("max_retries", self.cfg.task_max_retries_default),
+            retry_exceptions=opts.get("retry_exceptions", False),
+            owner_address=self.address,
+        )
+        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        if self.cfg.lineage_pinning_enabled:
+            self._lineage[spec.task_id] = spec
+        self.io.spawn(self._submit_normal(spec, deps))
+        return refs
+
+    async def _submit_normal(self, spec: TaskSpec, deps: List[ObjectID]):
+        try:
+            attempts = spec.max_retries + 1
+            last_error: Optional[BaseException] = None
+            for attempt in range(attempts):
+                try:
+                    await self._run_on_leased_worker(spec)
+                    last_error = None
+                    break
+                except (ConnectionLost, exc.WorkerCrashedError) as e:
+                    last_error = e
+                    await asyncio.sleep(0.02 * (2 ** attempt))
+            if last_error is not None:
+                self._store_error(spec, exc.WorkerCrashedError(
+                    f"task {spec.function.repr_name} failed after {attempts} attempts: {last_error}"))
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(spec, e)
+        finally:
+            for oid in deps:
+                self._unpin_task_dep(oid)
+
+    def _store_error(self, spec: TaskSpec, error: BaseException):
+        data = ser.serialize_error(error)
+        for oid in spec.return_ids():
+            self.memory_store.put(oid, data)
+            try:
+                self.store.put(oid, data)
+                self.io.spawn(self._notify_sealed(oid, len(data)))
+            except OSError:
+                pass  # store already destroyed (shutdown race)
+
+    async def _run_on_leased_worker(self, spec: TaskSpec):
+        sched_class = spec.scheduling_class()
+        pool = self._lease_pools.setdefault(sched_class, _LeasePool())
+        grant = await self._acquire_lease(pool, spec)
+        keep = False
+        try:
+            client = await self._client_for(grant["worker_address"])
+            reply = await client.call("push_task", cloudpickle.dumps(spec))
+            self._handle_task_reply(spec, reply)
+            keep = True
+        finally:
+            await self._release_lease(pool, grant, spec, reusable=keep)
+
+    async def _acquire_lease(self, pool: _LeasePool, spec: TaskSpec) -> dict:
+        while True:
+            if pool.idle:
+                return pool.idle.pop()
+            if pool.in_flight < self.cfg.max_pending_lease_requests_per_scheduling_class:
+                pool.in_flight += 1
+                try:
+                    return await self._request_lease(spec)
+                finally:
+                    pool.in_flight -= 1
+            # saturated: wait for a slot, then retry the whole acquisition
+            fut = asyncio.get_event_loop().create_future()
+            pool.waiters.append(fut)
+            await fut
+
+    async def _request_lease(self, spec: TaskSpec) -> dict:
+        payload = {
+            "resources": spec.resources.to_dict(),
+            "strategy": spec.scheduling_strategy,
+            "owner_address": self.address,
+            "actor_id": spec.actor_id if spec.actor_creation else None,
+        }
+        raylet = self.raylet
+        for _ in range(16):  # bounded spillback chain
+            reply = await raylet.call("request_worker_lease", payload)
+            if reply.get("granted"):
+                reply["_raylet"] = raylet
+                return reply
+            node_id, address = reply["retry_at"]
+            raylet = await self._raylet_client_for(address)
+        raise exc.RayTpuError("lease spillback chain too long")
+
+    async def _release_lease(self, pool: _LeasePool, grant: dict, spec: TaskSpec,
+                             reusable: bool):
+        if not spec.actor_creation:
+            if reusable and pool.waiters:
+                pool.idle.append(grant)  # hand the leased worker to the backlog
+            else:
+                raylet = grant.get("_raylet", self.raylet)
+                try:
+                    await raylet.call("return_worker", {
+                        "lease_id": grant["lease_id"],
+                        "disconnect_worker": not reusable,
+                    })
+                except Exception:
+                    pass
+        # always wake one waiter — even on the failure path, so queued
+        # submissions retry instead of stranding
+        while pool.waiters:
+            waiter = pool.waiters.pop(0)
+            if not waiter.done():
+                waiter.set_result(None)
+                break
+
+    _raylet_clients: Dict[str, RpcClient]
+
+    async def _raylet_client_for(self, address: str) -> RpcClient:
+        if not hasattr(self, "_raylet_clients_map"):
+            self._raylet_clients_map = {}
+        client = self._raylet_clients_map.get(address)
+        if client is None or client.closed:
+            client = RpcClient(address)
+            await client.connect()
+            self._raylet_clients_map[address] = client
+        return client
+
+    async def _client_for(self, address: str) -> RpcClient:
+        """One connection per peer. The connect task is cached synchronously so
+        concurrent callers share a single connection — per-caller actor task
+        ordering relies on all pushes riding one ordered stream."""
+        task = self._worker_clients.get(address)
+        if task is not None:
+            client = await asyncio.shield(task)
+            if not client.closed:
+                return client
+            self._worker_clients.pop(address, None)
+
+        async def _make():
+            client = RpcClient(address)
+            await client.connect(timeout=self.cfg.worker_startup_timeout_s)
+            return client
+
+        task = asyncio.ensure_future(_make())
+        self._worker_clients[address] = task
+        try:
+            return await asyncio.shield(task)
+        except BaseException:
+            if self._worker_clients.get(address) is task:
+                self._worker_clients.pop(address, None)
+            raise
+
+    def _handle_task_reply(self, spec: TaskSpec, reply: dict):
+        """reply: {results: [(oid, data|None)], error: bytes|None}"""
+        if reply.get("error") is not None:
+            for oid in spec.return_ids():
+                self.memory_store.put(oid, reply["error"])
+            return
+        for oid, data in reply["results"]:
+            if data is not None:
+                self.memory_store.put(oid, data)
+            # else: large result sealed in plasma by the executor
+
+    # ------------------------------------------------------------- actors
+    def submit_actor_creation(self, cls: Any, args: tuple, kwargs: dict, opts: dict) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        descriptor = self.export_function(cls)
+        packed, deps = self._pack_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(actor_id),
+            job_id=self.job_id,
+            function=descriptor,
+            args=packed,
+            num_returns=0,
+            resources=self._build_resources(opts),
+            scheduling_strategy=opts.get("scheduling_strategy") or DefaultSchedulingStrategy(),
+            actor_id=actor_id,
+            actor_creation=True,
+            actor_max_restarts=opts.get("max_restarts", self.cfg.actor_max_restarts_default),
+            actor_max_concurrency=opts.get("max_concurrency", 1),
+            actor_name=opts.get("name") or "",
+            owner_address=self.address,
+        )
+        state = _ActorState(actor_id=actor_id)
+        state.creation_spec = spec
+        state.owned = True
+        self._actors[actor_id] = state
+        self.io.run(self.gcs.call("register_actor", {
+            "actor_id": actor_id,
+            "name": spec.actor_name,
+            "namespace": opts.get("namespace", ""),
+            "class_name": spec.function.repr_name,
+            "max_restarts": spec.actor_max_restarts,
+            "creation_spec": cloudpickle.dumps(spec),
+        }))
+        # restartable actors keep creation args pinned for their lifetime so
+        # the creation spec can be resubmitted
+        self.io.spawn(self._submit_actor_creation(
+            spec, [] if spec.actor_max_restarts > 0 else deps))
+        return actor_id
+
+    async def _submit_actor_creation(self, spec: TaskSpec, deps: List[ObjectID]):
+        try:
+            sched_class = spec.scheduling_class()
+            pool = self._lease_pools.setdefault(sched_class, _LeasePool())
+            grant = await self._acquire_lease(pool, spec)
+            client = await self._client_for(grant["worker_address"])
+            reply = await client.call("push_task", cloudpickle.dumps(spec), timeout=None)
+            if reply.get("error") is not None:
+                try:
+                    (err, tb), _ = ser.deserialize(reply["error"])
+                    cause = f"creation task failed: {type(err).__name__}: {err}"
+                except Exception:
+                    cause = "creation task failed"
+                await self.gcs.call("actor_failed", {
+                    "actor_id": spec.actor_id, "cause": cause,
+                })
+                state = self._actors.get(spec.actor_id)
+                if state is not None:
+                    state.death_cause = cause
+        except BaseException as e:  # noqa: BLE001
+            try:
+                await self.gcs.call("actor_failed", {
+                    "actor_id": spec.actor_id, "cause": f"creation failed: {e}",
+                })
+            except Exception:
+                pass
+        finally:
+            for oid in deps:
+                self._unpin_task_dep(oid)
+
+    def _on_actor_update(self, payload):
+        info = payload["actor"]
+        state = self._actors.get(info.actor_id)
+        if state is None:
+            state = self._actors[info.actor_id] = _ActorState(actor_id=info.actor_id)
+        state.state = info.state
+        state.address = info.address
+        state.death_cause = info.death_cause
+        if info.state in ("ALIVE", "DEAD"):
+            state.restart_in_flight = False
+            for fut in state.waiters:
+                if not fut.done():
+                    fut.set_result(info.state)
+            state.waiters.clear()
+        elif (info.state == "RESTARTING" and state.owned
+              and state.creation_spec is not None and not state.restart_in_flight):
+            # the owner drives restarts: resubmit the creation task on a fresh
+            # lease (ref: gcs_actor_manager.cc:858 RestartActor — here the
+            # owner, not the GCS, re-runs the creation path)
+            state.restart_in_flight = True
+            spec = state.creation_spec
+            spec.task_id = TaskID.for_actor_task(info.actor_id)
+            self.io.spawn(self._submit_actor_creation(spec, []))
+
+    async def _wait_actor_alive(self, actor_id: ActorID, timeout: float = 120.0) -> _ActorState:
+        state = self._actors.get(actor_id)
+        if state is None:
+            info = await self.gcs.call("get_actor", {"actor_id": actor_id})
+            state = self._actors[actor_id] = _ActorState(actor_id=actor_id)
+            if info is not None:
+                state.state, state.address = info.state, info.address
+                state.death_cause = info.death_cause
+        while state.state != "ALIVE":
+            if state.state == "DEAD":
+                raise exc.ActorDiedError(actor_id, state.death_cause)
+            fut = asyncio.get_event_loop().create_future()
+            state.waiters.append(fut)
+            await asyncio.wait_for(fut, timeout)
+        return state
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args: tuple,
+                          kwargs: dict, opts: dict) -> List[ObjectRef]:
+        packed, deps = self._pack_args(args, kwargs)
+        num_returns = opts.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(actor_id),
+            job_id=self.job_id,
+            function=FunctionDescriptor(blob_id="", repr_name=method_name,
+                                        method_name=method_name),
+            args=packed,
+            num_returns=num_returns,
+            actor_id=actor_id,
+            max_retries=opts.get("max_task_retries", 0),
+            owner_address=self.address,
+        )
+        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        self.io.spawn(self._submit_actor_task(spec, deps))
+        return refs
+
+    async def _submit_actor_task(self, spec: TaskSpec, deps: List[ObjectID]):
+        try:
+            state = await self._wait_actor_alive(spec.actor_id)
+            spec.seq_no = state.seq_no
+            state.seq_no += 1
+            retries_left = spec.max_retries  # actor default: in-flight tasks
+            while True:                      # fail on death (ref: max_task_retries)
+                try:
+                    client = await self._client_for(state.address)
+                    reply = await client.call("push_task", cloudpickle.dumps(spec), timeout=None)
+                    self._handle_task_reply(spec, reply)
+                    return
+                except ConnectionLost:
+                    prev_address = state.address
+                    state.state = "RESTARTING" if state.state == "ALIVE" else state.state
+                    if retries_left <= 0:
+                        self._store_error(spec, exc.ActorDiedError(
+                            spec.actor_id,
+                            "the actor died while this call was in flight "
+                            "(set max_task_retries to retry on restart)"))
+                        return
+                    retries_left -= 1
+                    try:
+                        state = await self._wait_actor_alive(spec.actor_id)
+                    except exc.ActorDiedError as e:
+                        self._store_error(spec, e)
+                        return
+                    if state.address == prev_address:
+                        self._store_error(spec, exc.ActorDiedError(spec.actor_id, "unreachable"))
+                        return
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(spec, e)
+        finally:
+            for oid in deps:
+                self._unpin_task_dep(oid)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        async def _kill():
+            state = self._actors.get(actor_id)
+            await self.gcs.call("kill_actor", {"actor_id": actor_id,
+                                               "cause": "ray_tpu.kill"})
+            if state is not None and state.address:
+                try:
+                    client = await self._client_for(state.address)
+                    await client.call("kill_self", {}, timeout=2)
+                except Exception:
+                    pass
+        self.io.run(_kill())
+
+    def get_named_actor(self, name: str, namespace: str = "") -> ActorID:
+        info = self.io.run(self.gcs.call("get_actor", {"name": name, "namespace": namespace}))
+        if info is None or info.state == "DEAD":
+            raise ValueError(f"Failed to look up actor '{name}'")
+        state = self._actors.setdefault(info.actor_id, _ActorState(actor_id=info.actor_id))
+        state.state, state.address = info.state, info.address
+        return info.actor_id

@@ -1,0 +1,332 @@
+"""Global Control Service: cluster metadata + control plane.
+
+TPU-native analog of the reference GCS (ref: src/ray/gcs/gcs_server/
+gcs_server.h, gcs_actor_manager.cc:394,480,858, gcs_node_manager.h,
+gcs_kv_manager.h, gcs_job_manager.h) with its pubsub (ref: src/ray/pubsub/
+publisher.h:300) collapsed into push frames on the same RPC server. Storage is
+pluggable like the reference store_client (ref: gcs/store_client/
+store_client.h:33): in-memory by default, file-backed journal for
+fault-tolerant restart (the Redis-persistence analog).
+
+Tables: nodes, actors, jobs, KV (function blobs, named refs), placement
+groups. All mutating handlers publish deltas on pubsub channels so raylets and
+core workers keep eventually-consistent views (the RaySyncer role, ref:
+src/ray/common/ray_syncer/ray_syncer.h:73).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .ids import ActorID, JobID, NodeID, PlacementGroupID
+from .rpc import RpcServer, ServerConnection
+
+# Actor lifecycle states (ref: gcs.proto ActorTableData.ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str                      # raylet socket path
+    resources_total: Dict[str, float]
+    resources_available: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    # TPU slice topology (ICI coordinates of this host's chips)
+    slice_name: str = ""
+    host_index: int = 0
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    state: str
+    name: str = ""
+    address: str = ""                 # worker socket when ALIVE
+    node_id: Optional[NodeID] = None
+    class_name: str = ""
+    max_restarts: int = 0
+    num_restarts: int = 0
+    death_cause: str = ""
+    creation_spec: Any = None         # pickled TaskSpec for restarts
+
+
+class Storage:
+    """In-memory KV with optional append-only journal for GCS restart
+    (the redis_store_client.h analog, file-backed)."""
+
+    def __init__(self, journal_path: Optional[str] = None):
+        self._kv: Dict[str, Dict[str, bytes]] = {}
+        self._journal_path = journal_path
+        self._journal = None
+        if journal_path:
+            self._replay(journal_path)
+            self._journal = open(journal_path, "ab")
+
+    def _replay(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(4)
+                if len(header) < 4:
+                    break
+                length = int.from_bytes(header, "little")
+                body = f.read(length)
+                if len(body) < length:
+                    break
+                op, ns, key, val = pickle.loads(body)
+                if op == "put":
+                    self._kv.setdefault(ns, {})[key] = val
+                elif op == "del":
+                    self._kv.get(ns, {}).pop(key, None)
+
+    def _log(self, op: str, ns: str, key: str, val: Optional[bytes]) -> None:
+        if self._journal is not None:
+            body = pickle.dumps((op, ns, key, val))
+            self._journal.write(len(body).to_bytes(4, "little") + body)
+            self._journal.flush()
+
+    def put(self, ns: str, key: str, val: bytes) -> None:
+        self._kv.setdefault(ns, {})[key] = val
+        self._log("put", ns, key, val)
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        return self._kv.get(ns, {}).get(key)
+
+    def delete(self, ns: str, key: str) -> bool:
+        existed = key in self._kv.get(ns, {})
+        self._kv.get(ns, {}).pop(key, None)
+        self._log("del", ns, key, None)
+        return existed
+
+    def keys(self, ns: str, prefix: str = "") -> List[str]:
+        return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
+
+    def close(self):
+        if self._journal is not None:
+            self._journal.close()
+
+
+class GcsServer:
+    def __init__(self, socket_path: str, journal_path: Optional[str] = None):
+        self.server = RpcServer(socket_path, name="gcs")
+        self.server.register_all(self)
+        self.server.on_disconnect = self._on_disconnect
+        self.storage = Storage(journal_path)
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
+        self.jobs: Dict[JobID, dict] = {}
+        self.placement_groups: Dict[PlacementGroupID, dict] = {}
+        # pubsub: channel -> set of subscribed connections
+        self._subs: Dict[str, Set[ServerConnection]] = {}
+        self._node_conns: Dict[ServerConnection, NodeID] = {}
+        self._next_job = 1
+
+    async def start(self):
+        await self.server.start()
+
+    async def stop(self):
+        await self.server.stop()
+        self.storage.close()
+
+    # ---- pubsub ----
+    async def _publish(self, channel: str, payload: Any):
+        for conn in list(self._subs.get(channel, ())):
+            await conn.push("pubsub:" + channel, payload)
+
+    async def handle_subscribe(self, payload, conn):
+        for channel in payload["channels"]:
+            self._subs.setdefault(channel, set()).add(conn)
+        return True
+
+    async def _on_disconnect(self, conn):
+        for subs in self._subs.values():
+            subs.discard(conn)
+        node_id = self._node_conns.pop(conn, None)
+        if node_id is not None:
+            await self._mark_node_dead(node_id, "raylet disconnected")
+
+    # ---- nodes ----
+    async def handle_register_node(self, payload, conn):
+        info = NodeInfo(**payload)
+        self.nodes[info.node_id] = info
+        self._node_conns[conn] = info.node_id
+        await self._publish("node", {"event": "added", "node": info})
+        return {"nodes": list(self.nodes.values())}
+
+    async def handle_get_all_nodes(self, payload, conn):
+        return list(self.nodes.values())
+
+    async def handle_report_resources(self, payload, conn):
+        node_id = payload["node_id"]
+        if node_id in self.nodes:
+            self.nodes[node_id].resources_available = payload["available"]
+            await self._publish("resources", {
+                "node_id": node_id, "available": payload["available"],
+            })
+        return True
+
+    async def handle_drain_node(self, payload, conn):
+        await self._mark_node_dead(payload["node_id"], payload.get("reason", "drained"))
+        return True
+
+    async def _mark_node_dead(self, node_id: NodeID, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        await self._publish("node", {"event": "removed", "node_id": node_id, "reason": reason})
+        # Fail actors on the dead node (ref: gcs_actor_manager OnNodeDead)
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION):
+                await self._actor_failed(actor, f"node {node_id} died: {reason}")
+
+    # ---- jobs ----
+    async def handle_register_job(self, payload, conn):
+        job_id = JobID.from_int(self._next_job)
+        self._next_job += 1
+        self.jobs[job_id] = {"config": payload.get("config", {}), "start_time": time.time(),
+                             "driver_address": payload.get("driver_address", "")}
+        return job_id
+
+    async def handle_get_all_jobs(self, payload, conn):
+        return self.jobs
+
+    # ---- KV (function table etc.; ref: gcs_kv_manager.h) ----
+    async def handle_kv_put(self, payload, conn):
+        self.storage.put(payload["ns"], payload["key"], payload["value"])
+        return True
+
+    async def handle_kv_get(self, payload, conn):
+        return self.storage.get(payload["ns"], payload["key"])
+
+    async def handle_kv_del(self, payload, conn):
+        return self.storage.delete(payload["ns"], payload["key"])
+
+    async def handle_kv_keys(self, payload, conn):
+        return self.storage.keys(payload["ns"], payload.get("prefix", ""))
+
+    # ---- actors (ref: gcs_actor_manager.cc) ----
+    async def handle_register_actor(self, payload, conn):
+        info = ActorInfo(
+            actor_id=payload["actor_id"],
+            state=PENDING_CREATION,
+            name=payload.get("name", ""),
+            class_name=payload.get("class_name", ""),
+            max_restarts=payload.get("max_restarts", 0),
+            creation_spec=payload.get("creation_spec"),
+        )
+        ns = payload.get("namespace", "")
+        if info.name:
+            key = (ns, info.name)
+            existing = self.named_actors.get(key)
+            if existing is not None and self.actors[existing].state != DEAD:
+                raise ValueError(f"Actor name '{info.name}' already taken")
+            self.named_actors[key] = info.actor_id
+        self.actors[info.actor_id] = info
+        await self._publish("actor", {"actor": info})
+        return True
+
+    async def handle_actor_alive(self, payload, conn):
+        actor = self.actors.get(payload["actor_id"])
+        if actor is None:
+            return False
+        actor.state = ALIVE
+        actor.address = payload["address"]
+        actor.node_id = payload.get("node_id")
+        await self._publish("actor", {"actor": actor})
+        return True
+
+    async def handle_actor_failed(self, payload, conn):
+        actor = self.actors.get(payload["actor_id"])
+        if actor is not None:
+            await self._actor_failed(actor, payload.get("cause", "worker died"))
+        return True
+
+    async def _actor_failed(self, actor: ActorInfo, cause: str):
+        if actor.num_restarts < actor.max_restarts:
+            actor.num_restarts += 1
+            actor.state = RESTARTING
+            actor.address = ""
+            await self._publish("actor", {"actor": actor})
+            # restart is driven by the owning core worker, which subscribes
+            # to RESTARTING transitions and resubmits the creation task
+        else:
+            actor.state = DEAD
+            actor.death_cause = cause
+            actor.address = ""
+            await self._publish("actor", {"actor": actor})
+
+    async def handle_kill_actor(self, payload, conn):
+        actor = self.actors.get(payload["actor_id"])
+        if actor is None:
+            return False
+        actor.max_restarts = 0  # no_restart
+        if actor.state != DEAD:
+            actor.state = DEAD
+            actor.death_cause = payload.get("cause", "ray_tpu.kill")
+            await self._publish("actor", {"actor": actor})
+        return True
+
+    async def handle_get_actor(self, payload, conn):
+        if "actor_id" in payload:
+            return self.actors.get(payload["actor_id"])
+        key = (payload.get("namespace", ""), payload["name"])
+        actor_id = self.named_actors.get(key)
+        return self.actors.get(actor_id) if actor_id is not None else None
+
+    async def handle_list_actors(self, payload, conn):
+        return list(self.actors.values())
+
+    # ---- placement groups (ref: gcs_placement_group_manager.h) ----
+    async def handle_create_placement_group(self, payload, conn):
+        pg_id = payload["pg_id"]
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id, "bundles": payload["bundles"],
+            "strategy": payload["strategy"], "state": "PENDING", "name": payload.get("name", ""),
+            "bundle_nodes": [],
+        }
+        await self._publish("placement_group", self.placement_groups[pg_id])
+        return True
+
+    async def handle_placement_group_ready(self, payload, conn):
+        pg = self.placement_groups.get(payload["pg_id"])
+        if pg is not None:
+            pg["state"] = "CREATED"
+            pg["bundle_nodes"] = payload["bundle_nodes"]
+            await self._publish("placement_group", pg)
+        return True
+
+    async def handle_remove_placement_group(self, payload, conn):
+        pg = self.placement_groups.pop(payload["pg_id"], None)
+        if pg is not None:
+            pg["state"] = "REMOVED"
+            await self._publish("placement_group", pg)
+        return True
+
+    async def handle_get_placement_group(self, payload, conn):
+        return self.placement_groups.get(payload["pg_id"])
+
+    # ---- health / introspection ----
+    async def handle_ping(self, payload, conn):
+        return {"time": time.time()}
+
+    async def handle_cluster_status(self, payload, conn):
+        return {
+            "nodes": list(self.nodes.values()),
+            "num_actors": len(self.actors),
+            "num_jobs": len(self.jobs),
+        }

@@ -1,0 +1,128 @@
+"""Node: process/session bring-up for head and worker nodes.
+
+TPU-native analog of the reference launcher (ref: python/ray/_private/node.py,
+services.py — spawns gcs_server/raylet binaries). Here the GCS and raylet are
+asyncio servers hosted on a dedicated IO thread inside the head process;
+their socket-based contracts are identical whether they live in-process or as
+separate daemons, which is what lets the native (C++) substrate replace them
+under the same wire protocol in later milestones.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import time
+import uuid
+from typing import Dict, Optional
+
+from .config import global_config
+from .gcs import GcsServer
+from .ids import NodeID
+from .object_store import SharedObjectStore
+from .raylet import Raylet
+from .rpc import EventLoopThread
+
+_TEMP_ROOT = "/tmp/ray_tpu"
+
+
+def default_resources() -> Dict[str, float]:
+    res = {"CPU": float(os.cpu_count() or 1)}
+    res["memory"] = float(os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"))
+    # TPU detection: count local TPU chips without initializing the runtime
+    # for CPU-only runs (ref: _private/accelerators/tpu.py:109).
+    num_tpus = _detect_tpu_chips()
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    return res
+
+
+def _detect_tpu_chips() -> int:
+    if os.environ.get("RAY_TPU_FAKE_CHIPS"):
+        return int(os.environ["RAY_TPU_FAKE_CHIPS"])
+    try:
+        import glob
+
+        return len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/*"))
+    except Exception:
+        return 0
+
+
+class Node:
+    """A head (GCS + raylet) or worker (raylet only) node."""
+
+    def __init__(
+        self,
+        head: bool,
+        session_name: Optional[str] = None,
+        gcs_address: Optional[str] = None,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        io: Optional[EventLoopThread] = None,
+        object_store_memory: Optional[int] = None,
+    ):
+        self.head = head
+        cfg = global_config()
+        if head:
+            self.session_name = session_name or (
+                f"rtpu_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}_{uuid.uuid4().hex[:6]}"
+            )
+        else:
+            assert session_name and gcs_address, "worker nodes need a session + GCS"
+            self.session_name = session_name
+        self.session_dir = os.path.join(_TEMP_ROOT, self.session_name)
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.node_id = NodeID.from_random()
+        self.gcs_address = gcs_address or os.path.join(self.session_dir, "gcs.sock")
+        self.raylet_address = os.path.join(
+            self.session_dir, f"raylet_{self.node_id.hex()[:12]}.sock")
+        self.io = io or EventLoopThread(name="ray_tpu_node")
+        self._owns_io = io is None
+
+        self.store = SharedObjectStore(
+            self.session_name,
+            object_store_memory or cfg.object_store_memory_bytes,
+        )
+        self.gcs_server: Optional[GcsServer] = None
+        if head:
+            self.gcs_server = GcsServer(self.gcs_address)
+        self.raylet = Raylet(
+            node_id=self.node_id,
+            session_name=self.session_name,
+            socket_path=self.raylet_address,
+            gcs_address=self.gcs_address,
+            resources=resources or default_resources(),
+            store=self.store,
+            labels=labels,
+        )
+        self._started = False
+
+    def start(self):
+        async def _start():
+            if self.gcs_server is not None:
+                await self.gcs_server.start()
+            await self.raylet.start()
+
+        self.io.run(_start(), timeout=30)
+        self._started = True
+        atexit.register(self.stop)
+
+    def stop(self):
+        if not self._started:
+            return
+        self._started = False
+        try:
+            async def _stop():
+                await self.raylet.stop()
+                if self.gcs_server is not None:
+                    await self.gcs_server.stop()
+
+            self.io.run(_stop(), timeout=10)
+        except Exception:
+            pass
+        if self._owns_io:
+            self.io.stop()
+        if self.head:
+            self.store.destroy()
+            shutil.rmtree(self.session_dir, ignore_errors=True)

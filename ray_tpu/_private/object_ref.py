@@ -1,0 +1,84 @@
+"""ObjectRef: a distributed future (ref: python/ray/includes/object_ref.pxi).
+
+Reduces to (ObjectID, owner_address) on serialization; deserializing inside a
+worker registers a borrowed reference with that process's core worker (the
+borrower half of the distributed ref-counting protocol,
+ref: src/ray/core_worker/reference_count.h:66).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .ids import ObjectID
+
+# set by core_worker on init; avoids import cycle
+_ref_registry = None
+
+
+def _set_ref_registry(registry):
+    global _ref_registry
+    _ref_registry = registry
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_address", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: str = "", *, _register: bool = True):
+        self._id = object_id
+        self._owner_address = owner_address
+        if _register and _ref_registry is not None:
+            _ref_registry.add_local_ref(object_id)
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    @property
+    def owner_address(self) -> str:
+        return self._owner_address
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __del__(self):
+        if _ref_registry is not None:
+            try:
+                _ref_registry.remove_local_ref(self._id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        return (_deserialize_ref, (self._id, self._owner_address))
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        if _ref_registry is None:
+            raise RuntimeError("ray_tpu not initialized")
+        return _ref_registry.as_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+def _deserialize_ref(object_id: ObjectID, owner_address: str) -> "ObjectRef":
+    ref = ObjectRef(object_id, owner_address, _register=False)
+    if _ref_registry is not None:
+        _ref_registry.add_borrowed_ref(object_id, owner_address)
+    return ref

@@ -1,0 +1,325 @@
+"""Control-plane RPC: asyncio message streams over unix-domain sockets.
+
+TPU-native analog of the reference rpc layer (ref: src/ray/rpc/grpc_server.h:88,
+grpc_client.h:96, client_call.h:193, retryable_grpc_client.h). The control
+plane stays host-side and socket-based (gRPC-over-DCN equivalent); the device
+data plane never touches this layer — tensors move inside XLA programs.
+
+Wire format: [u32 frame_len][pickled Frame]. A Frame is
+(msg_id, kind, method, payload) with kind in {REQUEST, REPLY, ERROR, PUSH}.
+PUSH frames implement server->client pubsub (ref: src/ray/pubsub) without a
+pending long-poll.
+
+Includes deterministic fault injection (ref: src/ray/rpc/rpc_chaos.h:23
+`enum RpcFailure {Request, Response}`) driven by the
+`testing_rpc_failure` config flag: "method=max_failures:req_prob:resp_prob".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import random
+import struct
+import threading
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from .config import global_config
+
+_LEN = struct.Struct("<I")
+
+REQUEST, REPLY, ERROR, PUSH = 0, 1, 2, 3
+
+_MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class _ChaosInjector:
+    """Deterministic-ish request/response dropping for fault-tolerance tests."""
+
+    def __init__(self, spec: str):
+        self.rules: Dict[str, list] = {}
+        self._rng = random.Random(12345)
+        if spec:
+            for entry in spec.split(","):
+                method, params = entry.split("=")
+                parts = params.split(":")
+                max_failures = int(parts[0])
+                req_p = float(parts[1]) if len(parts) > 1 else 0.5
+                resp_p = float(parts[2]) if len(parts) > 2 else 0.0
+                self.rules[method] = [max_failures, req_p, resp_p]
+
+    def should_drop_request(self, method: str) -> bool:
+        rule = self.rules.get(method) or self.rules.get("*")
+        if not rule or rule[0] <= 0:
+            return False
+        if self._rng.random() < rule[1]:
+            rule[0] -= 1
+            return True
+        return False
+
+    def should_drop_response(self, method: str) -> bool:
+        rule = self.rules.get(method) or self.rules.get("*")
+        if not rule or rule[0] <= 0:
+            return False
+        if self._rng.random() < rule[2]:
+            rule[0] -= 1
+            return True
+        return False
+
+
+def _frame(msg_id: int, kind: int, method: str, payload: Any) -> bytes:
+    body = pickle.dumps((msg_id, kind, method, payload), protocol=5)
+    return _LEN.pack(len(body)) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > _MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return pickle.loads(body)
+
+
+Handler = Callable[[Any, "ServerConnection"], Awaitable[Any]]
+
+
+class ServerConnection:
+    """One accepted client connection; supports push back to the client."""
+
+    def __init__(self, server: "RpcServer", reader, writer):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.closed = asyncio.Event()
+        self._write_lock = asyncio.Lock()
+        self.peer_id: Optional[str] = None  # set by registration handlers
+
+    async def push(self, method: str, payload: Any) -> None:
+        try:
+            async with self._write_lock:
+                self.writer.write(_frame(0, PUSH, method, payload))
+                await self.writer.drain()
+        except (ConnectionError, RuntimeError):
+            self.closed.set()
+
+    async def _reply(self, msg_id: int, kind: int, method: str, payload: Any):
+        async with self._write_lock:
+            self.writer.write(_frame(msg_id, kind, method, payload))
+            await self.writer.drain()
+
+
+class RpcServer:
+    """Unix-socket RPC server dispatching to registered async handlers."""
+
+    def __init__(self, socket_path: str, name: str = "server"):
+        self.socket_path = socket_path
+        self.name = name
+        self.handlers: Dict[str, Handler] = {}
+        self.connections: set[ServerConnection] = set()
+        self.on_disconnect: Optional[Callable[[ServerConnection], Awaitable[None]]] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._chaos = _ChaosInjector(global_config().testing_rpc_failure)
+
+    def register(self, method: str, handler: Handler) -> None:
+        self.handlers[method] = handler
+
+    def register_all(self, obj: Any, prefix: str = "handle_") -> None:
+        for attr in dir(obj):
+            if attr.startswith(prefix):
+                self.register(attr[len(prefix):], getattr(obj, attr))
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_unix_server(self._on_client, path=self.socket_path)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for conn in list(self.connections):
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+
+    async def _on_client(self, reader, writer):
+        conn = ServerConnection(self, reader, writer)
+        self.connections.add(conn)
+        try:
+            while True:
+                msg_id, kind, method, payload = await _read_frame(reader)
+                if kind != REQUEST:
+                    continue
+                if self._chaos.should_drop_request(method):
+                    continue  # simulate lost request
+                asyncio.ensure_future(self._dispatch(conn, msg_id, method, payload))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self.connections.discard(conn)
+            conn.closed.set()
+            if self.on_disconnect is not None:
+                await self.on_disconnect(conn)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, conn, msg_id, method, payload):
+        handler = self.handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"{self.name}: no handler for '{method}'")
+            result = await handler(payload, conn)
+            if self._chaos.should_drop_response(method):
+                return  # simulate lost reply
+            await conn._reply(msg_id, REPLY, method, result)
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — errors cross the wire
+            try:
+                await conn._reply(msg_id, ERROR, method, e)
+            except Exception:
+                pass
+
+
+class RpcClient:
+    """Client with automatic request/future matching and push subscriptions."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._reader = None
+        self._writer = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._msg_ids = itertools.count(1)
+        self._push_handlers: Dict[str, Callable[[Any], Any]] = {}
+        self._write_lock = asyncio.Lock()
+        self._recv_task: Optional[asyncio.Task] = None
+        self.closed = False
+
+    def on_push(self, method: str, handler: Callable[[Any], Any]) -> None:
+        self._push_handlers[method] = handler
+
+    async def connect(self, timeout: float = 30.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            try:
+                self._reader, self._writer = await asyncio.open_unix_connection(self.socket_path)
+                break
+            except (ConnectionError, FileNotFoundError, OSError):
+                if asyncio.get_event_loop().time() > deadline:
+                    raise ConnectionLost(f"cannot connect to {self.socket_path}")
+                await asyncio.sleep(0.05)
+        self._recv_task = asyncio.ensure_future(self._recv_loop())
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                msg_id, kind, method, payload = await _read_frame(self._reader)
+                if kind == PUSH:
+                    handler = self._push_handlers.get(method)
+                    if handler is not None:
+                        res = handler(payload)
+                        if asyncio.iscoroutine(res):
+                            asyncio.ensure_future(res)
+                    continue
+                fut = self._pending.pop(msg_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == ERROR:
+                    fut.set_exception(payload if isinstance(payload, BaseException)
+                                      else RpcError(str(payload)))
+                else:
+                    fut.set_result(payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self.closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost(self.socket_path))
+            self._pending.clear()
+
+    async def call(self, method: str, payload: Any = None, timeout: Optional[float] = None):
+        if self.closed:
+            raise ConnectionLost(self.socket_path)
+        msg_id = next(self._msg_ids)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        async with self._write_lock:
+            self._writer.write(_frame(msg_id, REQUEST, method, payload))
+            await self._writer.drain()
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def call_retrying(self, method: str, payload: Any = None, *,
+                            attempts: int = 5, base_delay: float = 0.05,
+                            per_try_timeout: float = 10.0):
+        """Retryable call (ref: retryable_grpc_client.h) — safe only for
+        idempotent methods."""
+        last: Exception | None = None
+        for i in range(attempts):
+            try:
+                return await self.call(method, payload, timeout=per_try_timeout)
+            except (asyncio.TimeoutError, ConnectionLost) as e:
+                last = e
+                if self.closed:
+                    try:
+                        await self.connect(timeout=per_try_timeout)
+                    except ConnectionLost:
+                        pass
+                await asyncio.sleep(base_delay * (2 ** i))
+        raise last  # type: ignore[misc]
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+class EventLoopThread:
+    """Dedicated asyncio loop on a daemon thread — the instrumented-io-context
+    analog (ref: src/ray/common/asio/). Sync code submits coroutines and
+    blocks on concurrent futures."""
+
+    def __init__(self, name: str = "ray_tpu_io"):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        def _cancel_all():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+        self.loop.call_soon_threadsafe(_cancel_all)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)

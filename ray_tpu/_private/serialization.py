@@ -1,0 +1,147 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+TPU-native analog of the reference serializer (ref:
+python/ray/_private/serialization.py — msgpack header + pickle5 with
+out-of-band buffers, vendored cloudpickle). Design goals here:
+
+ * large numpy / jax host buffers travel out-of-band so the object store can
+   hold them in shared memory and readers can map them zero-copy;
+ * jax.Array device buffers are converted to host numpy on serialize (device
+   data never lives in the host object store — the device plane keeps tensors
+   in HBM; see ray_tpu/parallel/);
+ * wire format: [u32 meta_len][meta json][u64 pickled_len][pickled]
+   [u32 nbuffers][u64 len, bytes]* — a flat layout that can be written into a
+   single shm segment and lazily sliced on read.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import struct
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+_HEADER = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+# Error metadata tags (analog of ray error types carried in object metadata).
+META_PLAIN = "plain"
+META_ERROR = "error"
+META_ACTOR_HANDLE = "actor_handle"
+
+_custom_serializers: Dict[type, Tuple[Callable, Callable]] = {}
+
+
+def register_serializer(cls: type, *, serializer: Callable, deserializer: Callable) -> None:
+    """Public custom-serializer hook (ref: ray.util.serialization)."""
+    _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: type) -> None:
+    _custom_serializers.pop(cls, None)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, buffers: List[pickle.PickleBuffer]):
+        super().__init__(file, protocol=5, buffer_callback=buffers.append)
+
+    def reducer_override(self, obj):
+        for cls, (ser, de) in _custom_serializers.items():
+            if isinstance(obj, cls):
+                return (_reconstruct_custom, (cls.__module__, cls.__qualname__, ser(obj)))
+        return super().reducer_override(obj)
+
+
+def _reconstruct_custom(mod: str, qualname: str, payload):
+    import importlib
+
+    cls = importlib.import_module(mod)
+    for part in qualname.split("."):
+        cls = getattr(cls, part)
+    _, de = _custom_serializers[cls]
+    return de(payload)
+
+
+def _device_to_host(obj: Any) -> Any:
+    """Convert jax.Array leaves to numpy before pickling (pytree-aware)."""
+    try:
+        import jax
+        import numpy as np
+    except ImportError:  # pragma: no cover
+        return obj
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    return obj
+
+
+def serialize(value: Any, metadata: str = META_PLAIN) -> bytes:
+    """Serialize `value` to the flat wire format."""
+    value = _device_to_host(value)
+    buffers: List[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    _Pickler(f, buffers).dump(value)
+    pickled = f.getvalue()
+    meta = json.dumps({"m": metadata}).encode()
+
+    raw_bufs = [b.raw() for b in buffers]
+    total = (
+        _HEADER.size + len(meta) + _U64.size + len(pickled) + _HEADER.size
+        + sum(_U64.size + len(rb) for rb in raw_bufs)
+    )
+    out = bytearray(total)
+    off = 0
+    _HEADER.pack_into(out, off, len(meta)); off += _HEADER.size
+    out[off : off + len(meta)] = meta; off += len(meta)
+    _U64.pack_into(out, off, len(pickled)); off += _U64.size
+    out[off : off + len(pickled)] = pickled; off += len(pickled)
+    _HEADER.pack_into(out, off, len(raw_bufs)); off += _HEADER.size
+    for rb in raw_bufs:
+        _U64.pack_into(out, off, rb.nbytes); off += _U64.size
+        out[off : off + rb.nbytes] = rb; off += rb.nbytes
+    for b in buffers:
+        b.release()
+    return bytes(out)
+
+
+def serialize_into(value: Any, metadata: str = META_PLAIN) -> Tuple[bytes, int]:
+    data = serialize(value, metadata)
+    return data, len(data)
+
+
+def get_metadata(data) -> str:
+    (meta_len,) = _HEADER.unpack_from(data, 0)
+    meta = bytes(data[_HEADER.size : _HEADER.size + meta_len])
+    return json.loads(meta)["m"]
+
+
+def deserialize(data) -> Tuple[Any, str]:
+    """Deserialize from bytes/memoryview. Out-of-band buffers are zero-copy
+    views into `data` when it is a memoryview over shm."""
+    view = memoryview(data)
+    off = 0
+    (meta_len,) = _HEADER.unpack_from(view, off); off += _HEADER.size
+    metadata = json.loads(bytes(view[off : off + meta_len]))["m"]; off += meta_len
+    (pickled_len,) = _U64.unpack_from(view, off); off += _U64.size
+    pickled = view[off : off + pickled_len]; off += pickled_len
+    (nbufs,) = _HEADER.unpack_from(view, off); off += _HEADER.size
+    buffers = []
+    for _ in range(nbufs):
+        (blen,) = _U64.unpack_from(view, off); off += _U64.size
+        buffers.append(view[off : off + blen]); off += blen
+    value = pickle.loads(pickled, buffers=buffers)
+    return value, metadata
+
+
+def serialize_error(err: BaseException) -> bytes:
+    """Serialize an exception, falling back to a descriptive wrapper when the
+    exception itself is unpicklable."""
+    import traceback
+
+    tb = "".join(traceback.format_exception(type(err), err, err.__traceback__))
+    try:
+        return serialize((err, tb), metadata=META_ERROR)
+    except Exception:
+        return serialize((RuntimeError(f"{type(err).__name__}: {err}"), tb), metadata=META_ERROR)

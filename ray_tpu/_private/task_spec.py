@@ -1,0 +1,173 @@
+"""Task specifications, resource sets, and scheduling classes.
+
+TPU-native analog of the reference task model (ref: src/ray/common/task/
+task_spec.h, src/ray/common/scheduling/ — ResourceSet, SchedulingClass).
+Resources are float-valued named quantities; "TPU" is first-class next to
+"CPU", and slice topology resources (e.g. "TPU-v5p-16-head") gang-schedule
+whole ICI slices (ref: python/ray/_private/accelerators/tpu.py:401-403, here
+promoted into the scheduler proper — see ray_tpu/parallel/topology.py).
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+
+class ArgKind(enum.IntEnum):
+    VALUE = 0       # inline serialized bytes
+    OBJECT_REF = 1  # ObjectID to resolve before execution
+
+
+@dataclass
+class TaskArg:
+    kind: ArgKind
+    value: Any = None          # serialized bytes for VALUE
+    object_id: Optional[ObjectID] = None
+
+
+class ResourceSet:
+    """Float-valued named resources with TPU-aware comparison ops."""
+
+    __slots__ = ("res",)
+
+    def __init__(self, res: Optional[Dict[str, float]] = None):
+        self.res = {k: float(v) for k, v in (res or {}).items() if v != 0}
+
+    def fits(self, available: "ResourceSet") -> bool:
+        return all(available.res.get(k, 0.0) + 1e-9 >= v for k, v in self.res.items())
+
+    def subtract(self, other: "ResourceSet") -> None:
+        for k, v in other.res.items():
+            self.res[k] = self.res.get(k, 0.0) - v
+
+    def add(self, other: "ResourceSet") -> None:
+        for k, v in other.res.items():
+            self.res[k] = self.res.get(k, 0.0) + v
+
+    def copy(self) -> "ResourceSet":
+        return ResourceSet(dict(self.res))
+
+    def get(self, key: str, default: float = 0.0) -> float:
+        return self.res.get(key, default)
+
+    def is_empty(self) -> bool:
+        return not self.res
+
+    def to_dict(self) -> Dict[str, float]:
+        return dict(self.res)
+
+    def key(self) -> Tuple[Tuple[str, float], ...]:
+        return tuple(sorted(self.res.items()))
+
+    def __repr__(self):
+        return f"ResourceSet({self.res})"
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self.res == other.res
+
+
+# --- scheduling strategies (ref: python/ray/util/scheduling_strategies.py) ---
+
+@dataclass
+class DefaultSchedulingStrategy:
+    """Hybrid policy: pack locally until threshold, then spread (ref:
+    raylet/scheduling/policy/hybrid_scheduling_policy.h:50)."""
+
+
+@dataclass
+class SpreadSchedulingStrategy:
+    pass
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str = ""
+    soft: bool = False
+    spill_on_unavailable: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class SliceSchedulingStrategy:
+    """TPU-native: schedule onto a specific ICI slice / mesh sub-cube."""
+
+    slice_name: str = ""
+    host_index: int = -1
+
+
+SchedulingStrategy = Any  # union of the above
+
+
+_scheduling_class_cache: Dict[Tuple, int] = {}
+_scheduling_class_lock = threading.Lock()
+_next_scheduling_class = [0]
+
+
+def scheduling_class_of(resources: ResourceSet, strategy_key: str) -> int:
+    """Intern (resources, strategy) into a dense int id (ref:
+    SchedulingClass in task_spec.h; SchedulingKey normal_task_submitter.h:58)."""
+    key = (resources.key(), strategy_key)
+    with _scheduling_class_lock:
+        sc = _scheduling_class_cache.get(key)
+        if sc is None:
+            sc = _next_scheduling_class[0]
+            _next_scheduling_class[0] += 1
+            _scheduling_class_cache[key] = sc
+        return sc
+
+
+@dataclass
+class FunctionDescriptor:
+    """Identifies executable code: a blob in the GCS function table."""
+
+    blob_id: str            # sha1 of pickled function/class
+    repr_name: str          # human-readable, for errors/observability
+    method_name: str = ""   # for actor method calls
+
+    @staticmethod
+    def blob_id_for(pickled: bytes) -> str:
+        return hashlib.sha1(pickled).hexdigest()
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    function: FunctionDescriptor
+    args: List[TaskArg] = field(default_factory=list)
+    num_returns: int = 1
+    resources: ResourceSet = field(default_factory=ResourceSet)
+    scheduling_strategy: SchedulingStrategy = field(default_factory=DefaultSchedulingStrategy)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # actor-related
+    actor_id: Optional[ActorID] = None          # set for actor tasks
+    actor_creation: bool = False                # creation task
+    actor_max_restarts: int = 0
+    actor_max_concurrency: int = 1
+    actor_name: str = ""                        # named actors
+    seq_no: int = 0                             # per-caller actor task ordering
+    owner_address: str = ""                     # socket of the owning core worker
+    runtime_env: Optional[dict] = None
+
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None and not self.actor_creation
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i + 1) for i in range(self.num_returns)]
+
+    def scheduling_class(self) -> int:
+        strat = self.scheduling_strategy
+        return scheduling_class_of(self.resources, type(strat).__name__ + repr(strat))

@@ -1,0 +1,283 @@
+"""Worker process: registers with its raylet, executes pushed tasks.
+
+TPU-native analog of the reference worker runtime (ref: src/ray/core_worker/
+core_worker_process.cc:98 RunTaskExecutionLoop, transport/task_receiver.h,
+actor_scheduling_queue.h; python/ray/_private/workers/default_worker.py).
+
+Execution model: the process's RpcServer accepts `push_task` directly from
+submitting core workers (no raylet hop on the hot path). Normal tasks run on a
+small thread pool; an actor promotes the worker to a dedicated actor runtime —
+a single ordered execution thread fed FIFO (per-caller order is preserved by
+the connection stream), with `max_concurrency > 1` widening the pool.
+
+Every return value is sealed into the shared object store (so any process can
+resolve it via the raylet directory) and small values are additionally inlined
+in the reply as the owner's fast path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import queue
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, List, Optional, Tuple
+
+import cloudpickle
+
+from .config import global_config
+from .core_worker import CoreWorker
+from .ids import JobID, NodeID, ObjectID, WorkerID
+from .object_store import SharedObjectStore
+from .rpc import RpcClient, RpcServer
+from . import serialization as ser
+from .task_spec import ArgKind, TaskSpec
+from .. import exceptions as exc
+
+
+class TaskExecutor:
+    def __init__(self, core: CoreWorker, raylet: RpcClient):
+        self.core = core
+        self.raylet = raylet
+        self.pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="task_exec")
+        # actor runtime
+        self.actor_instance: Any = None
+        self.actor_id = None
+        self._actor_queue: "queue.Queue" = queue.Queue()
+        self._actor_threads: List[threading.Thread] = []
+
+    # ---------------------------------------------------------- arg loading
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        args, kwargs = [], {}
+        # gather plasma deps first so we wait once
+        dep_ids = [a.object_id for a in spec.args if a.kind == ArgKind.OBJECT_REF]
+        if dep_ids:
+            missing = [oid for oid in dep_ids if not self.core.store.contains(oid)]
+            if missing:
+                self.core.io.run(self.core.raylet.call("wait_objects", {
+                    "object_ids": missing, "num_returns": len(missing), "timeout": None,
+                }))
+        for arg in spec.args:
+            if arg.kind == ArgKind.VALUE:
+                kw, data = arg.value
+                value, _ = ser.deserialize(data)
+            else:
+                kw = arg.value
+                value = self.core._load_object(arg.object_id)
+            if kw is None:
+                args.append(value)
+            else:
+                kwargs[kw] = value
+        return args, kwargs
+
+    # -------------------------------------------------------- result sealing
+    def _seal_results(self, spec: TaskSpec, values: Any) -> list:
+        small_limit = global_config().object_store_small_object_threshold
+        if spec.num_returns == 0:
+            return []
+        if spec.num_returns == 1:
+            values = (values,)
+        elif not isinstance(values, tuple):
+            values = tuple(values)
+        results = []
+        for i, value in enumerate(values[: spec.num_returns]):
+            oid = ObjectID.for_return(spec.task_id, i + 1)
+            data = ser.serialize(value)
+            self.core.store.put(oid, data)
+            self.core.io.run(self.raylet.call("object_sealed",
+                                              {"object_id": oid, "size": len(data)}))
+            results.append((oid, data if len(data) <= small_limit else None))
+        return results
+
+    def _seal_error(self, spec: TaskSpec, error: BaseException) -> bytes:
+        data = ser.serialize_error(error)
+        for oid in spec.return_ids():
+            self.core.store.put(oid, data)
+            self.core.io.run(self.raylet.call("object_sealed",
+                                              {"object_id": oid, "size": len(data)}))
+        return data
+
+    # ------------------------------------------------------------ execution
+    def execute_normal(self, spec: TaskSpec) -> dict:
+        try:
+            func = self.core.load_function(spec.function.blob_id)
+            args, kwargs = self._resolve_args(spec)
+            self.core.set_task_context(spec.task_id)
+            try:
+                values = func(*args, **kwargs)
+            finally:
+                self.core.clear_task_context()
+            return {"results": self._seal_results(spec, values), "error": None}
+        except BaseException as e:  # noqa: BLE001
+            return {"results": [], "error": self._seal_error(spec, e)}
+
+    def execute_actor_creation(self, spec: TaskSpec) -> dict:
+        try:
+            cls = self.core.load_function(spec.function.blob_id)
+            if hasattr(cls, "__ray_tpu_actor_class__"):
+                cls = cls.__ray_tpu_actor_class__
+            args, kwargs = self._resolve_args(spec)
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_id = spec.actor_id
+            n_threads = max(1, spec.actor_max_concurrency)
+            for i in range(n_threads):
+                t = threading.Thread(target=self._actor_loop, daemon=True,
+                                     name=f"actor_exec_{i}")
+                t.start()
+                self._actor_threads.append(t)
+            return {"results": [], "error": None}
+        except BaseException as e:  # noqa: BLE001
+            return {"results": [], "error": self._seal_error(spec, e)}
+
+    def _actor_loop(self):
+        while True:
+            item = self._actor_queue.get()
+            if item is None:
+                return
+            spec, reply_cb = item
+            reply = self._execute_actor_task(spec)
+            reply_cb(reply)
+
+    def _execute_actor_task(self, spec: TaskSpec) -> dict:
+        try:
+            method = getattr(self.actor_instance, spec.function.method_name)
+            args, kwargs = self._resolve_args(spec)
+            self.core.set_task_context(spec.task_id)
+            try:
+                values = method(*args, **kwargs)
+            finally:
+                self.core.clear_task_context()
+            if asyncio.iscoroutine(values):
+                values = asyncio.get_event_loop_policy().new_event_loop().run_until_complete(values)
+            return {"results": self._seal_results(spec, values), "error": None}
+        except BaseException as e:  # noqa: BLE001
+            return {"results": [], "error": self._seal_error(spec, e)}
+
+
+async def _amain():
+    session = os.environ["RAY_TPU_SESSION"]
+    raylet_socket = os.environ["RAY_TPU_RAYLET_SOCKET"]
+    gcs_socket = os.environ["RAY_TPU_GCS_SOCKET"]
+    node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+    worker_id = WorkerID.from_random()
+    cfg = global_config()
+
+    session_dir = os.path.dirname(raylet_socket)
+    my_socket = os.path.join(session_dir, f"worker_{worker_id.hex()[:16]}.sock")
+
+    store = SharedObjectStore(session, cfg.object_store_memory_bytes, create_dir=False)
+    # the core worker shares this process's running loop
+    from .rpc import EventLoopThread
+
+    loop = asyncio.get_event_loop()
+
+    class _LoopShim:
+        """EventLoopThread interface over the already-running worker loop."""
+
+        def __init__(self, loop):
+            self.loop = loop
+
+        def run(self, coro, timeout=None):
+            import concurrent.futures as cf
+
+            if threading.current_thread() is threading.main_thread():
+                # called from the loop thread itself — must never happen for
+                # blocking calls; execute as a task and let caller await
+                raise RuntimeError("blocking io.run on loop thread")
+            fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+            return fut.result(timeout)
+
+        def spawn(self, coro):
+            return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+        def stop(self):
+            pass
+
+    core = CoreWorker(
+        mode="worker",
+        session_name=session,
+        gcs_address=gcs_socket,
+        raylet_address=raylet_socket,
+        job_id=JobID.from_int(0),
+        node_id=node_id,
+        store=store,
+        io=_LoopShim(loop),
+        worker_id=worker_id,
+    )
+    core.address = my_socket
+    await core._connect()
+    # user code inside tasks reaches the runtime through the module-level API
+    from .. import _worker_api
+
+    _worker_api._core = core
+
+    raylet = RpcClient(raylet_socket)
+    await raylet.connect()
+
+    executor = TaskExecutor(core, raylet)
+    server = RpcServer(my_socket, name=f"worker-{worker_id.hex()[:8]}")
+    shutdown_event = asyncio.Event()
+
+    async def handle_push_task(payload, conn):
+        spec: TaskSpec = cloudpickle.loads(payload)
+        if spec.actor_creation:
+            core.job_id = spec.job_id
+            core.current_task_id = spec.task_id
+            reply = await loop.run_in_executor(executor.pool,
+                                               executor.execute_actor_creation, spec)
+            if reply["error"] is None:
+                await core.gcs.call("actor_alive", {
+                    "actor_id": spec.actor_id,
+                    "address": my_socket,
+                    "node_id": node_id,
+                })
+            return reply
+        if spec.is_actor_task():
+            fut = loop.create_future()
+
+            def reply_cb(result, fut=fut):
+                loop.call_soon_threadsafe(
+                    lambda: fut.set_result(result) if not fut.done() else None)
+
+            executor._actor_queue.put((spec, reply_cb))
+            return await fut
+        core.job_id = spec.job_id
+        return await loop.run_in_executor(executor.pool, executor.execute_normal, spec)
+
+    async def handle_kill_self(payload, conn):
+        loop.call_later(0.05, lambda: os._exit(0))
+        return True
+
+    async def handle_health(payload, conn):
+        return {"pid": os.getpid(), "actor": executor.actor_id}
+
+    server.register("push_task", handle_push_task)
+    server.register("kill_self", handle_kill_self)
+    server.register("health", handle_health)
+    await server.start()
+
+    # register with raylet last — once registered, tasks may arrive
+    raylet.on_push("shutdown", lambda payload: shutdown_event.set())
+    await raylet.call("register_worker", {
+        "worker_id": worker_id,
+        "pid": os.getpid(),
+        "address": my_socket,
+    })
+
+    await shutdown_event.wait()
+    await server.stop()
+    os._exit(0)
+
+
+def main():
+    try:
+        asyncio.run(_amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

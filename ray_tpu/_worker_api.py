@@ -1,0 +1,175 @@
+"""Global driver/worker singleton: init/shutdown + the module-level API
+(ref: python/ray/_private/worker.py — init:1285, get:2660, put:2814, wait:2879)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ._private.config import global_config, reset_global_config
+from ._private.core_worker import CoreWorker
+from ._private.ids import JobID
+from ._private.node import Node
+from ._private.object_ref import ObjectRef
+from .actor import ActorHandle
+from . import exceptions as exc
+
+_lock = threading.RLock()
+_node: Optional[Node] = None
+_core: Optional[CoreWorker] = None
+
+
+def is_initialized() -> bool:
+    return _core is not None
+
+
+def core() -> CoreWorker:
+    if _core is None:
+        # auto-init like the reference does on first API use
+        init()
+    return _core
+
+
+def node() -> Optional[Node]:
+    return _node
+
+
+def init(
+    *,
+    resources: Optional[Dict[str, float]] = None,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    object_store_memory: Optional[int] = None,
+    labels: Optional[Dict[str, str]] = None,
+    _system_config: Optional[Dict[str, Any]] = None,
+    ignore_reinit_error: bool = False,
+) -> Dict[str, Any]:
+    global _node, _core
+    with _lock:
+        if _core is not None:
+            if ignore_reinit_error:
+                return {"session_name": _node.session_name if _node else ""}
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        if _system_config:
+            global_config().apply_overrides(_system_config)
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = num_cpus
+        if num_tpus is not None:
+            res["TPU"] = num_tpus
+        from ._private.node import default_resources
+
+        full = default_resources()
+        full.update(res)
+        _node = Node(head=True, resources=full, labels=labels,
+                     object_store_memory=object_store_memory)
+        _node.start()
+        _core = CoreWorker(
+            mode="driver",
+            session_name=_node.session_name,
+            gcs_address=_node.gcs_address,
+            raylet_address=_node.raylet_address,
+            job_id=JobID.from_int(1),
+            node_id=_node.node_id,
+            store=_node.store,
+        )
+        _core.connect()
+        job_id = _core.io.run(_core.gcs.call("register_job", {"config": {}}))
+        _core.job_id = job_id
+        from ._private.ids import TaskID
+
+        _core.current_task_id = TaskID.for_driver(job_id)
+        return {
+            "session_name": _node.session_name,
+            "node_id": _node.node_id.hex(),
+            "gcs_address": _node.gcs_address,
+        }
+
+
+def shutdown() -> None:
+    global _node, _core
+    with _lock:
+        if _core is not None:
+            _core.shutdown()
+            _core = None
+        if _node is not None:
+            _node.stop()
+            _node = None
+        reset_global_config()
+
+
+def put(value: Any) -> ObjectRef:
+    return core().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"ray_tpu.get takes ObjectRefs, got {type(r)}")
+    values = core().get(ref_list, timeout)
+    return values[0] if single else values
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    if isinstance(refs, ObjectRef):
+        raise TypeError("ray_tpu.wait takes a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns > number of refs")
+    return core().wait(list(refs), num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    core().kill_actor(actor._actor_id, no_restart)
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    actor_id = core().get_named_actor(name, namespace)
+    return ActorHandle(actor_id, name)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    # cancellation lands with the task-manager milestone; record the intent
+    raise NotImplementedError("cancel is not yet wired to the task manager")
+
+
+def cluster_resources() -> Dict[str, float]:
+    c = core()
+    nodes = c.io.run(c.gcs.call("get_all_nodes", {}))
+    total: Dict[str, float] = {}
+    for n in nodes:
+        if n.alive:
+            for k, v in n.resources_total.items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    c = core()
+    nodes = c.io.run(c.gcs.call("get_all_nodes", {}))
+    total: Dict[str, float] = {}
+    for n in nodes:
+        if n.alive:
+            for k, v in n.resources_available.items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def nodes() -> List[dict]:
+    c = core()
+    infos = c.io.run(c.gcs.call("get_all_nodes", {}))
+    return [
+        {
+            "NodeID": n.node_id.hex(),
+            "Alive": n.alive,
+            "Resources": n.resources_total,
+            "Available": n.resources_available,
+            "Labels": n.labels,
+            "Address": n.address,
+        }
+        for n in infos
+    ]

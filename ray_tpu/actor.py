@@ -1,0 +1,85 @@
+"""ActorClass and ActorHandle (ref: python/ray/actor.py — remote:215, _remote:900)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ._private.ids import ActorID
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 options: Optional[Dict[str, Any]] = None):
+        self._handle = handle
+        self._method_name = method_name
+        self._options = dict(options or {})
+
+    def remote(self, *args, **kwargs):
+        from . import _worker_api
+
+        refs = _worker_api.core().submit_actor_task(
+            self._handle._actor_id, self._method_name, args, kwargs, self._options)
+        if self._options.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+    def options(self, **new_options) -> "ActorMethod":
+        merged = dict(self._options)
+        merged.update(new_options)
+        return ActorMethod(self._handle, self._method_name, merged)
+
+    def bind(self, *args, **kwargs):
+        from .dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs, self._options)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = ""):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:16]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        self.__name__ = getattr(cls, "__name__", "ActorClass")
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self.__name__}' cannot be instantiated directly; "
+            f"use {self.__name__}.remote(...)"
+        )
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from . import _worker_api
+
+        actor_id = _worker_api.core().submit_actor_creation(
+            self._cls, args, kwargs, self._options)
+        return ActorHandle(actor_id, self.__name__)
+
+    def options(self, **new_options) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(new_options)
+        return ActorClass(self._cls, merged)
+
+    def bind(self, *args, **kwargs):
+        from .dag import ClassNode
+
+        return ClassNode(self, args, kwargs, self._options)

@@ -1,0 +1,64 @@
+"""Public exception types (ref: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution; re-raised at `get`."""
+
+    def __init__(self, cause: BaseException, traceback_str: str = ""):
+        self.cause = cause
+        self.traceback_str = traceback_str
+        super().__init__(str(cause))
+
+    def __str__(self):
+        return f"{type(self.cause).__name__}: {self.cause}\n{self.traceback_str}"
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead; its pending and future calls fail with this."""
+
+    def __init__(self, actor_id=None, cause: str = ""):
+        self.actor_id = actor_id
+        self.cause = cause
+        super().__init__(f"Actor {actor_id} died: {cause}")
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object was evicted/lost and could not be reconstructed from lineage."""
+
+    def __init__(self, object_id=None):
+        self.object_id = object_id
+        super().__init__(f"Object {object_id} lost")
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass

@@ -1,0 +1,38 @@
+"""RemoteFunction: the @remote task wrapper (ref: python/ray/remote_function.py:303)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class RemoteFunction:
+    def __init__(self, func, options: Optional[Dict[str, Any]] = None):
+        self._function = func
+        self._options = dict(options or {})
+        self.__name__ = getattr(func, "__name__", "remote_function")
+        self.__doc__ = getattr(func, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; "
+            f"use {self.__name__}.remote(...)"
+        )
+
+    def remote(self, *args, **kwargs):
+        from . import _worker_api
+
+        refs = _worker_api.core().submit_task(self._function, args, kwargs, self._options)
+        if self._options.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+    def options(self, **new_options) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(new_options)
+        return RemoteFunction(self._function, merged)
+
+    def bind(self, *args, **kwargs):
+        """Build a DAG node for compiled execution (ray_tpu.dag)."""
+        from .dag import FunctionNode
+
+        return FunctionNode(self._function, args, kwargs, self._options)

@@ -1,0 +1,44 @@
+"""Test fixtures (ref: python/ray/tests/conftest.py fixture ladder).
+
+Device-plane tests run on a virtual 8-device CPU mesh so mesh/collective logic
+is exercised without TPU hardware (SURVEY §4.4).
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS",
+                      (os.environ.get("XLA_FLAGS", "") +
+                       " --xla_force_host_platform_device_count=8").strip())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Fresh single-node cluster per test (ref: conftest.py:580)."""
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, ignore_reinit_error=False)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    """Module-scoped cluster (ref: ray_start_regular_shared conftest.py:597)."""
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest must force 8 host devices"
+    yield devices[:8]

@@ -1,0 +1,97 @@
+"""Fault-tolerance tests: task retries, actor death/restart
+(ref: python/ray/tests/test_actor_failures.py, test_chaos.py)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_task_retry_on_worker_death(ray_start_regular):
+    @ray_tpu.remote(max_retries=3)
+    def flaky(path):
+        # die the first two times, succeed after
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("1")
+            os._exit(1)
+        with open(path) as f:
+            n = int(f.read())
+        if n < 2:
+            with open(path, "w") as f:
+                f.write(str(n + 1))
+            os._exit(1)
+        return "survived"
+
+    marker = f"/tmp/rtpu_flaky_{os.getpid()}_{time.time()}"
+    try:
+        assert ray_tpu.get(flaky.remote(marker), timeout=60) == "survived"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_task_no_retry_exhausted(ray_start_regular):
+    @ray_tpu.remote(max_retries=1)
+    def always_dies():
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.exceptions.WorkerCrashedError):
+        ray_tpu.get(always_dies.remote(), timeout=60)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=2)
+    class Fragile:
+        def __init__(self):
+            self.count = 0
+
+        def inc(self):
+            self.count += 1
+            return self.count
+
+        def die(self):
+            os._exit(1)
+
+        def pid(self):
+            return os.getpid()
+
+    a = Fragile.remote()
+    assert ray_tpu.get(a.inc.remote(), timeout=60) == 1
+    pid1 = ray_tpu.get(a.pid.remote())
+    try:
+        ray_tpu.get(a.die.remote(), timeout=10)
+    except ray_tpu.exceptions.RayTpuError:
+        pass
+    # restarted actor: fresh state, new pid
+    deadline = time.time() + 60
+    val = None
+    while time.time() < deadline:
+        try:
+            val = ray_tpu.get(a.inc.remote(), timeout=30)
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            time.sleep(0.5)
+    assert val == 1, f"expected fresh state after restart, got {val}"
+    assert ray_tpu.get(a.pid.remote()) != pid1
+
+
+def test_actor_no_restart_dead(ray_start_regular):
+    @ray_tpu.remote
+    class OneShot:
+        def die(self):
+            os._exit(1)
+
+        def f(self):
+            return 1
+
+    a = OneShot.remote()
+    assert ray_tpu.get(a.f.remote(), timeout=60) == 1
+    try:
+        ray_tpu.get(a.die.remote(), timeout=10)
+    except ray_tpu.exceptions.RayTpuError:
+        pass
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        ray_tpu.get(a.f.remote(), timeout=30)
