@@ -70,16 +70,23 @@ class SharedObjectStore:
         seal() (or abort()) exactly once."""
         with self._lock:
             self._maybe_evict(size)
+            # Reserve capacity before dropping the lock so concurrent
+            # creates can't collectively overshoot it.
+            self._used += size
         tmp = self._path(oid) + ".tmp"
-        fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
         try:
-            os.ftruncate(fd, max(size, 1))
-            mm = mmap.mmap(fd, max(size, 1))
-        finally:
-            os.close(fd)
+            fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+            try:
+                os.ftruncate(fd, max(size, 1))
+                mm = mmap.mmap(fd, max(size, 1))
+            finally:
+                os.close(fd)
+        except BaseException:
+            with self._lock:
+                self._used -= size
+            raise
         with self._lock:
             self._entries[oid] = _Entry(path=self._path(oid), size=size, mm=mm, sealed=False)
-            self._used += size
         return memoryview(mm)[:size]
 
     def put(self, oid: ObjectID, data: bytes) -> None:
@@ -129,10 +136,18 @@ class SharedObjectStore:
         finally:
             os.close(fd)
         with self._lock:
-            entry = _Entry(path=path, size=size, mm=mm)
-            self._entries[oid] = entry
-            self._used += size
-            return memoryview(mm)[:size]
+            # A concurrent get() may have mapped it while we were outside
+            # the lock; keep the winner, drop our duplicate mapping.
+            entry = self._entries.get(oid)
+            if entry is not None and entry.mm is not None:
+                mm.close()
+            else:
+                entry = _Entry(path=path, size=size, mm=mm)
+                self._entries[oid] = entry
+                self._used += size
+            entry.last_access = time.monotonic()
+            self._entries.move_to_end(oid)
+            return memoryview(entry.mm)[: entry.size]
 
     def contains(self, oid: ObjectID) -> bool:
         with self._lock:

@@ -1,0 +1,18 @@
+"""ray_tpu.ops: TPU compute kernels (Pallas + XLA).
+
+Net-new relative to the reference, which has no device kernels of its own
+(it delegates tensors to torch/NCCL — SURVEY §5.7 notes ring/sequence
+parallel attention is entirely absent there). These ops are the compute
+substrate for ray_tpu.models and ray_tpu.serve.
+"""
+
+from .norms import rms_norm
+from .rotary import apply_rotary, rope_frequencies
+from .attention import attention, flash_attention_tpu, naive_attention
+from .ring_attention import ring_attention
+
+__all__ = [
+    "rms_norm", "apply_rotary", "rope_frequencies",
+    "attention", "flash_attention_tpu", "naive_attention",
+    "ring_attention",
+]

@@ -1,0 +1,20 @@
+"""Normalization ops.
+
+RMSNorm computes in float32 regardless of input dtype (bf16 accumulation
+loses enough precision to move loss curves), then casts back — the
+standard TPU recipe: the cast pair fuses into the surrounding XLA graph.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-5):
+    """RMSNorm: x * w / sqrt(mean(x^2) + eps), f32 accumulation."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    return (out * weight.astype(jnp.float32)).astype(dtype)

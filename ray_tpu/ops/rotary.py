@@ -1,0 +1,46 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+Frequencies are precomputed once per model (host side) and passed in as a
+(seq, head_dim/2) cos/sin table so the per-step work is one fused
+elementwise multiply on the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 500000.0,
+                     dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables of shape (max_seq, head_dim // 2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x, cos, sin, positions=None):
+    """Rotate half-split pairs (x[..., :d/2], x[..., d/2:]) by the
+    position angle — the GPT-NeoX / HF-Llama layout. Checkpoints stored
+    in Meta's interleaved even/odd layout must be permuted at load time
+    (handled by the model's checkpoint import, not here).
+
+    x: (..., seq, heads, head_dim). cos/sin: (max_seq, head_dim//2) or
+    already gathered (..., seq, head_dim//2) when ``positions`` is given
+    (decode path with per-sequence offsets).
+    """
+    if positions is not None:
+        cos = jnp.take(cos, positions, axis=0)
+        sin = jnp.take(sin, positions, axis=0)
+    else:
+        seq = x.shape[-3]
+        cos, sin = cos[:seq], sin[:seq]
+    # broadcast over heads: (..., seq, 1, head_dim//2)
+    cos = jnp.expand_dims(cos, axis=-2)
+    sin = jnp.expand_dims(sin, axis=-2)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

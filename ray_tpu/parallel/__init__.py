@@ -1,0 +1,40 @@
+"""ray_tpu.parallel: the TPU device plane.
+
+Replaces the reference's NCCL/GLOO collective stack
+(ref: python/ray/util/collective/collective.py) and torch process groups
+(ref: python/ray/train/torch/config.py:66) with XLA collectives over ICI:
+meshes + named shardings + shard_map, compiled by XLA.
+"""
+
+from .mesh import (
+    MeshSpec,
+    build_mesh,
+    local_mesh,
+    slice_topology,
+)
+from .sharding import (
+    LogicalAxisRules,
+    DEFAULT_RULES,
+    logical_sharding,
+    shard_pytree,
+    with_sharding_constraint_logical,
+)
+from .collectives import (
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    pgroup,
+    recv,
+    reducescatter,
+    send,
+)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "local_mesh", "slice_topology",
+    "LogicalAxisRules", "DEFAULT_RULES", "logical_sharding", "shard_pytree",
+    "with_sharding_constraint_logical",
+    "allreduce", "allgather", "reducescatter", "broadcast", "alltoall",
+    "send", "recv", "barrier", "pgroup",
+]

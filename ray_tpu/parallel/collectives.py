@@ -1,0 +1,189 @@
+"""Collective ops over mesh axes — the XLA/ICI replacement for the
+reference's NCCL/GLOO groups (ref: python/ray/util/collective/collective.py:
+init_collective_group:123, allreduce:268, reducescatter:482, send:541,
+recv:604; backends at util/collective/types.py:29-34).
+
+Two usage modes:
+
+1. **Inside shard_map / pjit** — call ``allreduce(x, axis="tp")`` etc.
+   directly; they are thin wrappers over ``jax.lax`` collectives, so XLA
+   schedules them on ICI and fuses around them.
+
+2. **Eager, host-level** — ``pgroup(mesh, axis)`` returns a
+   ``ProcessGroup`` whose methods compile one-off shard_map programs over
+   global arrays. This mirrors the reference's imperative
+   ``col.allreduce(tensor, group_name)`` API for code that isn't already
+   inside a compiled program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+AxisName = Union[str, tuple]
+
+# ---------------------------------------------------------------------------
+# Mode 1: symbolic — use inside shard_map/pjit-traced functions.
+# ---------------------------------------------------------------------------
+
+
+def allreduce(x, axis: AxisName, op: str = "sum"):
+    """Allreduce along a mesh axis (ref: collective.py:268 allreduce)."""
+    if op == "sum":
+        return jax.lax.psum(x, axis)
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    if op == "mean":
+        return jax.lax.pmean(x, axis)
+    if op == "prod":
+        # exp(psum(log|x|)) with the sign recovered from the parity of
+        # negative factors; a zero anywhere zeroes the product.
+        mag = jnp.exp(jax.lax.psum(jnp.log(jnp.maximum(jnp.abs(x), 1e-300)),
+                                   axis))
+        n_neg = jax.lax.psum((x < 0).astype(jnp.int32), axis)
+        has_zero = jax.lax.pmax((x == 0).astype(jnp.int32), axis)
+        sign = jnp.where(n_neg % 2 == 0, 1.0, -1.0).astype(mag.dtype)
+        return jnp.where(has_zero == 1, jnp.zeros_like(mag), sign * mag)
+    raise ValueError(f"unsupported reduce op: {op}")
+
+
+def allgather(x, axis: AxisName, *, concat_axis: int = 0, tiled: bool = True):
+    """Allgather along a mesh axis (ref: collective.py allgather:~430)."""
+    return jax.lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+
+
+def reducescatter(x, axis: AxisName, *, scatter_axis: int = 0, op: str = "sum"):
+    """Reduce-scatter along a mesh axis (ref: collective.py:482)."""
+    if op not in ("sum", "mean"):
+        raise ValueError("reducescatter supports sum/mean")
+    out = jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                               tiled=True)
+    if op == "mean":
+        out = out / jax.lax.psum(jnp.ones((), x.dtype), axis)
+    return out
+
+
+def broadcast(x, axis: AxisName, root: int = 0):
+    """Broadcast the root shard's value to all shards along ``axis``."""
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)
+
+
+def alltoall(x, axis: AxisName, *, split_axis: int, concat_axis: int):
+    """All-to-all: scatter ``split_axis``, gather ``concat_axis``.
+
+    The primitive behind Ulysses-style sequence<->head swaps and MoE token
+    dispatch (absent in the reference — SURVEY §5.7).
+    """
+    return jax.lax.all_to_all(x, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def send(x, axis: AxisName, *, shift: int = 1):
+    """Neighbour p2p along a ring: every rank sends to rank+shift.
+
+    XLA has no one-sided send; ``ppermute`` is the ICI-native p2p — each
+    device simultaneously sends and receives, riding neighbouring ICI
+    links (ref: NCCL send at collective.py:541).
+    """
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def recv(x, axis: AxisName, *, shift: int = 1):
+    """Inverse permutation of ``send``: pull from rank+shift (ref: :604).
+
+    ``recv(send(x, shift=k), shift=k) == x``.
+    """
+    return send(x, axis, shift=-shift)
+
+
+# ---------------------------------------------------------------------------
+# Mode 2: eager host-level process groups.
+# ---------------------------------------------------------------------------
+
+
+class ProcessGroup:
+    """Imperative collective API over one mesh axis.
+
+    Compiles (and caches) a shard_map program per (op, shape, dtype).
+    Mirrors the reference's group objects
+    (ref: util/collective/collective_group/nccl_collective_group.py).
+    """
+
+    def __init__(self, mesh: Mesh, axis: str):
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis
+        self._cache = {}
+
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def _run(self, name, fn, x, in_spec, out_spec):
+        key = (name, x.shape, str(x.dtype), in_spec, out_spec)
+        if key not in self._cache:
+            sm = shard_map(fn, mesh=self.mesh, in_specs=in_spec,
+                           out_specs=out_spec, check_vma=False)
+            self._cache[key] = jax.jit(sm)
+        return self._cache[key](x)
+
+    def allreduce(self, x, op: str = "sum"):
+        # x: replicated per-rank value laid out with leading axis = rank.
+        spec = P(self.axis)
+        return self._run(f"ar_{op}", lambda s: allreduce(s, self.axis, op),
+                         x, spec, spec)
+
+    def allgather(self, x):
+        spec = P(self.axis)
+        return self._run("ag", lambda s: allgather(s, self.axis),
+                         x, spec, P())
+
+    def reducescatter(self, x, op: str = "sum"):
+        return self._run(f"rs_{op}",
+                         lambda s: reducescatter(s, self.axis, op=op),
+                         x, P(), P(self.axis))
+
+    def broadcast(self, x, root: int = 0):
+        spec = P(self.axis)
+        return self._run(f"bc_{root}",
+                         lambda s: broadcast(s, self.axis, root=root),
+                         x, spec, spec)
+
+    def shift(self, x, shift: int = 1):
+        spec = P(self.axis)
+        return self._run(f"sh_{shift}",
+                         lambda s: send(s, self.axis, shift=shift),
+                         x, spec, spec)
+
+    def barrier(self):
+        # A zero-byte psum forces a synchronization point across the axis.
+        one = jnp.zeros((self.size,), jnp.float32)
+        self.allreduce(one).block_until_ready()
+
+
+def pgroup(mesh: Mesh, axis: str) -> ProcessGroup:
+    """Create (or fetch) the eager process group for a mesh axis
+    (ref: init_collective_group collective.py:123)."""
+    return ProcessGroup(mesh, axis)
+
+
+def barrier(mesh: Mesh, axis: Optional[str] = None):
+    """Cluster-wide barrier (ref: collective.py barrier)."""
+    axes = [axis] if axis else [a for a in mesh.axis_names
+                                if mesh.shape[a] > 1]
+    for a in axes:
+        ProcessGroup(mesh, a).barrier()

@@ -1,0 +1,145 @@
+"""Device meshes with ICI-topology awareness.
+
+The reference models TPU pods only as opaque resource strings
+(ref: python/ray/_private/accelerators/tpu.py:109 TPUAcceleratorManager,
+``TPU-{type}-head`` gang resource at tpu.py:401-403). Here topology is
+first-class: a mesh axis maps onto physical ICI dimensions so collectives
+ride ICI links, and the dp/fsdp/tp/sp/ep/pp axis order puts the
+highest-traffic axes (tp, then fsdp) on the fastest/innermost device
+dimension.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost (lowest-bandwidth, e.g. DCN across slices)
+# to innermost (highest-traffic, wants contiguous ICI): pipeline stages
+# across slices first, then data/replica axes, then sequence, experts, and
+# tensor-parallel innermost (tp does per-layer allreduce/allgather — the
+# hottest collective).
+AXIS_ORDER: Tuple[str, ...] = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape over named parallelism axes.
+
+    Any axis omitted (or sized 1) is inert; shardings referring to it
+    resolve to replication. Example: ``MeshSpec(dp=2, fsdp=2, tp=2)`` on 8
+    devices.
+    """
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    @property
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.axis_sizes.values())
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return AXIS_ORDER
+
+    @staticmethod
+    def for_devices(n: int, tp: int = 1, pp: int = 1, sp: int = 1,
+                    ep: int = 1, dp: Optional[int] = None,
+                    fsdp: Optional[int] = None) -> "MeshSpec":
+        """Fill the unspecified device factor into fsdp and/or dp.
+
+        With neither given, the whole leftover goes to fsdp — the safest
+        default for large models (ZeRO-style param sharding). With one of
+        dp/fsdp given, the other absorbs the remainder.
+        """
+        inner = tp * pp * sp * ep
+        if n % inner != 0:
+            raise ValueError(f"{n} devices not divisible by tp*pp*sp*ep={inner}")
+        rest = n // inner
+        if dp is None and fsdp is None:
+            dp, fsdp = 1, rest
+        elif fsdp is None:
+            if rest % dp != 0:
+                raise ValueError(f"residual {rest} not divisible by dp={dp}")
+            fsdp = rest // dp
+        elif dp is None:
+            if rest % fsdp != 0:
+                raise ValueError(f"residual {rest} not divisible by fsdp={fsdp}")
+            dp = rest // fsdp
+        elif dp * fsdp != rest:
+            raise ValueError(f"dp*fsdp={dp * fsdp} != residual {rest}")
+        return MeshSpec(pp=pp, dp=dp, fsdp=fsdp, sp=sp, ep=ep, tp=tp)
+
+
+def _device_order_key(d) -> Tuple:
+    """Sort devices so ICI neighbours are adjacent.
+
+    TPU devices expose physical ``coords`` (x, y, z) and ``core_on_chip``;
+    ordering by (slice_index, z, y, x, core) makes the innermost mesh axes
+    land on physically adjacent chips, so tp/fsdp collectives use
+    single-hop ICI links. Falls back to ``d.id`` (CPU/virtual devices).
+    """
+    slice_idx = getattr(d, "slice_index", 0) or 0
+    coords = getattr(d, "coords", None)
+    if coords is not None:
+        core = getattr(d, "core_on_chip", 0) or 0
+        return (slice_idx, *reversed(tuple(coords)), core)
+    return (slice_idx, d.id)
+
+
+def slice_topology(devices: Optional[Sequence] = None) -> Dict[str, object]:
+    """Summarise the physical topology of the given (default: all) devices.
+
+    Returns counts of slices, hosts, chips and the coordinate bounding box
+    — the scheduler uses this to map placement bundles onto ICI sub-cubes.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    slices = sorted({getattr(d, "slice_index", 0) or 0 for d in devices})
+    hosts = sorted({d.process_index for d in devices})
+    coords = [getattr(d, "coords", None) for d in devices]
+    bbox = None
+    if all(c is not None for c in coords):
+        arr = np.array(coords)
+        bbox = tuple(int(x) for x in (arr.max(axis=0) - arr.min(axis=0) + 1))
+    return {
+        "n_devices": len(devices),
+        "n_slices": len(slices),
+        "n_hosts": len(hosts),
+        "platform": devices[0].platform if devices else None,
+        "ici_bbox": bbox,
+    }
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` for the spec, ICI-ordered.
+
+    All axes in AXIS_ORDER are always present in the mesh (size-1 axes are
+    free), so shardings can name any axis regardless of the active layout.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if spec.size != len(devices):
+        raise ValueError(
+            f"MeshSpec wants {spec.size} devices ({spec.axis_sizes}) but "
+            f"{len(devices)} provided")
+    devices = sorted(devices, key=_device_order_key)
+    shape = tuple(spec.axis_sizes[a] for a in AXIS_ORDER)
+    dev_array = np.array(devices, dtype=object).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def local_mesh(**axis_sizes: int) -> Mesh:
+    """Convenience: mesh over all visible devices, e.g. local_mesh(tp=4)."""
+    n = len(jax.devices())
+    return build_mesh(MeshSpec.for_devices(n, **axis_sizes))

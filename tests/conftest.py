@@ -1,16 +1,24 @@
 """Test fixtures (ref: python/ray/tests/conftest.py fixture ladder).
 
-Device-plane tests run on a virtual 8-device CPU mesh so mesh/collective logic
-is exercised without TPU hardware (SURVEY §4.4).
+Device-plane tests run on a virtual 8-device CPU mesh so mesh/collective
+logic is exercised without TPU hardware (SURVEY §4.4). The environment's
+sitecustomize registers a remote-TPU backend and forces
+``jax_platforms="axon,cpu"`` at interpreter start; tests must NOT touch
+the (single, exclusive) TPU tunnel, so we hard-override the platform
+config back to cpu before any backend is initialized.
 """
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("XLA_FLAGS",
-                      (os.environ.get("XLA_FLAGS", "") +
-                       " --xla_force_host_platform_device_count=8").strip())
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+# sitecustomize may have set jax_platforms="axon,cpu" already; this update
+# lands before any backend is initialized, so tests stay CPU-only.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
@@ -37,8 +45,6 @@ def ray_start_shared():
 
 @pytest.fixture
 def cpu_mesh8():
-    import jax
-
     devices = jax.devices("cpu")
     assert len(devices) >= 8, "conftest must force 8 host devices"
     yield devices[:8]

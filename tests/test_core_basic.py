@@ -189,3 +189,30 @@ def test_actor_handle_in_task(ray_start_regular):
 
     assert ray_tpu.get(bump.remote(counter)) == 1
     assert ray_tpu.get(counter.read.remote()) == 1
+
+
+def test_object_store_concurrent_get(tmp_path):
+    """Concurrent gets of a foreign-sealed object must not double-count."""
+    import os
+    import threading
+    import numpy as np
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import SharedObjectStore
+
+    a = SharedObjectStore("rtpu_test_ccg", 1 << 24)
+    b = SharedObjectStore("rtpu_test_ccg", 1 << 24, create_dir=False)
+    try:
+        oid = ObjectID(os.urandom(ObjectID.SIZE))
+        a.put(oid, b"x" * 4096)
+        results = []
+
+        def reader():
+            results.append(bytes(b.get(oid)))
+
+        threads = [threading.Thread(target=reader) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert all(r == b"x" * 4096 for r in results)
+        assert b.used_bytes() == 4096, b.used_bytes()
+    finally:
+        a.destroy()

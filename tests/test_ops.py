@@ -1,0 +1,133 @@
+"""Kernel correctness vs naive oracles on the CPU mesh (SURVEY §4.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops import (
+    apply_rotary, attention, naive_attention, ring_attention, rms_norm,
+    rope_frequencies,
+)
+from ray_tpu.ops.attention import blockwise_attention
+from ray_tpu.parallel import MeshSpec, build_mesh
+
+
+def _qkv(key, b=2, sq=64, skv=64, hq=4, hkv=2, d=16, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, sq, hq, d), dtype)
+    k = jax.random.normal(ks[1], (b, skv, hkv, d), dtype)
+    v = jax.random.normal(ks[2], (b, skv, hkv, d), dtype)
+    return q, k, v
+
+
+def test_rms_norm():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32), jnp.bfloat16)
+    w = jnp.ones((32,), jnp.bfloat16) * 2
+    out = rms_norm(x, w)
+    assert out.dtype == jnp.bfloat16
+    xf = np.asarray(x, np.float32)
+    ref = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-5) * 2
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_rotary_norm_preserving():
+    cos, sin = rope_frequencies(16, 128)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 4, 16))
+    out = apply_rotary(x, cos, sin)
+    # Rotation preserves the norm of each pair.
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    # Position 0 is the identity rotation.
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(x[:, 0]),
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("hkv", [4, 2, 1])
+def test_blockwise_matches_naive(causal, hkv):
+    q, k, v = _qkv(jax.random.PRNGKey(2), hkv=hkv)
+    ref = naive_attention(q, k, v, causal=causal)
+    out = blockwise_attention(q, k, v, causal=causal, kv_block=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_blockwise_cross_attention_unpadded():
+    q, k, v = _qkv(jax.random.PRNGKey(3), sq=32, skv=80)
+    ref = naive_attention(q, k, v, causal=False)
+    out = blockwise_attention(q, k, v, causal=False, kv_block=32)  # pad 80->96
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_attention_dispatcher_grad():
+    q, k, v = _qkv(jax.random.PRNGKey(4), sq=32, skv=32)
+
+    def loss(q, k, v):
+        return attention(q, k, v, causal=True).sum()
+
+    g = jax.grad(loss)(q, k, v)
+    gref = jax.grad(lambda q, k, v: naive_attention(q, k, v).sum())(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_full(cpu_mesh8, causal):
+    mesh = build_mesh(MeshSpec(sp=8), cpu_mesh8)
+    q, k, v = _qkv(jax.random.PRNGKey(5), b=1, sq=64, skv=64, hq=4, hkv=2)
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, axis="sp", causal=causal)
+
+    out = jax.jit(shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"), check_vma=False))(q, k, v)
+    ref = naive_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_differentiable(cpu_mesh8):
+    mesh = build_mesh(MeshSpec(sp=4), cpu_mesh8[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(6), b=1, sq=32, skv=32, hq=2, hkv=2)
+
+    def loss(q, k, v):
+        out = shard_map(
+            lambda a, b, c: ring_attention(a, b, c, axis="sp"),
+            mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False)(q, k, v)
+        return (out ** 2).sum()
+
+    g = jax.jit(jax.grad(loss))(q, k, v)
+    gref = jax.grad(
+        lambda a, b, c: (naive_attention(a, b, c) ** 2).sum())(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fully_masked_rows_zero():
+    # Every key is in the future for the earliest queries when skv < sq:
+    # those rows must produce zeros, not uniform attention over padding.
+    q, k, v = _qkv(jax.random.PRNGKey(7), sq=16, skv=8)
+    ref = naive_attention(q, k, v, causal=True)
+    out = blockwise_attention(q, k, v, causal=True, kv_block=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # q rows 0..7 see no keys (offset skv-sq = -8): exact zeros.
+    np.testing.assert_array_equal(np.asarray(out[:, :7]), 0.0)
+
+
+def test_pick_block():
+    from ray_tpu.ops.attention import _pick_block
+    assert _pick_block(640, 512) == 128
+    assert _pick_block(1024, 512) == 512
+    assert _pick_block(384, 512) == 384
+    assert _pick_block(96, 512) == 96
+    assert _pick_block(250, 128) is None
