@@ -1,0 +1,23 @@
+"""ray_tpu.models: TPU-native model families.
+
+The reference ships no models of its own (it orchestrates torch models;
+its LLM path wraps vLLM — ref: python/ray/llm/_internal/serve/deployments/
+llm/vllm/vllm_models.py). Here the models are first-class jax programs
+with logical-axis sharding so the same definition runs dp/fsdp/tp/sp
+layouts by rule swap (BASELINE configs: Llama-3 8B/70B, Mixtral MoE,
+ViT/CLIP, Mamba).
+"""
+
+from .llama import (
+    LlamaConfig,
+    LLAMA_CONFIGS,
+    init_params,
+    param_logical_axes,
+    forward,
+    lm_loss,
+)
+
+__all__ = [
+    "LlamaConfig", "LLAMA_CONFIGS", "init_params", "param_logical_axes",
+    "forward", "lm_loss",
+]

@@ -1,0 +1,208 @@
+"""Llama-3 family: functional jax transformer, TPU-first.
+
+Design choices for the TPU/XLA compilation model:
+  * **scan over layers** — one compiled layer body, stacked params with a
+    leading "layers" axis: compile time stays flat as depth grows.
+  * **remat per layer** (``jax.checkpoint``) — trades FLOPs for HBM,
+    standard recipe for long-sequence training.
+  * **logical axis names** on every param; the rules table
+    (ray_tpu.parallel.sharding) maps them onto the dp/fsdp/tp/sp mesh, so
+    FSDP/TP/SP layouts need no model edits (GSPMD inserts collectives).
+  * **bf16 params/activations, f32 accumulation** in norms/softmax/loss.
+  * attention dispatches to the Pallas flash kernel on TPU, ring
+    attention over the "sp" axis when sequence-parallel is active.
+
+The reference has no native model code (tensors delegated to torch/vLLM
+— SURVEY §2.3); this file is the BASELINE "Llama-3 8B" config substrate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import apply_rotary, attention, ring_attention, rms_norm, rope_frequencies
+from ..parallel.sharding import DEFAULT_RULES, with_sharding_constraint_logical
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def n_params(self) -> int:
+        d, L = self.dim, self.n_layers
+        attn = d * self.n_heads * self.head_dim + 2 * d * self.n_kv_heads * self.head_dim \
+            + self.n_heads * self.head_dim * d
+        mlp = 3 * d * self.mlp_dim
+        return self.vocab * d * 2 + L * (attn + mlp + 2 * d) + d
+
+
+LLAMA_CONFIGS: Dict[str, LlamaConfig] = {
+    # test-size model: fits CPU tests, exercises GQA (4 q heads, 2 kv).
+    "tiny": LlamaConfig(vocab=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, mlp_dim=128, max_seq=256,
+                        dtype=jnp.float32, remat=False),
+    # ~420M: single-chip bench size.
+    "400m": LlamaConfig(vocab=32768, dim=1024, n_layers=24, n_heads=16,
+                        n_kv_heads=8, mlp_dim=2816, max_seq=2048),
+    "1b": LlamaConfig(vocab=128256, dim=2048, n_layers=16, n_heads=32,
+                      n_kv_heads=8, mlp_dim=8192, max_seq=8192),
+    "8b": LlamaConfig(),  # Llama-3-8B (BASELINE config #1)
+    "70b": LlamaConfig(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                       mlp_dim=28672),
+}
+
+
+# ---------------------------------------------------------------------------
+# Params: nested dict, layer params stacked on a leading "layers" axis.
+# ---------------------------------------------------------------------------
+
+
+def param_logical_axes(cfg: LlamaConfig):
+    """Pytree of logical-axis tuples mirroring init_params' structure."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(key, cfg: LlamaConfig):
+    """Scaled-normal init (1/sqrt(fan_in)); bf16 storage."""
+    L, d, hd = cfg.n_layers, cfg.dim, cfg.head_dim
+    h, hkv, m = cfg.n_heads, cfg.n_kv_heads, cfg.mlp_dim
+    ks = jax.random.split(key, 8)
+
+    def norm(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    return {
+        "embed": norm(ks[0], (cfg.vocab, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "wq": norm(ks[1], (L, d, h, hd), d),
+            "wk": norm(ks[2], (L, d, hkv, hd), d),
+            "wv": norm(ks[3], (L, d, hkv, hd), d),
+            "wo": norm(ks[4], (L, h, hd, d), h * hd),
+            "mlp_norm": jnp.ones((L, d), cfg.dtype),
+            "w_gate": norm(ks[5], (L, d, m), d),
+            "w_up": norm(ks[6], (L, d, m), d),
+            "w_down": norm(ks[7], (L, m, d), m),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": norm(ks[0], (d, cfg.vocab), d),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward.
+# ---------------------------------------------------------------------------
+
+
+def _attn(x, lp, cfg: LlamaConfig, cos, sin, mesh: Optional[Mesh], rules):
+    b, s, d = x.shape
+    q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"])
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # Sequence parallel: tokens sharded over "sp"; exact ring attention
+        # rotates kv shards over single-hop ICI neighbours.
+        spec = P(("dp", "fsdp"), "sp", "tp", None)
+        out = shard_map(
+            partial(ring_attention, axis="sp", causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)(q, k, v)
+    else:
+        out = attention(q, k, v, causal=True)
+    out = jnp.einsum("bshk,hkd->bsd", out, lp["wo"])
+    return out
+
+
+def _mlp(x, lp):
+    # SwiGLU; gate/up fuse into one pass over x in XLA.
+    g = jnp.einsum("bsd,dm->bsm", x, lp["w_gate"])
+    u = jnp.einsum("bsd,dm->bsm", x, lp["w_up"])
+    return jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
+
+
+def forward(params, tokens, cfg: LlamaConfig, *,
+            mesh: Optional[Mesh] = None, rules=DEFAULT_RULES):
+    """tokens (B, S) int32 → logits (B, S, vocab) in f32."""
+    csl = partial(with_sharding_constraint_logical, rules=rules, mesh=mesh)
+    cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1],
+                                cfg.rope_theta, dtype=jnp.float32)
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = csl(x, ("batch", "seq", "embed"))
+
+    def layer(x, lp):
+        h = x + _attn(rms_norm(x, lp["attn_norm"], cfg.norm_eps),
+                      lp, cfg, cos, sin, mesh, rules)
+        h = csl(h, ("batch", "seq", "embed"))
+        out = h + _mlp(rms_norm(h, lp["mlp_norm"], cfg.norm_eps), lp)
+        return csl(out, ("batch", "seq", "embed")), None
+
+    body = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    return csl(logits, ("batch", "seq", "vocab"))
+
+
+def lm_loss(params, batch, cfg: LlamaConfig, *,
+            mesh: Optional[Mesh] = None, rules=DEFAULT_RULES,
+            z_loss: float = 1e-4):
+    """Next-token cross-entropy (f32) with optional z-loss regularizer.
+
+    batch: {"tokens": (B, S) int32, "mask": optional (B, S) 0/1 valid}.
+    Targets are tokens shifted left; the final position is dropped.
+    """
+    tokens = batch["tokens"]
+    logits = forward(params, tokens, cfg, mesh=mesh, rules=rules)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(logits, targets[..., None],
+                                    axis=-1)[..., 0]
+    nll = logz - tgt_logit
+    if z_loss:
+        nll = nll + z_loss * jnp.square(logz)
+    mask = batch.get("mask")
+    mask = jnp.ones_like(nll) if mask is None else mask[:, 1:].astype(nll.dtype)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -94,6 +94,6 @@ def with_sharding_constraint_logical(x, logical_axes, rules=DEFAULT_RULES,
             return jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, spec))
         return jax.lax.with_sharding_constraint(x, spec)
-    except ValueError:
-        # No ambient mesh (pure eager / CPU test path): no-op.
+    except (ValueError, RuntimeError):
+        # No ambient/context mesh (eager or single-device path): no-op.
         return x

@@ -1,0 +1,13 @@
+"""ray_tpu.train: distributed training orchestration, TPU-first.
+
+Reference analog: Ray Train v2 (ref: python/ray/train/v2/ — controller
+at _internal/execution/controller/controller.py:91, worker group at
+_internal/execution/worker_group/worker_group.py:103). The torch/NCCL
+process-group plumbing (ref: train/torch/config.py:66) is replaced by
+pjit/GSPMD over a named mesh: the "worker group" for a single slice is
+the XLA program itself; actors orchestrate hosts, XLA owns chips.
+"""
+
+from .step import TrainState, make_train_step, make_eval_step
+
+__all__ = ["TrainState", "make_train_step", "make_eval_step"]
