@@ -1,0 +1,143 @@
+"""Driver benchmark: Llama train-step throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+What it measures: tokens/sec of a full pjit train step (fwd + bwd + adamw
+update, donated buffers) on the flagship Llama config that fits the chip,
+plus achieved MFU against the chip's peak bf16 FLOPs. On TPU it first
+asserts the Pallas flash-attention kernel matches the blockwise oracle on
+device — the kernel's on-hardware correctness gate (VERDICT round 1).
+
+``vs_baseline``: the reference repo publishes no tokens/s number for its
+training path (BASELINE.md: torch-DDP parity "within 2.5%" is its only
+training claim, and BASELINE.json's 7B tokens/s/chip metric has no
+published value). We therefore report achieved MFU / 0.40 — 40% MFU being
+the publicly accepted "good" llama-pretraining efficiency mark that a
+torch-DDP-parity system would need to hit on comparable hardware.
+"""
+
+import json
+import sys
+import time
+
+# Peak dense bf16 FLOPs/s per chip by device generation.
+_PEAK_FLOPS = {
+    "v6": 918e12,  # Trillium
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5lite": 197e12,  # v5e's device_kind reports as "TPU v5 lite"
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def _peak_flops(device) -> float:
+    import os
+    kind = (getattr(device, "device_kind", "") or "").lower().replace(" ", "")
+    kind += os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for tag, flops in _PEAK_FLOPS.items():
+        if tag in kind:
+            return flops
+    if device.platform in ("tpu", "axon"):
+        return 275e12
+    return 0.0  # unknown/CPU: MFU not meaningful
+
+
+def _model_flops_per_token(cfg, seq: int) -> float:
+    """fwd+bwd matmul FLOPs per token: 6*N params + causal attention."""
+    n = cfg.n_params()
+    # attention scores+values: 2 matmuls of S*S*d per head-group, causal
+    # halves them; x3 for backward.
+    attn = 6 * cfg.n_layers * seq * cfg.dim
+    return 6.0 * n + attn
+
+
+def _check_pallas_parity():
+    """Run the Pallas flash kernel on the device vs the blockwise oracle."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.ops.attention import blockwise_attention, flash_attention_tpu
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (1, 512, 8, 128), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, 512, 4, 128), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, 512, 4, 128), jnp.bfloat16)
+    out = jax.jit(lambda q, k, v: flash_attention_tpu(q, k, v, causal=True))(
+        q, k, v)
+    ref = blockwise_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+    return True
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import (
+        LLAMA_CONFIGS, init_params, lm_loss, param_logical_axes)
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.train import make_train_step
+
+    dev = jax.devices()[0]
+    # The axon relay backend fronts a real TPU but may report its own
+    # platform name; device_kind still identifies the chip.
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    on_tpu = dev.platform in ("tpu", "axon") or "tpu" in kind
+    if on_tpu:
+        name, batch, seq, steps = "400m", 8, 2048, 10
+        pallas_ok = _check_pallas_parity()
+    else:  # local/CI smoke: tiny model so the script still yields a number
+        name, batch, seq, steps = "tiny", 4, 128, 5
+        pallas_ok = None
+    cfg = LLAMA_CONFIGS[name]
+
+    mesh = build_mesh(MeshSpec(), [dev])
+    optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    init_fn, step_fn, place_batch = make_train_step(
+        lambda p, b: lm_loss(p, b, cfg, mesh=mesh),
+        optimizer, mesh, param_logical_axes(cfg))
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    state = init_fn(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
+                                0, cfg.vocab, jnp.int32)
+    data = place_batch({"tokens": tokens})
+
+    # Warmup (compile) then timed steps.
+    for _ in range(2):
+        state, metrics = step_fn(state, data)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, data)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    peak = _peak_flops(dev)
+    mfu = (tokens_per_sec * _model_flops_per_token(cfg, seq) / peak
+           if peak else 0.0)
+
+    print(json.dumps({
+        "metric": f"llama_{name}_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4) if peak else None,
+        "mfu": round(mfu, 4),
+        "step_ms": round(1e3 * dt / steps, 2),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "n_params": cfg.n_params(),
+        "batch": batch,
+        "seq": seq,
+        "pallas_parity": pallas_ok,
+        "loss": round(float(metrics["loss"]), 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,6 +142,13 @@ class SharedObjectStore:
             if entry is not None and entry.mm is not None:
                 mm.close()
             else:
+                # Mapping a foreign-sealed object grows the store too:
+                # evict LRU victims (or raise) before accounting it.
+                try:
+                    self._maybe_evict(size)
+                except ObjectStoreFullError:
+                    mm.close()
+                    raise
                 entry = _Entry(path=path, size=size, mm=mm)
                 self._entries[oid] = entry
                 self._used += size
@@ -191,6 +198,15 @@ class SharedObjectStore:
         # caller holds self._lock
         if self._used + incoming <= self.capacity:
             return
+        # Hopeless requests must not destroy the cache: check that evicting
+        # every unpinned sealed entry would actually make room first.
+        evictable = sum(e.size for e in self._entries.values()
+                        if e.sealed and e.pin_count == 0)
+        if self._used - evictable + incoming > self.capacity:
+            raise ObjectStoreFullError(
+                f"object store over capacity: need {incoming}, used "
+                f"{self._used} ({evictable} evictable), capacity "
+                f"{self.capacity}")
         target = self.capacity - incoming
         victims = []
         for oid, entry in self._entries.items():  # OrderedDict == LRU order

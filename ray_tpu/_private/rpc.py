@@ -302,6 +302,7 @@ class EventLoopThread:
 
     def __init__(self, name: str = "ray_tpu_io"):
         self.loop = asyncio.new_event_loop()
+        self._stopping = False
         self.thread = threading.Thread(target=self._run, name=name, daemon=True)
         self.thread.start()
 
@@ -314,9 +315,19 @@ class EventLoopThread:
         return fut.result(timeout)
 
     def spawn(self, coro):
+        # A coroutine submitted to a stopping/stopped loop would never be
+        # awaited (RuntimeWarning now, a silent hang once callers wait on
+        # the future); close it instead so best-effort notifications drop
+        # cleanly at shutdown. A loop that merely hasn't *started* yet is
+        # fine — run_coroutine_threadsafe queues onto it.
+        if self._stopping or self.loop.is_closed():
+            coro.close()
+            return None
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self):
+        self._stopping = True
+
         def _cancel_all():
             for task in asyncio.all_tasks(self.loop):
                 task.cancel()

@@ -102,7 +102,7 @@ def init_params(key, cfg: LlamaConfig):
     """Scaled-normal init (1/sqrt(fan_in)); bf16 storage."""
     L, d, hd = cfg.n_layers, cfg.dim, cfg.head_dim
     h, hkv, m = cfg.n_heads, cfg.n_kv_heads, cfg.mlp_dim
-    ks = jax.random.split(key, 8)
+    ks = jax.random.split(key, 9)
 
     def norm(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32)
@@ -122,7 +122,7 @@ def init_params(key, cfg: LlamaConfig):
             "w_down": norm(ks[7], (L, m, d), m),
         },
         "final_norm": jnp.ones((d,), cfg.dtype),
-        "lm_head": norm(ks[0], (d, cfg.vocab), d),
+        "lm_head": norm(ks[8], (d, cfg.vocab), d),
     }
 
 

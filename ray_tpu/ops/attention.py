@@ -179,18 +179,27 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _pick_block(seq: int, target: int) -> Optional[int]:
-    """Largest lane-aligned block <= target that divides seq."""
+    """Largest lane-aligned (multiple-of-128) block <= target dividing seq.
+
+    Returns None when no such block exists (e.g. seq=100): Mosaic needs
+    lane/sublane-aligned tiles, so the dispatcher must fall back to the
+    blockwise jax path rather than hand Pallas an illegal block.
+    """
     for b in range(min(target, seq), 127, -128):
         if seq % b == 0 and b % 128 == 0:
             return b
-    return seq if seq <= target else None
+    return None
 
 
 def flash_attention_tpu(q, k, v, *, causal: bool = True,
                         scale: Optional[float] = None,
-                        block_q: int = 512, block_k: int = 512):
+                        block_q: int = 512, block_k: int = 512,
+                        interpret: bool = False):
     """Pallas flash-attention forward (TPU). No autodiff — use
-    ``attention`` for a differentiable entry point."""
+    ``attention`` for a differentiable entry point.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (works on
+    CPU) so the kernel body is testable without TPU hardware."""
     b, sq, hq, d = q.shape
     skv, hkv = k.shape[1], k.shape[2]
     scale = scale if scale is not None else d ** -0.5
@@ -230,6 +239,7 @@ def flash_attention_tpu(q, k, v, *, causal: bool = True,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
+        interpret=interpret,
     )(qt, kt, vt)
     return jnp.moveaxis(out.reshape(b, hq, sq, d), 1, 2)
 
@@ -239,6 +249,11 @@ def flash_attention_tpu(q, k, v, *, causal: bool = True,
 # ---------------------------------------------------------------------------
 
 
+def _is_tpu_platform(name: str) -> bool:
+    # "axon" is a relay PJRT backend fronting a real TPU chip.
+    return name in ("tpu", "axon")
+
+
 def _on_tpu(x) -> bool:
     """True when ``x`` lives on (or will be committed to) a TPU device."""
     try:
@@ -246,10 +261,10 @@ def _on_tpu(x) -> bool:
         if callable(devs):
             ds = devs()
             if ds:
-                return all(d.platform == "tpu" for d in ds)
-        return jax.default_backend() == "tpu"
+                return all(_is_tpu_platform(d.platform) for d in ds)
+        return _is_tpu_platform(jax.default_backend())
     except Exception:  # pragma: no cover — tracers without devices
-        return jax.default_backend() == "tpu"
+        return _is_tpu_platform(jax.default_backend())
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -120,6 +120,11 @@ class ProcessGroup:
     Compiles (and caches) a shard_map program per (op, shape, dtype).
     Mirrors the reference's group objects
     (ref: util/collective/collective_group/nccl_collective_group.py).
+
+    Input convention: the **leading axis is the rank axis** — inputs carry
+    one slice per rank along dim 0 (shape ``(size, ...)`` or a multiple),
+    for every op including ``reducescatter`` (matching the reference's
+    per-rank input contribution semantics, collective.py:482).
     """
 
     def __init__(self, mesh: Mesh, axis: str):
@@ -153,9 +158,11 @@ class ProcessGroup:
                          x, spec, P())
 
     def reducescatter(self, x, op: str = "sum"):
+        # x: (size * chunk, ...) — rank i contributes x[i*chunk:(i+1)*chunk]
+        # and receives sum_j x_j's i-th chunk (leading-axis-is-rank).
         return self._run(f"rs_{op}",
                          lambda s: reducescatter(s, self.axis, op=op),
-                         x, P(), P(self.axis))
+                         x, P(self.axis), P(self.axis))
 
     def broadcast(self, x, root: int = 0):
         spec = P(self.axis)

@@ -32,6 +32,32 @@ def _batch_sharding(mesh: Mesh, rules) -> NamedSharding:
     return logical_sharding(mesh, ("batch", "seq"), rules)
 
 
+def opt_state_shardings(optimizer, params, param_shardings, mesh: Mesh):
+    """Shardings for ``optimizer.init(params)`` output, explicitly.
+
+    Optax first/second-moment states embed whole copies of the param
+    pytree (mu/nu); any subtree whose structure matches ``params`` gets
+    the param shardings leaf-for-leaf, everything else (step counters,
+    scalars) replicates. ``jax.jit`` gives no mirroring guarantee on its
+    own — at 8B scale replicated Adam moments would blow HBM.
+    """
+    pdef = jax.tree.structure(params)
+    replicated = NamedSharding(mesh, P())
+
+    def matches_params(sub) -> bool:
+        try:
+            return jax.tree.structure(sub) == pdef
+        except Exception:
+            return False
+
+    abstract = jax.eval_shape(optimizer.init, params)
+    return jax.tree.map(
+        lambda sub: param_shardings if matches_params(sub) else replicated,
+        abstract,
+        is_leaf=lambda x: matches_params(x)
+        or isinstance(x, jax.ShapeDtypeStruct))
+
+
 def make_train_step(
     loss_fn: Callable[..., jax.Array],
     optimizer: optax.GradientTransformation,
@@ -51,11 +77,8 @@ def make_train_step(
     def init_fn(params):
         ps = param_shardings(params)
         params = jax.device_put(params, ps)
-        opt_state = jax.jit(
-            optimizer.init,
-            # optimizer state mirrors param sharding leaf-for-leaf where
-            # shaped like params; scalars replicate.
-            out_shardings=None)(params)
+        opt_sh = opt_state_shardings(optimizer, params, ps, mesh)
+        opt_state = jax.jit(optimizer.init, out_shardings=opt_sh)(params)
         step0 = jnp.zeros((), jnp.int32)
         return TrainState(step=step0, params=params, opt_state=opt_state)
 

@@ -129,5 +129,40 @@ def test_pick_block():
     assert _pick_block(640, 512) == 128
     assert _pick_block(1024, 512) == 512
     assert _pick_block(384, 512) == 384
-    assert _pick_block(96, 512) == 96
+    # Blocks must be 128-lane aligned for Mosaic; seqs with no aligned
+    # divisor must return None so the dispatcher falls back to blockwise.
+    assert _pick_block(96, 512) is None
+    assert _pick_block(100, 512) is None
+    assert _pick_block(24, 512) is None
     assert _pick_block(250, 128) is None
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("hkv", [4, 2])
+def test_pallas_flash_interpret_matches_naive(causal, hkv):
+    """Run the Pallas kernel body in interpret mode (works on CPU) against
+    the naive oracle — covers the VMEM scratch accumulation and the GQA
+    kv_index map without TPU hardware."""
+    from ray_tpu.ops.attention import flash_attention_tpu
+
+    q, k, v = _qkv(jax.random.PRNGKey(8), b=2, sq=256, skv=256,
+                   hq=4, hkv=hkv, d=128)
+    ref = naive_attention(q, k, v, causal=causal)
+    out = flash_attention_tpu(q, k, v, causal=causal,
+                              block_q=128, block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_flash_interpret_bf16_and_uneven():
+    from ray_tpu.ops.attention import flash_attention_tpu
+
+    # bf16 inputs, q shorter than kv (decode-with-cache alignment).
+    q, k, v = _qkv(jax.random.PRNGKey(9), b=1, sq=128, skv=256,
+                   hq=2, hkv=1, d=128, dtype=jnp.bfloat16)
+    ref = naive_attention(q, k, v, causal=True)
+    out = flash_attention_tpu(q, k, v, causal=True,
+                              block_q=128, block_k=128, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)

@@ -75,6 +75,18 @@ def test_pgroup_eager(cpu_mesh8):
     g.barrier()
 
 
+def test_pgroup_reducescatter_per_rank(cpu_mesh8):
+    """Leading-axis-is-rank: rank i contributes x[i] and receives the sum
+    of every rank's i-th chunk (ref: collective.py:482 semantics)."""
+    mesh = build_mesh(MeshSpec(dp=4), cpu_mesh8[:4])
+    g = pgroup(mesh, "dp")
+    # 4 ranks, each contributing a (4,) vector: rank r contributes
+    # r * [1,1,1,1]; reduce-scatter leaves rank i with sum_r x_r[i] = 6.
+    x = jnp.broadcast_to(jnp.arange(4.0)[:, None], (4, 4)).reshape(16)
+    out = g.reducescatter(x.reshape(16, 1))
+    np.testing.assert_allclose(np.asarray(out), np.full((4, 1), 6.0))
+
+
 def test_reducescatter_and_alltoall(cpu_mesh8):
     mesh = build_mesh(MeshSpec(dp=8), cpu_mesh8)
 

@@ -1,0 +1,62 @@
+"""Train-step factory tests on the 8-device CPU mesh.
+
+Covers the VERDICT-flagged weakness: optimizer state must be explicitly
+sharded to mirror params (mu/nu FSDP/TP-sharded, counters replicated) —
+``jax.jit`` alone guarantees no such layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models import LLAMA_CONFIGS, init_params, lm_loss, param_logical_axes
+from ray_tpu.parallel import MeshSpec, build_mesh
+from ray_tpu.train import make_train_step
+
+CFG = LLAMA_CONFIGS["tiny"]
+
+
+def _setup(mesh):
+    optimizer = optax.adamw(1e-3)
+    init_fn, step_fn, place_batch = make_train_step(
+        lambda p, b: lm_loss(p, b, CFG, mesh=mesh),
+        optimizer, mesh, param_logical_axes(CFG))
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    state = init_fn(params)
+    return state, step_fn, place_batch
+
+
+def test_opt_state_mirrors_param_sharding(cpu_mesh8):
+    mesh = build_mesh(MeshSpec(fsdp=4, tp=2), cpu_mesh8)
+    state, _, _ = _setup(mesh)
+
+    param_sh = jax.tree.map(lambda p: p.sharding, state.params)
+    # Every Adam moment leaf must carry exactly its param's sharding.
+    mu = state.opt_state[0].mu
+    nu = state.opt_state[0].nu
+    for moments in (mu, nu):
+        shardings = jax.tree.map(lambda m: m.sharding, moments)
+        flat_m, _ = jax.tree.flatten(shardings)
+        flat_p, _ = jax.tree.flatten(param_sh)
+        assert len(flat_m) == len(flat_p)
+        for sm, sp in zip(flat_m, flat_p):
+            assert sm == sp, f"moment sharding {sm} != param sharding {sp}"
+    # Step counter replicates.
+    count = state.opt_state[0].count
+    assert count.sharding.is_fully_replicated
+
+
+def test_train_step_loss_decreases(cpu_mesh8):
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2), cpu_mesh8)
+    state, step_fn, place_batch = _setup(mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, CFG.vocab)
+    batch = place_batch({"tokens": tokens})
+    losses = []
+    for _ in range(5):
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    # Re-fitting the same batch must reduce loss.
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 5
