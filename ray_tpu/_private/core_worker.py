@@ -275,6 +275,12 @@ class CoreWorker:
             reply = await self.raylet.call("wait_objects", {
                 "object_ids": missing, "num_returns": len(missing), "timeout": timeout,
             })
+            lost = reply.get("lost", [])
+            if lost:
+                recovered = await self._try_recover(lost)
+                if not recovered:
+                    raise exc.ObjectLostError(lost[0])
+                return await self._get(oids, timeout)
             if len(reply["ready"]) < len(missing):
                 raise exc.GetTimeoutError(
                     f"Get timed out: {len(missing) - len(reply['ready'])} object(s) not ready")
@@ -282,6 +288,11 @@ class CoreWorker:
         for oid in oids:
             out.append(self._load_object(oid))
         return out
+
+    async def _try_recover(self, oids: List[ObjectID]) -> bool:
+        """Lineage reconstruction hook (ref: object_recovery_manager.h).
+        Wired in the object-recovery milestone; False = unrecoverable."""
+        return False
 
     def _load_object(self, oid: ObjectID) -> Any:
         data = self.memory_store.get(oid)
@@ -319,7 +330,10 @@ class CoreWorker:
             "num_returns": num_returns - len(local_ready),
             "timeout": timeout,
         })
-        return local_ready + reply["ready"]
+        # lost objects count as ready: their get() surfaces ObjectLostError
+        # (matches the reference, where a failed reconstruction stores an
+        # error object) — and keeps wait-loops from spinning hot on them
+        return local_ready + reply["ready"] + reply.get("lost", [])
 
     def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()

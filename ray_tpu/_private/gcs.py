@@ -24,7 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from .ids import ActorID, JobID, NodeID, PlacementGroupID
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
 from .rpc import RpcServer, ServerConnection
 
 # Actor lifecycle states (ref: gcs.proto ActorTableData.ActorState)
@@ -120,8 +120,10 @@ class Storage:
 
 
 class GcsServer:
-    def __init__(self, socket_path: str, journal_path: Optional[str] = None):
-        self.server = RpcServer(socket_path, name="gcs")
+    def __init__(self, socket_path: str, journal_path: Optional[str] = None,
+                 advertise_host: Optional[str] = None):
+        self.server = RpcServer(socket_path, name="gcs",
+                                advertise_host=advertise_host)
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
         self.storage = Storage(journal_path)
@@ -130,6 +132,11 @@ class GcsServer:
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
         self.jobs: Dict[JobID, dict] = {}
         self.placement_groups: Dict[PlacementGroupID, dict] = {}
+        # object directory: oid -> set of node ids holding a sealed copy
+        # (the ownership-based-object-directory role, ref:
+        # src/ray/object_manager/ownership_based_object_directory.h — here the
+        # GCS keeps the authoritative location view; owners cache it)
+        self.object_locations: Dict[ObjectID, Set[NodeID]] = {}
         # pubsub: channel -> set of subscribed connections
         self._subs: Dict[str, Set[ServerConnection]] = {}
         self._node_conns: Dict[ServerConnection, NodeID] = {}
@@ -193,6 +200,16 @@ class GcsServer:
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION):
                 await self._actor_failed(actor, f"node {node_id} died: {reason}")
+        # Objects whose last sealed copy lived on the dead node are lost;
+        # consumers surface ObjectLostError (or reconstruct via lineage).
+        lost = []
+        for oid, nodes in list(self.object_locations.items()):
+            nodes.discard(node_id)
+            if not nodes:
+                del self.object_locations[oid]
+                lost.append(oid)
+        for oid in lost:
+            await self._publish("object", {"event": "lost", "object_id": oid})
 
     # ---- jobs ----
     async def handle_register_job(self, payload, conn):
@@ -319,6 +336,34 @@ class GcsServer:
 
     async def handle_get_placement_group(self, payload, conn):
         return self.placement_groups.get(payload["pg_id"])
+
+    # ---- object directory ----
+    async def handle_add_object_location(self, payload, conn):
+        self.object_locations.setdefault(payload["object_id"], set()).add(payload["node_id"])
+        return True
+
+    async def handle_remove_object_location(self, payload, conn):
+        """Drop one node's copy (evicted/freed/stale). The last copy vanishing
+        via eviction is NOT a loss event — the object may be recreated; loss is
+        declared only on node death (see _mark_node_dead)."""
+        nodes = self.object_locations.get(payload["object_id"])
+        if nodes is not None:
+            nodes.discard(payload["node_id"])
+            if not nodes:
+                del self.object_locations[payload["object_id"]]
+        return True
+
+    async def handle_get_object_locations(self, payload, conn):
+        """oid -> [(node_id, raylet_address)] for live holders."""
+        out = {}
+        for oid in payload["object_ids"]:
+            holders = []
+            for node_id in self.object_locations.get(oid, ()):
+                info = self.nodes.get(node_id)
+                if info is not None and info.alive:
+                    holders.append((node_id, info.address))
+            out[oid] = holders
+        return out
 
     # ---- health / introspection ----
     async def handle_ping(self, payload, conn):

@@ -61,7 +61,14 @@ class Node:
         labels: Optional[Dict[str, str]] = None,
         io: Optional[EventLoopThread] = None,
         object_store_memory: Optional[int] = None,
+        port: Optional[int] = None,
+        node_ip: Optional[str] = None,
     ):
+        """``port``: bind the head GCS on TCP (0 = ephemeral) so worker nodes
+        on other hosts can join over DCN; default is a unix socket
+        (single-host). ``node_ip``: the routable IP this node advertises to
+        peers (TCP binds listen on 0.0.0.0); defaults to loopback, which is
+        correct for single-host test clusters only."""
         self.head = head
         cfg = global_config()
         if head:
@@ -74,19 +81,33 @@ class Node:
         self.session_dir = os.path.join(_TEMP_ROOT, self.session_name)
         os.makedirs(self.session_dir, exist_ok=True)
         self.node_id = NodeID.from_random()
-        self.gcs_address = gcs_address or os.path.join(self.session_dir, "gcs.sock")
-        self.raylet_address = os.path.join(
-            self.session_dir, f"raylet_{self.node_id.hex()[:12]}.sock")
+        self.node_ip = node_ip or "127.0.0.1"
+        if gcs_address:
+            self.gcs_address = gcs_address
+        elif port is not None:
+            self.gcs_address = f"0.0.0.0:{port}"  # advertised via node_ip
+        else:
+            self.gcs_address = os.path.join(self.session_dir, "gcs.sock")
+        tcp_mode = port is not None or (gcs_address and "/" not in gcs_address)
+        if tcp_mode:
+            self.raylet_address = "0.0.0.0:0"     # ephemeral, all interfaces
+        else:
+            self.raylet_address = os.path.join(
+                self.session_dir, f"raylet_{self.node_id.hex()[:12]}.sock")
         self.io = io or EventLoopThread(name="ray_tpu_node")
         self._owns_io = io is None
 
+        # Each node owns a distinct store namespace; cross-node access rides
+        # the raylet pull path (a same-host shortcut would mask transfer bugs
+        # in the multi-node test harness, ref: cluster_utils.py:135).
         self.store = SharedObjectStore(
-            self.session_name,
+            os.path.join(self.session_name, f"node_{self.node_id.hex()[:12]}"),
             object_store_memory or cfg.object_store_memory_bytes,
         )
         self.gcs_server: Optional[GcsServer] = None
         if head:
-            self.gcs_server = GcsServer(self.gcs_address)
+            self.gcs_server = GcsServer(self.gcs_address,
+                                        advertise_host=self.node_ip)
         self.raylet = Raylet(
             node_id=self.node_id,
             session_name=self.session_name,
@@ -95,6 +116,7 @@ class Node:
             resources=resources or default_resources(),
             store=self.store,
             labels=labels,
+            advertise_host=self.node_ip,
         )
         self._started = False
 
@@ -102,7 +124,10 @@ class Node:
         async def _start():
             if self.gcs_server is not None:
                 await self.gcs_server.start()
+                self.gcs_address = self.gcs_server.server.address
+                self.raylet.gcs_address = self.gcs_address
             await self.raylet.start()
+            self.raylet_address = self.raylet.server.address
 
         self.io.run(_start(), timeout=30)
         self._started = True
@@ -123,6 +148,22 @@ class Node:
             pass
         if self._owns_io:
             self.io.stop()
+        self.store.destroy()
         if self.head:
-            self.store.destroy()
+            # whole-session cleanup: worker nodes' store namespaces too
+            shutil.rmtree(os.path.join("/dev/shm", self.session_name),
+                          ignore_errors=True)
             shutil.rmtree(self.session_dir, ignore_errors=True)
+
+    def die(self):
+        """Abrupt node death (fault injection): kill workers + drop
+        connections; no graceful unregister, no store cleanup."""
+        if not self._started:
+            return
+        self._started = False
+        try:
+            self.io.run(self.raylet.die(), timeout=10)
+        except Exception:
+            pass
+        if self._owns_io:
+            self.io.stop()

@@ -52,7 +52,10 @@ class SharedObjectStore:
     metadata substrate, the node manager is the authority on existence."""
 
     def __init__(self, session_name: str, capacity_bytes: int, create_dir: bool = True):
-        self.dir = os.path.join(_SHM_ROOT, session_name)
+        # session_name may be a relative namespace (placed under /dev/shm) or
+        # an absolute store directory (worker processes inherit their node's)
+        self.dir = session_name if session_name.startswith("/") \
+            else os.path.join(_SHM_ROOT, session_name)
         self.capacity = capacity_bytes
         if create_dir:
             os.makedirs(self.dir, exist_ok=True)

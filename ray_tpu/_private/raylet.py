@@ -106,6 +106,7 @@ class Raylet:
         resources: Dict[str, float],
         store: SharedObjectStore,
         labels: Optional[Dict[str, str]] = None,
+        advertise_host: Optional[str] = None,
     ):
         self.node_id = node_id
         self.session_name = session_name
@@ -114,10 +115,12 @@ class Raylet:
         self.labels = labels or {}
         self.store = store
         self.resources = NodeResources(resources)
-        self.server = RpcServer(socket_path, name=f"raylet-{node_id.hex()[:8]}")
+        self.server = RpcServer(socket_path, name=f"raylet-{node_id.hex()[:8]}",
+                                advertise_host=advertise_host)
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
-        self.gcs = RpcClient(gcs_address)
+        # constructed in start() from the (possibly port-resolved) gcs_address
+        self.gcs: RpcClient = None  # type: ignore[assignment]
 
         cfg = global_config()
         self.cfg = cfg
@@ -135,6 +138,11 @@ class Raylet:
         # object directory + wait manager
         self._sealed: Dict[ObjectID, int] = {}          # oid -> size
         self._object_waiters: Dict[ObjectID, List[asyncio.Future]] = {}
+        self._lost_objects: Set[ObjectID] = set()
+        # inter-node object transfer (ref: object_manager/pull_manager.h:57,
+        # push_manager.h:32 — chunked transfer over the control transport)
+        self._pulls_in_flight: Dict[ObjectID, asyncio.Task] = {}
+        self._peer_clients: Dict[str, RpcClient] = {}
         # cluster view (for spillback) — node_id -> (address, available)
         self._remote_nodes: Dict[NodeID, Tuple[str, ResourceSet]] = {}
         self._worker_conns: Dict[ServerConnection, WorkerID] = {}
@@ -145,12 +153,15 @@ class Raylet:
     # ------------------------------------------------------------------ setup
     async def start(self):
         await self.server.start()
+        self.socket_path = self.server.address  # resolved (TCP port 0)
+        self.gcs = RpcClient(self.gcs_address)
         await self.gcs.connect()
         self.gcs.on_push("pubsub:resources", self._on_remote_resources)
         self.gcs.on_push("pubsub:node", self._on_node_event)
+        self.gcs.on_push("pubsub:object", self._on_object_event)
         reply = await self.gcs.call("register_node", {
             "node_id": self.node_id,
-            "address": self.socket_path,
+            "address": self.server.address,
             "resources_total": self.resources.total.to_dict(),
             "resources_available": self.resources.available.to_dict(),
             "labels": self.labels,
@@ -160,7 +171,7 @@ class Raylet:
         for info in reply["nodes"]:
             if info.node_id != self.node_id and info.alive:
                 self._remote_nodes[info.node_id] = (info.address, ResourceSet(info.resources_available))
-        await self.gcs.call("subscribe", {"channels": ["resources", "node"]})
+        await self.gcs.call("subscribe", {"channels": ["resources", "node", "object"]})
         if self.cfg.prestart_workers:
             for _ in range(min(2, self.max_workers)):
                 self._spawn_worker()
@@ -171,6 +182,8 @@ class Raylet:
                 await worker.conn.push("shutdown", {})
         await self.server.stop()
         await self.gcs.close()
+        for client in self._peer_clients.values():
+            await client.close()
         for proc in self._subprocs:
             try:
                 proc.terminate()
@@ -185,6 +198,22 @@ class Raylet:
                     proc.kill()
                 except Exception:
                     pass
+
+    async def die(self):
+        """Abrupt node death for fault-injection tests (the cluster_utils
+        `remove_node` analog): SIGKILL workers, drop connections ungracefully
+        so the GCS health path — not a clean unregister — detects it."""
+        for proc in self._subprocs:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        # drop the GCS connection first — that's the death signal the GCS
+        # health path turns into node-dead + object-lost events
+        await self.gcs.close()
+        await self.server.stop()
+        for client in self._peer_clients.values():
+            await client.close()
 
     def _on_remote_resources(self, payload):
         node_id, avail = payload["node_id"], payload["available"]
@@ -224,6 +253,7 @@ class Raylet:
         env["RAY_TPU_RAYLET_SOCKET"] = self.socket_path
         env["RAY_TPU_GCS_SOCKET"] = self.gcs_address
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_STORE_DIR"] = self.store.dir
         # Pool workers run CPU-only jax: skip the TPU PJRT bootstrap entirely
         # (it imports jax at interpreter start, ~2s). Dedicated TPU workers
         # (mesh actor groups) are spawned with the device env preserved.
@@ -467,46 +497,179 @@ class Raylet:
         return True
 
     # ------------------------------------------------------- object directory
-    async def handle_object_sealed(self, payload, conn):
-        oid, size = payload["object_id"], payload["size"]
+    def _mark_local_sealed(self, oid: ObjectID, size: int) -> None:
         self._sealed[oid] = size
+        self._lost_objects.discard(oid)
         for fut in self._object_waiters.pop(oid, []):
             if not fut.done():
                 fut.set_result(True)
+
+    async def handle_object_sealed(self, payload, conn):
+        oid, size = payload["object_id"], payload["size"]
+        self._mark_local_sealed(oid, size)
+        asyncio.ensure_future(self._report_location(oid))
         return True
 
+    async def _report_location(self, oid: ObjectID):
+        try:
+            await self.gcs.call("add_object_location", {
+                "object_id": oid, "node_id": self.node_id})
+        except Exception:
+            pass
+
+    async def _drop_location(self, oid: ObjectID):
+        try:
+            await self.gcs.call("remove_object_location", {
+                "object_id": oid, "node_id": self.node_id})
+        except Exception:
+            pass
+
+    def _on_object_event(self, payload):
+        if payload.get("event") != "lost":
+            return
+        oid = payload["object_id"]
+        if self.store.contains(oid):
+            return  # we hold a copy; not lost here
+        self._lost_objects.add(oid)
+        for fut in self._object_waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(False)  # False = lost
+
+    # ------------------------------------------------ inter-node object pull
+    async def _peer_client(self, address: str) -> RpcClient:
+        client = self._peer_clients.get(address)
+        if client is None or client.closed:
+            client = RpcClient(address)
+            await client.connect(timeout=10)
+            self._peer_clients[address] = client
+        return client
+
+    def _start_pull(self, oid: ObjectID) -> None:
+        """Idempotently kick off a background pull of oid to the local store
+        (ref: pull_manager.h:57 — retries while there are active waiters)."""
+        task = self._pulls_in_flight.get(oid)
+        if task is not None and not task.done():
+            return
+        self._pulls_in_flight[oid] = asyncio.ensure_future(self._pull(oid))
+
+    async def _pull(self, oid: ObjectID) -> None:
+        try:
+            backoff = 0.02
+            while True:
+                if self.store.contains(oid) or oid in self._lost_objects:
+                    return
+                if oid not in self._object_waiters:
+                    return  # nobody waiting anymore
+                try:
+                    locs = await self.gcs.call(
+                        "get_object_locations", {"object_ids": [oid]})
+                except Exception:
+                    locs = {oid: []}
+                for node_id, address in locs.get(oid, []):
+                    if node_id == self.node_id:
+                        continue
+                    try:
+                        if await self._fetch_from(oid, address):
+                            self._mark_local_sealed(oid, self._sealed.get(oid, 0))
+                            asyncio.ensure_future(self._report_location(oid))
+                            return
+                        # holder no longer has it: drop the stale location
+                        await self.gcs.call("remove_object_location", {
+                            "object_id": oid, "node_id": node_id})
+                    except Exception:
+                        continue
+                await asyncio.sleep(backoff)
+                # cap grows to 2s: pending-local objects (task still running
+                # here) shouldn't hammer the GCS with location polls
+                backoff = min(2.0, backoff * 2)
+        finally:
+            self._pulls_in_flight.pop(oid, None)
+
+    async def _fetch_from(self, oid: ObjectID, address: str) -> bool:
+        """Chunked fetch of a sealed object from a peer raylet into the local
+        store. Returns False if the peer no longer holds the object."""
+        client = await self._peer_client(address)
+        chunk = self.cfg.object_transfer_chunk_bytes
+        first = await client.call("pull_object", {
+            "object_id": oid, "offset": 0, "length": chunk}, timeout=60)
+        if first is None:
+            return False
+        size = first["size"]
+        if self.store.contains(oid):
+            return True
+        buf = self.store.create(oid, size)
+        try:
+            data = first["data"]
+            buf[: len(data)] = data
+            offset = len(data)
+            while offset < size:
+                part = await client.call("pull_object", {
+                    "object_id": oid, "offset": offset, "length": chunk}, timeout=60)
+                if part is None:
+                    raise ConnectionError("holder dropped object mid-transfer")
+                pdata = part["data"]
+                buf[offset: offset + len(pdata)] = pdata
+                offset += len(pdata)
+        except BaseException:
+            self.store.abort(oid)
+            raise
+        self.store.seal(oid)
+        self._sealed[oid] = size
+        return True
+
+    async def handle_forget_lost(self, payload, conn):
+        """Clear lost markers so a recovery attempt (lineage reconstruction
+        re-creating the object elsewhere) can be awaited afresh; without this
+        the lost flag is sticky and recovery could never be observed."""
+        for oid in payload["object_ids"]:
+            self._lost_objects.discard(oid)
+        return True
+
+    async def handle_pull_object(self, payload, conn):
+        """Serve one chunk of a sealed local object to a peer raylet
+        (ref: push_manager.h:32 — chunked sends on the control transport)."""
+        oid = payload["object_id"]
+        view = self.store.get(oid)
+        if view is None:
+            return None
+        offset, length = payload["offset"], payload["length"]
+        return {"size": len(view), "data": bytes(view[offset: offset + length])}
+
     async def handle_wait_objects(self, payload, conn):
-        """Block until `num_returns` of `object_ids` are sealed locally or
-        timeout (ref: wait_manager.h)."""
+        """Block until `num_returns` of `object_ids` are sealed locally, an
+        object is declared lost cluster-wide, or timeout (ref: wait_manager.h).
+        Missing objects trigger background pulls from remote holders."""
         oids: List[ObjectID] = payload["object_ids"]
         num_returns = payload.get("num_returns", len(oids))
         timeout = payload.get("timeout")
         # the store is authoritative: a directory entry whose file was evicted
         # must not be reported ready (get would ObjectLostError)
-        ready = []
+        ready, lost = [], []
         for oid in oids:
             if self.store.contains(oid):
                 self._sealed.setdefault(oid, 0)
                 ready.append(oid)
+            elif oid in self._lost_objects:
+                lost.append(oid)
             else:
                 self._sealed.pop(oid, None)
-        if len(ready) >= num_returns:
-            return {"ready": ready[:num_returns] if payload.get("trim", False) else ready}
+        if len(ready) >= num_returns or len(ready) + len(lost) >= len(oids):
+            return {"ready": ready, "lost": lost}
         futures = {}
         for oid in oids:
-            if oid not in self._sealed:
+            if oid not in self._sealed and oid not in self._lost_objects:
                 fut = asyncio.get_event_loop().create_future()
                 self._object_waiters.setdefault(oid, []).append(fut)
                 futures[oid] = fut
+                self._start_pull(oid)
         deadline = None if timeout is None else asyncio.get_event_loop().time() + timeout
-        while len(ready) < num_returns:
+        while len(ready) < num_returns and len(ready) + len(lost) < len(oids):
             remaining = None if deadline is None else max(0.0, deadline - asyncio.get_event_loop().time())
             pending = [f for f in futures.values() if not f.done()]
             if not pending:
                 break
-            # Bound each wait so we also poll the shared store: objects sealed
-            # through a co-hosted raylet land in the same tmpfs namespace but
-            # notify only their own directory.
+            # bound each wait so we also poll the local store (seal paths that
+            # bypass this raylet's directory, e.g. a co-located process)
             poll = 0.05 if remaining is None else min(0.05, remaining)
             done, _ = await asyncio.wait(pending, timeout=poll,
                                          return_when=asyncio.FIRST_COMPLETED)
@@ -515,7 +678,9 @@ class Raylet:
                     self._sealed.setdefault(oid, 0)
                     fut.set_result(True)
             ready = [oid for oid in oids if oid in self._sealed]
-            if not done and remaining is not None and remaining <= poll and len(ready) < num_returns:
+            lost = [oid for oid in oids if oid in self._lost_objects and oid not in self._sealed]
+            if not done and remaining is not None and remaining <= poll \
+                    and len(ready) < num_returns:
                 break  # timeout
         for oid, fut in futures.items():
             if not fut.done():
@@ -524,11 +689,14 @@ class Raylet:
                 except ValueError:
                     pass
                 fut.cancel()
-        return {"ready": ready}
+            if oid in self._object_waiters and not self._object_waiters[oid]:
+                del self._object_waiters[oid]
+        return {"ready": ready, "lost": lost}
 
     async def handle_free_objects(self, payload, conn):
         for oid in payload["object_ids"]:
-            self._sealed.pop(oid, None)
+            if self._sealed.pop(oid, None) is not None or self.store.contains(oid):
+                asyncio.ensure_future(self._drop_location(oid))
             self.store.delete(oid)
         return True
 

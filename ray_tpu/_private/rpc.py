@@ -1,9 +1,14 @@
-"""Control-plane RPC: asyncio message streams over unix-domain sockets.
+"""Control-plane RPC: asyncio message streams over unix or TCP sockets.
 
 TPU-native analog of the reference rpc layer (ref: src/ray/rpc/grpc_server.h:88,
 grpc_client.h:96, client_call.h:193, retryable_grpc_client.h). The control
 plane stays host-side and socket-based (gRPC-over-DCN equivalent); the device
 data plane never touches this layer — tensors move inside XLA programs.
+
+Addresses: a path ("/tmp/.../x.sock") binds a unix-domain socket (intra-host);
+"host:port" or "tcp://host:port" binds TCP (the DCN cross-host transport).
+Binding port 0 picks a free port; the server's resolved address is
+``server.address`` after ``start()``.
 
 Wire format: [u32 frame_len][pickled Frame]. A Frame is
 (msg_id, kind, method, payload) with kind in {REQUEST, REPLY, ERROR, PUSH}.
@@ -40,6 +45,16 @@ class RpcError(Exception):
 
 class ConnectionLost(RpcError):
     pass
+
+
+def parse_address(address: str):
+    """("unix", path) | ("tcp", host, port)."""
+    if address.startswith("tcp://"):
+        address = address[len("tcp://"):]
+    if "/" in address or ":" not in address:
+        return ("unix", address)
+    host, _, port = address.rpartition(":")
+    return ("tcp", host or "127.0.0.1", int(port))
 
 
 class _ChaosInjector:
@@ -119,16 +134,25 @@ class ServerConnection:
 
 
 class RpcServer:
-    """Unix-socket RPC server dispatching to registered async handlers."""
+    """Unix-or-TCP RPC server dispatching to registered async handlers."""
 
-    def __init__(self, socket_path: str, name: str = "server"):
-        self.socket_path = socket_path
+    def __init__(self, address: str, name: str = "server",
+                 advertise_host: Optional[str] = None):
+        """``advertise_host``: for TCP binds on 0.0.0.0, the routable IP
+        peers should dial (advertised in ``self.address`` after start)."""
+        self.address = address
+        self.advertise_host = advertise_host
         self.name = name
         self.handlers: Dict[str, Handler] = {}
         self.connections: set[ServerConnection] = set()
         self.on_disconnect: Optional[Callable[[ServerConnection], Awaitable[None]]] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._chaos = _ChaosInjector(global_config().testing_rpc_failure)
+
+    # back-compat alias
+    @property
+    def socket_path(self) -> str:
+        return self.address
 
     def register(self, method: str, handler: Handler) -> None:
         self.handlers[method] = handler
@@ -139,18 +163,30 @@ class RpcServer:
                 self.register(attr[len(prefix):], getattr(obj, attr))
 
     async def start(self) -> None:
-        self._server = await asyncio.start_unix_server(self._on_client, path=self.socket_path)
+        kind = parse_address(self.address)
+        if kind[0] == "unix":
+            self._server = await asyncio.start_unix_server(
+                self._on_client, path=kind[1])
+        else:
+            _, host, port = kind
+            self._server = await asyncio.start_server(self._on_client, host, port)
+            actual = self._server.sockets[0].getsockname()
+            adv = self.advertise_host or ("127.0.0.1" if host == "0.0.0.0" else host)
+            self.address = f"{adv}:{actual[1]}"
 
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            try:
-                await self._server.wait_closed()
-            except Exception:
-                pass
+        # close live connections BEFORE wait_closed: since 3.12 wait_closed
+        # blocks until every handler finishes, and handlers block on reads
         for conn in list(self.connections):
             try:
                 conn.writer.close()
+            except Exception:
+                pass
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2)
             except Exception:
                 pass
 
@@ -198,8 +234,9 @@ class RpcServer:
 class RpcClient:
     """Client with automatic request/future matching and push subscriptions."""
 
-    def __init__(self, socket_path: str):
-        self.socket_path = socket_path
+    def __init__(self, address: str):
+        self.address = address
+        self.socket_path = address  # back-compat alias
         self._reader = None
         self._writer = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -214,14 +251,19 @@ class RpcClient:
 
     async def connect(self, timeout: float = 30.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
+        kind = parse_address(self.address)
         while True:
             try:
-                self._reader, self._writer = await asyncio.open_unix_connection(self.socket_path)
+                if kind[0] == "unix":
+                    self._reader, self._writer = await asyncio.open_unix_connection(kind[1])
+                else:
+                    self._reader, self._writer = await asyncio.open_connection(kind[1], kind[2])
                 break
             except (ConnectionError, FileNotFoundError, OSError):
                 if asyncio.get_event_loop().time() > deadline:
-                    raise ConnectionLost(f"cannot connect to {self.socket_path}")
+                    raise ConnectionLost(f"cannot connect to {self.address}")
                 await asyncio.sleep(0.05)
+        self.closed = False
         self._recv_task = asyncio.ensure_future(self._recv_loop())
 
     async def _recv_loop(self):

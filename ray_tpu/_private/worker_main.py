@@ -165,10 +165,14 @@ async def _amain():
     worker_id = WorkerID.from_random()
     cfg = global_config()
 
-    session_dir = os.path.dirname(raylet_socket)
-    my_socket = os.path.join(session_dir, f"worker_{worker_id.hex()[:16]}.sock")
+    if "/" in raylet_socket:
+        session_dir = os.path.dirname(raylet_socket)
+        my_socket = os.path.join(session_dir, f"worker_{worker_id.hex()[:16]}.sock")
+    else:
+        my_socket = "127.0.0.1:0"  # TCP node: serve on an ephemeral port
 
-    store = SharedObjectStore(session, cfg.object_store_memory_bytes, create_dir=False)
+    store_ns = os.environ.get("RAY_TPU_STORE_DIR", session)
+    store = SharedObjectStore(store_ns, cfg.object_store_memory_bytes, create_dir=False)
     # the core worker shares this process's running loop
     from .rpc import EventLoopThread
 
@@ -258,6 +262,8 @@ async def _amain():
     server.register("kill_self", handle_kill_self)
     server.register("health", handle_health)
     await server.start()
+    my_socket = server.address  # resolved (TCP port 0)
+    core.address = my_socket
 
     # register with raylet last — once registered, tasks may arrive
     raylet.on_push("shutdown", lambda payload: shutdown_event.set())

@@ -62,9 +62,20 @@ def init(
 
         full = default_resources()
         full.update(res)
-        _node = Node(head=True, resources=full, labels=labels,
-                     object_store_memory=object_store_memory)
-        _node.start()
+        node = Node(head=True, resources=full, labels=labels,
+                    object_store_memory=object_store_memory)
+        node.start()
+        return _connect_to_node(node)
+
+
+def _connect_to_node(started_node: Node) -> Dict[str, Any]:
+    """Attach this process as a driver of an already-started node
+    (the cluster_utils / ray.init(address=...) path)."""
+    global _node, _core
+    with _lock:
+        if _core is not None:
+            raise RuntimeError("driver already connected")
+        _node = started_node
         _core = CoreWorker(
             mode="driver",
             session_name=_node.session_name,
