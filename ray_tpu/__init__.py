@@ -31,6 +31,7 @@ from ._worker_api import (
 )
 from .actor import ActorClass, ActorHandle
 from .remote_function import RemoteFunction
+from . import util
 
 __version__ = "0.1.0"
 
@@ -38,7 +39,7 @@ _OPTION_KEYS = {
     "num_cpus", "num_tpus", "num_returns", "resources", "max_retries",
     "retry_exceptions", "max_restarts", "max_task_retries", "max_concurrency",
     "name", "namespace", "scheduling_strategy", "runtime_env", "lifetime",
-    "placement_group",
+    "placement_group", "placement_group_bundle_index",
 }
 
 
@@ -78,5 +79,5 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method",
     "get", "put", "wait", "kill", "cancel", "get_actor",
     "cluster_resources", "available_resources", "nodes",
-    "exceptions", "__version__",
+    "util", "exceptions", "__version__",
 ]

@@ -44,6 +44,8 @@ class Config:
     # --- workers ---
     num_workers_soft_limit: int = -1          # -1: num_cpus
     worker_startup_timeout_s: float = 60.0
+    # dialing an already-registered worker (its RPC server is live): short
+    worker_dial_timeout_s: float = 3.0
     worker_register_timeout_s: float = 30.0
     idle_worker_killing_time_threshold_ms: int = 800
     prestart_workers: bool = True

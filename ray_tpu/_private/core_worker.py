@@ -131,6 +131,8 @@ class CoreWorker:
         # lineage: resubmittable specs for owned objects (recorded, replayed by
         # the recovery manager milestone)
         self._lineage: Dict[TaskID, TaskSpec] = {}
+        self._pg_rr = 0  # round-robin over bundles for wildcard PG leases
+        self._pg_cache: Dict[Any, list] = {}  # pg_id -> bundle (node, addr)
         self.address = ""  # worker-mode processes set their push address
 
         _set_ref_registry(self)
@@ -395,6 +397,25 @@ class CoreWorker:
         return packed, dep_ids
 
     @staticmethod
+    def _resolve_strategy(opts: dict):
+        """scheduling_strategy option, with the `placement_group=` shorthand
+        folded in (ref: ray_option_utils.py placement-group option group)."""
+        strategy = opts.get("scheduling_strategy")
+        pg = opts.get("placement_group")
+        if strategy is not None:
+            if pg is not None:
+                raise ValueError(
+                    "placement_group= and scheduling_strategy= are mutually "
+                    "exclusive; put the group in the strategy")
+            return strategy
+        if pg is not None:
+            return PlacementGroupSchedulingStrategy(
+                placement_group_id=getattr(pg, "id", pg),
+                placement_group_bundle_index=opts.get(
+                    "placement_group_bundle_index", -1))
+        return DefaultSchedulingStrategy()
+
+    @staticmethod
     def _build_resources(opts: dict) -> ResourceSet:
         res = dict(opts.get("resources") or {})
         if opts.get("num_cpus") is not None:
@@ -417,7 +438,7 @@ class CoreWorker:
             args=packed,
             num_returns=num_returns,
             resources=self._build_resources(opts),
-            scheduling_strategy=opts.get("scheduling_strategy") or DefaultSchedulingStrategy(),
+            scheduling_strategy=self._resolve_strategy(opts),
             max_retries=opts.get("max_retries", self.cfg.task_max_retries_default),
             retry_exceptions=opts.get("retry_exceptions", False),
             owner_address=self.address,
@@ -494,15 +515,59 @@ class CoreWorker:
             "owner_address": self.address,
             "actor_id": spec.actor_id if spec.actor_creation else None,
         }
-        raylet = self.raylet
-        for _ in range(16):  # bounded spillback chain
-            reply = await raylet.call("request_worker_lease", payload)
-            if reply.get("granted"):
-                reply["_raylet"] = raylet
-                return reply
-            node_id, address = reply["retry_at"]
-            raylet = await self._raylet_client_for(address)
-        raise exc.RayTpuError("lease spillback chain too long")
+        strategy = spec.scheduling_strategy
+        pg_strategy = (isinstance(strategy, PlacementGroupSchedulingStrategy)
+                       and strategy.placement_group_id is not None)
+        for pg_attempt in range(8):
+            raylet = self.raylet
+            if pg_strategy:
+                address = await self._pg_bundle_address(strategy)
+                raylet = await self._raylet_client_for(address)
+            try:
+                for _ in range(16):  # bounded spillback chain
+                    reply = await raylet.call("request_worker_lease", payload)
+                    if reply.get("granted"):
+                        reply["_raylet"] = raylet
+                        return reply
+                    node_id, address = reply["retry_at"]
+                    raylet = await self._raylet_client_for(address)
+                raise exc.RayTpuError("lease spillback chain too long")
+            except (ValueError, ConnectionLost):
+                # the bundle moved (node died, PG rescheduling) between the
+                # directory lookup and the lease request — re-resolve
+                if not pg_strategy:
+                    raise
+                self._pg_cache.pop(strategy.placement_group_id, None)
+                await asyncio.sleep(0.05 * (pg_attempt + 1))
+        raise exc.RayTpuError(
+            f"could not lease into placement group "
+            f"{strategy.placement_group_id} (bundle unavailable)")
+
+    async def _pg_bundle_address(self, strategy) -> str:
+        """Resolve the raylet address of the bundle the lease targets,
+        blocking until the PG is reserved (this is what makes `pg.ready()` —
+        a trivial task scheduled into the PG — resolve exactly when the
+        reservation lands, matching the reference's
+        bundle_reservation_check_func trick)."""
+        nodes = self._pg_cache.get(strategy.placement_group_id)
+        if nodes is None:
+            reply = await self.gcs.call("wait_placement_group_ready", {
+                "pg_id": strategy.placement_group_id})
+            if reply["status"] != "ready":
+                raise exc.RayTpuError(
+                    f"placement group {strategy.placement_group_id} was removed")
+            nodes = reply["bundle_nodes"]
+            # cached so steady-state submissions skip the GCS hop; the lease
+            # retry path invalidates on ValueError/ConnectionLost
+            self._pg_cache[strategy.placement_group_id] = nodes
+        index = strategy.placement_group_bundle_index
+        if index >= 0:
+            if index >= len(nodes):
+                raise ValueError(
+                    f"bundle index {index} out of range ({len(nodes)} bundles)")
+            return nodes[index][1]
+        self._pg_rr += 1
+        return nodes[self._pg_rr % len(nodes)][1]
 
     async def _release_lease(self, pool: _LeasePool, grant: dict, spec: TaskSpec,
                              reusable: bool):
@@ -551,7 +616,11 @@ class CoreWorker:
 
         async def _make():
             client = RpcClient(address)
-            await client.connect(timeout=self.cfg.worker_startup_timeout_s)
+            # target workers are already registered (their server is up), so a
+            # dead socket means death, not startup: fail fast so in-flight
+            # actor calls surface ActorDiedError promptly instead of burning
+            # the whole startup window re-dialing a corpse
+            await client.connect(timeout=self.cfg.worker_dial_timeout_s)
             return client
 
         task = asyncio.ensure_future(_make())
@@ -586,7 +655,7 @@ class CoreWorker:
             args=packed,
             num_returns=0,
             resources=self._build_resources(opts),
-            scheduling_strategy=opts.get("scheduling_strategy") or DefaultSchedulingStrategy(),
+            scheduling_strategy=self._resolve_strategy(opts),
             actor_id=actor_id,
             actor_creation=True,
             actor_max_restarts=opts.get("max_restarts", self.cfg.actor_max_restarts_default),
@@ -736,6 +805,35 @@ class CoreWorker:
         finally:
             for oid in deps:
                 self._unpin_task_dep(oid)
+
+    # ---------------------------------------------------- placement groups
+    def create_placement_group(self, bundles: List[Dict[str, float]],
+                               strategy: str, name: str = "") -> "PlacementGroupID":
+        from .ids import PlacementGroupID
+
+        pg_id = PlacementGroupID.of(self.job_id)
+        self.io.run(self.gcs.call("create_placement_group", {
+            "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+            "name": name,
+        }))
+        return pg_id
+
+    def remove_placement_group(self, pg_id) -> None:
+        self.io.run(self.gcs.call("remove_placement_group", {"pg_id": pg_id}))
+
+    def wait_placement_group(self, pg_id, timeout: Optional[float]) -> bool:
+        reply = self.io.run(
+            self.gcs.call("wait_placement_group_ready",
+                          {"pg_id": pg_id, "timeout": timeout}),
+            timeout=None if timeout is None else timeout + 30)
+        return reply["status"] == "ready"
+
+    def get_placement_group_info(self, pg_id=None, name: str = "") -> Optional[dict]:
+        payload = {"pg_id": pg_id} if pg_id is not None else {"name": name}
+        return self.io.run(self.gcs.call("get_placement_group", payload))
+
+    def list_placement_groups(self) -> List[dict]:
+        return self.io.run(self.gcs.call("list_placement_groups", {}))
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         async def _kill():

@@ -132,6 +132,9 @@ class GcsServer:
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
         self.jobs: Dict[JobID, dict] = {}
         self.placement_groups: Dict[PlacementGroupID, dict] = {}
+        self._pg_tasks: Dict[PlacementGroupID, asyncio.Task] = {}
+        self._pg_raylet_clients: Dict[str, Any] = {}
+        self._pg_waiters: Dict[PlacementGroupID, List[asyncio.Future]] = {}
         # object directory: oid -> set of node ids holding a sealed copy
         # (the ownership-based-object-directory role, ref:
         # src/ray/object_manager/ownership_based_object_directory.h — here the
@@ -146,6 +149,13 @@ class GcsServer:
         await self.server.start()
 
     async def stop(self):
+        for task in list(self._pg_tasks.values()):
+            task.cancel()
+        for client in self._pg_raylet_clients.values():
+            try:
+                await client.close()
+            except Exception:
+                pass
         await self.server.stop()
         self.storage.close()
 
@@ -210,6 +220,18 @@ class GcsServer:
                 lost.append(oid)
         for oid in lost:
             await self._publish("object", {"event": "lost", "object_id": oid})
+        # Bundles reserved on the dead node are gone: put their placement
+        # groups back on the scheduler to re-reserve elsewhere (ref:
+        # gcs_placement_group_manager OnNodeDead -> RESCHEDULING)
+        for pg in self.placement_groups.values():
+            hit = [i for i, nid in enumerate(pg["bundle_nodes"]) if nid == node_id]
+            if hit:
+                for i in hit:
+                    pg["bundle_nodes"][i] = None
+                if pg["state"] == "CREATED":
+                    pg["state"] = "RESCHEDULING"
+                await self._publish("placement_group", pg)
+                self._kick_pg_scheduler(pg["pg_id"])
 
     # ---- jobs ----
     async def handle_register_job(self, payload, conn):
@@ -308,34 +330,252 @@ class GcsServer:
     async def handle_list_actors(self, payload, conn):
         return list(self.actors.values())
 
-    # ---- placement groups (ref: gcs_placement_group_manager.h) ----
+    # ---- placement groups (ref: gcs_placement_group_manager.h +
+    #      gcs_placement_group_scheduler.h: the GCS owns bundle placement and
+    #      drives the raylets' two-phase reserve/commit protocol) ----
     async def handle_create_placement_group(self, payload, conn):
         pg_id = payload["pg_id"]
+        bundles = payload["bundles"]
+        if not bundles or any(not b for b in bundles):
+            raise ValueError("placement group bundles must be non-empty dicts")
         self.placement_groups[pg_id] = {
-            "pg_id": pg_id, "bundles": payload["bundles"],
-            "strategy": payload["strategy"], "state": "PENDING", "name": payload.get("name", ""),
-            "bundle_nodes": [],
+            "pg_id": pg_id, "bundles": bundles,
+            "strategy": payload["strategy"], "state": "PENDING",
+            "name": payload.get("name", ""),
+            # one entry per bundle: NodeID once reserved, None while pending
+            "bundle_nodes": [None] * len(bundles),
         }
         await self._publish("placement_group", self.placement_groups[pg_id])
+        self._kick_pg_scheduler(pg_id)
         return True
 
-    async def handle_placement_group_ready(self, payload, conn):
-        pg = self.placement_groups.get(payload["pg_id"])
-        if pg is not None:
-            pg["state"] = "CREATED"
-            pg["bundle_nodes"] = payload["bundle_nodes"]
-            await self._publish("placement_group", pg)
-        return True
+    def _kick_pg_scheduler(self, pg_id: PlacementGroupID) -> None:
+        task = self._pg_tasks.get(pg_id)
+        if task is not None and not task.done():
+            return
+        self._pg_tasks[pg_id] = asyncio.ensure_future(self._schedule_pg_loop(pg_id))
+
+    async def _schedule_pg_loop(self, pg_id: PlacementGroupID) -> None:
+        """Retry placement until the PG is fully reserved or removed (ref:
+        gcs_placement_group_manager.h pending queue + retry on resource change;
+        here a per-PG task with a short poll — cluster views are tiny)."""
+        try:
+            while True:
+                pg = self.placement_groups.get(pg_id)
+                if pg is None or pg["state"] in ("CREATED", "REMOVED"):
+                    return
+                ok = await self._try_schedule_pg(pg)
+                if self.placement_groups.get(pg_id) is not pg:
+                    # removed while the 2PC was in flight: the remove handler
+                    # could not see these fresh reservations — roll them back
+                    # here so no raylet resources leak
+                    for i, nid in enumerate(pg["bundle_nodes"]):
+                        if nid is not None:
+                            await self._cancel_bundle(pg_id, i, nid)
+                    return
+                if ok:
+                    pg["state"] = "CREATED"
+                    self._wake_pg_waiters(pg_id)
+                    await self._publish("placement_group", pg)
+                    return
+                await asyncio.sleep(0.1)
+        finally:
+            self._pg_tasks.pop(pg_id, None)
+
+    def _wake_pg_waiters(self, pg_id) -> None:
+        for fut in self._pg_waiters.pop(pg_id, []):
+            if not fut.done():
+                fut.set_result(None)
+
+    def _plan_bundles(self, pg: dict) -> Optional[List[NodeID]]:
+        """Pick a node per unplaced bundle per strategy, against the current
+        resource view (ref: policy/bundle_scheduling_policy.h:82-106). Returns
+        a full bundle->node list, or None if infeasible right now. The plan is
+        validated authoritatively by reserve_bundle on each raylet."""
+        from .task_spec import ResourceSet
+
+        avail = {nid: ResourceSet(dict(info.resources_available))
+                 for nid, info in self.nodes.items() if info.alive}
+        placed: List[Optional[NodeID]] = list(pg["bundle_nodes"])
+        # already-reserved bundles keep their node; their resources are
+        # already deducted from the reporting raylet's availability
+        strategy = pg["strategy"]
+        used_nodes = {n for n in placed if n is not None}
+        todo = [i for i, n in enumerate(placed) if n is None or n not in avail]
+        if strategy == "STRICT_PACK":
+            # every bundle on one node (respect any existing reservation)
+            candidates = list(used_nodes) if used_nodes else list(avail)
+            for nid in candidates:
+                if nid not in avail:
+                    continue
+                trial = avail[nid].copy()
+                ok = True
+                for i in todo:
+                    req = ResourceSet(pg["bundles"][i])
+                    if not req.fits(trial):
+                        ok = False
+                        break
+                    trial.subtract(req)
+                if ok:
+                    for i in todo:
+                        placed[i] = nid
+                    return placed  # type: ignore[return-value]
+            return None
+        # place most-constrained bundles first (fewest feasible nodes) so a
+        # bundle needing a rare resource isn't starved by flexible ones
+        todo.sort(key=lambda i: sum(
+            1 for a in avail.values() if ResourceSet(pg["bundles"][i]).fits(a)))
+        for i in todo:
+            req = ResourceSet(pg["bundles"][i])
+            feasible = [nid for nid, a in avail.items() if req.fits(a)]
+            if strategy == "STRICT_SPREAD":
+                feasible = [nid for nid in feasible if nid not in used_nodes]
+            if not feasible:
+                return None
+            if strategy == "PACK":
+                # prefer nodes already carrying bundles, then most-utilized
+                feasible.sort(key=lambda nid: (
+                    nid not in used_nodes,
+                    sum(avail[nid].res.values())))
+            elif strategy in ("SPREAD", "STRICT_SPREAD"):
+                # prefer fresh, least-loaded nodes
+                feasible.sort(key=lambda nid: (
+                    nid in used_nodes,
+                    -sum(avail[nid].res.values())))
+            nid = feasible[0]
+            placed[i] = nid
+            avail[nid].subtract(req)
+            used_nodes.add(nid)
+        return placed  # type: ignore[return-value]
+
+    async def _try_schedule_pg(self, pg: dict) -> bool:
+        plan = self._plan_bundles(pg)
+        if plan is None:
+            return False
+        pg_id = pg["pg_id"]
+        newly = [(i, nid) for i, nid in enumerate(plan)
+                 if pg["bundle_nodes"][i] != nid]
+        # phase 1: reserve every new bundle; roll back all of them on any miss
+        reserved: List[Tuple[int, NodeID]] = []
+        ok = True
+        for i, nid in newly:
+            info = self.nodes.get(nid)
+            if info is None or not info.alive:
+                ok = False
+                break
+            try:
+                client = await self._raylet_client(info.address)
+                granted = await client.call("reserve_bundle", {
+                    "pg_id": pg_id, "bundle_index": i,
+                    "resources": pg["bundles"][i]})
+            except Exception:
+                granted = False
+            if not granted:
+                ok = False
+                break
+            reserved.append((i, nid))
+        if not ok:
+            for i, nid in reserved:
+                await self._cancel_bundle(pg_id, i, nid)
+            return False
+        # phase 2: commit (ref: placement_group_resource_manager.h 2PC);
+        # a failed commit means the raylet lost the reservation — do not
+        # record the bundle as placed, retry the whole group
+        all_committed = True
+        for i, nid in newly:
+            committed = False
+            info = self.nodes.get(nid)
+            if info is not None:
+                try:
+                    client = await self._raylet_client(info.address)
+                    committed = bool(await client.call("commit_bundle", {
+                        "pg_id": pg_id, "bundle_index": i}))
+                except Exception:
+                    committed = False
+            if committed:
+                pg["bundle_nodes"][i] = nid
+            else:
+                await self._cancel_bundle(pg_id, i, nid)
+                all_committed = False
+        return all_committed
+
+    async def _cancel_bundle(self, pg_id, bundle_index, node_id) -> None:
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        try:
+            client = await self._raylet_client(info.address)
+            await client.call("cancel_bundle", {
+                "pg_id": pg_id, "bundle_index": bundle_index})
+        except Exception:
+            pass
 
     async def handle_remove_placement_group(self, payload, conn):
+        # NOTE: the scheduler task is NOT canceled — interrupting it mid-2PC
+        # would strand reservations; _schedule_pg_loop detects the removal
+        # after its in-flight attempt and rolls back itself
         pg = self.placement_groups.pop(payload["pg_id"], None)
         if pg is not None:
+            for i, nid in enumerate(pg["bundle_nodes"]):
+                if nid is not None:
+                    await self._cancel_bundle(pg["pg_id"], i, nid)
             pg["state"] = "REMOVED"
+            self._wake_pg_waiters(pg["pg_id"])
             await self._publish("placement_group", pg)
         return True
 
     async def handle_get_placement_group(self, payload, conn):
-        return self.placement_groups.get(payload["pg_id"])
+        if "pg_id" in payload:
+            return self.placement_groups.get(payload["pg_id"])
+        for pg in self.placement_groups.values():
+            if pg["name"] and pg["name"] == payload.get("name"):
+                return pg
+        return None
+
+    async def handle_list_placement_groups(self, payload, conn):
+        return list(self.placement_groups.values())
+
+    async def handle_wait_placement_group_ready(self, payload, conn):
+        """Block until the PG is fully reserved, removed, or timeout (the
+        driver-side `pg.ready()` / `pg.wait()` backend). Waiters park on a
+        future resolved at state transitions — no polling."""
+        pg_id = payload["pg_id"]
+        timeout = payload.get("timeout")
+        deadline = None if timeout is None else asyncio.get_event_loop().time() + timeout
+        while True:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None:
+                return {"status": "removed"}
+            if pg["state"] == "CREATED":
+                nodes = []
+                for nid in pg["bundle_nodes"]:
+                    info = self.nodes.get(nid)
+                    nodes.append((nid, info.address if info else ""))
+                return {"status": "ready", "bundle_nodes": nodes}
+            fut = asyncio.get_event_loop().create_future()
+            self._pg_waiters.setdefault(pg_id, []).append(fut)
+            try:
+                remaining = (None if deadline is None
+                             else deadline - asyncio.get_event_loop().time())
+                if remaining is not None and remaining <= 0:
+                    return {"status": "timeout"}
+                await asyncio.wait_for(fut, remaining)
+            except asyncio.TimeoutError:
+                return {"status": "timeout"}
+            finally:
+                waiters = self._pg_waiters.get(pg_id, [])
+                if fut in waiters:
+                    waiters.remove(fut)
+
+    async def _raylet_client(self, address: str):
+        from .rpc import RpcClient
+
+        client = self._pg_raylet_clients.get(address)
+        if client is None or client.closed:
+            client = RpcClient(address)
+            await client.connect(timeout=10)
+            self._pg_raylet_clients[address] = client
+        return client
 
     # ---- object directory ----
     async def handle_add_object_location(self, payload, conn):

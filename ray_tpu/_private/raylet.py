@@ -59,6 +59,7 @@ class Lease:
     worker: WorkerHandle
     resources: ResourceSet
     owner_address: str
+    pg_key: Optional[tuple] = None    # (pg_id, bundle_idx) the lease lives in
 
 
 @dataclass
@@ -66,7 +67,6 @@ class _PendingLease:
     payload: dict
     future: asyncio.Future
     resources: ResourceSet
-    deduct: bool = True   # False for PG-bundle leases (bundle pre-reserved)
 
 
 class NodeResources:
@@ -148,7 +148,10 @@ class Raylet:
         self._worker_conns: Dict[ServerConnection, WorkerID] = {}
         self._spill_rr = 0
         self._subprocs: List[subprocess.Popen] = []
-        self._pg_bundles: Dict[tuple, ResourceSet] = {}  # (pg_id, bundle_idx) -> reserved
+        # (pg_id, bundle_idx) -> bundle-local resource accounting: reserved
+        # total + what's still leasable within it (ref:
+        # placement_group_resource_manager.h bundle resource bookkeeping)
+        self._pg_bundles: Dict[tuple, NodeResources] = {}
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -283,6 +286,9 @@ class Raylet:
         return {"node_id": self.node_id, "session": self.session_name}
 
     async def _on_disconnect(self, conn):
+        # reap exited worker subprocesses and drop them from tracking (dead
+        # workers would otherwise linger as zombies until node stop)
+        self._subprocs = [p for p in self._subprocs if p.poll() is None]
         worker_id = self._worker_conns.pop(conn, None)
         if worker_id is None:
             return
@@ -294,7 +300,7 @@ class Raylet:
             self._idle.remove(worker)
         if worker.lease is not None:
             lease = worker.lease
-            self.resources.release(lease.resources)
+            self._release_lease_resources(lease)
             self._leases.pop(lease.lease_id, None)
             await self._report_resources()
         if worker.actor_id is not None:
@@ -330,19 +336,16 @@ class Raylet:
         if target is not None and target != self.node_id:
             addr, _ = self._remote_nodes[target]
             return {"granted": False, "retry_at": (target, addr)}
-        deduct = True
         if self._pg_key(strategy) is not None:
-            reserved = self._pg_bundles.get(self._pg_key(strategy))
-            if reserved is None:
+            pg_id = self._pg_key(strategy)[0]
+            if not any(k[0] == pg_id for k in self._pg_bundles):
                 raise ValueError("placement group bundle not reserved on this node")
-            # bundle resources were pre-deducted at reservation; lease within them
-            deduct = False
-        grant = await self._try_grant(resources, payload, deduct=deduct)
+        grant = await self._try_grant(resources, payload)
         if grant is not None:
             return grant
         # queue until a worker/resources free up
         fut = asyncio.get_event_loop().create_future()
-        self._pending_leases.append(_PendingLease(payload, fut, resources, deduct))
+        self._pending_leases.append(_PendingLease(payload, fut, resources))
         return await fut
 
     def _pg_key(self, strategy) -> Optional[tuple]:
@@ -350,16 +353,41 @@ class Raylet:
             return (strategy.placement_group_id, strategy.placement_group_bundle_index)
         return None
 
-    async def _try_grant(self, resources: ResourceSet, payload, deduct: bool = True):
-        if deduct and not self.resources.try_allocate(resources):
+    def _pg_allocate(self, key: tuple, resources: ResourceSet) -> Optional[tuple]:
+        """Allocate the lease's resources inside a reserved bundle; a -1 index
+        is a wildcard over this node's bundles of that PG (reference
+        semantics: `bundle_index=-1` = any bundle)."""
+        pg_id, idx = key
+        if idx >= 0:
+            bundle = self._pg_bundles.get(key)
+            if bundle is not None and bundle.try_allocate(resources):
+                return key
+            return None
+        for k, bundle in self._pg_bundles.items():
+            if k[0] == pg_id and bundle.try_allocate(resources):
+                return k
+        return None
+
+    async def _try_grant(self, resources: ResourceSet, payload):
+        pg_key = self._pg_key(payload.get("strategy"))
+        alloc_key = None
+        if pg_key is not None:
+            # bundle resources were deducted from the node at reservation;
+            # the lease draws from the bundle's own pool
+            alloc_key = self._pg_allocate(pg_key, resources)
+            if alloc_key is None:
+                return None
+        elif not self.resources.try_allocate(resources):
             return None
         worker = await self._pop_worker()
         if worker is None:
-            if deduct:
+            if alloc_key is not None:
+                self._pg_bundles[alloc_key].release(resources)
+            else:
                 self.resources.release(resources)
             return None
-        lease = Lease(self._next_lease_id, worker, resources if deduct else ResourceSet(),
-                      payload.get("owner_address", ""))
+        lease = Lease(self._next_lease_id, worker, resources,
+                      payload.get("owner_address", ""), pg_key=alloc_key)
         self._next_lease_id += 1
         worker.lease = lease
         if payload.get("actor_id") is not None:
@@ -378,7 +406,7 @@ class Raylet:
         lease = self._leases.pop(payload["lease_id"], None)
         if lease is None:
             return False
-        self.resources.release(lease.resources)
+        self._release_lease_resources(lease)
         worker = lease.worker
         worker.lease = None
         if payload.get("disconnect_worker"):
@@ -413,8 +441,7 @@ class Raylet:
                     if pending.future.done():
                         self._pending_leases.pop(i)
                         continue
-                    grant = await self._try_grant(pending.resources, pending.payload,
-                                                  deduct=pending.deduct)
+                    grant = await self._try_grant(pending.resources, pending.payload)
                     if grant is None:
                         i += 1
                         continue
@@ -472,6 +499,17 @@ class Raylet:
         return self.node_id if local_fits else None
 
     # ------------------------------------------------- placement group bundles
+    def _release_lease_resources(self, lease: Lease) -> None:
+        """Return a finished lease's resources to the bundle it drew from, or
+        to the node pool. A canceled bundle already released its whole
+        reservation, so its leases return nothing."""
+        if lease.pg_key is not None:
+            bundle = self._pg_bundles.get(lease.pg_key)
+            if bundle is not None:
+                bundle.release(lease.resources)
+            return
+        self.resources.release(lease.resources)
+
     async def handle_reserve_bundle(self, payload, conn):
         """Two-phase commit, phase 1: reserve resources for a PG bundle
         (ref: placement_group_resource_manager.h)."""
@@ -481,7 +519,7 @@ class Raylet:
             return True
         if not self.resources.try_allocate(resources):
             return False
-        self._pg_bundles[key] = resources
+        self._pg_bundles[key] = NodeResources(resources.to_dict())
         await self._report_resources()
         return True
 
@@ -491,9 +529,31 @@ class Raylet:
     async def handle_cancel_bundle(self, payload, conn):
         key = (payload["pg_id"], payload["bundle_index"])
         reserved = self._pg_bundles.pop(key, None)
-        if reserved is not None:
-            self.resources.release(reserved)
-            await self._report_resources()
+        if reserved is None:
+            return True
+        # evict leases living inside the bundle: their workers are killed so
+        # PG removal reclaims the processes (ref: gcs_placement_group_scheduler
+        # DestroyPlacementGroupCommittedBundleResources kills bundle workers)
+        for lease in list(self._leases.values()):
+            if lease.pg_key == key:
+                self._leases.pop(lease.lease_id, None)
+                worker = lease.worker
+                worker.lease = None
+                worker.alive = False
+                if worker.conn is not None:
+                    await worker.conn.push("shutdown", {})
+        self.resources.release(reserved.total)
+        # queued leases waiting on this PG with no bundle left here would wait
+        # forever: fail them so the submitter re-resolves (and learns of
+        # removal from the GCS directory)
+        if not any(k[0] == key[0] for k in self._pg_bundles):
+            for pending in self._pending_leases[:]:
+                pgk = self._pg_key(pending.payload.get("strategy"))
+                if pgk is not None and pgk[0] == key[0] and not pending.future.done():
+                    pending.future.set_exception(
+                        ValueError("placement group bundle canceled"))
+        await self._report_resources()
+        await self._pump_pending()
         return True
 
     # ------------------------------------------------------- object directory

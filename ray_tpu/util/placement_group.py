@@ -1,0 +1,124 @@
+"""Placement groups: gang-reserve resource bundles across the cluster.
+
+Public surface of the GCS placement-group manager (ref:
+python/ray/util/placement_group.py; backend in _private/gcs.py — the
+gcs_placement_group_manager.h / bundle_scheduling_policy.h:82-106 analog).
+Strategies: PACK (fewest nodes), SPREAD (many nodes, best-effort),
+STRICT_PACK (one node or fail), STRICT_SPREAD (distinct node per bundle or
+fail). On TPU clusters bundles are how whole ICI slices are gang-reserved:
+one bundle per host of the slice, STRICT_SPREAD, each bundle carrying the
+host's TPU chips.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .._private.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    """Handle to a created placement group."""
+
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self._bundles = bundles
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return list(self._bundles)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._bundles)
+
+    def ready(self):
+        """ObjectRef that resolves once every bundle is reserved — a trivial
+        task scheduled into the group, so it runs exactly when the
+        reservation commits (ref: placement_group.py ready() /
+        bundle_reservation_check_func)."""
+        from .. import remote
+        from .scheduling_strategies import PlacementGroupSchedulingStrategy
+
+        @remote
+        def _bundle_reservation_check(pg_id):
+            return pg_id
+
+        # zero resources: the check must lease into ANY bundle (TPU-only
+        # bundles have no CPU to give), gated purely on the reservation
+        return _bundle_reservation_check.options(
+            num_cpus=0,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=self, placement_group_bundle_index=0),
+        ).remote(self.id)
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        """Block until the group is fully reserved; False on timeout."""
+        from .. import _worker_api
+
+        return _worker_api.core().wait_placement_group(self.id, timeout_seconds)
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id})"
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    """Create a placement group of resource bundles (async: use
+    ``pg.wait()`` / ``ray_tpu.get(pg.ready())`` for reservation)."""
+    from .. import _worker_api
+
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    for bundle in bundles:
+        if not isinstance(bundle, dict) or not bundle:
+            raise ValueError("each bundle must be a non-empty dict of resources")
+        if any(v < 0 for v in bundle.values()):
+            raise ValueError("bundle resource quantities must be non-negative")
+        if all(v == 0 for v in bundle.values()):
+            raise ValueError("bundle cannot be all-zero")
+    if lifetime not in (None, "detached"):
+        raise ValueError("lifetime must be None or 'detached'")
+    norm = [{k: float(v) for k, v in b.items() if v} for b in bundles]
+    pg_id = _worker_api.core().create_placement_group(norm, strategy, name)
+    return PlacementGroup(pg_id, norm)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    """Release every bundle and kill workers leased within them."""
+    from .. import _worker_api
+
+    pg_id = pg.id if isinstance(pg, PlacementGroup) else pg
+    _worker_api.core().remove_placement_group(pg_id)
+
+
+def placement_group_table(pg: Optional[PlacementGroup] = None):
+    """State of one or all placement groups (ref: placement_group_table)."""
+    from .. import _worker_api
+
+    def _fmt(info: dict) -> dict:
+        return {
+            "placement_group_id": info["pg_id"].hex(),
+            "name": info["name"],
+            "bundles": {i: b for i, b in enumerate(info["bundles"])},
+            "strategy": info["strategy"],
+            "state": info["state"],
+            "bundle_nodes": [n.hex() if n is not None else None
+                             for n in info["bundle_nodes"]],
+        }
+
+    if pg is not None:
+        info = _worker_api.core().get_placement_group_info(pg.id)
+        return _fmt(info) if info is not None else {}
+    return {
+        entry["pg_id"].hex(): _fmt(entry)
+        for entry in _worker_api.core().list_placement_groups()
+    }

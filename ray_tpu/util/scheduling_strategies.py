@@ -1,0 +1,49 @@
+"""Scheduling strategies for tasks and actors.
+
+Mirrors the reference public surface (ref:
+python/ray/util/scheduling_strategies.py — PlacementGroupSchedulingStrategy:15,
+NodeAffinitySchedulingStrategy:41); these construct the internal strategy
+dataclasses the raylet policies dispatch on (task_spec.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from .._private.ids import PlacementGroupID
+from .._private.task_spec import (
+    DefaultSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy as _PgStrategy,
+    SpreadSchedulingStrategy,
+)
+
+
+def PlacementGroupSchedulingStrategy(
+    placement_group=None,
+    placement_group_bundle_index: int = -1,
+    placement_group_capture_child_tasks: bool = False,
+) -> _PgStrategy:
+    """Schedule into a placement group bundle. Accepts a ``PlacementGroup``
+    handle or a raw ``PlacementGroupID``; ``bundle_index=-1`` means any
+    bundle of the group."""
+    pg_id: Optional[PlacementGroupID]
+    if placement_group is None:
+        pg_id = None
+    elif isinstance(placement_group, PlacementGroupID):
+        pg_id = placement_group
+    else:
+        pg_id = placement_group.id
+    return _PgStrategy(
+        placement_group_id=pg_id,
+        placement_group_bundle_index=placement_group_bundle_index,
+        placement_group_capture_child_tasks=placement_group_capture_child_tasks,
+    )
+
+
+__all__ = [
+    "DefaultSchedulingStrategy",
+    "SpreadSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

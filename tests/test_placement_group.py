@@ -1,0 +1,221 @@
+"""Placement-group tests: reservation, strategies, bundle scheduling,
+removal, rescheduling on node death (ref: python/ray/tests/
+test_placement_group*.py over cluster_utils.Cluster)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+@pytest.fixture
+def cluster():
+    cluster = Cluster(head_node_args={"resources": {"CPU": 2.0}}, connect=True)
+    yield cluster
+    cluster.shutdown()
+
+
+@ray_tpu.remote
+def where_am_i():
+    return os.environ["RAY_TPU_NODE_ID"]
+
+
+def test_pg_ready_and_task_scheduling(cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=30)
+    assert ray_tpu.get(pg.ready(), timeout=30) == pg.id
+    ref = where_am_i.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote()
+    assert ray_tpu.get(ref, timeout=30) == cluster.head_node.node_id.hex()
+    table = placement_group_table(pg)
+    assert table["state"] == "CREATED"
+    assert table["strategy"] == "PACK"
+    remove_placement_group(pg)
+
+
+def test_pg_reserves_resources(cluster):
+    """Reserved bundles are deducted from the node's availability even while
+    no task runs in them."""
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout_seconds=30)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) == 0:
+            break
+        time.sleep(0.05)
+    assert ray_tpu.available_resources().get("CPU", 0) == 0
+    # a plain 1-CPU task cannot run while the PG holds everything...
+    ref = where_am_i.remote()
+    _, not_ready = ray_tpu.wait([ref], timeout=0.5)
+    assert not_ready
+    # ...but removal releases the bundle and the task proceeds
+    remove_placement_group(pg)
+    assert ray_tpu.get(ref, timeout=30)
+
+
+def test_pg_placement_group_option_shorthand(cluster):
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(timeout_seconds=30)
+    assert ray_tpu.get(
+        where_am_i.options(placement_group=pg).remote(), timeout=30)
+    remove_placement_group(pg)
+
+
+def test_strict_spread_across_nodes(cluster):
+    node2 = cluster.add_node(num_cpus=1)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=30)
+    homes = ray_tpu.get([
+        where_am_i.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i)).remote()
+        for i in range(2)
+    ], timeout=60)
+    assert set(homes) == {cluster.head_node.node_id.hex(), node2.node_id.hex()}
+    remove_placement_group(pg)
+
+
+def test_strict_pack_on_one_node(cluster):
+    cluster.add_node(num_cpus=1)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.wait(timeout_seconds=30)
+    homes = ray_tpu.get([
+        where_am_i.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i)).remote()
+        for i in range(2)
+    ], timeout=60)
+    # both bundles (2 CPU total) only fit the 2-CPU head
+    assert set(homes) == {cluster.head_node.node_id.hex()}
+    remove_placement_group(pg)
+
+
+def test_infeasible_pg_becomes_ready_on_node_add(cluster):
+    """STRICT_SPREAD over 3 bundles with 1 node pends; adding nodes heals it."""
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert not pg.wait(timeout_seconds=0.5)
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    assert pg.wait(timeout_seconds=30)
+    remove_placement_group(pg)
+
+
+def test_actor_in_pg_bundle(cluster):
+    node2 = cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.wait(timeout_seconds=30)
+
+    @ray_tpu.remote(num_cpus=2)
+    class Host:
+        def where(self):
+            return os.environ["RAY_TPU_NODE_ID"]
+
+    actor = Host.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote()
+    assert ray_tpu.get(actor.where.remote(), timeout=60) == node2.node_id.hex()
+    remove_placement_group(pg)
+
+
+def test_remove_pg_kills_bundle_actor(cluster):
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(timeout_seconds=30)
+
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    actor = Victim.options(placement_group=pg).remote()
+    assert ray_tpu.get(actor.ping.remote(), timeout=30) == "pong"
+    remove_placement_group(pg)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        for _ in range(100):
+            ray_tpu.get(actor.ping.remote(), timeout=10)
+            time.sleep(0.05)
+    # bundle resources restored to the node
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) == 2.0:
+            break
+        time.sleep(0.05)
+    assert ray_tpu.available_resources().get("CPU", 0) == 2.0
+
+
+def test_pg_rescheduled_after_node_death(cluster):
+    node2 = cluster.add_node(num_cpus=4, resources={"spot": 1.0})
+    pg = placement_group([{"CPU": 1}, {"CPU": 1, "spot": 1}],
+                         strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=30)
+    table = placement_group_table(pg)
+    assert node2.node_id.hex() in table["bundle_nodes"]
+    cluster.remove_node(node2)
+    # bundle 1 needs a "spot" node again
+    node3 = cluster.add_node(num_cpus=4, resources={"spot": 1.0})
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        table = placement_group_table(pg)
+        if table["state"] == "CREATED" and node3.node_id.hex() in table["bundle_nodes"]:
+            break
+        time.sleep(0.1)
+    assert table["state"] == "CREATED"
+    assert table["bundle_nodes"][1] == node3.node_id.hex()
+    ref = where_am_i.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=1)).remote()
+    assert ray_tpu.get(ref, timeout=60) == node3.node_id.hex()
+    remove_placement_group(pg)
+
+
+def test_wildcard_bundle_index(cluster):
+    node2 = cluster.add_node(num_cpus=1)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=30)
+    homes = set(ray_tpu.get(
+        [where_am_i.options(placement_group=pg).remote() for _ in range(8)],
+        timeout=60))
+    assert homes == {cluster.head_node.node_id.hex(), node2.node_id.hex()}
+    remove_placement_group(pg)
+
+
+def test_pg_ready_with_tpu_only_bundle(cluster):
+    """`ready()` must resolve for bundles that carry no CPU at all (the
+    flagship TPU use: bundles of chips, gated purely on reservation)."""
+    node2 = cluster.add_node(resources={"TPU": 4.0}, num_cpus=0)
+    pg = placement_group([{"TPU": 4}], strategy="PACK")
+    assert ray_tpu.get(pg.ready(), timeout=30) == pg.id
+    table = placement_group_table(pg)
+    assert table["bundle_nodes"] == [node2.node_id.hex()]
+    remove_placement_group(pg)
+
+
+def test_pg_option_conflict_rejected(cluster):
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(timeout_seconds=30)
+    from ray_tpu._private.task_spec import SpreadSchedulingStrategy
+    with pytest.raises(ValueError):
+        where_am_i.options(
+            placement_group=pg,
+            scheduling_strategy=SpreadSchedulingStrategy()).remote()
+    remove_placement_group(pg)
+
+
+def test_pg_validation():
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="NOT_A_STRATEGY")
+    with pytest.raises(ValueError):
+        placement_group([{}])
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 0}])
