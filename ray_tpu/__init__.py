@@ -14,7 +14,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from . import exceptions
-from ._private.object_ref import ObjectRef
+from ._private.object_ref import ObjectRef, ObjectRefGenerator
 from ._worker_api import (
     available_resources,
     cancel,
@@ -40,6 +40,7 @@ _OPTION_KEYS = {
     "retry_exceptions", "max_restarts", "max_task_retries", "max_concurrency",
     "name", "namespace", "scheduling_strategy", "runtime_env", "lifetime",
     "placement_group", "placement_group_bundle_index",
+    "generator_backpressure_num_objects",
 }
 
 
@@ -75,7 +76,8 @@ def method(**kwargs):
 
 
 __all__ = [
-    "ObjectRef", "ActorClass", "ActorHandle", "RemoteFunction",
+    "ObjectRef", "ObjectRefGenerator", "ActorClass", "ActorHandle",
+    "RemoteFunction",
     "init", "shutdown", "is_initialized", "remote", "method",
     "get", "put", "wait", "kill", "cancel", "get_actor",
     "cluster_resources", "available_resources", "nodes",

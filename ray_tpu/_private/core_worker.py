@@ -37,7 +37,7 @@ import cloudpickle
 
 from .config import global_config
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
-from .object_ref import ObjectRef, _set_ref_registry
+from .object_ref import ObjectRef, ObjectRefGenerator, _set_ref_registry
 from .object_store import MemoryStore, SharedObjectStore
 from .rpc import ConnectionLost, EventLoopThread, RpcClient
 from . import serialization as ser
@@ -67,6 +67,21 @@ class _ActorState:
     owned: bool = False                 # this process registered the actor
     creation_spec: Optional["TaskSpec"] = None
     restart_in_flight: bool = False
+
+
+_STREAM_DONE = object()
+
+
+@dataclass
+class _StreamState:
+    """Owner-side view of one streaming task's item queue (ref:
+    task_manager.h ObjectRefStream)."""
+
+    queue: asyncio.Queue = field(default_factory=asyncio.Queue)
+    worker_address: str = ""
+    consumed: int = 0
+    received: int = 0
+    total: Optional[int] = None
 
 
 class _LeasePool:
@@ -133,6 +148,13 @@ class CoreWorker:
         self._lineage: Dict[TaskID, TaskSpec] = {}
         self._pg_rr = 0  # round-robin over bundles for wildcard PG leases
         self._pg_cache: Dict[Any, list] = {}  # pg_id -> bundle (node, addr)
+        # object recovery (ref: object_recovery_manager.h): reconstruction
+        # attempts consumed per lineage task
+        self._reconstructions: Dict[TaskID, int] = {}
+        # cancellation: in-flight normal tasks (ref: core_worker.cc Cancel)
+        self._inflight: Dict[TaskID, dict] = {}
+        # streaming generators (ref: task_manager.h ObjectRefStream)
+        self._streams: Dict[TaskID, _StreamState] = {}
         self.address = ""  # worker-mode processes set their push address
 
         _set_ref_registry(self)
@@ -292,9 +314,49 @@ class CoreWorker:
         return out
 
     async def _try_recover(self, oids: List[ObjectID]) -> bool:
-        """Lineage reconstruction hook (ref: object_recovery_manager.h).
-        Wired in the object-recovery milestone; False = unrecoverable."""
-        return False
+        """Lineage reconstruction (ref: object_recovery_manager.h,
+        task_manager.h resubmit): re-execute the recorded creating task of
+        each lost object, recursively recovering lost arguments first.
+        Bounded by the task's max_retries. False = any object unrecoverable
+        (no lineage: ray_tpu.put data, actor returns, exhausted budget)."""
+        for oid in dict.fromkeys(oids):
+            if not await self._recover_object(oid):
+                return False
+        return True
+
+    async def _recover_object(self, oid: ObjectID, depth: int = 0) -> bool:
+        if depth > 16:
+            return False
+        if self.memory_store.contains(oid) or self.store.contains(oid):
+            return True
+        spec = self._lineage.get(oid.task_id())
+        if spec is None or spec.actor_id is not None or spec.streaming:
+            return False
+        if spec.max_retries <= 0:
+            return False
+        used = self._reconstructions.get(spec.task_id, 0)
+        if used >= spec.max_retries:
+            return False
+        self._reconstructions[spec.task_id] = used + 1
+        # lost args must be rebuilt before the task can run again; args that
+        # are merely remote are pulled by the executing raylet as usual
+        for arg in spec.args:
+            if arg.kind != ArgKind.OBJECT_REF:
+                continue
+            reply = await self.raylet.call("wait_objects", {
+                "object_ids": [arg.object_id], "num_returns": 1, "timeout": 0})
+            if reply.get("lost"):
+                await self.raylet.call(
+                    "forget_lost", {"object_ids": [arg.object_id]})
+                if not await self._recover_object(arg.object_id, depth + 1):
+                    return False
+        # clear sticky lost markers so the fresh copy can be awaited
+        await self.raylet.call("forget_lost", {"object_ids": spec.return_ids()})
+        try:
+            await self._run_on_leased_worker(spec)
+        except BaseException:  # noqa: BLE001 — unrecoverable, surface as lost
+            return False
+        return True
 
     def _load_object(self, oid: ObjectID) -> Any:
         data = self.memory_store.get(oid)
@@ -427,38 +489,63 @@ class CoreWorker:
         return ResourceSet(res)
 
     # ------------------------------------------------------ normal tasks
-    def submit_task(self, func: Any, args: tuple, kwargs: dict, opts: dict) -> List[ObjectRef]:
+    def submit_task(self, func: Any, args: tuple, kwargs: dict, opts: dict):
+        # validate options BEFORE packing args: _pack_args pins dependencies
+        # that are only released through the submit coroutine's finally
+        strategy = self._resolve_strategy(opts)
         descriptor = self.export_function(func)
         packed, deps = self._pack_args(args, kwargs)
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
         spec = TaskSpec(
             task_id=TaskID.for_normal_task(self.job_id),
             job_id=self.job_id,
             function=descriptor,
             args=packed,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
             resources=self._build_resources(opts),
-            scheduling_strategy=self._resolve_strategy(opts),
-            max_retries=opts.get("max_retries", self.cfg.task_max_retries_default),
+            scheduling_strategy=strategy,
+            # streaming tasks never auto-retry: a replay would re-emit items
+            # the consumer already saw (the failure rides the stream instead)
+            max_retries=0 if streaming else opts.get(
+                "max_retries", self.cfg.task_max_retries_default),
             retry_exceptions=opts.get("retry_exceptions", False),
+            streaming=streaming,
+            backpressure_items=opts.get(
+                "generator_backpressure_num_objects", 0) or 0,
             owner_address=self.address,
         )
-        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
-        if self.cfg.lineage_pinning_enabled:
+        # registered before the submit coroutine runs, so an immediate
+        # cancel() cannot race past the bookkeeping
+        self._inflight[spec.task_id] = {"canceled": False, "worker_address": None}
+        if self.cfg.lineage_pinning_enabled and not streaming:
             self._lineage[spec.task_id] = spec
+        if streaming:
+            self._streams[spec.task_id] = _StreamState()
+            self.io.spawn(self._submit_normal(spec, deps))
+            return ObjectRefGenerator(spec.task_id, self)
+        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
         self.io.spawn(self._submit_normal(spec, deps))
         return refs
 
     async def _submit_normal(self, spec: TaskSpec, deps: List[ObjectID]):
+        info = self._inflight.setdefault(spec.task_id, {
+            "canceled": False, "worker_address": None})
         try:
             attempts = spec.max_retries + 1
             last_error: Optional[BaseException] = None
             for attempt in range(attempts):
+                if info["canceled"]:
+                    raise exc.TaskCancelledError(
+                        f"task {spec.function.repr_name} was cancelled")
                 try:
-                    await self._run_on_leased_worker(spec)
+                    await self._run_on_leased_worker(spec, info)
                     last_error = None
                     break
                 except (ConnectionLost, exc.WorkerCrashedError) as e:
+                    if info["canceled"]:
+                        raise exc.TaskCancelledError(
+                            f"task {spec.function.repr_name} was cancelled")
                     last_error = e
                     await asyncio.sleep(0.02 * (2 ** attempt))
             if last_error is not None:
@@ -467,11 +554,22 @@ class CoreWorker:
         except BaseException as e:  # noqa: BLE001
             self._store_error(spec, e)
         finally:
+            self._inflight.pop(spec.task_id, None)
             for oid in deps:
                 self._unpin_task_dep(oid)
 
     def _store_error(self, spec: TaskSpec, error: BaseException):
         data = ser.serialize_error(error)
+        if spec.streaming:
+            # submission-level failure becomes the next (final) stream item
+            state = self._streams.get(spec.task_id)
+            if state is not None:
+                index = state.received + 1
+                oid = ObjectID.for_return(spec.task_id, index)
+                self.memory_store.put(oid, data)
+                state.queue.put_nowait(ObjectRef(oid, self.address))
+                state.queue.put_nowait(_STREAM_DONE)
+            return
         for oid in spec.return_ids():
             self.memory_store.put(oid, data)
             try:
@@ -480,12 +578,18 @@ class CoreWorker:
             except OSError:
                 pass  # store already destroyed (shutdown race)
 
-    async def _run_on_leased_worker(self, spec: TaskSpec):
+    async def _run_on_leased_worker(self, spec: TaskSpec, info: Optional[dict] = None):
         sched_class = spec.scheduling_class()
         pool = self._lease_pools.setdefault(sched_class, _LeasePool())
         grant = await self._acquire_lease(pool, spec)
         keep = False
         try:
+            if info is not None:
+                if info["canceled"]:
+                    keep = True  # lease unused; return it to the pool clean
+                    raise exc.TaskCancelledError(
+                        f"task {spec.function.repr_name} was cancelled")
+                info["worker_address"] = grant["worker_address"]
             client = await self._client_for(grant["worker_address"])
             reply = await client.call("push_task", cloudpickle.dumps(spec))
             self._handle_task_reply(spec, reply)
@@ -514,7 +618,9 @@ class CoreWorker:
             "strategy": spec.scheduling_strategy,
             "owner_address": self.address,
             "actor_id": spec.actor_id if spec.actor_creation else None,
+            "task_id": spec.task_id,
         }
+        info = self._inflight.get(spec.task_id)
         strategy = spec.scheduling_strategy
         pg_strategy = (isinstance(strategy, PlacementGroupSchedulingStrategy)
                        and strategy.placement_group_id is not None)
@@ -525,6 +631,10 @@ class CoreWorker:
                 raylet = await self._raylet_client_for(address)
             try:
                 for _ in range(16):  # bounded spillback chain
+                    if info is not None:
+                        # remembered so cancel() can reach the raylet
+                        # currently queueing this lease request
+                        info["lease_raylet"] = raylet
                     reply = await raylet.call("request_worker_lease", payload)
                     if reply.get("granted"):
                         reply["_raylet"] = raylet
@@ -616,6 +726,8 @@ class CoreWorker:
 
         async def _make():
             client = RpcClient(address)
+            # streaming tasks report items as PUSH frames on this connection
+            client.on_push("generator_item", self._on_generator_item)
             # target workers are already registered (their server is up), so a
             # dead socket means death, not startup: fail fast so in-flight
             # actor calls surface ActorDiedError promptly instead of burning
@@ -643,8 +755,103 @@ class CoreWorker:
                 self.memory_store.put(oid, data)
             # else: large result sealed in plasma by the executor
 
+    # ------------------------------------------------- streaming generators
+    def _on_generator_item(self, payload):
+        """PUSH from the executing worker: one yielded object, or the end
+        marker (ref: _raylet.pyx streaming_generator_returns). Runs on the
+        io loop inside the client recv loop."""
+        state = self._streams.get(payload["task_id"])
+        if state is None:
+            return
+        if payload.get("worker_address"):
+            state.worker_address = payload["worker_address"]
+        if payload.get("done"):
+            state.total = payload.get("total", 0)
+            state.queue.put_nowait(_STREAM_DONE)
+            return
+        oid = payload["object_id"]
+        data = payload.get("data")
+        if data is not None:
+            self.memory_store.put(oid, data)
+        self._owned_in_plasma.add(oid)
+        state.received += 1
+        state.queue.put_nowait(ObjectRef(oid, self.address))
+
+    def next_stream_item(self, task_id: TaskID,
+                         timeout: Optional[float]) -> Optional[ObjectRef]:
+        """Block for the next yielded ObjectRef; None = stream exhausted."""
+        return self.io.run(self._next_stream_item(task_id), timeout)
+
+    async def _next_stream_item(self, task_id: TaskID) -> Optional[ObjectRef]:
+        state = self._streams.get(task_id)
+        if state is None:
+            return None
+        item = await state.queue.get()
+        if item is _STREAM_DONE:
+            self._streams.pop(task_id, None)
+            return None
+        state.consumed += 1
+        if state.worker_address:
+            asyncio.ensure_future(self._send_stream_ack(task_id, state))
+        return item
+
+    async def _send_stream_ack(self, task_id: TaskID, state: _StreamState):
+        """Consumption ack driving producer backpressure (the
+        generator_waiter.h role)."""
+        try:
+            client = await self._client_for(state.worker_address)
+            await client.call("generator_ack", {
+                "task_id": task_id, "consumed": state.consumed})
+        except Exception:
+            pass  # producer gone (stream finished/worker died) — no ack needed
+
+    def stream_completed(self, task_id: TaskID) -> bool:
+        state = self._streams.get(task_id)
+        return state is None or (state.total is not None
+                                 and state.consumed >= state.total)
+
+    def release_stream(self, task_id: TaskID) -> None:
+        self._streams.pop(task_id, None)
+
+    # ------------------------------------------------------------ cancel
+    def cancel(self, ref_or_gen, force: bool = False) -> None:
+        """Cancel an in-flight normal task (ref: core_worker.cc CancelTask,
+        _raylet.pyx cancel paths). Queued tasks are dropped before dispatch;
+        running tasks get TaskCancelledError raised in their executing
+        thread; force kills the worker process."""
+        if isinstance(ref_or_gen, ObjectRefGenerator):
+            task_id = ref_or_gen.task_id
+        else:
+            task_id = ref_or_gen.id().task_id()
+        self.io.run(self._cancel(task_id, force))
+
+    async def _cancel(self, task_id: TaskID, force: bool):
+        info = self._inflight.get(task_id)
+        if info is None:
+            return  # already finished (or not a task this worker submitted)
+        info["canceled"] = True
+        address = info.get("worker_address")
+        if address:
+            try:
+                client = await self._client_for(address)
+                await client.call("cancel_task", {
+                    "task_id": task_id, "force": force}, timeout=5)
+            except Exception:
+                pass  # worker already gone — the retry loop sees `canceled`
+        else:
+            # no worker yet: the lease request may be queued at a raylet
+            # behind resources that never free — fail it there so the submit
+            # coroutine wakes up (ref: node_manager CancelWorkerLease)
+            raylet = info.get("lease_raylet") or self.raylet
+            try:
+                await raylet.call("cancel_lease_request",
+                                  {"task_id": task_id}, timeout=5)
+            except Exception:
+                pass
+
     # ------------------------------------------------------------- actors
     def submit_actor_creation(self, cls: Any, args: tuple, kwargs: dict, opts: dict) -> ActorID:
+        strategy = self._resolve_strategy(opts)  # validate before pinning args
         actor_id = ActorID.of(self.job_id)
         descriptor = self.export_function(cls)
         packed, deps = self._pack_args(args, kwargs)
@@ -655,7 +862,7 @@ class CoreWorker:
             args=packed,
             num_returns=0,
             resources=self._build_resources(opts),
-            scheduling_strategy=self._resolve_strategy(opts),
+            scheduling_strategy=strategy,
             actor_id=actor_id,
             actor_creation=True,
             actor_max_restarts=opts.get("max_restarts", self.cfg.actor_max_restarts_default),

@@ -223,7 +223,7 @@ class GcsServer:
         # Bundles reserved on the dead node are gone: put their placement
         # groups back on the scheduler to re-reserve elsewhere (ref:
         # gcs_placement_group_manager OnNodeDead -> RESCHEDULING)
-        for pg in self.placement_groups.values():
+        for pg in list(self.placement_groups.values()):
             hit = [i for i, nid in enumerate(pg["bundle_nodes"]) if nid == node_id]
             if hit:
                 for i in hit:

@@ -82,3 +82,43 @@ def _deserialize_ref(object_id: ObjectID, owner_address: str) -> "ObjectRef":
     if _ref_registry is not None:
         _ref_registry.add_borrowed_ref(object_id, owner_address)
     return ref
+
+
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs a streaming task yields, in yield order
+    (ref: python/ray/_raylet.pyx ObjectRefGenerator; items are reported
+    eagerly by the executor and consumed with backpressure acks)."""
+
+    def __init__(self, task_id, core):
+        self._task_id = task_id
+        self._core = core
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        ref = self._core.next_stream_item(self._task_id, timeout=None)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def completed(self) -> bool:
+        return self._core.stream_completed(self._task_id)
+
+    def close(self) -> None:
+        """Drop the owner-side stream state. An abandoned generator would
+        otherwise pin its queue (and any unconsumed items) forever."""
+        self._core.release_stream(self._task_id)
+
+    @property
+    def task_id(self):
+        return self._task_id
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()})"

@@ -402,6 +402,22 @@ class Raylet:
             "node_id": self.node_id,
         }
 
+    async def handle_cancel_lease_request(self, payload, conn):
+        """Fail a queued lease request for a cancelled task so the owner's
+        submit path unblocks (ref: node_manager.cc HandleCancelWorkerLease).
+        Races with a grant are benign: the owner re-checks its cancel flag
+        before pushing the task and returns the worker unused."""
+        from .. import exceptions as exc
+
+        task_id = payload["task_id"]
+        hit = False
+        for pending in self._pending_leases[:]:
+            if pending.payload.get("task_id") == task_id and not pending.future.done():
+                pending.future.set_exception(
+                    exc.TaskCancelledError("lease request cancelled"))
+                hit = True
+        return hit
+
     async def handle_return_worker(self, payload, conn):
         lease = self._leases.pop(payload["lease_id"], None)
         if lease is None:

@@ -152,6 +152,10 @@ class TaskSpec:
     scheduling_strategy: SchedulingStrategy = field(default_factory=DefaultSchedulingStrategy)
     max_retries: int = 0
     retry_exceptions: bool = False
+    # streaming generators (ref: _raylet.pyx:1138-1225 streaming_generator_*):
+    # the executor reports each yielded object eagerly instead of one reply
+    streaming: bool = False
+    backpressure_items: int = 0   # 0 = unbounded producer
     # actor-related
     actor_id: Optional[ActorID] = None          # set for actor tasks
     actor_creation: bool = False                # creation task

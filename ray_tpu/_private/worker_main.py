@@ -38,6 +38,28 @@ from .task_spec import ArgKind, TaskSpec
 from .. import exceptions as exc
 
 
+class _GenBudget:
+    """Producer-side backpressure (ref: generator_waiter.h): the generator
+    thread blocks while produced - consumed >= threshold."""
+
+    def __init__(self, threshold: int):
+        self.threshold = threshold
+        self.consumed = 0
+        self._cond = threading.Condition()
+
+    def ack(self, consumed: int) -> None:
+        with self._cond:
+            self.consumed = max(self.consumed, consumed)
+            self._cond.notify_all()
+
+    def wait_for_budget(self, produced: int) -> None:
+        if self.threshold <= 0:
+            return
+        with self._cond:
+            while produced - self.consumed >= self.threshold:
+                self._cond.wait(timeout=1.0)
+
+
 class TaskExecutor:
     def __init__(self, core: CoreWorker, raylet: RpcClient):
         self.core = core
@@ -48,6 +70,20 @@ class TaskExecutor:
         self.actor_id = None
         self._actor_queue: "queue.Queue" = queue.Queue()
         self._actor_threads: List[threading.Thread] = []
+        # cancellation: task_id -> executing thread (ref: _raylet.pyx
+        # execute_task_with_cancellation_handler); requests arriving before
+        # the task registers (still loading its function) are parked
+        self._running: dict = {}
+        self._cancel_requested: set = set()
+        # streaming: task_id -> producer budget
+        self._gen_budgets: dict = {}
+
+    def _register_running(self, task_id) -> None:
+        """Bind the executing thread; honor a cancel that raced startup."""
+        self._running[task_id] = threading.current_thread()
+        if task_id in self._cancel_requested:
+            self._cancel_requested.discard(task_id)
+            raise exc.TaskCancelledError("task cancelled before start")
 
     # ---------------------------------------------------------- arg loading
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
@@ -106,13 +142,79 @@ class TaskExecutor:
             func = self.core.load_function(spec.function.blob_id)
             args, kwargs = self._resolve_args(spec)
             self.core.set_task_context(spec.task_id)
+            self._register_running(spec.task_id)
             try:
                 values = func(*args, **kwargs)
             finally:
+                self._running.pop(spec.task_id, None)
                 self.core.clear_task_context()
             return {"results": self._seal_results(spec, values), "error": None}
         except BaseException as e:  # noqa: BLE001
             return {"results": [], "error": self._seal_error(spec, e)}
+
+    def cancel(self, task_id, force: bool) -> bool:
+        """Interrupt a running task: TaskCancelledError is raised at the next
+        bytecode boundary of its thread (force: the process exits). A task
+        still in startup (function load / arg fetch) is marked so it raises
+        the moment it registers."""
+        if force:
+            threading.Timer(0.02, lambda: os._exit(1)).start()
+            return True
+        thread = self._running.get(task_id)
+        if thread is None or not thread.is_alive():
+            self._cancel_requested.add(task_id)
+            return False
+        import ctypes
+
+        n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread.ident),
+            ctypes.py_object(exc.TaskCancelledError))
+        return n == 1
+
+    def execute_streaming(self, spec: TaskSpec, push) -> dict:
+        """Run a generator task, sealing + reporting each item eagerly
+        (ref: _raylet.pyx:1138-1225 streaming generator returns). ``push``
+        delivers one ordered frame to the owner and blocks until written."""
+        import inspect
+
+        small_limit = global_config().object_store_small_object_threshold
+        budget = self._gen_budgets[spec.task_id] = _GenBudget(
+            spec.backpressure_items)
+        index = 0
+
+        def _emit(data: bytes) -> None:
+            nonlocal index
+            index += 1
+            oid = ObjectID.for_return(spec.task_id, index)
+            self.core.store.put(oid, data)
+            self.core.io.run(self.raylet.call("object_sealed",
+                                              {"object_id": oid, "size": len(data)}))
+            push({"task_id": spec.task_id, "index": index, "object_id": oid,
+                  "data": data if len(data) <= small_limit else None,
+                  "done": False, "worker_address": self.core.address})
+
+        try:
+            try:
+                func = self.core.load_function(spec.function.blob_id)
+                args, kwargs = self._resolve_args(spec)
+                self.core.set_task_context(spec.task_id)
+                self._register_running(spec.task_id)
+                try:
+                    out = func(*args, **kwargs)
+                    items = out if inspect.isgenerator(out) else iter([out])
+                    for value in items:
+                        _emit(ser.serialize(value))
+                        budget.wait_for_budget(index)
+                finally:
+                    self._running.pop(spec.task_id, None)
+                    self.core.clear_task_context()
+            except BaseException as e:  # noqa: BLE001 — errors ride the stream
+                _emit(ser.serialize_error(e))
+            push({"task_id": spec.task_id, "done": True, "total": index,
+                  "worker_address": self.core.address})
+            return {"results": [], "error": None}
+        finally:
+            self._gen_budgets.pop(spec.task_id, None)
 
     def execute_actor_creation(self, spec: TaskSpec) -> dict:
         try:
@@ -249,7 +351,25 @@ async def _amain():
             executor._actor_queue.put((spec, reply_cb))
             return await fut
         core.job_id = spec.job_id
+        if spec.streaming:
+            def push(frame, conn=conn):
+                # called from the generator thread; blocking on the loop-side
+                # write keeps frames ordered and paces the producer
+                asyncio.run_coroutine_threadsafe(
+                    conn.push("generator_item", frame), loop).result()
+
+            return await loop.run_in_executor(
+                executor.pool, executor.execute_streaming, spec, push)
         return await loop.run_in_executor(executor.pool, executor.execute_normal, spec)
+
+    async def handle_cancel_task(payload, conn):
+        return executor.cancel(payload["task_id"], payload.get("force", False))
+
+    async def handle_generator_ack(payload, conn):
+        budget = executor._gen_budgets.get(payload["task_id"])
+        if budget is not None:
+            budget.ack(payload["consumed"])
+        return True
 
     async def handle_kill_self(payload, conn):
         loop.call_later(0.05, lambda: os._exit(0))
@@ -259,6 +379,8 @@ async def _amain():
         return {"pid": os.getpid(), "actor": executor.actor_id}
 
     server.register("push_task", handle_push_task)
+    server.register("cancel_task", handle_cancel_task)
+    server.register("generator_ack", handle_generator_ack)
     server.register("kill_self", handle_kill_self)
     server.register("health", handle_health)
     await server.start()

@@ -143,9 +143,11 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
     return ActorHandle(actor_id, name)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    # cancellation lands with the task-manager milestone; record the intent
-    raise NotImplementedError("cancel is not yet wired to the task manager")
+def cancel(ref, *, force: bool = False) -> None:
+    """Cancel an in-flight task by its ObjectRef or ObjectRefGenerator
+    (ref: python/ray/_private/worker.py:3090 ray.cancel). No-op if the task
+    already finished. Actor method calls are not cancellable."""
+    core().cancel(ref, force)
 
 
 def cluster_resources() -> Dict[str, float]:

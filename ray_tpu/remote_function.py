@@ -22,7 +22,10 @@ class RemoteFunction:
         from . import _worker_api
 
         refs = _worker_api.core().submit_task(self._function, args, kwargs, self._options)
-        if self._options.get("num_returns", 1) == 1:
+        num_returns = self._options.get("num_returns", 1)
+        if num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
+        if num_returns == 1:
             return refs[0]
         return refs
 

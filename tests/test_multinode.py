@@ -68,7 +68,10 @@ def test_node_affinity_strategy(cluster2):
 def test_node_death_loses_objects(cluster2):
     cluster, node2 = cluster2
 
-    @ray_tpu.remote(num_cpus=4)
+    # max_retries=0: with retries the object would be recoverable via
+    # lineage reconstruction (test_object_lifecycle.py covers that); here we
+    # want the unrecoverable-loss path
+    @ray_tpu.remote(num_cpus=4, max_retries=0)
     def big_result():
         return np.ones(300_000, dtype=np.float32)
 

@@ -1,0 +1,235 @@
+"""Object lifecycle: streaming generators, task cancellation, lineage
+reconstruction (ref: python/ray/tests/test_streaming_generator.py,
+test_cancel.py, test_reconstruction.py)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu._private.task_spec import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def ray_cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- streaming
+
+def test_streaming_generator_order(ray_cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(20)]
+    assert out == [i * 10 for i in range(20)]
+
+
+def test_streaming_generator_large_items(ray_cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(4):
+            yield np.full(200_000, i, dtype=np.float32)  # > inline threshold
+
+    for i, ref in enumerate(gen.remote()):
+        arr = ray_tpu.get(ref)
+        assert arr.shape == (200_000,) and arr[0] == i
+
+
+def test_streaming_generator_midstream_error(ray_cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 1
+        yield 2
+        raise ValueError("boom at 3")
+
+    it = gen.remote()
+    assert ray_tpu.get(next(it)) == 1
+    assert ray_tpu.get(next(it)) == 2
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="boom at 3"):
+        ray_tpu.get(next(it))
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_streaming_generator_backpressure(ray_cluster, tmp_path):
+    marker = str(tmp_path / "produced.txt")
+
+    @ray_tpu.remote(num_returns="streaming",
+                    generator_backpressure_num_objects=2)
+    def gen(path):
+        for i in range(8):
+            with open(path, "w") as f:
+                f.write(str(i + 1))
+            yield i
+
+    it = gen.remote(marker)
+    time.sleep(1.0)  # producer must stall at the budget, not sprint to 8
+    produced = int(open(marker).read())
+    assert produced <= 3, f"producer ran {produced} items ahead despite budget"
+    out = [ray_tpu.get(r) for r in it]
+    assert out == list(range(8))
+
+
+def test_streaming_non_generator_function(ray_cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def single():
+        return 42
+
+    out = [ray_tpu.get(r) for r in single.remote()]
+    assert out == [42]
+
+
+# ------------------------------------------------------------------ cancel
+
+def busy_wait(seconds):
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        sum(range(100))
+
+
+def test_cancel_running_task(ray_cluster):
+    @ray_tpu.remote
+    def spin():
+        busy_wait(30)
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # let it start
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=15)
+
+    # the worker survives a non-force cancel and keeps serving
+    @ray_tpu.remote
+    def ping():
+        return "pong"
+
+    assert ray_tpu.get(ping.remote(), timeout=30) == "pong"
+
+
+def test_cancel_queued_task(ray_cluster):
+    @ray_tpu.remote(num_cpus=2)
+    def blocker():
+        busy_wait(8)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=2)
+    def queued():
+        return "ran"
+
+    b = blocker.remote()
+    time.sleep(0.3)
+    q = queued.remote()  # cannot lease: blocker holds both CPUs
+    ray_tpu.cancel(q)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(q, timeout=20)
+    assert ray_tpu.get(b, timeout=30) == "done"
+
+
+def test_cancel_lease_that_can_never_be_granted(ray_cluster):
+    """Cancelling a task queued behind resources that never free must
+    unblock it (the lease request is failed at the raylet)."""
+    @ray_tpu.remote(resources={"nonexistent": 1})
+    def stuck():
+        return "never"
+
+    ref = stuck.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+
+
+def test_cancel_force_kills_worker(ray_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def sleeper():
+        time.sleep(60)  # blocking sleep: only force can stop it promptly
+
+    ref = sleeper.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+
+
+def test_cancel_finished_task_is_noop(ray_cluster):
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=30) == 7
+    ray_tpu.cancel(ref)  # no-op, no error
+    assert ray_tpu.get(ref, timeout=30) == 7
+
+
+# --------------------------------------------------- lineage reconstruction
+
+@pytest.fixture
+def cluster2():
+    cluster = Cluster(head_node_args={"resources": {"CPU": 2.0}}, connect=True)
+    node2 = cluster.add_node(num_cpus=2)
+    yield cluster, node2
+    cluster.shutdown()
+
+
+def _on(node):
+    return NodeAffinitySchedulingStrategy(node_id=node.node_id.hex(), soft=True)
+
+
+def test_lineage_reconstruction_after_node_death(cluster2):
+    cluster, node2 = cluster2
+
+    @ray_tpu.remote(num_returns=2)
+    def make(seed):
+        arr = np.full(300_000, seed, dtype=np.float32)  # big: stays remote
+        return "done", arr
+
+    marker, big = make.options(
+        scheduling_strategy=_on(node2)).remote(5)
+    assert ray_tpu.get(marker, timeout=60) == "done"  # inline: no pull of big
+    cluster.remove_node(node2)  # big's only copy dies with the node
+    arr = ray_tpu.get(big, timeout=60)  # lineage re-executes make on the head
+    assert arr[0] == 5 and arr.shape == (300_000,)
+
+
+def test_recursive_lineage_reconstruction(cluster2):
+    cluster, node2 = cluster2
+
+    @ray_tpu.remote(num_returns=2)
+    def base():
+        return "done", np.full(300_000, 1.0, dtype=np.float32)
+
+    @ray_tpu.remote(num_returns=2)
+    def double(a):
+        return "done", a * 2
+
+    m1, a = base.options(scheduling_strategy=_on(node2)).remote()
+    m2, b = double.options(scheduling_strategy=_on(node2)).remote(a)
+    assert ray_tpu.get([m1, m2], timeout=60) == ["done", "done"]
+    cluster.remove_node(node2)
+    # b is lost AND its argument a is lost: recovery must rebuild the chain
+    out = ray_tpu.get(b, timeout=60)
+    assert out[0] == 2.0
+
+
+def test_unrecoverable_without_retries(cluster2):
+    cluster, node2 = cluster2
+
+    @ray_tpu.remote(num_returns=2, max_retries=0)
+    def make():
+        return "done", np.full(300_000, 3.0, dtype=np.float32)
+
+    marker, big = make.options(scheduling_strategy=_on(node2)).remote()
+    assert ray_tpu.get(marker, timeout=60) == "done"
+    cluster.remove_node(node2)
+    with pytest.raises(ray_tpu.exceptions.ObjectLostError):
+        ray_tpu.get(big, timeout=60)
