@@ -9,5 +9,14 @@ the XLA program itself; actors orchestrate hosts, XLA owns chips.
 """
 
 from .step import TrainState, make_train_step, make_eval_step
+from ._checkpoint import Checkpoint
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .controller import Result, TrainController, Trainer
+from .session import get_checkpoint, get_context, report
 
-__all__ = ["TrainState", "make_train_step", "make_eval_step"]
+__all__ = [
+    "TrainState", "make_train_step", "make_eval_step",
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
+    "ScalingConfig", "Result", "TrainController", "Trainer",
+    "get_checkpoint", "get_context", "report",
+]

@@ -1,0 +1,42 @@
+"""Checkpoint: a directory handle on storage (ref: python/ray/train/
+_checkpoint.py:56 — a Checkpoint is a path plus helpers, not a format).
+
+Framework-agnostic: training code writes whatever it wants into the
+directory (orbax trees, numpy archives, pickled pytrees) and reports it;
+the controller's CheckpointManager owns placement and retention under
+``RunConfig.storage_path``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+
+class Checkpoint:
+    """Handle to a checkpoint directory."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @contextmanager
+    def as_directory(self) -> Iterator[str]:
+        """Yield a local directory with the checkpoint contents."""
+        yield self.path
+
+    def to_directory(self, target: Optional[str] = None) -> str:
+        """Copy the checkpoint into ``target`` (or a temp dir)."""
+        target = target or tempfile.mkdtemp(prefix="ckpt_")
+        if os.path.abspath(target) != self.path:
+            shutil.copytree(self.path, target, dirs_exist_ok=True)
+        return target
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"

@@ -1,0 +1,89 @@
+"""Checkpoint registration + retention (ref: train/v2/_internal/execution/
+checkpoint/checkpoint_manager.py — register reported checkpoints under the
+run's storage path, keep the top-k most recent, expose the latest for
+restore)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+import io
+from typing import List, Optional
+
+from ._checkpoint import Checkpoint
+from .config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, storage_dir: str, config: CheckpointConfig):
+        self.storage_dir = storage_dir
+        self.config = config
+        self._registered: List[str] = []   # oldest → newest, persisted dirs
+        os.makedirs(storage_dir, exist_ok=True)
+        # resume support: pre-existing checkpoint dirs from a previous run.
+        # In-progress staging dirs (crash mid-copy) are cleaned, never
+        # registered — only atomically-renamed final dirs count.
+        for name in sorted(os.listdir(storage_dir)):
+            path = os.path.join(storage_dir, name)
+            if name.startswith("_staging_"):
+                shutil.rmtree(path, ignore_errors=True)
+            elif name.startswith("checkpoint_"):
+                self._registered.append(path)
+
+    def max_step(self) -> int:
+        """Highest step already persisted (resume must continue past it)."""
+        best = 0
+        for path in self._registered:
+            name = os.path.basename(path)
+            try:
+                best = max(best, int(name.split("_")[-1]))
+            except ValueError:
+                pass
+        return best
+
+    def register(self, source_path: str, step: int) -> Checkpoint:
+        """Persist a worker-reported checkpoint directory into storage.
+        Copy lands in a staging dir and is renamed into place, so a crash
+        mid-copy can never leave a half checkpoint that resume would trust."""
+        target = os.path.join(self.storage_dir, f"checkpoint_{step:06d}")
+        if os.path.abspath(source_path) != target:
+            staging = os.path.join(self.storage_dir, f"_staging_{step:06d}")
+            shutil.rmtree(staging, ignore_errors=True)
+            shutil.copytree(source_path, staging)
+            if os.path.exists(target):
+                shutil.rmtree(target)
+            os.rename(staging, target)
+        if target not in self._registered:
+            self._registered.append(target)
+        self._apply_retention()
+        return Checkpoint(target)
+
+    def register_bytes(self, blob: bytes, step: int) -> Checkpoint:
+        """Persist a checkpoint shipped as a tar blob (cross-node path: the
+        worker's filesystem is not ours)."""
+        staging = os.path.join(self.storage_dir, f"_staging_{step:06d}")
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging)
+        with tarfile.open(fileobj=io.BytesIO(blob), mode="r") as tar:
+            tar.extractall(staging, filter="data")
+        target = os.path.join(self.storage_dir, f"checkpoint_{step:06d}")
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        os.rename(staging, target)
+        if target not in self._registered:
+            self._registered.append(target)
+        self._apply_retention()
+        return Checkpoint(target)
+
+    def _apply_retention(self) -> None:
+        keep = self.config.num_to_keep
+        if keep is None:
+            return
+        while len(self._registered) > keep:
+            victim = self._registered.pop(0)
+            shutil.rmtree(victim, ignore_errors=True)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        return Checkpoint(self._registered[-1]) if self._registered else None

@@ -1,0 +1,49 @@
+"""Training run configuration (ref: python/ray/air/config.py —
+ScalingConfig/RunConfig/CheckpointConfig/FailureConfig; train/v2/api/config.py).
+
+TPU deltas: ``resources_per_worker`` defaults to one host's worth of chips
+when ``use_tpu`` is set, and workers are gang-placed with STRICT_SPREAD so
+each host of a slice gets exactly one controller process (SPMD
+multi-controller model, SURVEY §7.1)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """Shape of the worker gang."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    # PG strategy for the gang; STRICT_SPREAD = one worker per host (the TPU
+    # slice model), PACK = colocate when possible (CPU tests, small jobs)
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker:
+            return dict(self.resources_per_worker)
+        if self.use_tpu:
+            return {"CPU": 1.0, "TPU": 4.0}  # one v5p host's chips
+        return {"CPU": 1.0}
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None          # None = keep all
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0                      # gang restarts allowed
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None         # default: /tmp/ray_tpu_results
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)

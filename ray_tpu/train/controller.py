@@ -1,0 +1,159 @@
+"""Train controller: the run-loop state machine driving a worker gang
+(ref: train/v2/_internal/execution/controller/controller.py:91, run loop
+:446 — SCHEDULING → RUNNING → [RESTARTING | ERRORED | FINISHED]).
+
+TPU-first failure semantics: any rank dying kills the WHOLE gang and the
+gang restarts from the latest registered checkpoint — an SPMD program
+compiled for a fixed mesh cannot continue with a missing rank the way an
+allreduce ring sometimes can (SURVEY §7.1 point 3). Elasticity is
+therefore restart-shaped, not resize-shaped.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from .checkpoint_manager import CheckpointManager
+from .config import RunConfig, ScalingConfig
+from .worker_group import WorkerGroup
+from ._checkpoint import Checkpoint
+
+
+@dataclass
+class Result:
+    """Outcome of a training run (ref: ray.train.Result)."""
+
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    path: str = ""
+    error: Optional[str] = None
+
+
+class TrainController:
+    POLL_INTERVAL_S = 0.2
+
+    def __init__(self, train_fn: Callable, train_config: Optional[dict],
+                 scaling: ScalingConfig, run_config: RunConfig):
+        self.train_fn = train_fn
+        self.train_config = train_config
+        self.scaling = scaling
+        self.run_config = run_config
+        name = run_config.name or f"run_{int(time.time())}"
+        base = run_config.storage_path or "/tmp/ray_tpu_results"
+        self.run_dir = os.path.join(base, name)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.checkpoints = CheckpointManager(
+            os.path.join(self.run_dir, "checkpoints"),
+            run_config.checkpoint_config)
+        self.state = "INITIALIZING"
+        self.restarts = 0
+        self._latest_metrics: Dict[str, Any] = {}
+        # a resumed run must number new checkpoints past what's already in
+        # storage — restarting at 0 would overwrite old dirs in place while
+        # retention still treats them as oldest
+        self._global_step = self.checkpoints.max_step()
+
+    def run(self) -> Result:
+        error: Optional[str] = None
+        group: Optional[WorkerGroup] = None
+        try:
+            while True:
+                self.state = "SCHEDULING"
+                group = WorkerGroup(self.scaling,
+                                    os.path.basename(self.run_dir))
+                try:
+                    group.start()
+                    restore = self.checkpoints.latest
+                    group.start_training(
+                        self.train_fn, self.train_config,
+                        restore.path if restore else None)
+                    self.state = "RUNNING"
+                    failure = self._poll_until_done(group)
+                except Exception as e:  # gang bring-up died (e.g. a node
+                    # was lost mid-schedule): a restartable failure, same as
+                    # a rank dying mid-run (ref: controller.py worker-group
+                    # startup failure handling)
+                    failure = f"worker group failure: {e}"
+                group.shutdown()
+                group = None
+                if failure is None:
+                    self.state = "FINISHED"
+                    return Result(
+                        metrics=self._latest_metrics,
+                        checkpoint=self.checkpoints.latest,
+                        path=self.run_dir)
+                if self.restarts >= self.run_config.failure_config.max_failures:
+                    self.state = "ERRORED"
+                    error = failure
+                    return Result(
+                        metrics=self._latest_metrics,
+                        checkpoint=self.checkpoints.latest,
+                        path=self.run_dir,
+                        error=failure)
+                # whole-gang restart from the latest checkpoint
+                self.restarts += 1
+                self.state = "RESTARTING"
+        finally:
+            if group is not None:
+                group.shutdown()
+
+    def _poll_until_done(self, group: WorkerGroup) -> Optional[str]:
+        """Poll the gang until every rank finishes or any rank fails.
+        Returns the failure description, or None on clean finish."""
+        while True:
+            statuses = group.poll()
+            for status in statuses:
+                self._ingest_reports(status, group)
+            failed = [s for s in statuses if s["status"] in ("errored", "dead")]
+            if failed:
+                return (f"rank {failed[0]['rank']} "
+                        f"{failed[0]['status']}: {failed[0]['error']}")
+            if all(s["status"] == "finished" for s in statuses):
+                return None
+            time.sleep(self.POLL_INTERVAL_S)
+
+    def _ingest_reports(self, status: Dict[str, Any],
+                        group: WorkerGroup) -> None:
+        for rep in status.get("reports", []):
+            if status["rank"] != 0:
+                continue
+            self._latest_metrics = rep["metrics"]
+            self._global_step += 1
+            path = rep.get("checkpoint_path")
+            if not path:
+                continue
+            if os.path.isdir(path):
+                # shared filesystem (same host / NFS / in-process cluster)
+                self.checkpoints.register(path, self._global_step)
+            else:
+                # rank 0 lives on another filesystem: ship the directory as
+                # a tar blob through the worker (the reference's
+                # storage-context upload role)
+                blob = group.fetch_checkpoint_blob(0, path)
+                if blob is not None:
+                    self.checkpoints.register_bytes(blob, self._global_step)
+
+
+class Trainer:
+    """Public entry point (ref: train/v2/api/data_parallel_trainer.py:55
+    DataParallelTrainer; fit():96). ``train_fn`` runs on every rank of the
+    gang; inside it use ray_tpu.train.{get_context, report, get_checkpoint}.
+    """
+
+    def __init__(self, train_fn: Callable, *,
+                 train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.train_fn = train_fn
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        controller = TrainController(
+            self.train_fn, self.train_loop_config,
+            self.scaling_config, self.run_config)
+        return controller.run()

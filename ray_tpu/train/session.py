@@ -1,0 +1,94 @@
+"""Worker-side training session: the `ray_tpu.train.report` surface
+(ref: python/ray/train/_internal/session.py — the _TrainSession singleton
+each worker's train_fn talks to; report flow in
+train/v2/_internal/execution/worker_group/thread_runner.py).
+
+One session per worker process, installed by TrainWorker before the user
+function runs. ``report()`` hands metrics (and optionally a checkpoint
+directory) to the worker actor, which the controller polls."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ._checkpoint import Checkpoint
+
+
+@dataclass
+class TrainContext:
+    world_size: int
+    rank: int
+    node_rank: int
+    experiment_name: str
+    coordinator_address: str = ""     # rank-0 host:port for jax.distributed
+    restored_checkpoint: Optional[Checkpoint] = None
+
+
+@dataclass
+class _Report:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint] = None
+    step: int = 0
+
+
+class _Session:
+    def __init__(self, context: TrainContext):
+        self.context = context
+        self.reports: List[_Report] = []
+        self.lock = threading.Lock()
+        self._step = 0
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
+        with self.lock:
+            self._step += 1
+            self.reports.append(_Report(dict(metrics), checkpoint, self._step))
+
+    def drain(self) -> List[_Report]:
+        """Hand pending reports to the poller and forget them — a long run
+        reporting every step must not accumulate every metrics dict."""
+        with self.lock:
+            pending = self.reports
+            self.reports = []
+        return pending
+
+
+_session: Optional[_Session] = None
+
+
+def _init_session(context: TrainContext) -> _Session:
+    global _session
+    _session = _Session(context)
+    return _session
+
+
+def _shutdown_session() -> None:
+    global _session
+    _session = None
+
+
+def _require_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "ray_tpu.train.report/get_context can only be called inside a "
+            "training function launched by a Trainer")
+    return _session
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) to the controller
+    (ref: ray.train.report). Only rank 0's checkpoint is registered."""
+    _require_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    """World/rank info for this training worker (ref: ray.train.get_context)."""
+    return _require_session().context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from, if the controller restored one
+    (ref: ray.train.get_checkpoint)."""
+    return _require_session().context.restored_checkpoint
