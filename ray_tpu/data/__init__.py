@@ -1,0 +1,62 @@
+"""ray_tpu.data: streaming distributed datasets (ref: python/ray/data/).
+
+Blocks flow between operators as shared-memory object refs; execution is
+streaming with bounded queues for backpressure; `streaming_split` feeds
+training gangs with per-worker iterators that prefetch to device (HBM).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .block import Block
+from .dataset import DataIterator, Dataset, _LogicalOp
+from .datasource import (
+    Datasource,
+    ItemsDatasource,
+    JSONLinesDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+)
+
+_DEFAULT_PARALLELISM = 8
+
+
+def read_datasource(datasource: Datasource, *,
+                    parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    return Dataset([_LogicalOp("read", "read",
+                               {"datasource": datasource},
+                               {"num_cpus": 1})], parallelism)
+
+
+def range(n: int, *, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:  # noqa: A001
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def from_items(items: List[Any], *,
+               parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    return read_datasource(ItemsDatasource(items), parallelism=parallelism)
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    return read_datasource(ParquetDatasource(paths, columns),
+                           parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    return read_datasource(JSONLinesDatasource(paths),
+                           parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    return read_datasource(NumpyDatasource(paths), parallelism=parallelism)
+
+
+__all__ = [
+    "Block", "Dataset", "DataIterator", "Datasource", "ReadTask",
+    "read_datasource", "range", "from_items", "read_parquet", "read_json",
+    "read_numpy",
+]

@@ -1,0 +1,123 @@
+"""Block model: the unit of data movement (ref: python/ray/data/block.py,
+_internal/arrow_block.py).
+
+A block is either a dict of equal-length numpy arrays (columnar — the
+canonical form, directly `jax.device_put`-able for the Data→HBM path) or a
+plain list of rows (simple form, from from_items / flat python data).
+Blocks travel between operators as ObjectRefs through the shared-memory
+store; these helpers are the BlockAccessor role."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+Block = Union[Dict[str, np.ndarray], List[Any]]
+
+
+def is_columnar(block: Block) -> bool:
+    return isinstance(block, dict)
+
+
+def block_num_rows(block: Block) -> int:
+    if is_columnar(block):
+        if not block:
+            return 0
+        return len(next(iter(block.values())))
+    return len(block)
+
+
+def block_size_bytes(block: Block) -> int:
+    if is_columnar(block):
+        return int(sum(np.asarray(v).nbytes for v in block.values()))
+    return int(sum(getattr(x, "nbytes", 64) for x in block))
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    if is_columnar(block):
+        return {k: v[start:end] for k, v in block.items()}
+    return block[start:end]
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if block_num_rows(b) > 0]
+    if not blocks:
+        return []
+    if is_columnar(blocks[0]):
+        keys = blocks[0].keys()
+        return {k: np.concatenate([np.asarray(b[k]) for b in blocks])
+                for k in keys}
+    out: List[Any] = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+def iter_batches(blocks: Iterator[Block], batch_size: Optional[int],
+                 drop_last: bool = False) -> Iterator[Block]:
+    """Re-chunk a stream of blocks into exact-size batches across block
+    boundaries (ref: _internal/block_batching/). An offset cursor walks the
+    buffered blocks — numpy slices are views, so only the emitted batch is
+    ever copied (O(n) total, not O(n²/batch))."""
+    from collections import deque
+
+    if batch_size is None:
+        yield from blocks
+        return
+    dq: "deque" = deque()
+    head_off = 0
+    buffered = 0
+    for block in blocks:
+        n = block_num_rows(block)
+        if n:
+            dq.append(block)
+            buffered += n
+        while buffered >= batch_size:
+            need = batch_size
+            parts: List[Block] = []
+            while need:
+                head = dq[0]
+                avail = block_num_rows(head) - head_off
+                take = min(avail, need)
+                parts.append(slice_block(head, head_off, head_off + take))
+                head_off += take
+                need -= take
+                if head_off == block_num_rows(head):
+                    dq.popleft()
+                    head_off = 0
+            buffered -= batch_size
+            yield parts[0] if len(parts) == 1 else concat_blocks(parts)
+    if buffered and not drop_last:
+        parts = []
+        if dq:
+            parts.append(slice_block(dq[0], head_off, block_num_rows(dq[0])))
+            parts.extend(list(dq)[1:])
+        yield parts[0] if len(parts) == 1 else concat_blocks(parts)
+
+
+def block_schema(block: Block) -> Optional[dict]:
+    if is_columnar(block):
+        return {k: str(np.asarray(v).dtype) for k, v in block.items()}
+    if block:
+        return {"item": type(block[0]).__name__}
+    return None
+
+
+def rows_of(block: Block) -> Iterator[Any]:
+    if is_columnar(block):
+        keys = list(block.keys())
+        for i in range(block_num_rows(block)):
+            yield {k: block[k][i] for k in keys}
+    else:
+        yield from block
+
+
+def to_columnar(block: Block) -> Dict[str, np.ndarray]:
+    """Best-effort conversion of a simple block to columnar form."""
+    if is_columnar(block):
+        return block
+    if block and isinstance(block[0], dict):
+        keys = block[0].keys()
+        return {k: np.asarray([row[k] for row in block]) for k in keys}
+    return {"item": np.asarray(block)}

@@ -1,0 +1,336 @@
+"""Dataset: lazy logical plan + streaming execution (ref: python/ray/data/
+dataset.py — Dataset:153, map_batches:408, streaming_split:1606,
+iter_batches:4216; plan machinery in _internal/logical/ + _internal/plan.py).
+
+Blocks are numpy-dict columnar (or simple lists); batches default to the
+columnar numpy format — the form `jax.device_put` consumes directly, which
+is the whole Data→HBM point on TPU."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from .block import (
+    Block,
+    block_num_rows,
+    block_schema,
+    concat_blocks,
+    iter_batches as _rebatch,
+    rows_of,
+    slice_block,
+    to_columnar,
+)
+from .datasource import Datasource
+
+_DEFAULT_PARALLELISM = 8
+
+
+@dataclass
+class _LogicalOp:
+    kind: str                     # read | refs | map_block | limit
+    name: str
+    args: Dict[str, Any] = field(default_factory=dict)
+    remote_args: Dict[str, Any] = field(default_factory=dict)
+
+
+def _norm_remote_args(kwargs: dict) -> dict:
+    out = {"num_cpus": kwargs.pop("num_cpus", 1)}
+    for key in ("num_tpus", "resources", "max_retries"):
+        if key in kwargs:
+            out[key] = kwargs.pop(key)
+    if kwargs:
+        raise ValueError(f"unknown remote args: {sorted(kwargs)}")
+    return out
+
+
+class Dataset:
+    """A lazy, streaming-executed distributed dataset."""
+
+    def __init__(self, plan: List[_LogicalOp],
+                 parallelism: int = _DEFAULT_PARALLELISM):
+        self._plan = plan
+        self._parallelism = parallelism
+        self._last_stats = None
+
+    # ------------------------------------------------------------ transforms
+    def _append(self, op: _LogicalOp) -> "Dataset":
+        return Dataset(self._plan + [op], self._parallelism)
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy", **ray_remote_args) -> "Dataset":
+        """Apply fn to batches (ref: dataset.py:408). fn: dict[str, ndarray]
+        -> dict[str, ndarray] under the default numpy format."""
+        remote_args = _norm_remote_args(ray_remote_args)
+
+        def block_fn(block):
+            outs = []
+            for batch in _rebatch(iter([block]), batch_size):
+                if batch_format == "numpy":
+                    batch = to_columnar(batch)
+                out = fn(batch)
+                outs.append(out)
+            return concat_blocks(outs)
+
+        return self._append(_LogicalOp(
+            "map_block", f"map_batches({getattr(fn, '__name__', 'fn')})",
+            {"block_fn": block_fn}, remote_args))
+
+    def map(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        remote_args = _norm_remote_args(ray_remote_args)
+
+        def block_fn(block):
+            return [fn(row) for row in rows_of(block)]
+
+        return self._append(_LogicalOp(
+            "map_block", f"map({getattr(fn, '__name__', 'fn')})",
+            {"block_fn": block_fn}, remote_args))
+
+    def flat_map(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        remote_args = _norm_remote_args(ray_remote_args)
+
+        def block_fn(block):
+            out = []
+            for row in rows_of(block):
+                out.extend(fn(row))
+            return out
+
+        return self._append(_LogicalOp(
+            "map_block", "flat_map", {"block_fn": block_fn}, remote_args))
+
+    def filter(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        remote_args = _norm_remote_args(ray_remote_args)
+
+        def block_fn(block):
+            kept = [row for row in rows_of(block) if fn(row)]
+            from .block import is_columnar
+
+            return to_columnar(kept) if is_columnar(block) else kept
+
+        return self._append(_LogicalOp(
+            "map_block", "filter", {"block_fn": block_fn}, remote_args))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(_LogicalOp("limit", f"limit({n})", {"n": n},
+                                       {"num_cpus": 1}))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Shuffle: global block-order permutation + per-block row
+        permutation with distinct seeds (an all-to-all barrier stage, ref:
+        dataset.py:1463; full cross-block row exchange is a later round)."""
+        return self._append(_LogicalOp(
+            "shuffle", "random_shuffle", {"seed": seed}, {"num_cpus": 1}))
+
+    # ------------------------------------------------------------ execution
+    def _execute(self):
+        from .executor import build_executor
+
+        executor = build_executor(self._plan, self._parallelism)
+        self._last_stats = executor
+        return executor
+
+    def iter_block_refs(self) -> Iterator[Any]:
+        yield from self._execute().iter_output()
+
+    def iter_blocks(self) -> Iterator[Block]:
+        from .. import get
+
+        for ref in self.iter_block_refs():
+            yield get(ref)
+
+    def iter_batches(self, *, batch_size: Optional[int] = None,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Block]:
+        for batch in _rebatch(self.iter_blocks(), batch_size, drop_last):
+            yield to_columnar(batch) if batch_format == "numpy" else batch
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from rows_of(block)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        """Row count via tiny per-block metadata tasks — blocks stay remote
+        (ref: dataset.py count() fast path)."""
+        from .. import get, remote
+
+        @remote(num_cpus=0.25)
+        def _nrows(block):
+            return block_num_rows(block)
+
+        refs = [_nrows.remote(ref) for ref in self.iter_block_refs()]
+        return sum(get(refs)) if refs else 0
+
+    def schema(self) -> Optional[dict]:
+        for block in self.limit(1).iter_blocks():
+            return block_schema(block)
+        return None
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result holds block refs and re-iterates without
+        recomputation (ref: dataset.py materialize → MaterializedDataset)."""
+        refs = list(self.iter_block_refs())
+        ds = Dataset([_LogicalOp("refs", "materialized", {"refs": refs})],
+                     self._parallelism)
+        return ds
+
+    def split(self, n: int) -> List["Dataset"]:
+        refs = list(self.iter_block_refs())
+        shards: List[List[Any]] = [refs[i::n] for i in range(n)]
+        return [
+            Dataset([_LogicalOp("refs", f"split_{i}", {"refs": shard})],
+                    self._parallelism)
+            for i, shard in enumerate(shards)
+        ]
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """n iterators fed concurrently from ONE streaming execution
+        (ref: dataset.py:1606). The returned iterators are picklable and
+        pullable from any node — hand them to train workers. Dispatch is
+        round-robin, so shares are equal to within one block."""
+        import cloudpickle
+
+        from .. import remote
+        from .executor import SplitCoordinator
+
+        coordinator = remote(SplitCoordinator).options(
+            num_cpus=0.5, max_concurrency=n + 2,
+        ).remote(cloudpickle.dumps(self._plan), self._parallelism, n)
+        group = _SplitGroup(coordinator)
+        return [DataIterator(coordinator, i, group) for i in range(n)]
+
+    # --------------------------------------------------------------- writes
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            table = pa.table(to_columnar(block))
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_json(self, path: str) -> None:
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            with open(os.path.join(path, f"part-{i:05d}.jsonl"), "w") as f:
+                for row in rows_of(block):
+                    if hasattr(row, "items"):
+                        row = {k: (v.tolist() if hasattr(v, "tolist") else v)
+                               for k, v in row.items()}
+                    f.write(json.dumps(row) + "\n")
+
+    def stats(self) -> str:
+        if self._last_stats is None:
+            return "(not executed)"
+        return "\n".join(
+            f"{s.name}: {s.tasks_submitted} tasks, {s.blocks_out} blocks out"
+            for s in self._last_stats.stats())
+
+    def __repr__(self):
+        names = " -> ".join(op.name for op in self._plan)
+        return f"Dataset({names})"
+
+
+class _SplitGroup:
+    """Driver-side lifetime anchor for a SplitCoordinator actor: when the
+    driver's iterators are garbage-collected, the coordinator (which holds
+    CPU resources for the whole execution) is killed rather than leaked.
+    The coordinator also self-exits once every split drains."""
+
+    def __init__(self, coordinator):
+        self._coordinator = coordinator
+
+    def __del__(self):
+        try:
+            from .. import kill
+
+            kill(self._coordinator)
+        except Exception:
+            pass
+
+
+class DataIterator:
+    """One split of a streaming execution; picklable, usable inside train
+    workers (ref: data/iterator.py DataIterator /
+    _internal/iterator/stream_split_iterator.py)."""
+
+    def __init__(self, coordinator, split: int, group=None):
+        self._coordinator = coordinator
+        self._split = split
+        self._group = group  # driver-only lifetime anchor
+
+    def __reduce__(self):
+        # shipped copies (into train workers) must NOT carry the lifetime
+        # anchor — only the driver's original iterators control cleanup
+        return (DataIterator, (self._coordinator, self._split))
+
+    def iter_blocks(self) -> Iterator[Block]:
+        from .. import get
+        from .executor import _SENTINEL
+
+        while True:
+            block = get(self._coordinator.next_block.remote(self._split))
+            if isinstance(block, str) and block == _SENTINEL:
+                return
+            yield block
+
+    def iter_batches(self, *, batch_size: Optional[int] = None,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     prefetch_batches: int = 2,
+                     to_device: Optional[Callable[[Block], Any]] = None
+                     ) -> Iterator[Any]:
+        """Batches with background prefetch: the next batches are fetched —
+        and `to_device` (e.g. a sharded jax.device_put) applied — on a
+        prefetch thread while the caller consumes the current one. This is
+        the host→HBM double-buffering path (BASELINE: "Data streams to
+        HBM")."""
+        def produce() -> Iterator[Any]:
+            for batch in _rebatch(self.iter_blocks(), batch_size, drop_last):
+                if batch_format == "numpy":
+                    batch = to_columnar(batch)
+                yield to_device(batch) if to_device is not None else batch
+
+        if prefetch_batches <= 0:
+            yield from produce()
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=prefetch_batches)
+        END = object()
+
+        def pump():
+            try:
+                for item in produce():
+                    q.put(item)
+                q.put(END)
+            except BaseException as e:  # noqa: BLE001
+                q.put(e)
+
+        threading.Thread(target=pump, daemon=True,
+                         name=f"prefetch_split_{self._split}").start()
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def __iter__(self):
+        return self.iter_batches()

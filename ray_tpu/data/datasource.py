@@ -1,0 +1,163 @@
+"""Datasources: how blocks enter the pipeline (ref: python/ray/data/
+datasource/datasource.py — Datasource.get_read_tasks returns serializable
+ReadTasks the executor schedules as remote tasks; concrete connectors in
+data/_internal/datasource/)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .block import Block
+
+
+@dataclass
+class ReadTask:
+    """A serializable unit of reading: call `read()` inside a worker to get
+    the blocks of one input shard."""
+
+    read: Callable[[], Iterable[Block]]
+    num_rows: Optional[int] = None
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimated_rows(self) -> Optional[int]:
+        return None
+
+
+class RangeDatasource(Datasource):
+    """ds = range(n): integers in [0, n) as an 'id' column
+    (ref: _internal/datasource/range_datasource.py)."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        shard = -(-self.n // parallelism)
+        tasks = []
+        for start in range(0, self.n, shard):
+            end = min(start + shard, self.n)
+
+            def _read(start=start, end=end):
+                yield {"id": np.arange(start, end, dtype=np.int64)}
+
+            tasks.append(ReadTask(_read, num_rows=end - start))
+        return tasks
+
+    def estimated_rows(self) -> Optional[int]:
+        return self.n
+
+
+class ItemsDatasource(Datasource):
+    """ds = from_items([...]) (ref: from_items building simple blocks)."""
+
+    def __init__(self, items: List[Any]):
+        self.items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self.items)
+        if n == 0:
+            return [ReadTask(lambda: iter([[]]), num_rows=0)]
+        parallelism = max(1, min(parallelism, n))
+        shard = -(-n // parallelism)
+        tasks = []
+        for start in range(0, n, shard):
+            chunk = self.items[start: start + shard]
+
+            def _read(chunk=chunk):
+                yield list(chunk)
+
+            tasks.append(ReadTask(_read, num_rows=len(chunk)))
+        return tasks
+
+    def estimated_rows(self) -> Optional[int]:
+        return len(self.items)
+
+
+def _expand_paths(paths, suffixes) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for name in sorted(os.listdir(path)):
+                if not suffixes or any(name.endswith(s) for s in suffixes):
+                    out.append(os.path.join(path, name))
+        else:
+            out.append(path)
+    if not out:
+        raise ValueError(f"no input files found under {paths}")
+    return out
+
+
+class ParquetDatasource(Datasource):
+    """read_parquet: one read task per file, emitted as columnar blocks
+    (ref: _internal/datasource/parquet_datasource.py, minus fragment-level
+    splitting)."""
+
+    def __init__(self, paths, columns: Optional[List[str]] = None,
+                 batch_rows: int = 32768):
+        self.files = _expand_paths(paths, (".parquet",))
+        self.columns = columns
+        self.batch_rows = batch_rows
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.files:
+            def _read(path=path, columns=self.columns, rows=self.batch_rows):
+                import pyarrow.parquet as pq
+
+                table = pq.read_table(path, columns=columns)
+                for batch in table.to_batches(max_chunksize=rows):
+                    yield {name: batch.column(i).to_numpy(zero_copy_only=False)
+                           for i, name in enumerate(batch.schema.names)}
+
+            tasks.append(ReadTask(_read))
+        return tasks
+
+
+class JSONLinesDatasource(Datasource):
+    """read_json: newline-delimited json, one task per file."""
+
+    def __init__(self, paths):
+        self.files = _expand_paths(paths, (".json", ".jsonl"))
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.files:
+            def _read(path=path):
+                import json
+
+                rows = []
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            rows.append(json.loads(line))
+                yield rows
+
+            tasks.append(ReadTask(_read))
+        return tasks
+
+
+class NumpyDatasource(Datasource):
+    """read_numpy: one .npy file per task as a 'data' column."""
+
+    def __init__(self, paths):
+        self.files = _expand_paths(paths, (".npy",))
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.files:
+            def _read(path=path):
+                yield {"data": np.load(path)}
+
+            tasks.append(ReadTask(_read))
+        return tasks

@@ -1,0 +1,403 @@
+"""Streaming execution of dataset plans.
+
+Reference analog: data/_internal/execution/streaming_executor.py:48 (+
+streaming_executor_state.py select_operator_to_run/process_completed_tasks,
+operators/ task pools, output_splitter.py). Re-shaped for this runtime: each
+physical operator is a pipeline stage thread connected by bounded queues —
+the queue bound IS the backpressure policy (a slow consumer stalls the whole
+chain without buffering the dataset in memory), and per-stage in-flight task
+caps bound cluster resource use. Reads ride streaming generators so a large
+file's blocks flow before the file finishes reading.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+_SENTINEL = "__stream_end__"
+
+# stage tuning (ref: backpressure_policy/ + resource_manager defaults)
+MAX_INFLIGHT_PER_STAGE = 4
+STAGE_QUEUE_CAP = 8
+
+
+@dataclass
+class StageStats:
+    name: str
+    blocks_out: int = 0
+    tasks_submitted: int = 0
+
+
+class _Stage(threading.Thread):
+    """One physical operator: consume refs from in_q, produce refs to out_q.
+    ``stop_event`` is the downstream-satisfied signal (a reached limit):
+    stages stop dispatching and drop inputs once it fires."""
+
+    def __init__(self, name: str, out_q: "queue.Queue",
+                 in_q: Optional["queue.Queue"] = None):
+        super().__init__(daemon=True, name=f"data_stage_{name}")
+        self.stage_name = name
+        self.in_q = in_q
+        self.out_q = out_q
+        self.stats = StageStats(name)
+        self.error: Optional[BaseException] = None
+        self.stop_event = threading.Event()
+
+    def run(self):
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001 — surfaced by the executor
+            self.error = e
+        finally:
+            self.out_q.put(_SENTINEL)
+
+    def _run(self):
+        raise NotImplementedError
+
+
+class ReadStage(_Stage):
+    """Dispatch ReadTasks as streaming-generator remote tasks; drain each
+    generator on a small thread so multiple files read concurrently
+    (ref: operators/input_data_buffer.py + read task scheduling)."""
+
+    def __init__(self, read_tasks: List[Any], out_q, ray_remote_args: dict):
+        super().__init__("read", out_q)
+        self.read_tasks = read_tasks
+        self.ray_remote_args = ray_remote_args
+
+    def _run(self):
+        import cloudpickle
+
+        from .. import remote
+
+        @remote(num_returns="streaming", **self.ray_remote_args)
+        def _exec_read(task_blob):
+            task = cloudpickle.loads(task_blob)
+            for block in task.read():
+                yield block
+
+        # Reads run concurrently (bounded), but blocks are EMITTED in read
+        # task order — the stream is ordered, which is what makes
+        # take()/limit() deterministic (ref: preserve_order execution).
+        slots = threading.Semaphore(MAX_INFLIGHT_PER_STAGE)
+        task_done = "__task_done__"
+        buffers: List["queue.Queue"] = []
+
+        def _drain(gen, buf):
+            try:
+                for ref in gen:
+                    if self.stop_event.is_set():
+                        from .. import cancel
+
+                        cancel(gen)
+                        break
+                    buf.put(ref)
+            finally:
+                buf.put(task_done)
+                slots.release()
+
+        def _launch_all():
+            for task in self.read_tasks:
+                if self.stop_event.is_set():
+                    break  # downstream satisfied (limit reached)
+                slots.acquire()
+                buf: "queue.Queue" = queue.Queue(maxsize=STAGE_QUEUE_CAP)
+                buffers.append(buf)
+                gen = _exec_read.remote(cloudpickle.dumps(task))
+                self.stats.tasks_submitted += 1
+                threading.Thread(target=_drain, args=(gen, buf),
+                                 daemon=True).start()
+            buffers.append(None)  # end of tasks
+
+        threading.Thread(target=_launch_all, daemon=True).start()
+        import time as _time
+
+        i = 0
+        while True:
+            while len(buffers) <= i:
+                _time.sleep(0.01)
+            buf = buffers[i]
+            if buf is None:
+                return
+            while True:
+                item = buf.get()
+                if item is task_done:
+                    break
+                self.out_q.put(item)
+                self.stats.blocks_out += 1
+            i += 1
+
+
+class RefsStage(_Stage):
+    """Source stage over pre-materialized block refs (ref:
+    operators/input_data_buffer.py)."""
+
+    def __init__(self, refs: List[Any], out_q):
+        super().__init__("refs", out_q)
+        self.refs = refs
+
+    def _run(self):
+        for ref in self.refs:
+            self.out_q.put(ref)
+            self.stats.blocks_out += 1
+
+
+class MapStage(_Stage):
+    """One remote task per input block, emitted in input order so the block
+    stream stays ordered end-to-end (ref: task_pool_map_operator.py with
+    preserve_order). Up to MAX_INFLIGHT tasks run concurrently; only
+    emission is head-of-line."""
+
+    def __init__(self, name: str, in_q, out_q, block_fn: Callable,
+                 ray_remote_args: dict):
+        super().__init__(name, out_q, in_q)
+        self.block_fn = block_fn
+        self.ray_remote_args = ray_remote_args
+
+    def _run(self):
+        import collections
+
+        from .. import remote, wait
+
+        map_task = remote(**self.ray_remote_args)(self.block_fn)
+        inflight: "collections.deque" = collections.deque()
+        eof = False
+        while True:
+            # keep the task pool full without blocking on a quiet input
+            while not eof and len(inflight) < MAX_INFLIGHT_PER_STAGE:
+                try:
+                    timeout = 0.2 if self.stop_event.is_set() else (
+                        None if not inflight else 0.02)
+                    item = self.in_q.get(timeout=timeout)
+                except queue.Empty:
+                    if self.stop_event.is_set() and not inflight:
+                        return
+                    break
+                if item is _SENTINEL:
+                    eof = True
+                    break
+                if self.stop_event.is_set():
+                    continue  # downstream satisfied: drop, don't dispatch
+                inflight.append(map_task.remote(item))
+                self.stats.tasks_submitted += 1
+            if not inflight:
+                if eof:
+                    return
+                continue
+            head = inflight[0]
+            ready, _ = wait([head], num_returns=1,
+                            timeout=None if eof else 0.1)
+            if ready:
+                self.out_q.put(inflight.popleft())
+                self.stats.blocks_out += 1
+
+
+class ShuffleStage(_Stage):
+    """random_shuffle: an all-to-all barrier — gather every input block,
+    permute BLOCK order globally, and re-permute rows within each block
+    with a distinct per-block seed (ref: dataset.py:1463 random_shuffle's
+    exchange; full row-level cross-block exchange is a later round)."""
+
+    def __init__(self, in_q, out_q, seed, ray_remote_args: dict):
+        super().__init__("random_shuffle", out_q, in_q)
+        self.seed = seed
+        self.ray_remote_args = ray_remote_args
+
+    def _run(self):
+        import numpy as np
+
+        from .. import remote
+
+        @remote(**self.ray_remote_args)
+        def _shuffle_block(block, block_seed):
+            from .block import block_num_rows, is_columnar
+
+            rng = np.random.default_rng(block_seed)
+            perm = rng.permutation(block_num_rows(block))
+            if is_columnar(block):
+                return {k: np.asarray(v)[perm] for k, v in block.items()}
+            return [block[i] for i in perm]
+
+        refs = []
+        while True:
+            item = self.in_q.get()
+            if item is _SENTINEL:
+                break
+            refs.append(item)
+        rng = np.random.default_rng(self.seed)
+        order = rng.permutation(len(refs))
+        seeds = rng.integers(0, 2**31, size=len(refs))
+        for i in order:
+            self.out_q.put(_shuffle_block.remote(refs[i], int(seeds[i])))
+            self.stats.tasks_submitted += 1
+            self.stats.blocks_out += 1
+
+
+class LimitStage(_Stage):
+    """Truncate the stream to n rows (ref: operators/limit_operator.py).
+    Row counts come from tiny metadata tasks so blocks stay remote."""
+
+    def __init__(self, in_q, out_q, limit: int, ray_remote_args: dict):
+        super().__init__("limit", out_q, in_q)
+        self.limit = limit
+        self.ray_remote_args = ray_remote_args
+        self.upstream: List[_Stage] = []  # wired by build_executor
+
+    def _run(self):
+        from .. import get, remote
+
+        from .block import block_num_rows, slice_block
+
+        @remote(**self.ray_remote_args)
+        def _nrows(block):
+            return block_num_rows(block)
+
+        @remote(**self.ray_remote_args)
+        def _head(block, n):
+            return slice_block(block, 0, n)
+
+        taken = 0
+        while taken < self.limit:
+            item = self.in_q.get()
+            if item is _SENTINEL:
+                return
+            rows = get(_nrows.remote(item))
+            if taken + rows <= self.limit:
+                self.out_q.put(item)
+                taken += rows
+            else:
+                self.out_q.put(_head.remote(item, self.limit - taken))
+                taken = self.limit
+            self.stats.blocks_out += 1
+        # limit satisfied: tell upstream stages to stop dispatching/reading,
+        # then drain (and drop) what's already in flight
+        for stage in self.upstream:
+            stage.stop_event.set()
+        while self.in_q.get() is not _SENTINEL:
+            pass
+
+
+class StreamingExecutor:
+    """Run a chain of stages, exposing the final bounded queue."""
+
+    def __init__(self, stages: List[_Stage], out_q: "queue.Queue"):
+        self.stages = stages
+        self.out_q = out_q
+        self._started = False
+
+    def start(self):
+        if not self._started:
+            self._started = True
+            for stage in self.stages:
+                stage.start()
+
+    def iter_output(self):
+        """Yield block refs; raises the first stage error at stream end."""
+        self.start()
+        while True:
+            item = self.out_q.get()
+            if item is _SENTINEL:
+                break
+            yield item
+        for stage in self.stages:
+            if stage.error is not None:
+                raise stage.error
+
+    def stats(self) -> List[StageStats]:
+        return [s.stats for s in self.stages]
+
+
+def build_executor(plan, parallelism: int) -> StreamingExecutor:
+    """Logical plan → stage chain (the planner role, ref:
+    _internal/planner/)."""
+    from .dataset import _LogicalOp  # noqa: F401 — typing only
+
+    stages: List[_Stage] = []
+    q: "queue.Queue" = queue.Queue(maxsize=STAGE_QUEUE_CAP)
+    first = plan[0]
+    if first.kind == "read":
+        read_tasks = first.args["datasource"].get_read_tasks(parallelism)
+        stages.append(ReadStage(read_tasks, q, first.remote_args))
+    elif first.kind == "refs":
+        stages.append(RefsStage(first.args["refs"], q))
+    else:
+        raise ValueError(f"plan must start with read/refs, got {first.kind}")
+    for op in plan[1:]:
+        next_q: "queue.Queue" = queue.Queue(maxsize=STAGE_QUEUE_CAP)
+        if op.kind == "map_block":
+            stages.append(MapStage(op.name, q, next_q, op.args["block_fn"],
+                                   op.remote_args))
+        elif op.kind == "shuffle":
+            stages.append(ShuffleStage(q, next_q, op.args.get("seed"),
+                                       op.remote_args))
+        elif op.kind == "limit":
+            limit_stage = LimitStage(q, next_q, op.args["n"], op.remote_args)
+            limit_stage.upstream = list(stages)
+            stages.append(limit_stage)
+        else:
+            raise ValueError(f"unknown physical op {op.kind}")
+        q = next_q
+    return StreamingExecutor(stages, q)
+
+
+class SplitCoordinator:
+    """Actor fanning one executed stream into n consumer queues
+    (ref: dataset.py:1606 streaming_split → _internal/execution/operators/
+    output_splitter.py + the StreamSplitDataIterator coordinator actor).
+    Round-robin dispatch; every consumer sees a near-equal share. Runs as
+    an actor so train workers on any node can pull their split."""
+
+    def __init__(self, plan_blob: bytes, parallelism: int, n: int):
+        import cloudpickle
+
+        self.plan = cloudpickle.loads(plan_blob)
+        self.parallelism = parallelism
+        self.n = n
+        self.queues = [queue.Queue(maxsize=STAGE_QUEUE_CAP) for _ in range(n)]
+        self._pump: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._drained: set = set()
+
+    def _ensure_started(self):
+        with self._lock:
+            if self._pump is not None:
+                return
+            executor = build_executor(self.plan, self.parallelism)
+
+            def pump():
+                i = 0
+                try:
+                    for ref in executor.iter_output():
+                        self.queues[i % self.n].put(ref)
+                        i += 1
+                finally:
+                    for q in self.queues:
+                        q.put(_SENTINEL)
+
+            self._pump = threading.Thread(target=pump, daemon=True,
+                                          name="split_pump")
+            self._pump.start()
+
+    def next_block(self, split: int):
+        """The next block for this split (as a value — the actor-task
+        return is owned by the caller, so it cannot be freed out from
+        under a prefetching consumer), or the end sentinel."""
+        from .. import get
+
+        self._ensure_started()
+        item = self.queues[split].get()
+        if isinstance(item, str) and item == _SENTINEL:
+            with self._lock:
+                self._drained.add(split)
+                if len(self._drained) == self.n:
+                    # every consumer saw end-of-stream: release this actor's
+                    # worker + resources instead of idling forever
+                    import os
+                    import threading as _t
+
+                    _t.Timer(0.5, lambda: os._exit(0)).start()
+            return _SENTINEL
+        return get(item)

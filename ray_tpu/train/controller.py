@@ -11,10 +11,13 @@ therefore restart-shaped, not resize-shaped.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger("ray_tpu.train")
 
 from .checkpoint_manager import CheckpointManager
 from .config import RunConfig, ScalingConfig
@@ -115,6 +118,15 @@ class TrainController:
                 return None
             time.sleep(self.POLL_INTERVAL_S)
 
+    @staticmethod
+    def _local_node_id() -> str:
+        from .. import _worker_api
+
+        node = _worker_api.node()
+        if node is not None:
+            return node.node_id.hex()
+        return os.environ.get("RAY_TPU_NODE_ID", "")
+
     def _ingest_reports(self, status: Dict[str, Any],
                         group: WorkerGroup) -> None:
         for rep in status.get("reports", []):
@@ -125,8 +137,11 @@ class TrainController:
             path = rep.get("checkpoint_path")
             if not path:
                 continue
-            if os.path.isdir(path):
-                # shared filesystem (same host / NFS / in-process cluster)
+            # only trust a local path when rank 0 is on OUR node — a
+            # same-named directory here could be stale state from a
+            # previous incarnation on a different host
+            same_node = status.get("node_id", "") == self._local_node_id()
+            if same_node and os.path.isdir(path):
                 self.checkpoints.register(path, self._global_step)
             else:
                 # rank 0 lives on another filesystem: ship the directory as
@@ -135,6 +150,12 @@ class TrainController:
                 blob = group.fetch_checkpoint_blob(0, path)
                 if blob is not None:
                     self.checkpoints.register_bytes(blob, self._global_step)
+                else:
+                    logger.warning(
+                        "dropping checkpoint %s from rank 0 (step %d): "
+                        "worker could not hand it over before dying — a "
+                        "future restart will restore an older checkpoint",
+                        path, self._global_step)
 
 
 class Trainer:

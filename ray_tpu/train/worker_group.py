@@ -51,20 +51,39 @@ class TrainWorker:
 
     def start(self, train_fn_blob: bytes, train_config: Optional[dict],
               world_size: int, coordinator_address: str,
-              restore_path: Optional[str]) -> bool:
+              restore_path: Optional[str],
+              restore_blob: Optional[bytes] = None,
+              use_tpu: bool = False) -> bool:
         """Install the session and launch the user function on a thread
         (ref: worker_group/thread_runner.py — the train_fn must not block
-        the actor, which keeps serving poll()/shutdown())."""
+        the actor, which keeps serving poll()/shutdown()). ``restore_blob``
+        carries the checkpoint as a tar when the controller's filesystem is
+        not visible from this host; a local ``restore_path`` is used
+        directly when it is."""
+        restored = None
+        if restore_blob is not None:
+            # the blob is ground truth from the controller — a same-named
+            # local directory could be stale state from a previous run
+            import io
+            import tarfile
+            import tempfile
+
+            local = tempfile.mkdtemp(prefix="restore_ckpt_")
+            with tarfile.open(fileobj=io.BytesIO(restore_blob)) as tar:
+                tar.extractall(local, filter="data")
+            restored = Checkpoint(local)
+        elif restore_path and os.path.isdir(restore_path):
+            restored = Checkpoint(restore_path)
         context = TrainContext(
             world_size=world_size,
             rank=self.rank,
             node_rank=self.rank,
             experiment_name=self.experiment_name,
             coordinator_address=coordinator_address,
-            restored_checkpoint=Checkpoint(restore_path) if restore_path else None,
+            restored_checkpoint=restored,
         )
         self._session = _init_session(context)
-        self._maybe_init_jax_distributed(context)
+        self._maybe_init_jax_distributed(context, use_tpu)
         train_fn = cloudpickle.loads(train_fn_blob)
 
         def _run():
@@ -86,14 +105,20 @@ class TrainWorker:
         self._thread.start()
         return True
 
-    def _maybe_init_jax_distributed(self, context: TrainContext) -> None:
+    def _maybe_init_jax_distributed(self, context: TrainContext,
+                                    use_tpu: bool) -> None:
         """Multi-host SPMD bring-up (the NCCL-rendezvous analog, ref:
         train/torch/config.py:66 _setup_torch_process_group → here
-        jax.distributed over the gang's rank-0 coordinator). Only on real
-        TPU hosts: CPU test gangs run per-process local meshes."""
-        if context.world_size <= 1 or not context.coordinator_address:
+        jax.distributed over the gang's rank-0 coordinator). Gated on the
+        ScalingConfig's use_tpu — NOT on JAX_PLATFORMS, which the raylet
+        sets to "cpu" for every pool worker it spawns; a TPU worker must
+        first reclaim the device plane."""
+        if not use_tpu:
             return
+        # undo the pool-worker CPU pin so jax sees the host's chips
         if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            os.environ.pop("JAX_PLATFORMS", None)
+        if context.world_size <= 1 or not context.coordinator_address:
             return
         try:
             import jax
@@ -133,6 +158,7 @@ class TrainWorker:
         else:
             status = "idle"
         return {"rank": self.rank, "status": status, "error": self._error,
+                "node_id": os.environ.get("RAY_TPU_NODE_ID", ""),
                 "reports": new_reports}
 
     def pack_checkpoint(self, path: str) -> bytes:
@@ -201,10 +227,22 @@ class WorkerGroup:
         if self.scaling.num_workers > 1:
             port = get(self.workers[0].pick_port.remote(), timeout=60)
             self.coordinator_address = f"{infos[0]['hostname']}:{port}"
+        # checkpoint for workers on other filesystems rides as a tar blob
+        restore_blob = None
+        if restore_path and os.path.isdir(restore_path):
+            import io
+            import tarfile
+
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w") as tar:
+                for name in sorted(os.listdir(restore_path)):
+                    tar.add(os.path.join(restore_path, name), arcname=name)
+            restore_blob = buf.getvalue()
         blob = cloudpickle.dumps(train_fn)
         get([
             w.start.remote(blob, train_config, self.scaling.num_workers,
-                           self.coordinator_address, restore_path)
+                           self.coordinator_address, restore_path,
+                           restore_blob, self.scaling.use_tpu)
             for w in self.workers
         ], timeout=300)
 
