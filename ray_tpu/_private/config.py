@@ -58,6 +58,10 @@ class Config:
     max_lineage_bytes: int = 1024**3
     # --- chaos / testing (mirrors rpc_chaos.h fault injection) ---
     testing_rpc_failure: str = ""             # "method=prob_req:prob_resp,..."
+    # per-try timeout for lease RPCs; 0 = wait forever (reliable transport).
+    # Chaos/unreliable setups set this so dropped frames trigger a retry,
+    # which the raylet dedups by request id.
+    lease_rpc_timeout_s: float = 0.0
     # --- logging / metrics ---
     event_log_enabled: bool = True
     metrics_report_interval_ms: int = 2000

@@ -283,7 +283,11 @@ class CoreWorker:
 
     async def _notify_sealed(self, oid: ObjectID, size: int):
         try:
-            await self.raylet.call("object_sealed", {"object_id": oid, "size": size})
+            # idempotent: retried on loss so the object directory cannot
+            # silently miss a sealed object (chaos/unreliable transports)
+            await self.raylet.call_retrying(
+                "object_sealed", {"object_id": oid, "size": size},
+                attempts=5, per_try_timeout=2.0)
         except Exception:
             pass
 
@@ -613,12 +617,17 @@ class CoreWorker:
             await fut
 
     async def _request_lease(self, spec: TaskSpec) -> dict:
+        import uuid
+
         payload = {
             "resources": spec.resources.to_dict(),
             "strategy": spec.scheduling_strategy,
             "owner_address": self.address,
             "actor_id": spec.actor_id if spec.actor_creation else None,
             "task_id": spec.task_id,
+            # stable across retries: the raylet dedups grants by this id, so
+            # a lost reply cannot leak a second worker lease
+            "request_id": uuid.uuid4().hex,
         }
         info = self._inflight.get(spec.task_id)
         strategy = spec.scheduling_strategy
@@ -635,7 +644,7 @@ class CoreWorker:
                         # remembered so cancel() can reach the raylet
                         # currently queueing this lease request
                         info["lease_raylet"] = raylet
-                    reply = await raylet.call("request_worker_lease", payload)
+                    reply = await self._lease_call(raylet, payload)
                     if reply.get("granted"):
                         reply["_raylet"] = raylet
                         return reply
@@ -652,6 +661,27 @@ class CoreWorker:
         raise exc.RayTpuError(
             f"could not lease into placement group "
             f"{strategy.placement_group_id} (bundle unavailable)")
+
+    async def _lease_call(self, raylet: RpcClient, payload: dict):
+        """One lease RPC. With `lease_rpc_timeout_s` set (chaos tests,
+        unreliable transports), lost frames time out and retry; the
+        request_id makes retries idempotent at the raylet."""
+        per_try = self.cfg.lease_rpc_timeout_s
+        if per_try <= 0:
+            return await raylet.call("request_worker_lease", payload)
+        last: Optional[BaseException] = None
+        for _ in range(10):
+            try:
+                return await raylet.call("request_worker_lease", payload,
+                                         timeout=per_try)
+            except asyncio.TimeoutError as e:
+                last = e
+                # a queued lease legitimately takes as long as the cluster
+                # is busy — escalate the per-try window so retries (cheap,
+                # deduped) only fire fast when loss is likely
+                per_try = min(per_try * 2, 60.0)
+        raise exc.RayTpuError(
+            f"lease request timed out after retries: {last}")
 
     async def _pg_bundle_address(self, strategy) -> str:
         """Resolve the raylet address of the bundle the lease targets,

@@ -46,6 +46,7 @@ class NodeInfo:
     # TPU slice topology (ICI coordinates of this host's chips)
     slice_name: str = ""
     host_index: int = 0
+    resource_seq: int = 0     # last-applied availability report sequence
 
 
 @dataclass
@@ -53,6 +54,7 @@ class ActorInfo:
     actor_id: ActorID
     state: str
     name: str = ""
+    namespace: str = ""
     address: str = ""                 # worker socket when ALIVE
     node_id: Optional[NodeID] = None
     class_name: str = ""
@@ -72,7 +74,23 @@ class Storage:
         self._journal = None
         if journal_path:
             self._replay(journal_path)
+            # compact on startup: the journal is append-only (every actor
+            # state transition appends a full record), so a restart rewrites
+            # it as a snapshot of live state — replay time and disk stay
+            # bounded by state size, not cluster age
+            self._compact(journal_path)
             self._journal = open(journal_path, "ab")
+
+    def _compact(self, path: str) -> None:
+        tmp = path + ".compact"
+        with open(tmp, "wb") as f:
+            for ns, table in self._kv.items():
+                for key, val in table.items():
+                    body = pickle.dumps(("put", ns, key, val))
+                    f.write(len(body).to_bytes(4, "little") + body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def _replay(self, path: str) -> None:
         if not os.path.exists(path):
@@ -144,9 +162,42 @@ class GcsServer:
         self._subs: Dict[str, Set[ServerConnection]] = {}
         self._node_conns: Dict[ServerConnection, NodeID] = {}
         self._next_job = 1
+        self._restore_tables()
+
+    # ---- journal-backed table persistence (the Redis-persistence analog:
+    #      gcs_table_storage.h + gcs_init_data.h restart rebuild) ----
+    def _persist(self, table: str, key: str, obj: Any) -> None:
+        self.storage.put("__table_" + table, key, pickle.dumps(obj))
+
+    def _unpersist(self, table: str, key: str) -> None:
+        self.storage.delete("__table_" + table, key)
+
+    def _restore_tables(self) -> None:
+        """Rebuild actor/PG/job tables from the journal on restart. Nodes
+        are NOT restored — raylets re-register and their liveness is
+        re-derived from fresh connections. Restored actor addresses may be
+        stale; callers re-resolve through actor_failed on first contact."""
+        for key in self.storage.keys("__table_actors"):
+            info: ActorInfo = pickle.loads(
+                self.storage.get("__table_actors", key))
+            self.actors[info.actor_id] = info
+            if info.name:
+                self.named_actors[(info.namespace, info.name)] = info.actor_id
+        for key in self.storage.keys("__table_pgs"):
+            pg = pickle.loads(self.storage.get("__table_pgs", key))
+            self.placement_groups[pg["pg_id"]] = pg
+        for key in self.storage.keys("__table_jobs"):
+            job_id, job = pickle.loads(self.storage.get("__table_jobs", key))
+            self.jobs[job_id] = job
+            self._next_job = max(self._next_job, int(key) + 1)
 
     async def start(self):
         await self.server.start()
+        # restored placement groups that never finished reserving resume
+        # scheduling now that the loop is live (restart recovery)
+        for pg in self.placement_groups.values():
+            if pg["state"] in ("PENDING", "RESCHEDULING"):
+                self._kick_pg_scheduler(pg["pg_id"])
 
     async def stop(self):
         for task in list(self._pg_tasks.values()):
@@ -189,8 +240,13 @@ class GcsServer:
 
     async def handle_report_resources(self, payload, conn):
         node_id = payload["node_id"]
-        if node_id in self.nodes:
-            self.nodes[node_id].resources_available = payload["available"]
+        info = self.nodes.get(node_id)
+        if info is not None:
+            seq = payload.get("seq", 0)
+            if seq and seq <= info.resource_seq:
+                return True  # stale retry of an older report — ignore
+            info.resource_seq = seq
+            info.resources_available = payload["available"]
             await self._publish("resources", {
                 "node_id": node_id, "available": payload["available"],
             })
@@ -236,9 +292,11 @@ class GcsServer:
     # ---- jobs ----
     async def handle_register_job(self, payload, conn):
         job_id = JobID.from_int(self._next_job)
+        job_num = self._next_job
         self._next_job += 1
         self.jobs[job_id] = {"config": payload.get("config", {}), "start_time": time.time(),
                              "driver_address": payload.get("driver_address", "")}
+        self._persist("jobs", str(job_num), (job_id, self.jobs[job_id]))
         return job_id
 
     async def handle_get_all_jobs(self, payload, conn):
@@ -264,18 +322,19 @@ class GcsServer:
             actor_id=payload["actor_id"],
             state=PENDING_CREATION,
             name=payload.get("name", ""),
+            namespace=payload.get("namespace", ""),
             class_name=payload.get("class_name", ""),
             max_restarts=payload.get("max_restarts", 0),
             creation_spec=payload.get("creation_spec"),
         )
-        ns = payload.get("namespace", "")
         if info.name:
-            key = (ns, info.name)
+            key = (info.namespace, info.name)
             existing = self.named_actors.get(key)
             if existing is not None and self.actors[existing].state != DEAD:
                 raise ValueError(f"Actor name '{info.name}' already taken")
             self.named_actors[key] = info.actor_id
         self.actors[info.actor_id] = info
+        self._persist("actors", info.actor_id.hex(), info)
         await self._publish("actor", {"actor": info})
         return True
 
@@ -286,6 +345,7 @@ class GcsServer:
         actor.state = ALIVE
         actor.address = payload["address"]
         actor.node_id = payload.get("node_id")
+        self._persist("actors", actor.actor_id.hex(), actor)
         await self._publish("actor", {"actor": actor})
         return True
 
@@ -300,6 +360,7 @@ class GcsServer:
             actor.num_restarts += 1
             actor.state = RESTARTING
             actor.address = ""
+            self._persist("actors", actor.actor_id.hex(), actor)
             await self._publish("actor", {"actor": actor})
             # restart is driven by the owning core worker, which subscribes
             # to RESTARTING transitions and resubmits the creation task
@@ -307,6 +368,7 @@ class GcsServer:
             actor.state = DEAD
             actor.death_cause = cause
             actor.address = ""
+            self._persist("actors", actor.actor_id.hex(), actor)
             await self._publish("actor", {"actor": actor})
 
     async def handle_kill_actor(self, payload, conn):
@@ -317,6 +379,7 @@ class GcsServer:
         if actor.state != DEAD:
             actor.state = DEAD
             actor.death_cause = payload.get("cause", "ray_tpu.kill")
+            self._persist("actors", actor.actor_id.hex(), actor)
             await self._publish("actor", {"actor": actor})
         return True
 
@@ -345,6 +408,7 @@ class GcsServer:
             # one entry per bundle: NodeID once reserved, None while pending
             "bundle_nodes": [None] * len(bundles),
         }
+        self._persist("pgs", pg_id.hex(), self.placement_groups[pg_id])
         await self._publish("placement_group", self.placement_groups[pg_id])
         self._kick_pg_scheduler(pg_id)
         return True
@@ -375,6 +439,7 @@ class GcsServer:
                     return
                 if ok:
                     pg["state"] = "CREATED"
+                    self._persist("pgs", pg_id.hex(), pg)
                     self._wake_pg_waiters(pg_id)
                     await self._publish("placement_group", pg)
                     return
@@ -520,6 +585,7 @@ class GcsServer:
                 if nid is not None:
                     await self._cancel_bundle(pg["pg_id"], i, nid)
             pg["state"] = "REMOVED"
+            self._unpersist("pgs", pg["pg_id"].hex())
             self._wake_pg_waiters(pg["pg_id"])
             await self._publish("placement_group", pg)
         return True

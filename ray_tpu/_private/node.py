@@ -106,8 +106,12 @@ class Node:
         )
         self.gcs_server: Optional[GcsServer] = None
         if head:
-            self.gcs_server = GcsServer(self.gcs_address,
-                                        advertise_host=self.node_ip)
+            # journal in the session dir: a restarted GCS rebuilds its
+            # actor/PG/job/KV tables from it (the Redis-persistence analog)
+            self.gcs_server = GcsServer(
+                self.gcs_address,
+                journal_path=os.path.join(self.session_dir, "gcs_journal.bin"),
+                advertise_host=self.node_ip)
         self.raylet = Raylet(
             node_id=self.node_id,
             session_name=self.session_name,

@@ -135,6 +135,13 @@ class Raylet:
         self._leases: Dict[int, Lease] = {}
         self._next_lease_id = 1
         self._pending_leases: List[_PendingLease] = []
+        # lease-request dedup by client request id, so a retried request
+        # (reply lost, injected chaos, flaky DCN) returns the SAME grant
+        # instead of leaking a second worker (ref: retryable_grpc_client.h +
+        # lease idempotency in node_manager)
+        self._lease_rid_grants: Dict[str, dict] = {}
+        self._lease_rid_pending: Dict[str, asyncio.Future] = {}
+        self._lease_id_to_rid: Dict[int, str] = {}
         # object directory + wait manager
         self._sealed: Dict[ObjectID, int] = {}          # oid -> size
         self._object_waiters: Dict[ObjectID, List[asyncio.Future]] = {}
@@ -147,6 +154,7 @@ class Raylet:
         self._remote_nodes: Dict[NodeID, Tuple[str, ResourceSet]] = {}
         self._worker_conns: Dict[ServerConnection, WorkerID] = {}
         self._spill_rr = 0
+        self._resource_seq = 0
         self._subprocs: List[subprocess.Popen] = []
         # (pg_id, bundle_idx) -> bundle-local resource accounting: reserved
         # total + what's still leasable within it (ref:
@@ -235,13 +243,25 @@ class Raylet:
             self._remote_nodes.pop(payload.get("node_id"), None)
 
     async def _report_resources(self):
-        try:
-            await self.gcs.call("report_resources", {
-                "node_id": self.node_id,
-                "available": self.resources.available.to_dict(),
-            })
-        except Exception:
-            pass
+        """Fire-and-forget availability report. Never awaited into the lease
+        grant path — a lost frame must not stall granting. The sequence
+        number lets the GCS drop late/stale reports (absolute values +
+        last-writer-wins needs an order)."""
+        self._resource_seq += 1
+        payload = {
+            "node_id": self.node_id,
+            "available": self.resources.available.to_dict(),
+            "seq": self._resource_seq,
+        }
+
+        async def _send():
+            try:
+                await self.gcs.call_retrying("report_resources", payload,
+                                             attempts=3, per_try_timeout=2.0)
+            except Exception:
+                pass
+
+        asyncio.ensure_future(_send())
 
     # ---------------------------------------------------------- worker pool
     def _spawn_worker(self) -> None:
@@ -300,6 +320,7 @@ class Raylet:
             self._idle.remove(worker)
         if worker.lease is not None:
             lease = worker.lease
+            self._forget_rid(lease.lease_id)
             self._release_lease_resources(lease)
             self._leases.pop(lease.lease_id, None)
             await self._report_resources()
@@ -330,6 +351,17 @@ class Raylet:
         reply:   {granted: bool, worker_address, lease_id, node_id}
                | {retry_at: (node_id, address)}
         """
+        rid = payload.get("request_id")
+        if rid is not None:
+            cached = self._lease_rid_grants.get(rid)
+            if cached is not None and cached["lease_id"] in self._leases:
+                return cached  # duplicate of an already-granted request
+            pending = self._lease_rid_pending.get(rid)
+            if pending is not None:
+                # duplicate of a queued request; also covers the race where
+                # the future resolved but the original handler hasn't
+                # recorded the grant yet (awaiting a done future is a no-op)
+                return await pending
         resources = ResourceSet(payload.get("resources", {}))
         strategy = payload.get("strategy")
         target = self._pick_node(resources, strategy)
@@ -342,11 +374,30 @@ class Raylet:
                 raise ValueError("placement group bundle not reserved on this node")
         grant = await self._try_grant(resources, payload)
         if grant is not None:
+            self._record_rid_grant(rid, grant)
             return grant
         # queue until a worker/resources free up
         fut = asyncio.get_event_loop().create_future()
         self._pending_leases.append(_PendingLease(payload, fut, resources))
-        return await fut
+        if rid is not None:
+            self._lease_rid_pending[rid] = fut
+        try:
+            grant = await fut
+        finally:
+            if self._lease_rid_pending.get(rid) is fut:
+                self._lease_rid_pending.pop(rid, None)
+        self._record_rid_grant(rid, grant)
+        return grant
+
+    def _record_rid_grant(self, rid: Optional[str], grant: dict) -> None:
+        if rid is not None and grant.get("granted"):
+            self._lease_rid_grants[rid] = grant
+            self._lease_id_to_rid[grant["lease_id"]] = rid
+
+    def _forget_rid(self, lease_id: int) -> None:
+        rid = self._lease_id_to_rid.pop(lease_id, None)
+        if rid is not None:
+            self._lease_rid_grants.pop(rid, None)
 
     def _pg_key(self, strategy) -> Optional[tuple]:
         if isinstance(strategy, PlacementGroupSchedulingStrategy) and strategy.placement_group_id:
@@ -422,6 +473,7 @@ class Raylet:
         lease = self._leases.pop(payload["lease_id"], None)
         if lease is None:
             return False
+        self._forget_rid(lease.lease_id)
         self._release_lease_resources(lease)
         worker = lease.worker
         worker.lease = None
@@ -553,6 +605,7 @@ class Raylet:
         for lease in list(self._leases.values()):
             if lease.pg_key == key:
                 self._leases.pop(lease.lease_id, None)
+                self._forget_rid(lease.lease_id)
                 worker = lease.worker
                 worker.lease = None
                 worker.alive = False

@@ -243,6 +243,7 @@ class RpcClient:
         self._msg_ids = itertools.count(1)
         self._push_handlers: Dict[str, Callable[[Any], Any]] = {}
         self._write_lock = asyncio.Lock()
+        self._connect_lock = asyncio.Lock()
         self._recv_task: Optional[asyncio.Task] = None
         self.closed = False
 
@@ -264,6 +265,10 @@ class RpcClient:
                     raise ConnectionLost(f"cannot connect to {self.address}")
                 await asyncio.sleep(0.05)
         self.closed = False
+        # a reconnect must not leave the previous loop reading the stream —
+        # two readers on one StreamReader is a runtime error
+        if self._recv_task is not None and not self._recv_task.done():
+            self._recv_task.cancel()
         self._recv_task = asyncio.ensure_future(self._recv_loop())
 
     async def _recv_loop(self):
@@ -318,11 +323,14 @@ class RpcClient:
                 return await self.call(method, payload, timeout=per_try_timeout)
             except (asyncio.TimeoutError, ConnectionLost) as e:
                 last = e
-                if self.closed:
-                    try:
-                        await self.connect(timeout=per_try_timeout)
-                    except ConnectionLost:
-                        pass
+                # serialize reconnects: concurrent retriers racing connect()
+                # would spawn duplicate recv loops on one stream
+                async with self._connect_lock:
+                    if self.closed:
+                        try:
+                            await self.connect(timeout=per_try_timeout)
+                        except ConnectionLost:
+                            pass
                 await asyncio.sleep(base_delay * (2 ** i))
         raise last  # type: ignore[misc]
 

@@ -66,13 +66,20 @@ def _reconstruct_custom(mod: str, qualname: str, payload):
 
 
 def _device_to_host(obj: Any) -> Any:
-    """Convert jax.Array leaves to numpy before pickling (pytree-aware)."""
-    try:
-        import jax
-        import numpy as np
-    except ImportError:  # pragma: no cover
+    """Convert jax.Array leaves to numpy before pickling (pytree-aware).
+
+    Looks jax up in sys.modules instead of importing it: if this process
+    never imported jax there CANNOT be a jax.Array to convert, and a cold
+    jax import costs ~2s — which used to tax every pool worker's first
+    task result."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
         return obj
     if isinstance(obj, jax.Array):
+        import numpy as np
+
         return np.asarray(obj)
     return obj
 

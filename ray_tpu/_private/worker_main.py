@@ -123,17 +123,22 @@ class TaskExecutor:
             oid = ObjectID.for_return(spec.task_id, i + 1)
             data = ser.serialize(value)
             self.core.store.put(oid, data)
-            self.core.io.run(self.raylet.call("object_sealed",
-                                              {"object_id": oid, "size": len(data)}))
+            self._notify_sealed(oid, len(data))
             results.append((oid, data if len(data) <= small_limit else None))
         return results
+
+    def _notify_sealed(self, oid: ObjectID, size: int) -> None:
+        # idempotent + retried: a lost seal notification would strand every
+        # consumer waiting on this object in the directory
+        self.core.io.run(self.raylet.call_retrying(
+            "object_sealed", {"object_id": oid, "size": size},
+            attempts=5, per_try_timeout=2.0))
 
     def _seal_error(self, spec: TaskSpec, error: BaseException) -> bytes:
         data = ser.serialize_error(error)
         for oid in spec.return_ids():
             self.core.store.put(oid, data)
-            self.core.io.run(self.raylet.call("object_sealed",
-                                              {"object_id": oid, "size": len(data)}))
+            self._notify_sealed(oid, len(data))
         return data
 
     # ------------------------------------------------------------ execution
@@ -187,8 +192,7 @@ class TaskExecutor:
             index += 1
             oid = ObjectID.for_return(spec.task_id, index)
             self.core.store.put(oid, data)
-            self.core.io.run(self.raylet.call("object_sealed",
-                                              {"object_id": oid, "size": len(data)}))
+            self._notify_sealed(oid, len(data))
             push({"task_id": spec.task_id, "index": index, "object_id": oid,
                   "data": data if len(data) <= small_limit else None,
                   "done": False, "worker_address": self.core.address})

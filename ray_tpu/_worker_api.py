@@ -26,7 +26,13 @@ def is_initialized() -> bool:
 
 def core() -> CoreWorker:
     if _core is None:
-        # auto-init like the reference does on first API use
+        # auto-init like the reference does on first API use — but only
+        # from the main thread: a background thread (e.g. a leaked data
+        # pipeline stage) hitting the API after shutdown() must fail, not
+        # silently resurrect a whole new cluster
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                "ray_tpu is not initialized (auto-init is main-thread only)")
         init()
     return _core
 
@@ -99,15 +105,23 @@ def _connect_to_node(started_node: Node) -> Dict[str, Any]:
 
 
 def shutdown() -> None:
+    """Tear the runtime down. Best-effort and idempotent (ref: ray.shutdown):
+    globals are cleared FIRST so a failure mid-teardown can never strand a
+    half-dead core that makes the next init() refuse to run."""
     global _node, _core
     with _lock:
-        if _core is not None:
-            _core.shutdown()
-            _core = None
-        if _node is not None:
-            _node.stop()
-            _node = None
-        reset_global_config()
+        core, node = _core, _node
+        _core = None
+        _node = None
+        try:
+            if core is not None:
+                core.shutdown()
+        finally:
+            try:
+                if node is not None:
+                    node.stop()
+            finally:
+                reset_global_config()
 
 
 def put(value: Any) -> ObjectRef:

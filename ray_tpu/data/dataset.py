@@ -104,10 +104,17 @@ class Dataset:
         remote_args = _norm_remote_args(ray_remote_args)
 
         def block_fn(block):
-            kept = [row for row in rows_of(block) if fn(row)]
+            import numpy as np
+
             from .block import is_columnar
 
-            return to_columnar(kept) if is_columnar(block) else kept
+            if is_columnar(block):
+                # boolean-mask the columns: schema and dtypes survive even
+                # when no rows do
+                mask = np.fromiter((bool(fn(row)) for row in rows_of(block)),
+                                   dtype=bool, count=block_num_rows(block))
+                return {k: np.asarray(v)[mask] for k, v in block.items()}
+            return [row for row in block if fn(row)]
 
         return self._append(_LogicalOp(
             "map_block", "filter", {"block_fn": block_fn}, remote_args))
@@ -302,35 +309,52 @@ class DataIterator:
         prefetch thread while the caller consumes the current one. This is
         the host→HBM double-buffering path (BASELINE: "Data streams to
         HBM")."""
+        finished = False
+
         def produce() -> Iterator[Any]:
+            nonlocal finished
             for batch in _rebatch(self.iter_blocks(), batch_size, drop_last):
                 if batch_format == "numpy":
                     batch = to_columnar(batch)
                 yield to_device(batch) if to_device is not None else batch
+            finished = True
 
-        if prefetch_batches <= 0:
-            yield from produce()
-            return
-        q: "queue.Queue" = queue.Queue(maxsize=prefetch_batches)
-        END = object()
-
-        def pump():
-            try:
-                for item in produce():
-                    q.put(item)
-                q.put(END)
-            except BaseException as e:  # noqa: BLE001
-                q.put(e)
-
-        threading.Thread(target=pump, daemon=True,
-                         name=f"prefetch_split_{self._split}").start()
-        while True:
-            item = q.get()
-            if item is END:
+        try:
+            if prefetch_batches <= 0:
+                yield from produce()
                 return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+            q: "queue.Queue" = queue.Queue(maxsize=prefetch_batches)
+            END = object()
+
+            def pump():
+                try:
+                    for item in produce():
+                        q.put(item)
+                    q.put(END)
+                except BaseException as e:  # noqa: BLE001
+                    q.put(e)
+
+            threading.Thread(target=pump, daemon=True,
+                             name=f"prefetch_split_{self._split}").start()
+            while True:
+                item = q.get()
+                if item is END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            if not finished:
+                self.stop()
+
+    def stop(self) -> None:
+        """Abandon this split mid-stream: tells the coordinator to stop
+        feeding it so its full queue cannot stall the other splits. Called
+        automatically when a batch loop exits early."""
+        try:
+            self._coordinator.release_split.remote(self._split)
+        except Exception:
+            pass
 
     def __iter__(self):
         return self.iter_batches()

@@ -39,7 +39,10 @@ class RangeDatasource(Datasource):
         self.n = n
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
-        parallelism = max(1, min(parallelism, self.n or 1))
+        if self.n <= 0:
+            return [ReadTask(lambda: iter([{"id": np.empty(0, np.int64)}]),
+                             num_rows=0)]
+        parallelism = max(1, min(parallelism, self.n))
         shard = -(-self.n // parallelism)
         tasks = []
         for start in range(0, self.n, shard):

@@ -52,7 +52,23 @@ class _Stage(threading.Thread):
         except BaseException as e:  # noqa: BLE001 — surfaced by the executor
             self.error = e
         finally:
-            self.out_q.put(_SENTINEL)
+            try:
+                self.out_q.put(_SENTINEL, timeout=1.0)
+            except queue.Full:
+                pass  # stream already abandoned; downstream stops by event
+
+    def _put_out(self, item) -> bool:
+        """Bounded, stop-aware put: returns False (dropping the item) once
+        the stream is being torn down, so no stage thread can block forever
+        on a queue whose consumer is gone."""
+        while True:
+            if self.stop_event.is_set():
+                return False
+            try:
+                self.out_q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
 
     def _run(self):
         raise NotImplementedError
@@ -94,9 +110,17 @@ class ReadStage(_Stage):
 
                         cancel(gen)
                         break
-                    buf.put(ref)
+                    while not self.stop_event.is_set():
+                        try:
+                            buf.put(ref, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
             finally:
-                buf.put(task_done)
+                try:
+                    buf.put(task_done, timeout=1.0)
+                except queue.Full:
+                    pass
                 slots.release()
 
         def _launch_all():
@@ -123,11 +147,16 @@ class ReadStage(_Stage):
             if buf is None:
                 return
             while True:
-                item = buf.get()
+                try:
+                    item = buf.get(timeout=0.5)
+                except queue.Empty:
+                    if self.stop_event.is_set():
+                        return
+                    continue
                 if item is task_done:
                     break
-                self.out_q.put(item)
-                self.stats.blocks_out += 1
+                if self._put_out(item):
+                    self.stats.blocks_out += 1
             i += 1
 
 
@@ -141,7 +170,8 @@ class RefsStage(_Stage):
 
     def _run(self):
         for ref in self.refs:
-            self.out_q.put(ref)
+            if not self._put_out(ref):
+                return
             self.stats.blocks_out += 1
 
 
@@ -166,12 +196,12 @@ class MapStage(_Stage):
         inflight: "collections.deque" = collections.deque()
         eof = False
         while True:
-            # keep the task pool full without blocking on a quiet input
+            # keep the task pool full; every wait is bounded so stop_event
+            # (limit satisfied, stream torn down) always terminates the
+            # stage — a stage thread must never outlive its executor
             while not eof and len(inflight) < MAX_INFLIGHT_PER_STAGE:
                 try:
-                    timeout = 0.2 if self.stop_event.is_set() else (
-                        None if not inflight else 0.02)
-                    item = self.in_q.get(timeout=timeout)
+                    item = self.in_q.get(timeout=0.2)
                 except queue.Empty:
                     if self.stop_event.is_set() and not inflight:
                         return
@@ -188,11 +218,10 @@ class MapStage(_Stage):
                     return
                 continue
             head = inflight[0]
-            ready, _ = wait([head], num_returns=1,
-                            timeout=None if eof else 0.1)
+            ready, _ = wait([head], num_returns=1, timeout=0.2)
             if ready:
-                self.out_q.put(inflight.popleft())
-                self.stats.blocks_out += 1
+                if self._put_out(inflight.popleft()):
+                    self.stats.blocks_out += 1
 
 
 class ShuffleStage(_Stage):
@@ -223,7 +252,12 @@ class ShuffleStage(_Stage):
 
         refs = []
         while True:
-            item = self.in_q.get()
+            try:
+                item = self.in_q.get(timeout=0.5)
+            except queue.Empty:
+                if self.stop_event.is_set():
+                    return
+                continue
             if item is _SENTINEL:
                 break
             refs.append(item)
@@ -231,7 +265,8 @@ class ShuffleStage(_Stage):
         order = rng.permutation(len(refs))
         seeds = rng.integers(0, 2**31, size=len(refs))
         for i in order:
-            self.out_q.put(_shuffle_block.remote(refs[i], int(seeds[i])))
+            if not self._put_out(_shuffle_block.remote(refs[i], int(seeds[i]))):
+                return
             self.stats.tasks_submitted += 1
             self.stats.blocks_out += 1
 
@@ -261,23 +296,35 @@ class LimitStage(_Stage):
 
         taken = 0
         while taken < self.limit:
-            item = self.in_q.get()
+            try:
+                item = self.in_q.get(timeout=0.5)
+            except queue.Empty:
+                if self.stop_event.is_set():
+                    return
+                continue
             if item is _SENTINEL:
                 return
             rows = get(_nrows.remote(item))
             if taken + rows <= self.limit:
-                self.out_q.put(item)
+                if not self._put_out(item):
+                    return
                 taken += rows
             else:
-                self.out_q.put(_head.remote(item, self.limit - taken))
+                if not self._put_out(_head.remote(item, self.limit - taken)):
+                    return
                 taken = self.limit
             self.stats.blocks_out += 1
         # limit satisfied: tell upstream stages to stop dispatching/reading,
         # then drain (and drop) what's already in flight
         for stage in self.upstream:
             stage.stop_event.set()
-        while self.in_q.get() is not _SENTINEL:
-            pass
+        while True:
+            try:
+                if self.in_q.get(timeout=0.5) is _SENTINEL:
+                    return
+            except queue.Empty:
+                if self.stop_event.is_set():
+                    return
 
 
 class StreamingExecutor:
@@ -295,16 +342,22 @@ class StreamingExecutor:
                 stage.start()
 
     def iter_output(self):
-        """Yield block refs; raises the first stage error at stream end."""
+        """Yield block refs; raises the first stage error at stream end.
+        On exit (clean, error, or abandoned generator) every stage is told
+        to stop so no thread outlives the execution."""
         self.start()
-        while True:
-            item = self.out_q.get()
-            if item is _SENTINEL:
-                break
-            yield item
-        for stage in self.stages:
-            if stage.error is not None:
-                raise stage.error
+        try:
+            while True:
+                item = self.out_q.get()
+                if item is _SENTINEL:
+                    break
+                yield item
+            for stage in self.stages:
+                if stage.error is not None:
+                    raise stage.error
+        finally:
+            for stage in self.stages:
+                stage.stop_event.set()
 
     def stats(self) -> List[StageStats]:
         return [s.stats for s in self.stages]
@@ -360,6 +413,7 @@ class SplitCoordinator:
         self._pump: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._drained: set = set()
+        self._released: set = set()   # splits whose consumer gave up
 
     def _ensure_started(self):
         with self._lock:
@@ -371,7 +425,22 @@ class SplitCoordinator:
                 i = 0
                 try:
                     for ref in executor.iter_output():
-                        self.queues[i % self.n].put(ref)
+                        # bounded put, re-checking for released splits: a
+                        # consumer that stopped pulling (early epoch end,
+                        # dead worker whose iterator was released) must not
+                        # wedge every other split behind its full queue
+                        if len(self._released) == self.n:
+                            return  # every consumer gone: stop executing
+                        while True:
+                            split = i % self.n
+                            if split in self._released:
+                                i += 1  # drop this split's share
+                                continue
+                            try:
+                                self.queues[split].put(ref, timeout=1.0)
+                                break
+                            except queue.Full:
+                                continue
                         i += 1
                 finally:
                     for q in self.queues:
@@ -380,6 +449,24 @@ class SplitCoordinator:
             self._pump = threading.Thread(target=pump, daemon=True,
                                           name="split_pump")
             self._pump.start()
+
+    def release_split(self, split: int) -> bool:
+        """Consumer gave up on this split (iterator closed): stop feeding
+        it so its full queue cannot wedge the other splits."""
+        self._released.add(split)
+        with self._lock:
+            self._drained.add(split)
+            if len(self._drained) == self.n:
+                import os
+                import threading as _t
+
+                _t.Timer(0.5, lambda: os._exit(0)).start()
+        # unblock a pump stuck on this queue right now
+        try:
+            self.queues[split].get_nowait()
+        except queue.Empty:
+            pass
+        return True
 
     def next_block(self, split: int):
         """The next block for this split (as a value — the actor-task

@@ -115,8 +115,19 @@ class TrainWorker:
         first reclaim the device plane."""
         if not use_tpu:
             return
-        # undo the pool-worker CPU pin so jax sees the host's chips
+        # undo the pool-worker CPU pin so jax sees the host's chips — but
+        # only if jax hasn't initialized yet in this process: a reused pool
+        # worker whose earlier task touched jax is pinned to CPU for good,
+        # and silently training a "TPU" gang on CPU must not happen
+        import sys
+
         if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            if "jax" in sys.modules:
+                raise RuntimeError(
+                    "TPU train worker landed in a process where jax was "
+                    "already initialized under JAX_PLATFORMS=cpu; the device "
+                    "plane cannot be reclaimed. Schedule TPU gangs onto "
+                    "fresh workers (dedicated PG bundles).")
             os.environ.pop("JAX_PLATFORMS", None)
         if context.world_size <= 1 or not context.coordinator_address:
             return
@@ -227,9 +238,14 @@ class WorkerGroup:
         if self.scaling.num_workers > 1:
             port = get(self.workers[0].pick_port.remote(), timeout=60)
             self.coordinator_address = f"{infos[0]['hostname']}:{port}"
-        # checkpoint for workers on other filesystems rides as a tar blob
+        # checkpoint for workers on OTHER nodes rides as a tar blob; workers
+        # sharing this node's filesystem read the path directly (no n-fold
+        # copy of a multi-GB checkpoint through the object store)
+        local_node = self._local_node_id()
         restore_blob = None
-        if restore_path and os.path.isdir(restore_path):
+        remote_ranks = {i for i, inf in enumerate(infos)
+                        if inf["node_id"] != local_node}
+        if restore_path and os.path.isdir(restore_path) and remote_ranks:
             import io
             import tarfile
 
@@ -242,9 +258,19 @@ class WorkerGroup:
         get([
             w.start.remote(blob, train_config, self.scaling.num_workers,
                            self.coordinator_address, restore_path,
-                           restore_blob, self.scaling.use_tpu)
-            for w in self.workers
+                           restore_blob if i in remote_ranks else None,
+                           self.scaling.use_tpu)
+            for i, w in enumerate(self.workers)
         ], timeout=300)
+
+    @staticmethod
+    def _local_node_id() -> str:
+        from .. import _worker_api
+
+        node = _worker_api.node()
+        if node is not None:
+            return node.node_id.hex()
+        return os.environ.get("RAY_TPU_NODE_ID", "")
 
     def poll(self) -> List[Dict[str, Any]]:
         """One poll round; a dead or unresponsive worker surfaces as

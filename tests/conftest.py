@@ -28,6 +28,8 @@ def ray_start_regular():
     """Fresh single-node cluster per test (ref: conftest.py:580)."""
     import ray_tpu
 
+    if ray_tpu.is_initialized():  # a prior module's teardown misfired
+        ray_tpu.shutdown()
     info = ray_tpu.init(num_cpus=4, ignore_reinit_error=False)
     yield info
     ray_tpu.shutdown()

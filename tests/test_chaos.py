@@ -1,0 +1,151 @@
+"""RPC chaos + GCS restart recovery (ref: src/ray/rpc/rpc_chaos.h:23 +
+RAY_testing_rpc_failure tests; gcs FT via redis persistence —
+gcs_init_data.h restart rebuild).
+
+Chaos format: "method=max_failures:req_drop_prob:resp_drop_prob,...".
+Dropped requests never dispatch; dropped responses execute server-side but
+the reply vanishes — exercising idempotency (request-id lease dedup,
+retried seal notifications)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def chaos_env():
+    """Set chaos + short lease RPC timeout before init; clean after."""
+    def _set(spec: str):
+        os.environ["RAY_TPU_TESTING_RPC_FAILURE"] = spec
+        os.environ["RAY_TPU_LEASE_RPC_TIMEOUT_S"] = "1.0"
+        ray_tpu.init(num_cpus=2)
+
+    yield _set
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_TESTING_RPC_FAILURE", None)
+    os.environ.pop("RAY_TPU_LEASE_RPC_TIMEOUT_S", None)
+
+
+@ray_tpu.remote
+def add_one(x):
+    return x + 1
+
+
+def test_lease_request_drops(chaos_env):
+    """First 4 lease requests vanish: retries must land the leases."""
+    chaos_env("request_worker_lease=4:1.0:0.0")
+    out = ray_tpu.get([add_one.remote(i) for i in range(8)], timeout=120)
+    assert out == [i + 1 for i in range(8)]
+
+
+def test_lease_response_drops_do_not_leak_workers(chaos_env):
+    """Replies to granted leases vanish: the retried request must get the
+    SAME grant back (request-id dedup), not leak a worker + resources."""
+    chaos_env("request_worker_lease=3:0.0:1.0")
+    out = ray_tpu.get([add_one.remote(i) for i in range(8)], timeout=120)
+    assert out == [i + 1 for i in range(8)]
+    # every lease returned: the cluster drains back to full capacity
+    import time
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) == 2.0:
+            break
+        time.sleep(0.1)
+    assert ray_tpu.available_resources().get("CPU", 0) == 2.0
+
+
+def test_seal_notification_drops(chaos_env):
+    """Sealed-object notifications vanish: retries must still register the
+    objects so consumers find them. (3 drop credits < the 4 per-call retry
+    attempts, so no single seal can exhaust its retries.)"""
+    chaos_env("object_sealed=3:1.0:0.0")
+
+    @ray_tpu.remote
+    def big(i):
+        return np.full(200_000, i, dtype=np.float32)  # plasma path
+
+    refs = [big.remote(i) for i in range(4)]
+    for i, ref in enumerate(refs):
+        assert ray_tpu.get(ref, timeout=120)[0] == i
+
+
+def test_mixed_chaos_suite_green(chaos_env):
+    """Drops across lease + seal + resource-report paths at once."""
+    chaos_env("request_worker_lease=3:0.5:0.5,object_sealed=4:1.0:0.0,"
+              "report_resources=10:1.0:0.0")
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    out = ray_tpu.get([add_one.remote(i) for i in range(12)], timeout=120)
+    assert out == [i + 1 for i in range(12)]
+    c = Counter.remote()
+    assert ray_tpu.get([c.incr.remote() for _ in range(5)],
+                       timeout=120) == [1, 2, 3, 4, 5]
+
+
+# ------------------------------------------------------- GCS journal restart
+
+def test_gcs_restart_rebuilds_state(tmp_path):
+    """Kill the GCS; a new instance on the same journal must serve the KV
+    table, actor table (incl. named lookup), jobs, and placement groups."""
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.ids import ActorID, JobID, PlacementGroupID
+    from ray_tpu._private.rpc import RpcClient
+
+    journal = str(tmp_path / "journal.bin")
+    sock1 = str(tmp_path / "gcs1.sock")
+    sock2 = str(tmp_path / "gcs2.sock")
+    job = JobID.from_int(1)
+    actor_id = ActorID.of(job)
+    pg_id = PlacementGroupID.of(job)
+
+    async def first_life():
+        gcs = GcsServer(sock1, journal_path=journal)
+        await gcs.start()
+        client = RpcClient(sock1)
+        await client.connect()
+        await client.call("kv_put", {"ns": "functions", "key": "blob1",
+                                     "value": b"pickled_fn"})
+        await client.call("register_job", {"config": {"x": 1}})
+        await client.call("register_actor", {
+            "actor_id": actor_id, "name": "svc", "namespace": "prod",
+            "class_name": "Svc", "max_restarts": 2})
+        await client.call("actor_alive", {"actor_id": actor_id,
+                                          "address": "host:1234"})
+        await client.call("create_placement_group", {
+            "pg_id": pg_id, "bundles": [{"CPU": 1}], "strategy": "PACK"})
+        await client.close()
+        await gcs.stop()   # hard stop: no clean table flush beyond journal
+
+    async def second_life():
+        gcs = GcsServer(sock2, journal_path=journal)
+        await gcs.start()
+        client = RpcClient(sock2)
+        await client.connect()
+        assert await client.call("kv_get", {"ns": "functions",
+                                            "key": "blob1"}) == b"pickled_fn"
+        actor = await client.call("get_actor", {"name": "svc",
+                                                "namespace": "prod"})
+        assert actor is not None and actor.actor_id == actor_id
+        assert actor.state == "ALIVE" and actor.max_restarts == 2
+        jobs = await client.call("get_all_jobs", {})
+        assert len(jobs) == 1
+        pg = await client.call("get_placement_group", {"pg_id": pg_id})
+        assert pg is not None and pg["bundles"] == [{"CPU": 1}]
+        await client.close()
+        await gcs.stop()
+
+    asyncio.run(first_life())
+    asyncio.run(second_life())
