@@ -54,6 +54,13 @@ from .. import exceptions as exc
 
 _SMALL = None  # resolved from config at init
 
+# per-coroutine task binding for async actors (thread-locals cannot
+# distinguish coroutines interleaving on one loop thread)
+import contextvars
+
+_task_ctx_var: "contextvars.ContextVar[Optional[TaskID]]" = \
+    contextvars.ContextVar("ray_tpu_task_ctx", default=None)
+
 
 @dataclass
 class _ActorState:
@@ -162,6 +169,9 @@ class CoreWorker:
     # ------------------------------------------------------- task context
     @property
     def current_task_id(self) -> TaskID:
+        ctx = _task_ctx_var.get()
+        if ctx is not None:
+            return ctx
         return getattr(self._task_local, "task_id", None) or self._default_task_id
 
     @current_task_id.setter
@@ -175,6 +185,12 @@ class CoreWorker:
 
     def clear_task_context(self) -> None:
         self._task_local.task_id = None
+
+    def set_async_task_context(self, task_id: TaskID) -> None:
+        """Bind the executing task to the current coroutine context: async
+        actor methods interleave on ONE loop thread, so thread-locals
+        cannot tell them apart — contextvars can."""
+        _task_ctx_var.set(task_id)
 
     # ------------------------------------------------------------- lifecycle
     def connect(self):
@@ -195,6 +211,15 @@ class CoreWorker:
         _set_ref_registry(None)
 
     async def _shutdown(self):
+        if self.mode == "driver" and not self.gcs.closed:
+            try:
+                # clean detach: the GCS tears down this job's non-detached
+                # actors immediately instead of waiting out the
+                # connection-drop grace window
+                await self.gcs.call("driver_exit", {"job_id": self.job_id},
+                                    timeout=3)
+            except Exception:
+                pass
         for task in list(self._worker_clients.values()):
             try:
                 client = await asyncio.wait_for(asyncio.shield(task), 1.0)
@@ -881,7 +906,12 @@ class CoreWorker:
 
     # ------------------------------------------------------------- actors
     def submit_actor_creation(self, cls: Any, args: tuple, kwargs: dict, opts: dict) -> ActorID:
-        strategy = self._resolve_strategy(opts)  # validate before pinning args
+        # all option validation BEFORE any state mutation/arg pinning
+        strategy = self._resolve_strategy(opts)
+        detached = opts.get("lifetime") == "detached"
+        if detached and not opts.get("name"):
+            raise ValueError("detached actors must be named (lookup is the "
+                             "only way to reach them after the driver exits)")
         actor_id = ActorID.of(self.job_id)
         descriptor = self.export_function(cls)
         packed, deps = self._pack_args(args, kwargs)
@@ -896,7 +926,9 @@ class CoreWorker:
             actor_id=actor_id,
             actor_creation=True,
             actor_max_restarts=opts.get("max_restarts", self.cfg.actor_max_restarts_default),
-            actor_max_concurrency=opts.get("max_concurrency", 1),
+            # 0 = unset: sync actors default to 1 thread, async actors to
+            # 1000 slots; an EXPLICIT max_concurrency=1 stays serialized
+            actor_max_concurrency=opts.get("max_concurrency") or 0,
             actor_name=opts.get("name") or "",
             owner_address=self.address,
         )
@@ -908,6 +940,7 @@ class CoreWorker:
             "actor_id": actor_id,
             "name": spec.actor_name,
             "namespace": opts.get("namespace", ""),
+            "detached": detached,
             "class_name": spec.function.repr_name,
             "max_restarts": spec.actor_max_restarts,
             "creation_spec": cloudpickle.dumps(spec),

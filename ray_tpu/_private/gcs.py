@@ -47,6 +47,7 @@ class NodeInfo:
     slice_name: str = ""
     host_index: int = 0
     resource_seq: int = 0     # last-applied availability report sequence
+    store_dir: str = ""       # shm namespace (same-host drivers attach to it)
 
 
 @dataclass
@@ -55,6 +56,8 @@ class ActorInfo:
     state: str
     name: str = ""
     namespace: str = ""
+    detached: bool = False    # survives its creating driver (ref: detached
+    #                           lifetime, gcs_actor_manager job cleanup)
     address: str = ""                 # worker socket when ALIVE
     node_id: Optional[NodeID] = None
     class_name: str = ""
@@ -161,6 +164,8 @@ class GcsServer:
         # pubsub: channel -> set of subscribed connections
         self._subs: Dict[str, Set[ServerConnection]] = {}
         self._node_conns: Dict[ServerConnection, NodeID] = {}
+        self._driver_conns: Dict[ServerConnection, JobID] = {}
+        self._driver_cleanup_timers: Dict[JobID, asyncio.Task] = {}
         self._next_job = 1
         self._restore_tables()
 
@@ -226,6 +231,70 @@ class GcsServer:
         node_id = self._node_conns.pop(conn, None)
         if node_id is not None:
             await self._mark_node_dead(node_id, "raylet disconnected")
+        job_id = self._driver_conns.pop(conn, None)
+        if job_id is not None:
+            # a dropped connection is only a HINT of driver death (network
+            # blip, reconnect in flight): grant a grace window and cancel
+            # if the driver re-registers. Clean exits send driver_exit
+            # explicitly and skip the grace.
+            self._schedule_driver_cleanup(job_id)
+
+    def _schedule_driver_cleanup(self, job_id: JobID, grace_s: float = 10.0):
+        if job_id in self._driver_cleanup_timers:
+            return
+
+        async def _later():
+            try:
+                await asyncio.sleep(grace_s)
+                await self._on_driver_exit(job_id)
+            finally:
+                self._driver_cleanup_timers.pop(job_id, None)
+
+        self._driver_cleanup_timers[job_id] = asyncio.ensure_future(_later())
+
+    async def handle_register_driver(self, payload, conn):
+        """Bind this connection to a driver's job: when the driver goes
+        away, its non-detached actors are torn down (ref:
+        gcs_actor_manager.cc OnJobFinished)."""
+        job_id = payload["job_id"]
+        self._driver_conns[conn] = job_id
+        timer = self._driver_cleanup_timers.pop(job_id, None)
+        if timer is not None:
+            timer.cancel()  # driver reconnected within the grace window
+        return True
+
+    async def handle_driver_exit(self, payload, conn):
+        """Explicit clean driver detach: immediate cleanup, no grace."""
+        timer = self._driver_cleanup_timers.pop(payload["job_id"], None)
+        if timer is not None:
+            timer.cancel()
+        self._driver_conns.pop(conn, None)
+        await self._on_driver_exit(payload["job_id"])
+        return True
+
+    async def _on_driver_exit(self, job_id: JobID):
+        for actor in list(self.actors.values()):
+            if (actor.actor_id.job_id() == job_id and not actor.detached
+                    and actor.state != DEAD):
+                address = actor.address
+                actor.max_restarts = 0
+                actor.state = DEAD
+                actor.death_cause = "creating driver exited"
+                self._persist("actors", actor.actor_id.hex(), actor)
+                await self._publish("actor", {"actor": actor})
+                if address:
+                    asyncio.ensure_future(self._kill_actor_process(address))
+
+    async def _kill_actor_process(self, address: str):
+        from .rpc import RpcClient
+
+        try:
+            client = RpcClient(address)
+            await client.connect(timeout=2)
+            await client.call("kill_self", {}, timeout=2)
+            await client.close()
+        except Exception:
+            pass  # worker already gone
 
     # ---- nodes ----
     async def handle_register_node(self, payload, conn):
@@ -323,6 +392,7 @@ class GcsServer:
             state=PENDING_CREATION,
             name=payload.get("name", ""),
             namespace=payload.get("namespace", ""),
+            detached=payload.get("detached", False),
             class_name=payload.get("class_name", ""),
             max_restarts=payload.get("max_restarts", 0),
             creation_spec=payload.get("creation_spec"),
@@ -341,6 +411,12 @@ class GcsServer:
     async def handle_actor_alive(self, payload, conn):
         actor = self.actors.get(payload["actor_id"])
         if actor is None:
+            return False
+        if actor.state == DEAD:
+            # killed while still creating (driver exited, explicit kill):
+            # do NOT resurrect — put the late-arriving worker down instead
+            asyncio.ensure_future(
+                self._kill_actor_process(payload["address"]))
             return False
         actor.state = ALIVE
         actor.address = payload["address"]

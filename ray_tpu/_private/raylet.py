@@ -178,6 +178,7 @@ class Raylet:
             "labels": self.labels,
             "slice_name": self.labels.get("slice_name", ""),
             "host_index": int(self.labels.get("host_index", 0)),
+            "store_dir": self.store.dir,
         })
         for info in reply["nodes"]:
             if info.node_id != self.node_id and info.alive:

@@ -68,6 +68,9 @@ class TaskExecutor:
         # actor runtime
         self.actor_instance: Any = None
         self.actor_id = None
+        self.actor_async = False
+        self._actor_loop_obj = None
+        self._actor_sem = None
         self._actor_queue: "queue.Queue" = queue.Queue()
         self._actor_threads: List[threading.Thread] = []
         # cancellation: task_id -> executing thread (ref: _raylet.pyx
@@ -222,21 +225,75 @@ class TaskExecutor:
 
     def execute_actor_creation(self, spec: TaskSpec) -> dict:
         try:
+            import inspect
+
             cls = self.core.load_function(spec.function.blob_id)
             if hasattr(cls, "__ray_tpu_actor_class__"):
                 cls = cls.__ray_tpu_actor_class__
             args, kwargs = self._resolve_args(spec)
             self.actor_instance = cls(*args, **kwargs)
             self.actor_id = spec.actor_id
-            n_threads = max(1, spec.actor_max_concurrency)
-            for i in range(n_threads):
-                t = threading.Thread(target=self._actor_loop, daemon=True,
-                                     name=f"actor_exec_{i}")
+            # async actors: any coroutine method promotes the actor to an
+            # asyncio runtime — methods interleave at await points, bounded
+            # by max_concurrency (ref: _raylet.pyx async actor path /
+            # core_worker fiber.h; reference default concurrency is 1000)
+            self.actor_async = any(
+                inspect.iscoroutinefunction(m)
+                for _, m in inspect.getmembers(type(self.actor_instance),
+                                               inspect.isfunction))
+            if self.actor_async:
+                concurrency = (spec.actor_max_concurrency
+                               if spec.actor_max_concurrency > 0 else 1000)
+                self._actor_loop_obj = asyncio.new_event_loop()
+                self._actor_sem = None  # created on the actor loop
+                self._actor_concurrency = concurrency
+
+                def _loop_main():
+                    asyncio.set_event_loop(self._actor_loop_obj)
+                    self._actor_sem = asyncio.Semaphore(concurrency)
+                    self._actor_loop_obj.run_forever()
+
+                t = threading.Thread(target=_loop_main, daemon=True,
+                                     name="actor_asyncio")
                 t.start()
                 self._actor_threads.append(t)
+            else:
+                n_threads = max(1, spec.actor_max_concurrency or 1)
+                for i in range(n_threads):
+                    t = threading.Thread(target=self._actor_loop, daemon=True,
+                                         name=f"actor_exec_{i}")
+                    t.start()
+                    self._actor_threads.append(t)
             return {"results": [], "error": None}
         except BaseException as e:  # noqa: BLE001
             return {"results": [], "error": self._seal_error(spec, e)}
+
+    async def execute_actor_task_async(self, spec: TaskSpec) -> dict:
+        """One actor task on the actor's asyncio loop: blocking work
+        (arg fetch, sealing) is pushed to the thread pool so thousands of
+        calls can be parked at await points concurrently."""
+        loop = asyncio.get_event_loop()
+        while self._actor_sem is None:  # loop thread still starting
+            await asyncio.sleep(0.001)
+        async with self._actor_sem:
+            try:
+                # run_coroutine_threadsafe gave this task its own Context,
+                # so the binding is visible to this coroutine only
+                self.core.set_async_task_context(spec.task_id)
+                method = getattr(self.actor_instance, spec.function.method_name)
+                args, kwargs = await loop.run_in_executor(
+                    self.pool, self._resolve_args, spec)
+                values = method(*args, **kwargs)
+                if asyncio.iscoroutine(values):
+                    values = await values
+                return await loop.run_in_executor(
+                    self.pool, lambda: {
+                        "results": self._seal_results(spec, values),
+                        "error": None})
+            except BaseException as e:  # noqa: BLE001
+                return {"results": [],
+                        "error": await loop.run_in_executor(
+                            self.pool, self._seal_error, spec, e)}
 
     def _actor_loop(self):
         while True:
@@ -346,6 +403,11 @@ async def _amain():
                 })
             return reply
         if spec.is_actor_task():
+            if getattr(executor, "actor_async", False):
+                afut = asyncio.run_coroutine_threadsafe(
+                    executor.execute_actor_task_async(spec),
+                    executor._actor_loop_obj)
+                return await asyncio.wrap_future(afut)
             fut = loop.create_future()
 
             def reply_cb(result, fut=fut):

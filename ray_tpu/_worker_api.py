@@ -42,6 +42,7 @@ def node() -> Optional[Node]:
 
 
 def init(
+    address: Optional[str] = None,
     *,
     resources: Optional[Dict[str, float]] = None,
     num_cpus: Optional[float] = None,
@@ -51,6 +52,9 @@ def init(
     _system_config: Optional[Dict[str, Any]] = None,
     ignore_reinit_error: bool = False,
 ) -> Dict[str, Any]:
+    """Start a local cluster, or — with ``address`` (a GCS address) —
+    attach this process as a driver to an existing one (ref: ray.init
+    address= semantics). Detaching drivers leave the cluster running."""
     global _node, _core
     with _lock:
         if _core is not None:
@@ -59,6 +63,8 @@ def init(
             raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
         if _system_config:
             global_config().apply_overrides(_system_config)
+        if address is not None:
+            return _connect_to_address(address)
         res = dict(resources or {})
         if num_cpus is not None:
             res["CPU"] = num_cpus
@@ -72,6 +78,61 @@ def init(
                     object_store_memory=object_store_memory)
         node.start()
         return _connect_to_node(node)
+
+
+def _connect_to_address(gcs_address: str) -> Dict[str, Any]:
+    """Driver-only attach to a running cluster: no node is started or
+    owned, so shutdown() detaches without stopping anything. Assumes a
+    same-host head node (the shm store is attached directly); remote
+    drivers are the future ray-client analog."""
+    global _core
+    from ._private.ids import NodeID, TaskID
+    from ._private.object_store import SharedObjectStore
+    from ._private.rpc import EventLoopThread, RpcClient
+
+    import os
+
+    io = EventLoopThread(name="ray_tpu_io_driver")
+
+    async def _head_info():
+        client = RpcClient(gcs_address)
+        await client.connect(timeout=10)
+        nodes = await client.call("get_all_nodes", {})
+        await client.close()
+        # pick a node whose store is reachable on THIS host: on multi-node
+        # clusters get_all_nodes ordering is arbitrary and a remote node's
+        # shm path would silently give us a store its raylet never sees
+        for info in nodes:
+            if info.alive and info.store_dir and os.path.isdir(info.store_dir):
+                return info
+        raise RuntimeError(
+            f"no live same-host node found at {gcs_address} (remote "
+            "drivers are not supported yet — run on a cluster host)")
+
+    try:
+        head = io.run(_head_info())
+    except BaseException:
+        io.stop()  # don't leak the io thread on a failed attach
+        raise
+    store = SharedObjectStore(head.store_dir,
+                              global_config().object_store_memory_bytes,
+                              create_dir=False)
+    _core = CoreWorker(
+        mode="driver",
+        session_name="",
+        gcs_address=gcs_address,
+        raylet_address=head.address,
+        job_id=JobID.from_int(0),
+        node_id=head.node_id,
+        store=store,
+        io=io,
+    )
+    _core.connect()
+    job_id = _core.io.run(_core.gcs.call("register_job", {"config": {}}))
+    _core.job_id = job_id
+    _core.current_task_id = TaskID.for_driver(job_id)
+    _core.io.run(_core.gcs.call("register_driver", {"job_id": job_id}))
+    return {"gcs_address": gcs_address, "node_id": head.node_id.hex()}
 
 
 def _connect_to_node(started_node: Node) -> Dict[str, Any]:
@@ -94,6 +155,7 @@ def _connect_to_node(started_node: Node) -> Dict[str, Any]:
         _core.connect()
         job_id = _core.io.run(_core.gcs.call("register_job", {"config": {}}))
         _core.job_id = job_id
+        _core.io.run(_core.gcs.call("register_driver", {"job_id": job_id}))
         from ._private.ids import TaskID
 
         _core.current_task_id = TaskID.for_driver(job_id)

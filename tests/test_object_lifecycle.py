@@ -71,7 +71,11 @@ def test_streaming_generator_backpressure(ray_cluster, tmp_path):
             yield i
 
     it = gen.remote(marker)
-    time.sleep(1.0)  # producer must stall at the budget, not sprint to 8
+    deadline = time.time() + 30
+    while time.time() < deadline and not os.path.exists(marker):
+        time.sleep(0.05)  # wait out cold worker spawn
+    assert os.path.exists(marker), "producer never started"
+    time.sleep(0.8)  # producer must stall at the budget, not sprint to 8
     produced = int(open(marker).read())
     assert produced <= 3, f"producer ran {produced} items ahead despite budget"
     out = [ray_tpu.get(r) for r in it]
