@@ -941,6 +941,7 @@ class CoreWorker:
             "name": spec.actor_name,
             "namespace": opts.get("namespace", ""),
             "detached": detached,
+            "owner_is_driver": self.mode == "driver",
             "class_name": spec.function.repr_name,
             "max_restarts": spec.actor_max_restarts,
             "creation_spec": cloudpickle.dumps(spec),

@@ -58,6 +58,7 @@ class ActorInfo:
     namespace: str = ""
     detached: bool = False    # survives its creating driver (ref: detached
     #                           lifetime, gcs_actor_manager job cleanup)
+    owner_is_driver: bool = True  # created by a driver (vs by another actor)
     address: str = ""                 # worker socket when ALIVE
     node_id: Optional[NodeID] = None
     class_name: str = ""
@@ -393,6 +394,7 @@ class GcsServer:
             name=payload.get("name", ""),
             namespace=payload.get("namespace", ""),
             detached=payload.get("detached", False),
+            owner_is_driver=payload.get("owner_is_driver", True),
             class_name=payload.get("class_name", ""),
             max_restarts=payload.get("max_restarts", 0),
             creation_spec=payload.get("creation_spec"),
@@ -432,6 +434,17 @@ class GcsServer:
         return True
 
     async def _actor_failed(self, actor: ActorInfo, cause: str):
+        # restarts are owner-driven: an actor created DIRECTLY by a driver
+        # that has since exited has nobody to resubmit its creation task, so
+        # leaving it RESTARTING would hang every caller forever — mark it
+        # DEAD instead. Actors created by other actors keep their worker
+        # process as a live owner and restart normally. (GCS-driven restart
+        # of orphaned detached actors is future work.)
+        if (actor.owner_is_driver
+                and actor.actor_id.job_id() not in self._driver_conns.values()
+                and actor.num_restarts < actor.max_restarts):
+            cause += " (creating driver exited; restart impossible)"
+            actor.num_restarts = actor.max_restarts
         if actor.num_restarts < actor.max_restarts:
             actor.num_restarts += 1
             actor.state = RESTARTING

@@ -1,0 +1,23 @@
+"""ray_tpu.serve: model serving — controller, replicas, router, HTTP proxy
+(ref: python/ray/serve/). Deployments are gangs of async replica actors;
+requests route by power-of-two-choices; streamed replica output becomes
+chunked HTTP."""
+
+from .api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .handle import DeploymentHandle
+
+__all__ = [
+    "Application", "Deployment", "DeploymentHandle",
+    "deployment", "run", "start", "status", "delete", "shutdown",
+    "get_deployment_handle",
+]

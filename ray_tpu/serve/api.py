@@ -1,0 +1,131 @@
+"""Serve public API (ref: python/ray/serve/api.py — serve.run:591,
+@serve.deployment, serve.start/shutdown, get_deployment_handle)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+
+from .controller import CONTROLLER_NAME, ServeController
+from .handle import DeploymentHandle
+
+
+class Application:
+    """A deployment bound to its init args (ref: Application from
+    Deployment.bind)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, cls: type, name: str, config: Dict[str, Any]):
+        self._cls = cls
+        self.name = name
+        self.config = config
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                ray_actor_options: Optional[dict] = None) -> "Deployment":
+        config = dict(self.config)
+        if num_replicas is not None:
+            config["num_replicas"] = num_replicas
+        if max_ongoing_requests is not None:
+            config["max_ongoing_requests"] = max_ongoing_requests
+        if ray_actor_options is not None:
+            config["ray_actor_options"] = ray_actor_options
+        return Deployment(self._cls, name or self.name, config)
+
+
+def deployment(cls: Optional[type] = None, *,
+               name: Optional[str] = None,
+               num_replicas: int = 1,
+               max_ongoing_requests: int = 100,
+               ray_actor_options: Optional[dict] = None):
+    """@serve.deployment — turn a class into a deployable unit."""
+    def _wrap(target: type) -> Deployment:
+        return Deployment(target, name or target.__name__, {
+            "num_replicas": num_replicas,
+            "max_ongoing_requests": max_ongoing_requests,
+            "ray_actor_options": ray_actor_options,
+        })
+
+    if cls is not None:
+        return _wrap(cls)
+    return _wrap
+
+
+def _get_or_create_controller():
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, lifetime="detached", num_cpus=0.5,
+        ).remote()
+
+
+def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
+    """Deploy (or update) an application; returns its handle
+    (ref: serve.run → controller.deploy_applications)."""
+    import ray_tpu
+
+    dep = app.deployment
+    dep_name = name or dep.name
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.deploy.remote(
+        dep_name,
+        cloudpickle.dumps(dep._cls),
+        cloudpickle.dumps((app.init_args, app.init_kwargs)),
+        dep.config,
+    ), timeout=120)
+    return DeploymentHandle(dep_name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def start(http_port: int = 0) -> int:
+    """Ensure the HTTP proxy is up; returns the bound port."""
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.ensure_proxy.remote(http_port), timeout=120)
+
+
+def status() -> list:
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.list_deployments.remote(), timeout=60)
+
+
+def delete(name: str) -> None:
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    """Tear down all deployments, replicas, proxy, and the controller."""
+    import ray_tpu
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+    except Exception:
+        pass
+    ray_tpu.kill(controller)

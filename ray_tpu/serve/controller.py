@@ -1,0 +1,193 @@
+"""Serve controller: deployment reconciliation + replica lifecycle
+(ref: python/ray/serve/_private/controller.py:84 ServeController,
+deployment_state.py DeploymentState — replica STARTING/RUNNING/STOPPING
+reconciliation loops, rolling updates, health checks).
+
+A detached async actor: deployments survive the deploying driver. The
+reconcile loop converges actual replicas toward each deployment's target
+(scale up/down, replace unhealthy), and bumps a version consumers use to
+refresh their cached replica sets."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE::controller"
+HEALTH_PERIOD_S = 2.0
+
+
+async def _await_ref(ref):
+    """Adapter: ObjectRef's __await__ into a coroutine asyncio.wait_for
+    accepts."""
+    return await ref
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, dict] = {}
+        self._version = 0
+        self._reconcile_task: Optional[asyncio.Task] = None
+        self._proxy = None
+        self._proxy_port: Optional[int] = None
+        self._proxy_lock: Optional[asyncio.Lock] = None
+
+    # ------------------------------------------------------------- deploy
+    async def deploy(self, name: str, cls_blob: bytes, init_args_blob: bytes,
+                     config: dict) -> int:
+        """Create or update a deployment; returns the new version. A change
+        to code/init-args/config bumps the deployment's code_version, and
+        reconciliation ROLLS the running replicas onto it (ref:
+        deployment_state.py rolling updates) — stale replicas must not keep
+        serving old code."""
+        dep = self._deployments.get(name)
+        if dep is None:
+            dep = self._deployments[name] = {
+                "name": name, "replicas": [],  # [(handle, code_version)]
+                "next_replica": 0, "code_version": 0,
+            }
+        if (dep.get("cls_blob") != cls_blob
+                or dep.get("init_args_blob") != init_args_blob
+                or dep.get("config") != config):
+            dep["code_version"] += 1
+        dep["cls_blob"] = cls_blob
+        dep["init_args_blob"] = init_args_blob
+        dep["config"] = config
+        self._version += 1
+        await self._reconcile_deployment(dep)
+        self._ensure_reconcile_loop()
+        return self._version
+
+    async def delete_deployment(self, name: str) -> bool:
+        dep = self._deployments.pop(name, None)
+        if dep is None:
+            return False
+        for replica, _ in dep["replicas"]:
+            await self._stop_replica(replica)
+        self._version += 1
+        return True
+
+    async def _make_replica(self, dep: dict):
+        from .. import remote
+        from .replica import Replica
+
+        index = dep["next_replica"]
+        dep["next_replica"] += 1
+        config = dep["config"]
+        actor_opts = dict(config.get("ray_actor_options") or {})
+        actor_opts.setdefault("num_cpus", 1)
+        handle = remote(Replica).options(
+            name=f"SERVE::{dep['name']}#{index}",
+            lifetime="detached",
+            max_restarts=3,
+            **actor_opts,
+        ).remote(dep["cls_blob"], dep["init_args_blob"],
+                 config.get("max_ongoing_requests", 100))
+        return handle
+
+    async def _stop_replica(self, handle) -> None:
+        from .. import kill
+
+        try:
+            kill(handle)
+        except Exception:
+            pass
+
+    async def _reconcile_deployment(self, dep: dict) -> None:
+        target = dep["config"].get("num_replicas", 1)
+        code_version = dep["code_version"]
+
+        # concurrent health checks: one hung replica must not stall the
+        # control loop for 15s per replica (NB: awaiting ObjectRefs — a
+        # blocking get() would stall this actor's loop)
+        async def _check(entry):
+            replica, version = entry
+            try:
+                await asyncio.wait_for(
+                    _await_ref(replica.health_check.remote()), 15)
+                return version == code_version  # stale code = replace
+            except Exception:
+                return False
+
+        results = await asyncio.gather(
+            *[_check(entry) for entry in dep["replicas"]])
+        alive = []
+        for entry, healthy in zip(dep["replicas"], results):
+            if healthy:
+                alive.append(entry)
+            else:
+                await self._stop_replica(entry[0])
+        changed = len(alive) != len(dep["replicas"])
+        dep["replicas"] = alive
+        while len(dep["replicas"]) < target:
+            dep["replicas"].append(
+                (await self._make_replica(dep), code_version))
+            changed = True
+        while len(dep["replicas"]) > target:
+            await self._stop_replica(dep["replicas"].pop()[0])
+            changed = True
+        if changed:
+            self._version += 1
+
+    def _ensure_reconcile_loop(self) -> None:
+        if self._reconcile_task is None or self._reconcile_task.done():
+            self._reconcile_task = asyncio.ensure_future(self._loop())
+
+    async def _loop(self):
+        while self._deployments:
+            await asyncio.sleep(HEALTH_PERIOD_S)
+            for dep in list(self._deployments.values()):
+                try:
+                    await self._reconcile_deployment(dep)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------ queries
+    async def get_replicas(self, name: str):
+        """(version, [replica handles]) — consumers cache until the version
+        moves (the long-poll config-push role, ref: _private/long_poll.py)."""
+        dep = self._deployments.get(name)
+        if dep is None:
+            return self._version, None
+        return self._version, [replica for replica, _ in dep["replicas"]]
+
+    async def get_version(self) -> int:
+        return self._version
+
+    async def list_deployments(self) -> List[dict]:
+        return [
+            {"name": d["name"],
+             "num_replicas": len(d["replicas"]),
+             "target_replicas": d["config"].get("num_replicas", 1)}
+            for d in self._deployments.values()
+        ]
+
+    # -------------------------------------------------------------- proxy
+    async def ensure_proxy(self, port: int) -> int:
+        from .. import remote
+        from .proxy import ProxyActor
+
+        if self._proxy_lock is None:
+            self._proxy_lock = asyncio.Lock()
+        async with self._proxy_lock:  # concurrent starts interleave on the
+            # actor loop; without the lock both would create 'SERVE::proxy'
+            if self._proxy_port is not None:
+                return self._proxy_port  # one proxy; later ports ignored
+            self._proxy = remote(ProxyActor).options(
+                name="SERVE::proxy", lifetime="detached", num_cpus=0.5,
+            ).remote()
+            self._proxy_port = await asyncio.wait_for(
+                _await_ref(self._proxy.start.remote(port)), 60)
+            return self._proxy_port
+
+    async def shutdown(self) -> bool:
+        from .. import kill
+
+        for name in list(self._deployments):
+            await self.delete_deployment(name)
+        if self._proxy is not None:
+            try:
+                kill(self._proxy)
+            except Exception:
+                pass
+        return True

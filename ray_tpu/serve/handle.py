@@ -1,0 +1,107 @@
+"""DeploymentHandle + router: pick a replica per request
+(ref: python/ray/serve/_private/router.py:586 AsyncioRouter.assign_request,
+replica_scheduler/pow_2_scheduler.py).
+
+Routing is power-of-two-choices over the router's OWN in-flight counts —
+each router tracks requests it issued minus completions, so steady-state
+routing needs no queue-length probe RPCs. The replica set is cached and
+refreshed from the controller when its version moves or a replica dies."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class DeploymentHandle:
+    """Callable handle to a deployment; picklable (it re-resolves the
+    controller by name wherever it lands)."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self._name = deployment_name
+        self._method = method_name
+        self._lock = threading.Lock()
+        self._replicas: list = []
+        self._version = -1
+        self._ongoing: Dict[Any, int] = {}
+        self._last_refresh = 0.0
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name, self._method))
+
+    def options(self, *, method_name: str) -> "DeploymentHandle":
+        handle = DeploymentHandle(self._name, method_name)
+        return handle
+
+    # ------------------------------------------------------------ routing
+    def _controller(self):
+        from .. import get_actor
+        from .controller import CONTROLLER_NAME
+
+        return get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False) -> None:
+        from .. import get
+
+        now = time.monotonic()
+        with self._lock:
+            if not force and self._replicas and now - self._last_refresh < 2.0:
+                return
+        version, replicas = get(
+            self._controller().get_replicas.remote(self._name), timeout=30)
+        if replicas is None:
+            raise ValueError(f"Serve deployment '{self._name}' not found")
+        with self._lock:
+            self._replicas = replicas
+            self._version = version
+            self._last_refresh = now
+            self._ongoing = {r._actor_id: self._ongoing.get(r._actor_id, 0)
+                             for r in replicas}
+
+    def _pick(self):
+        """Power-of-two-choices on local in-flight counts."""
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            self._refresh(force=True)
+            with self._lock:
+                replicas = list(self._replicas)
+            if not replicas:
+                raise RuntimeError(
+                    f"deployment '{self._name}' has no running replicas")
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        with self._lock:
+            na = self._ongoing.get(a._actor_id, 0)
+            nb = self._ongoing.get(b._actor_id, 0)
+        return a if na <= nb else b
+
+    def remote(self, *args, **kwargs):
+        """Route one request; returns the ObjectRef of the replica call."""
+        return self.route(*args, **kwargs)[0]
+
+    def route(self, *args, **kwargs):
+        """Route one request, returning (ref, replica handle). The replica
+        is exposed for stream follow-ups that must stay pinned to the
+        replica holding the stream state."""
+        self._refresh()
+        replica = self._pick()
+        with self._lock:
+            self._ongoing[replica._actor_id] = \
+                self._ongoing.get(replica._actor_id, 0) + 1
+        ref = replica.handle.remote(self._method, args, kwargs)
+
+        def _done(_):
+            with self._lock:
+                count = self._ongoing.get(replica._actor_id, 0)
+                if count > 0:
+                    self._ongoing[replica._actor_id] = count - 1
+
+        ref.future().add_done_callback(_done)
+        return ref, replica
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._name}.{self._method})"

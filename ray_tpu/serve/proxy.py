@@ -1,0 +1,101 @@
+"""HTTP proxy actor (ref: python/ray/serve/_private/proxy.py — uvicorn
+there, aiohttp here, same role): routes ``/{deployment}`` to replicas via
+DeploymentHandles and turns streamed replica output into chunked HTTP.
+
+Runs as an async actor: the aiohttp server lives on the actor's asyncio
+loop, so request handling shares the loop with routing awaits."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict
+
+from .replica import _STREAM_END
+
+
+class ProxyActor:
+    def __init__(self):
+        self._handles: Dict[str, "DeploymentHandle"] = {}
+        self._runner = None
+        self._port = None
+
+    def _handle_for(self, name: str):
+        from .handle import DeploymentHandle
+
+        handle = self._handles.get(name)
+        if handle is None:
+            handle = self._handles[name] = DeploymentHandle(name)
+        return handle
+
+    async def start(self, port: int) -> int:
+        from aiohttp import web
+
+        async def dispatch(request: "web.Request") -> "web.StreamResponse":
+            name = request.match_info["deployment"]
+            try:
+                if request.can_read_body:
+                    body = await request.read()
+                    payload = json.loads(body) if body else None
+                else:
+                    payload = dict(request.query) or None
+                handle = self._handle_for(name)
+                args = () if payload is None else (payload,)
+                result, replica = await self._route(handle, args)
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=404)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": repr(e)}, status=500)
+            if isinstance(result, dict) and "__stream__" in result:
+                return await self._stream_response(
+                    request, replica, result["__stream__"])
+            return web.json_response({"result": result})
+
+        app = web.Application()
+        app.router.add_route("*", "/{deployment}", dispatch)
+        app.router.add_route("*", "/{deployment}/", dispatch)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def _route(self, handle, args):
+        ref, replica = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: handle.route(*args))
+        return await ref, replica
+
+    async def _stream_response(self, request, replica, stream_id: int):
+        """Chunked transfer of a replica's async-generator output (the
+        streamed-tokens path, ref: proxy.py streaming responses). Pinned to
+        the replica holding the stream state."""
+        from aiohttp import web
+
+        response = web.StreamResponse()
+        response.headers["Content-Type"] = "text/plain; charset=utf-8"
+        await response.prepare(request)
+        finished = False
+        try:
+            while True:
+                chunk = await replica.next_chunk.remote(stream_id)
+                if isinstance(chunk, str) and chunk == _STREAM_END:
+                    finished = True
+                    break
+                if isinstance(chunk, bytes):
+                    await response.write(chunk)
+                else:
+                    await response.write(str(chunk).encode())
+            await response.write_eof()
+        finally:
+            if not finished:
+                # client hung up mid-stream: release the replica-side
+                # generator instead of leaking it
+                try:
+                    replica.cancel_stream.remote(stream_id)
+                except Exception:
+                    pass
+        return response
+
+    async def ping(self) -> bool:
+        return True

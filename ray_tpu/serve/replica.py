@@ -1,0 +1,102 @@
+"""Replica actor: hosts one copy of a deployment's user callable
+(ref: python/ray/serve/_private/replica.py:885 ReplicaActor,
+handle_request_streaming:1008).
+
+Runs as an async actor: requests interleave at await points up to
+``max_ongoing_requests``; sync user callables are pushed to a thread pool
+so they cannot stall the loop. Async-generator results become streams
+consumed chunk-by-chunk (the HTTP proxy turns them into chunked
+responses)."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+_STREAM_END = "__serve_stream_end__"
+
+
+class Replica:
+    def __init__(self, cls_blob: bytes, init_args_blob: bytes,
+                 max_ongoing_requests: int):
+        cls = cloudpickle.loads(cls_blob)
+        args, kwargs = cloudpickle.loads(init_args_blob)
+        self.user = cls(*args, **kwargs)
+        self.max_ongoing = max_ongoing_requests
+        self._sem = asyncio.Semaphore(max_ongoing_requests)
+        self._ongoing = 0
+        self._streams: Dict[int, Any] = {}
+        self._stream_ids = itertools.count(1)
+
+    async def handle(self, method_name: str, args: tuple, kwargs: dict):
+        """One request. Returns the call result, or {"__stream__": id} when
+        the user callable produced an async generator."""
+        async with self._sem:
+            self._ongoing += 1
+            try:
+                # resolve the bound method — iscoroutinefunction(instance)
+                # is False even when the instance's __call__ is async
+                target = getattr(self.user, method_name)
+                if asyncio.iscoroutinefunction(target):
+                    result = await target(*args, **kwargs)
+                else:
+                    loop = asyncio.get_event_loop()
+                    result = await loop.run_in_executor(
+                        None, lambda: target(*args, **kwargs))
+                    if asyncio.iscoroutine(result):
+                        result = await result
+                if hasattr(result, "__anext__"):
+                    stream_id = next(self._stream_ids)
+                    self._streams[stream_id] = result
+                    return {"__stream__": stream_id}
+                return result
+            finally:
+                self._ongoing -= 1
+
+    async def next_chunk(self, stream_id: int):
+        """Advance a response stream (ref: handle_request_streaming — here
+        pulled by the consumer instead of pushed)."""
+        gen = self._streams.get(stream_id)
+        if gen is None:
+            return _STREAM_END
+        try:
+            return await gen.__anext__()
+        except StopAsyncIteration:
+            self._streams.pop(stream_id, None)
+            return _STREAM_END
+
+    async def cancel_stream(self, stream_id: int) -> bool:
+        """Drop an abandoned response stream (client disconnected): the
+        generator is closed so it cannot accumulate on a long-lived
+        replica."""
+        gen = self._streams.pop(stream_id, None)
+        if gen is not None:
+            try:
+                await gen.aclose()
+            except Exception:
+                pass
+        return True
+
+    async def queue_len(self) -> int:
+        return self._ongoing
+
+    async def health_check(self) -> bool:
+        check = getattr(self.user, "check_health", None)
+        if check is not None:
+            if asyncio.iscoroutinefunction(check):
+                await check()
+            else:
+                check()
+        return True
+
+    async def reconfigure(self, user_config) -> bool:
+        hook = getattr(self.user, "reconfigure", None)
+        if hook is not None:
+            if asyncio.iscoroutinefunction(hook):
+                await hook(user_config)
+            else:
+                hook(user_config)
+        return True
