@@ -162,6 +162,9 @@ class CoreWorker:
         self._inflight: Dict[TaskID, dict] = {}
         # streaming generators (ref: task_manager.h ObjectRefStream)
         self._streams: Dict[TaskID, _StreamState] = {}
+        # task events buffered toward the GCS (ref: task_event_buffer.h)
+        self._task_events: List[dict] = []
+        self._task_events_lock = threading.Lock()
         self.address = ""  # worker-mode processes set their push address
 
         _set_ref_registry(self)
@@ -279,6 +282,41 @@ class CoreWorker:
     async def _free_remote(self, oids: List[ObjectID]):
         try:
             await self.raylet.call("free_objects", {"object_ids": oids})
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------- task events
+    def _record_task_event(self, task_id: TaskID, **fields) -> None:
+        """Buffer a task state transition; flushed to the GCS in batches
+        (ref: task_event_buffer.h → gcs_task_manager.h). Fire-and-forget:
+        observability must never block or fail the submission path."""
+        event = {"task_id": task_id}
+        event.update(fields)
+        flush = None
+        arm_timer = False
+        with self._task_events_lock:
+            self._task_events.append(event)
+            if len(self._task_events) >= 20:
+                flush, self._task_events = self._task_events, []
+            else:
+                # one timer per buffer fill, not per event — high submit
+                # rates must not stack thousands of sleeper coroutines
+                arm_timer = len(self._task_events) == 1
+        if flush is not None:
+            self.io.spawn(self._send_task_events(flush))
+        elif arm_timer:
+            self.io.spawn(self._flush_task_events_soon())
+
+    async def _flush_task_events_soon(self):
+        await asyncio.sleep(0.5)
+        with self._task_events_lock:
+            flush, self._task_events = self._task_events, []
+        if flush:
+            await self._send_task_events(flush)
+
+    async def _send_task_events(self, events: List[dict]):
+        try:
+            await self.gcs.call("report_task_events", {"events": events})
         except Exception:
             pass
 
@@ -549,6 +587,8 @@ class CoreWorker:
         self._inflight[spec.task_id] = {"canceled": False, "worker_address": None}
         if self.cfg.lineage_pinning_enabled and not streaming:
             self._lineage[spec.task_id] = spec
+        self._record_task_event(spec.task_id, name=spec.function.repr_name,
+                                state="SUBMITTED", start_time=time.time())
         if streaming:
             self._streams[spec.task_id] = _StreamState()
             self.io.spawn(self._submit_normal(spec, deps))
@@ -580,8 +620,16 @@ class CoreWorker:
             if last_error is not None:
                 self._store_error(spec, exc.WorkerCrashedError(
                     f"task {spec.function.repr_name} failed after {attempts} attempts: {last_error}"))
+                self._record_task_event(spec.task_id, state="FAILED",
+                                        end_time=time.time(),
+                                        error=str(last_error))
+            else:
+                self._record_task_event(spec.task_id, state="FINISHED",
+                                        end_time=time.time())
         except BaseException as e:  # noqa: BLE001
             self._store_error(spec, e)
+            self._record_task_event(spec.task_id, state="FAILED",
+                                    end_time=time.time(), error=str(e))
         finally:
             self._inflight.pop(spec.task_id, None)
             for oid in deps:

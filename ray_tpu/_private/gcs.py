@@ -167,6 +167,11 @@ class GcsServer:
         self._node_conns: Dict[ServerConnection, NodeID] = {}
         self._driver_conns: Dict[ServerConnection, JobID] = {}
         self._driver_cleanup_timers: Dict[JobID, asyncio.Task] = {}
+        # observability tables (in-memory, bounded; not journaled)
+        self.metrics: Dict[tuple, dict] = {}
+        self.task_events: Dict[Any, dict] = {}
+        self.MAX_TASK_EVENTS = 10_000
+        self.MAX_METRICS = 10_000
         self._next_job = 1
         self._restore_tables()
 
@@ -748,6 +753,10 @@ class GcsServer:
                 del self.object_locations[payload["object_id"]]
         return True
 
+    async def handle_list_object_locations(self, payload, conn):
+        return {oid: set(nodes)
+                for oid, nodes in self.object_locations.items()}
+
     async def handle_get_object_locations(self, payload, conn):
         """oid -> [(node_id, raylet_address)] for live holders."""
         out = {}
@@ -759,6 +768,59 @@ class GcsServer:
                     holders.append((node_id, info.address))
             out[oid] = holders
         return out
+
+    # ---- metrics (ref: stats/metric.h registry + metrics agent; the GCS
+    #      is the aggregation point the state API reads) ----
+    async def handle_report_metrics(self, payload, conn):
+        worker = payload["worker_id"]
+        for entry in payload["metrics"]:
+            key = (entry["name"], tuple(sorted(entry["tags"].items())), worker)
+            # bounded like task_events: worker churn + high-cardinality tags
+            # must not grow the GCS without limit (FIFO eviction)
+            if key not in self.metrics and len(self.metrics) >= self.MAX_METRICS:
+                self.metrics.pop(next(iter(self.metrics)))
+            self.metrics[key] = {
+                "name": entry["name"], "kind": entry["kind"],
+                "tags": entry["tags"], "value": entry["value"],
+                "worker_id": worker,
+                "description": entry.get("description", ""),
+            }
+        return True
+
+    async def handle_get_metrics(self, payload, conn):
+        """Aggregated across workers: counters/histogram buckets sum,
+        gauges report per-worker last values summed (the common scrape
+        semantic for distributed gauges of additive quantities)."""
+        name_filter = payload.get("name")
+        out: Dict[tuple, dict] = {}
+        for (name, tags, _worker), entry in self.metrics.items():
+            if name_filter and name != name_filter:
+                continue
+            agg_key = (name, tags)
+            if agg_key in out:
+                out[agg_key]["value"] += entry["value"]
+            else:
+                out[agg_key] = dict(entry)
+                out[agg_key].pop("worker_id", None)
+        return list(out.values())
+
+    # ---- task events (ref: gcs_task_manager.h — the state API backend) ----
+    async def handle_report_task_events(self, payload, conn):
+        for event in payload["events"]:
+            task_id = event["task_id"]
+            record = self.task_events.get(task_id)
+            if record is None:
+                if len(self.task_events) >= self.MAX_TASK_EVENTS:
+                    self.task_events.pop(next(iter(self.task_events)))
+                record = self.task_events[task_id] = {
+                    "task_id": task_id, "name": "", "state": "",
+                    "start_time": None, "end_time": None, "error": "",
+                }
+            record.update({k: v for k, v in event.items() if v is not None})
+        return True
+
+    async def handle_list_task_events(self, payload, conn):
+        return list(self.task_events.values())
 
     # ---- health / introspection ----
     async def handle_ping(self, payload, conn):

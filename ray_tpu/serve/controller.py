@@ -31,6 +31,16 @@ class ServeController:
         self._proxy = None
         self._proxy_port: Optional[int] = None
         self._proxy_lock: Optional[asyncio.Lock] = None
+        # serializes deploy/delete/reconcile: the reconcile gather suspends
+        # for seconds, and a concurrent mutation of dep["replicas"] would
+        # pair stale health verdicts with fresh replicas (killing them) or
+        # resurrect replicas of a just-deleted deployment
+        self._reconcile_lock: Optional[asyncio.Lock] = None
+
+    def _lock(self) -> asyncio.Lock:
+        if self._reconcile_lock is None:
+            self._reconcile_lock = asyncio.Lock()
+        return self._reconcile_lock
 
     # ------------------------------------------------------------- deploy
     async def deploy(self, name: str, cls_blob: bytes, init_args_blob: bytes,
@@ -54,18 +64,20 @@ class ServeController:
         dep["init_args_blob"] = init_args_blob
         dep["config"] = config
         self._version += 1
-        await self._reconcile_deployment(dep)
+        async with self._lock():
+            await self._reconcile_deployment(dep)
         self._ensure_reconcile_loop()
         return self._version
 
     async def delete_deployment(self, name: str) -> bool:
-        dep = self._deployments.pop(name, None)
-        if dep is None:
-            return False
-        for replica, _ in dep["replicas"]:
-            await self._stop_replica(replica)
-        self._version += 1
-        return True
+        async with self._lock():
+            dep = self._deployments.pop(name, None)
+            if dep is None:
+                return False
+            for replica, _ in dep["replicas"]:
+                await self._stop_replica(replica)
+            self._version += 1
+            return True
 
     async def _make_replica(self, dep: dict):
         from .. import remote
@@ -136,11 +148,15 @@ class ServeController:
     async def _loop(self):
         while self._deployments:
             await asyncio.sleep(HEALTH_PERIOD_S)
-            for dep in list(self._deployments.values()):
-                try:
-                    await self._reconcile_deployment(dep)
-                except Exception:
-                    pass
+            for name in list(self._deployments):
+                async with self._lock():
+                    dep = self._deployments.get(name)
+                    if dep is None:
+                        continue  # deleted while we waited on the lock
+                    try:
+                        await self._reconcile_deployment(dep)
+                    except Exception:
+                        pass
 
     # ------------------------------------------------------------ queries
     async def get_replicas(self, name: str):
@@ -172,7 +188,22 @@ class ServeController:
         async with self._proxy_lock:  # concurrent starts interleave on the
             # actor loop; without the lock both would create 'SERVE::proxy'
             if self._proxy_port is not None:
-                return self._proxy_port  # one proxy; later ports ignored
+                try:  # the cached proxy may have died since
+                    await asyncio.wait_for(
+                        _await_ref(self._proxy.ping.remote()), 10)
+                    return self._proxy_port  # one proxy; later ports ignored
+                except Exception:
+                    from .. import kill
+
+                    try:
+                        kill(self._proxy)
+                    except Exception:
+                        pass
+                    self._proxy = None
+                    self._proxy_port = None
+            # no max_restarts: a bare actor restart would re-run __init__
+            # but not start(), leaving no listener — recreation through
+            # this path (ping fails -> new actor + start) is the recovery
             self._proxy = remote(ProxyActor).options(
                 name="SERVE::proxy", lifetime="detached", num_cpus=0.5,
             ).remote()

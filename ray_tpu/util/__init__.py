@@ -10,6 +10,7 @@ from .scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from . import metrics, state
 
 __all__ = [
     "PlacementGroup",
@@ -18,4 +19,6 @@ __all__ = [
     "remove_placement_group",
     "NodeAffinitySchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
+    "metrics",
+    "state",
 ]

@@ -1,0 +1,152 @@
+"""Application metrics: Counter / Gauge / Histogram with tags
+(ref: python/ray/util/metrics.py; export pipeline ref:
+_private/metrics_agent.py — here metrics flush to the GCS metrics table,
+the aggregation point the state API reads).
+
+Each process keeps a local registry; a daemon flusher pushes deltas to the
+GCS every ~2s. Metrics survive the emitting process (last-written values
+stay in the table, keyed by metric/tags/worker)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+_FLUSH_PERIOD_S = 2.0
+
+_registry_lock = threading.Lock()
+_registry: List["_Metric"] = []
+_flusher_started = False
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class _Metric:
+    kind = "base"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+        with _registry_lock:
+            # dedupe by (name, kind): re-creating a metric (e.g. inside a
+            # task body on a reused worker) aliases the existing storage
+            # instead of growing the registry/flush payload per task
+            for existing in _registry:
+                if existing.name == name and existing.kind == self.kind:
+                    self._values = existing._values
+                    self._lock = existing._lock
+                    break
+            else:
+                _registry.append(self)
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "_Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        return merged
+
+    def _snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"name": self.name, "kind": self.kind,
+                 "tags": dict(key), "value": value,
+                 "description": self.description}
+                for key, value in self._values.items()
+            ]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("Counter can only increase")
+        with self._lock:
+            self._values[_tag_key(self._merged(tags))] += value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_tag_key(self._merged(tags))] = value
+
+
+class Histogram(_Metric):
+    """Bucketed observations; exported as per-bucket counts plus sum/count
+    (the prometheus histogram layout)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1, 10, 100, 1000])
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        merged = self._merged(tags)
+        with self._lock:
+            for bound in self.boundaries:
+                if value <= bound:
+                    self._values[_tag_key({**merged, "le": str(bound)})] += 1
+            self._values[_tag_key({**merged, "le": "+Inf"})] += 1
+            self._values[_tag_key({**merged, "__stat__": "sum"})] += value
+            self._values[_tag_key({**merged, "__stat__": "count"})] += 1
+
+
+def _flush_once() -> bool:
+    from .. import _worker_api
+
+    core = _worker_api._core
+    if core is None:
+        return False
+    with _registry_lock:
+        metrics = list(_registry)
+    batch: List[dict] = []
+    for metric in metrics:
+        batch.extend(metric._snapshot())
+    if not batch:
+        return True
+    try:
+        core.io.spawn(core.gcs.call("report_metrics", {
+            "worker_id": core.worker_id.hex(), "metrics": batch}))
+        return True
+    except Exception:
+        return False
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def _loop():
+        while True:
+            time.sleep(_FLUSH_PERIOD_S)
+            try:
+                _flush_once()
+            except Exception:
+                pass
+
+    threading.Thread(target=_loop, daemon=True,
+                     name="ray_tpu_metrics_flush").start()

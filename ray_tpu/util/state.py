@@ -1,0 +1,89 @@
+"""State API: programmatic cluster introspection (ref:
+python/ray/util/state/api.py:554-1434 — list_actors/list_nodes/
+list_placement_groups/list_tasks/list_objects, backed by GCS tables)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _core():
+    from .. import _worker_api
+
+    return _worker_api.core()
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    core = _core()
+    infos = core.io.run(core.gcs.call("get_all_nodes", {}))
+    return [
+        {"node_id": n.node_id.hex(), "state": "ALIVE" if n.alive else "DEAD",
+         "address": n.address, "resources_total": n.resources_total,
+         "resources_available": n.resources_available, "labels": n.labels}
+        for n in infos
+    ]
+
+
+def list_actors(*, state: Optional[str] = None) -> List[Dict[str, Any]]:
+    core = _core()
+    infos = core.io.run(core.gcs.call("list_actors", {}))
+    out = [
+        {"actor_id": a.actor_id.hex(), "state": a.state, "name": a.name,
+         "class_name": a.class_name, "pid_address": a.address,
+         "num_restarts": a.num_restarts, "death_cause": a.death_cause,
+         "detached": a.detached}
+        for a in infos
+    ]
+    if state is not None:
+        out = [a for a in out if a["state"] == state]
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    core = _core()
+    infos = core.io.run(core.gcs.call("list_placement_groups", {}))
+    return [
+        {"placement_group_id": pg["pg_id"].hex(), "name": pg["name"],
+         "state": pg["state"], "strategy": pg["strategy"],
+         "bundles": pg["bundles"]}
+        for pg in infos
+    ]
+
+
+def list_tasks(*, state: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Task state transitions as reported by owning core workers
+    (ref: gcs_task_manager-backed list_tasks)."""
+    core = _core()
+    events = core.io.run(core.gcs.call("list_task_events", {}))
+    out = [
+        {"task_id": e["task_id"].hex(), "name": e["name"],
+         "state": e["state"], "start_time": e["start_time"],
+         "end_time": e["end_time"], "error": e.get("error", "")}
+        for e in events
+    ]
+    if state is not None:
+        out = [t for t in out if t["state"] == state]
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Cluster object directory view: which nodes hold each sealed object."""
+    core = _core()
+    status = core.io.run(core.gcs.call("list_object_locations", {}))
+    return [
+        {"object_id": oid.hex(), "locations": [n.hex() for n in nodes]}
+        for oid, nodes in status.items()
+    ]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for task in list_tasks():
+        counts[task["state"]] = counts.get(task["state"], 0) + 1
+    return counts
+
+
+def get_metrics(name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Aggregated application metrics (see ray_tpu.util.metrics)."""
+    core = _core()
+    return core.io.run(core.gcs.call("get_metrics", {"name": name}))

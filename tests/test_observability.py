@@ -1,0 +1,145 @@
+"""Metrics + state API (ref: python/ray/tests/test_state_api.py,
+test_metrics_agent.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics, state
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_list_nodes_and_actors(ray_cluster):
+    @ray_tpu.remote
+    class Marked:
+        def ping(self):
+            return "pong"
+
+    actor = Marked.options(name="marked").remote()
+    assert ray_tpu.get(actor.ping.remote(), timeout=30) == "pong"
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+    actors = state.list_actors(state="ALIVE")
+    names = [a["name"] for a in actors]
+    assert "marked" in names
+    assert any("Marked" in a["class_name"] for a in actors)
+
+
+def test_list_tasks_and_summary(ray_cluster):
+    @ray_tpu.remote
+    def tracked(x):
+        return x
+
+    ray_tpu.get([tracked.remote(i) for i in range(5)], timeout=60)
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        tasks = [t for t in state.list_tasks()
+                 if t["name"].endswith("tracked")]
+        if len(tasks) >= 5 and all(t["state"] == "FINISHED" for t in tasks):
+            break
+        time.sleep(0.2)
+    assert len(tasks) >= 5
+    assert all(t["state"] == "FINISHED" for t in tasks)
+    assert all(t["end_time"] >= t["start_time"] for t in tasks)
+    summary = state.summarize_tasks()
+    assert summary.get("FINISHED", 0) >= 5
+
+
+def test_failed_task_recorded(ray_cluster):
+    import os
+
+    @ray_tpu.remote(max_retries=0)
+    def dies():
+        os._exit(1)
+
+    with pytest.raises(Exception):
+        ray_tpu.get(dies.remote(), timeout=60)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        failed = [t for t in state.list_tasks(state="FAILED")
+                  if t["name"].endswith("dies")]
+        if failed:
+            break
+        time.sleep(0.2)
+    assert failed and failed[0]["error"]
+
+
+def test_metrics_counter_gauge_histogram(ray_cluster):
+    requests = metrics.Counter("app_requests", description="requests",
+                               tag_keys=("route",))
+    depth = metrics.Gauge("app_queue_depth")
+    latency = metrics.Histogram("app_latency_s", boundaries=[0.1, 1.0])
+
+    for _ in range(7):
+        requests.inc(tags={"route": "/a"})
+    requests.inc(3, tags={"route": "/b"})
+    depth.set(42)
+    latency.observe(0.05)
+    latency.observe(0.5)
+    latency.observe(5.0)
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        got = {(m["name"], tuple(sorted(m["tags"].items()))): m["value"]
+               for m in state.get_metrics()}
+        if got.get(("app_requests", (("route", "/a"),))) == 7:
+            break
+        time.sleep(0.5)
+    assert got[("app_requests", (("route", "/a"),))] == 7
+    assert got[("app_requests", (("route", "/b"),))] == 3
+    assert got[("app_queue_depth", ())] == 42
+    assert got[("app_latency_s", (("__stat__", "count"),))] == 3
+    assert got[("app_latency_s", (("le", "0.1"),))] == 1
+    assert got[("app_latency_s", (("le", "+Inf"),))] == 3
+
+
+def test_metrics_from_workers_aggregate(ray_cluster):
+    @ray_tpu.remote
+    def emit(i):
+        from ray_tpu.util import metrics as wm
+
+        counter = wm.Counter("worker_side_events", tag_keys=("t",))
+        counter.inc(5, tags={"t": str(i)})
+        wm._flush_once()
+        return i
+
+    ray_tpu.get([emit.remote(i) for i in range(3)], timeout=60)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        total = sum(m["value"]
+                    for m in state.get_metrics("worker_side_events"))
+        if total >= 15:
+            break
+        time.sleep(0.5)
+    assert total == 15
+
+
+def test_list_objects(ray_cluster):
+    import numpy as np
+
+    ref = ray_tpu.put(np.zeros(200_000, dtype=np.float32))
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        objs = {o["object_id"] for o in state.list_objects()}
+        if ref.hex() in objs:
+            break
+        time.sleep(0.2)
+    assert ref.hex() in objs
+
+
+def test_list_placement_groups(ray_cluster):
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK", name="obs_pg")
+    assert pg.wait(timeout_seconds=30)
+    pgs = {p["name"]: p for p in state.list_placement_groups()}
+    assert pgs["obs_pg"]["state"] == "CREATED"
+    remove_placement_group(pg)
