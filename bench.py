@@ -108,14 +108,16 @@ def main():
                                 0, cfg.vocab, jnp.int32)
     data = place_batch({"tokens": tokens})
 
-    # Warmup (compile) then timed steps.
+    # Warmup (compile) then timed steps. Sync on a metric VALUE: on the
+    # relay backend block_until_ready has been observed returning before
+    # queued steps finish, which would inflate the number.
     for _ in range(2):
         state, metrics = step_fn(state, data)
-    jax.block_until_ready(state.params)
+    float(metrics["loss"])
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step_fn(state, data)
-    jax.block_until_ready(state.params)
+    float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt

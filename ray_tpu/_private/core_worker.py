@@ -608,7 +608,7 @@ class CoreWorker:
                     raise exc.TaskCancelledError(
                         f"task {spec.function.repr_name} was cancelled")
                 try:
-                    await self._run_on_leased_worker(spec, info)
+                    app_errored = await self._run_on_leased_worker(spec, info)
                     last_error = None
                     break
                 except (ConnectionLost, exc.WorkerCrashedError) as e:
@@ -624,8 +624,14 @@ class CoreWorker:
                                         end_time=time.time(),
                                         error=str(last_error))
             else:
-                self._record_task_event(spec.task_id, state="FINISHED",
-                                        end_time=time.time())
+                # a task whose body raised is FAILED in the state API even
+                # though submission completed cleanly (its returns hold the
+                # serialized error)
+                self._record_task_event(
+                    spec.task_id,
+                    state="FAILED" if app_errored else "FINISHED",
+                    end_time=time.time(),
+                    error="application error" if app_errored else None)
         except BaseException as e:  # noqa: BLE001
             self._store_error(spec, e)
             self._record_task_event(spec.task_id, state="FAILED",
@@ -669,8 +675,9 @@ class CoreWorker:
                 info["worker_address"] = grant["worker_address"]
             client = await self._client_for(grant["worker_address"])
             reply = await client.call("push_task", cloudpickle.dumps(spec))
-            self._handle_task_reply(spec, reply)
+            errored = self._handle_task_reply(spec, reply)
             keep = True
+            return errored
         finally:
             await self._release_lease(pool, grant, spec, reusable=keep)
 
@@ -847,16 +854,18 @@ class CoreWorker:
                 self._worker_clients.pop(address, None)
             raise
 
-    def _handle_task_reply(self, spec: TaskSpec, reply: dict):
-        """reply: {results: [(oid, data|None)], error: bytes|None}"""
+    def _handle_task_reply(self, spec: TaskSpec, reply: dict) -> bool:
+        """reply: {results: [(oid, data|None)], error: bytes|None}.
+        Returns True when the task raised (its returns hold the error)."""
         if reply.get("error") is not None:
             for oid in spec.return_ids():
                 self.memory_store.put(oid, reply["error"])
-            return
+            return True
         for oid, data in reply["results"]:
             if data is not None:
                 self.memory_store.put(oid, data)
             # else: large result sealed in plasma by the executor
+        return False
 
     # ------------------------------------------------- streaming generators
     def _on_generator_item(self, payload):

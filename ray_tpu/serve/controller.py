@@ -50,21 +50,24 @@ class ServeController:
         reconciliation ROLLS the running replicas onto it (ref:
         deployment_state.py rolling updates) — stale replicas must not keep
         serving old code."""
-        dep = self._deployments.get(name)
-        if dep is None:
-            dep = self._deployments[name] = {
-                "name": name, "replicas": [],  # [(handle, code_version)]
-                "next_replica": 0, "code_version": 0,
-            }
-        if (dep.get("cls_blob") != cls_blob
-                or dep.get("init_args_blob") != init_args_blob
-                or dep.get("config") != config):
-            dep["code_version"] += 1
-        dep["cls_blob"] = cls_blob
-        dep["init_args_blob"] = init_args_blob
-        dep["config"] = config
-        self._version += 1
         async with self._lock():
+            # mutation happens under the SAME lock as reconciliation: a
+            # reconcile suspended in health checks must not observe a
+            # half-updated deployment (new code, old code_version)
+            dep = self._deployments.get(name)
+            if dep is None:
+                dep = self._deployments[name] = {
+                    "name": name, "replicas": [],  # [(handle, code_version)]
+                    "next_replica": 0, "code_version": 0,
+                }
+            if (dep.get("cls_blob") != cls_blob
+                    or dep.get("init_args_blob") != init_args_blob
+                    or dep.get("config") != config):
+                dep["code_version"] += 1
+            dep["cls_blob"] = cls_blob
+            dep["init_args_blob"] = init_args_blob
+            dep["config"] = config
+            self._version += 1
             await self._reconcile_deployment(dep)
         self._ensure_reconcile_loop()
         return self._version

@@ -37,11 +37,15 @@ class _Metric:
         self._values: Dict[Tuple, float] = defaultdict(float)
         self._lock = threading.Lock()
         with _registry_lock:
-            # dedupe by (name, kind): re-creating a metric (e.g. inside a
+            # dedupe by identity key: re-creating a metric (e.g. inside a
             # task body on a reused worker) aliases the existing storage
-            # instead of growing the registry/flush payload per task
+            # instead of growing the registry/flush payload per task.
+            # Histograms include their boundaries — aliasing two different
+            # bucket layouts would corrupt the cumulative counts.
             for existing in _registry:
-                if existing.name == name and existing.kind == self.kind:
+                if (existing.name == name and existing.kind == self.kind
+                        and getattr(existing, "boundaries", None)
+                        == getattr(self, "boundaries", None)):
                     self._values = existing._values
                     self._lock = existing._lock
                     break
@@ -96,9 +100,10 @@ class Histogram(_Metric):
     def __init__(self, name: str, description: str = "",
                  boundaries: Optional[List[float]] = None,
                  tag_keys: Tuple[str, ...] = ()):
-        super().__init__(name, description, tag_keys)
+        # set BEFORE registration so the registry dedupe can compare layouts
         self.boundaries = sorted(boundaries or
                                  [0.001, 0.01, 0.1, 1, 10, 100, 1000])
+        super().__init__(name, description, tag_keys)
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
