@@ -44,6 +44,12 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Mixture-of-Experts: n_experts > 0 replaces the dense MLP with a
+    # top-k routed expert MLP (experts sharded over the "ep" mesh axis)
+    n_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    aux_loss_coef: float = 0.01
     # "full": recompute everything (max HBM savings, ~1/3 extra FLOPs);
     # "dots": save matmul outputs, recompute elementwise only — the right
     # trade when HBM fits it (ref: jax checkpoint_policies)
@@ -58,6 +64,8 @@ class LlamaConfig:
         attn = d * self.n_heads * self.head_dim + 2 * d * self.n_kv_heads * self.head_dim \
             + self.n_heads * self.head_dim * d
         mlp = 3 * d * self.mlp_dim
+        if self.n_experts:
+            mlp = self.n_experts * mlp + d * self.n_experts  # experts+router
         return self.vocab * d * 2 + L * (attn + mlp + 2 * d) + d
 
 
@@ -87,6 +95,19 @@ LLAMA_CONFIGS: Dict[str, LlamaConfig] = {
 
 def param_logical_axes(cfg: LlamaConfig):
     """Pytree of logical-axis tuples mirroring init_params' structure."""
+    if cfg.n_experts:
+        mlp_axes = {
+            "router": ("layers", "embed", "expert"),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        }
+    else:
+        mlp_axes = {
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
     return {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -96,9 +117,7 @@ def param_logical_axes(cfg: LlamaConfig):
             "wv": ("layers", "embed", "kv_heads", "head_dim"),
             "wo": ("layers", "heads", "head_dim", "embed"),
             "mlp_norm": ("layers", "embed"),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
+            **mlp_axes,
         },
         "final_norm": ("embed",),
         "lm_head": ("embed", "vocab"),
@@ -115,6 +134,24 @@ def init_params(key, cfg: LlamaConfig):
         return (jax.random.normal(k, shape, jnp.float32)
                 * (fan_in ** -0.5)).astype(cfg.dtype)
 
+    if cfg.n_experts:
+        E = cfg.n_experts
+        kr = jax.random.split(ks[5], 4)
+        mlp_params = {
+            # router stays genuinely f32 (no bf16 round trip): routing
+            # decisions are precision-sensitive
+            "router": jax.random.normal(kr[0], (L, d, E), jnp.float32)
+            * (d ** -0.5),
+            "w_gate": norm(kr[1], (L, E, d, m), d),
+            "w_up": norm(kr[2], (L, E, d, m), d),
+            "w_down": norm(kr[3], (L, E, m, d), m),
+        }
+    else:
+        mlp_params = {
+            "w_gate": norm(ks[5], (L, d, m), d),
+            "w_up": norm(ks[6], (L, d, m), d),
+            "w_down": norm(ks[7], (L, m, d), m),
+        }
     return {
         "embed": norm(ks[0], (cfg.vocab, d), d),
         "layers": {
@@ -124,9 +161,7 @@ def init_params(key, cfg: LlamaConfig):
             "wv": norm(ks[3], (L, d, hkv, hd), d),
             "wo": norm(ks[4], (L, h, hd, d), h * hd),
             "mlp_norm": jnp.ones((L, d), cfg.dtype),
-            "w_gate": norm(ks[5], (L, d, m), d),
-            "w_up": norm(ks[6], (L, d, m), d),
-            "w_down": norm(ks[7], (L, m, d), m),
+            **mlp_params,
         },
         "final_norm": jnp.ones((d,), cfg.dtype),
         "lm_head": norm(ks[8], (d, cfg.vocab), d),
@@ -159,16 +194,27 @@ def _attn(x, lp, cfg: LlamaConfig, cos, sin, mesh: Optional[Mesh], rules):
     return out
 
 
-def _mlp(x, lp):
+def _mlp(x, lp, cfg: LlamaConfig, csl):
+    if cfg.n_experts:
+        from ..ops.moe import moe_mlp
+
+        out, aux = moe_mlp(
+            x, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            top_k=cfg.top_k, capacity_factor=cfg.capacity_factor, csl=csl)
+        return out, aux
     # SwiGLU; gate/up fuse into one pass over x in XLA.
     g = jnp.einsum("bsd,dm->bsm", x, lp["w_gate"])
     u = jnp.einsum("bsd,dm->bsm", x, lp["w_up"])
-    return jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
+    out = jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
+    return out, jnp.zeros((), jnp.float32)
 
 
 def forward(params, tokens, cfg: LlamaConfig, *,
-            mesh: Optional[Mesh] = None, rules=DEFAULT_RULES):
-    """tokens (B, S) int32 → logits (B, S, vocab) in f32."""
+            mesh: Optional[Mesh] = None, rules=DEFAULT_RULES,
+            return_aux: bool = False):
+    """tokens (B, S) int32 → logits (B, S, vocab) in f32.
+
+    ``return_aux``: also return the summed MoE load-balancing loss."""
     csl = partial(with_sharding_constraint_logical, rules=rules, mesh=mesh)
     cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1],
                                 cfg.rope_theta, dtype=jnp.float32)
@@ -180,8 +226,10 @@ def forward(params, tokens, cfg: LlamaConfig, *,
         h = x + _attn(rms_norm(x, lp["attn_norm"], cfg.norm_eps),
                       lp, cfg, cos, sin, mesh, rules)
         h = csl(h, ("batch", "seq", "embed"))
-        out = h + _mlp(rms_norm(h, lp["mlp_norm"], cfg.norm_eps), lp)
-        return csl(out, ("batch", "seq", "embed")), None
+        mlp_out, aux = _mlp(rms_norm(h, lp["mlp_norm"], cfg.norm_eps),
+                            lp, cfg, csl)
+        out = h + mlp_out
+        return csl(out, ("batch", "seq", "embed")), aux
 
     if cfg.remat and cfg.remat_policy == "dots":
         body = jax.checkpoint(
@@ -190,7 +238,7 @@ def forward(params, tokens, cfg: LlamaConfig, *,
         body = jax.checkpoint(layer)
     else:
         body = layer
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, aux_losses = jax.lax.scan(body, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     # bf16 operands on the MXU with f32 accumulation — an f32 lm_head
@@ -198,7 +246,10 @@ def forward(params, tokens, cfg: LlamaConfig, *,
     logits = jnp.einsum("bsd,dv->bsv", x.astype(cfg.dtype),
                         params["lm_head"].astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
-    return csl(logits, ("batch", "seq", "vocab"))
+    logits = csl(logits, ("batch", "seq", "vocab"))
+    if return_aux:
+        return logits, jnp.sum(aux_losses)
+    return logits
 
 
 def lm_loss(params, batch, cfg: LlamaConfig, *,
@@ -210,7 +261,8 @@ def lm_loss(params, batch, cfg: LlamaConfig, *,
     Targets are tokens shifted left; the final position is dropped.
     """
     tokens = batch["tokens"]
-    logits = forward(params, tokens, cfg, mesh=mesh, rules=rules)
+    logits, aux = forward(params, tokens, cfg, mesh=mesh, rules=rules,
+                          return_aux=True)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -221,4 +273,7 @@ def lm_loss(params, batch, cfg: LlamaConfig, *,
         nll = nll + z_loss * jnp.square(logz)
     mask = batch.get("mask")
     mask = jnp.ones_like(nll) if mask is None else mask[:, 1:].astype(nll.dtype)
-    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    if cfg.n_experts:
+        loss = loss + cfg.aux_loss_coef * aux
+    return loss

@@ -10,9 +10,10 @@ from .norms import rms_norm
 from .rotary import apply_rotary, rope_frequencies
 from .attention import attention, flash_attention_tpu, naive_attention
 from .ring_attention import ring_attention
+from .moe import moe_dispatch, moe_mlp, moe_mlp_oracle
 
 __all__ = [
     "rms_norm", "apply_rotary", "rope_frequencies",
     "attention", "flash_attention_tpu", "naive_attention",
-    "ring_attention",
+    "ring_attention", "moe_dispatch", "moe_mlp", "moe_mlp_oracle",
 ]

@@ -1,0 +1,120 @@
+"""Mixture-of-Experts with expert parallelism, TPU-first.
+
+GShard/Switch-style DENSE dispatch: routing is expressed as one-hot
+einsums with a static per-expert capacity, so the whole layer is three
+batched matmuls + masks — fully static shapes, MXU-friendly, and GSPMD
+inserts the token all-to-alls automatically when the expert axis is
+sharded over the "ep" mesh axis (logical axis "expert"). This replaces
+ragged/dynamic dispatch, which XLA cannot tile.
+
+The reference has no MoE of its own (SURVEY §2.3: EP listed as "not
+implemented", placement groups only as the placement substrate) — this is
+net-new TPU substrate, required natively by BASELINE.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _top_k_mask(probs: jnp.ndarray, k: int) -> jnp.ndarray:
+    """(T, E) probs → (T, E) 0/1 mask of each token's top-k experts."""
+    mask = jnp.zeros_like(probs)
+    remaining = probs
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        one = jax.nn.one_hot(idx, probs.shape[-1], dtype=probs.dtype)
+        mask = mask + one
+        remaining = remaining * (1.0 - one) - one  # never re-pick
+    return mask
+
+
+def moe_dispatch(gates: jnp.ndarray, top_k: int, capacity: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Build dispatch/combine tensors from router probabilities.
+
+    gates: (T, E) softmax router output.
+    Returns (dispatch (T, E, C) 0/1, combine (T, E, C) weights,
+    aux_loss scalar). Tokens beyond an expert's capacity are dropped
+    (standard Switch behavior — the residual stream carries them).
+    """
+    T, E = gates.shape
+    mask = _top_k_mask(gates, top_k)                       # (T, E)
+    # position of each token in each expert's buffer: order by token index
+    position = jnp.cumsum(mask, axis=0) - 1.0              # (T, E)
+    in_capacity = (position < capacity) & (mask > 0)
+    pos_hot = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                             dtype=gates.dtype)            # (T, E, C)
+    dispatch = pos_hot * in_capacity[..., None].astype(gates.dtype)
+    # combine weights: renormalized top-k gate probs
+    selected = gates * mask
+    denom = jnp.maximum(selected.sum(-1, keepdims=True), 1e-9)
+    combine = dispatch * (selected / denom)[..., None]
+    # Switch aux loss: E * sum_e f_e * p_e  (f: token fraction routed to e,
+    # p: mean router prob) — pushes toward uniform load. f is divided by
+    # top_k so the uniform-load floor is 1.0 regardless of k (the
+    # coefficient stays top_k-invariant).
+    f = mask.mean(axis=0) / top_k
+    p = gates.mean(axis=0)
+    aux = E * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, w_gate: jnp.ndarray,
+            w_up: jnp.ndarray, w_down: jnp.ndarray, *,
+            top_k: int = 2, capacity_factor: float = 1.25,
+            csl=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """SwiGLU expert MLP over a routed token subset.
+
+    x (B, S, D); router_w (D, E); w_gate/w_up (E, D, M); w_down (E, M, D).
+    ``csl``: optional sharding-constraint fn (arr, logical_axes) -> arr —
+    pins the expert-major intermediates to the ep axis so GSPMD routes the
+    dispatch/combine einsums as all-to-alls over ICI.
+    Returns (out (B, S, D), aux_loss).
+    """
+    B, S, D = x.shape
+    E = router_w.shape[-1]
+    T = B * S
+    xt = x.reshape(T, D)
+    # router in f32: tiny matmul, stability matters more than speed
+    gates = jax.nn.softmax(
+        jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                   router_w.astype(jnp.float32)), axis=-1)
+    capacity = max(int(top_k * T / E * capacity_factor), 1)
+    capacity = -(-capacity // 8) * 8  # sublane-aligned buffers
+    dispatch, combine, aux = moe_dispatch(gates, top_k, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)    # all-to-all in
+    if csl is not None:
+        expert_in = csl(expert_in, ("expert", None, "embed"))
+    g = jnp.einsum("ecd,edm->ecm", expert_in, w_gate)
+    u = jnp.einsum("ecd,edm->ecm", expert_in, w_up)
+    h = jax.nn.silu(g) * u
+    expert_out = jnp.einsum("ecm,emd->ecd", h, w_down)
+    if csl is not None:
+        expert_out = csl(expert_out, ("expert", None, "embed"))
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)   # all-to-all out
+    return out.reshape(B, S, D), aux
+
+
+def moe_mlp_oracle(x, router_w, w_gate, w_up, w_down, *, top_k=2):
+    """Per-token reference (no capacity drops): for each token, sum over
+    its top-k experts of renormalized_prob * SwiGLU_e(x). Test oracle."""
+    B, S, D = x.shape
+    xt = x.reshape(-1, D).astype(jnp.float32)
+    gates = jax.nn.softmax(xt @ router_w.astype(jnp.float32), axis=-1)
+    mask = _top_k_mask(gates, top_k)
+    selected = gates * mask
+    weights = selected / jnp.maximum(selected.sum(-1, keepdims=True), 1e-9)
+    # compute EVERY expert on every token, weight, and sum
+    g = jnp.einsum("td,edm->etm", xt, w_gate.astype(jnp.float32))
+    u = jnp.einsum("td,edm->etm", xt, w_up.astype(jnp.float32))
+    h = jax.nn.silu(g) * u
+    outs = jnp.einsum("etm,emd->etd", h, w_down.astype(jnp.float32))
+    out = jnp.einsum("te,etd->td", weights, outs)
+    return out.reshape(B, S, D).astype(x.dtype)

@@ -19,6 +19,7 @@ from .sharding import (
     shard_pytree,
     with_sharding_constraint_logical,
 )
+from .pipeline import pipeline_apply, split_stages
 from .collectives import (
     allgather,
     allreduce,
@@ -32,6 +33,7 @@ from .collectives import (
 )
 
 __all__ = [
+    "pipeline_apply", "split_stages",
     "MeshSpec", "build_mesh", "local_mesh", "slice_topology",
     "LogicalAxisRules", "DEFAULT_RULES", "logical_sharding", "shard_pytree",
     "with_sharding_constraint_logical",

@@ -1,0 +1,184 @@
+"""Expert parallelism (MoE) + pipeline parallelism on the virtual 8-device
+mesh (SURVEY §2.3: EP and PP must be first-class, net-new vs the
+reference)."""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import (
+    LLAMA_CONFIGS, init_params, lm_loss, param_logical_axes)
+from ray_tpu.ops.moe import moe_dispatch, moe_mlp, moe_mlp_oracle
+from ray_tpu.parallel import (
+    MeshSpec, build_mesh, pipeline_apply, split_stages)
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES, with_sharding_constraint_logical)
+from ray_tpu.train import make_train_step
+
+
+def _moe_weights(key, D=8, M=16, E=4):
+    ks = jax.random.split(key, 5)
+    return (jax.random.normal(ks[0], (2, 16, D), jnp.float32),
+            jax.random.normal(ks[1], (D, E)) * 0.1,
+            jax.random.normal(ks[2], (E, D, M)) * 0.2,
+            jax.random.normal(ks[3], (E, D, M)) * 0.2,
+            jax.random.normal(ks[4], (E, M, D)) * 0.2)
+
+
+def test_moe_matches_per_token_oracle():
+    """Dense one-hot dispatch with ample capacity == computing every
+    token's top-k experts directly."""
+    x, rw, wg, wu, wd = _moe_weights(jax.random.PRNGKey(0))
+    out, aux = moe_mlp(x, rw, wg, wu, wd, top_k=2, capacity_factor=8.0)
+    ref = moe_mlp_oracle(x, rw, wg, wu, wd, top_k=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    assert float(aux) > 0
+
+
+def test_moe_capacity_drops_tokens():
+    """With capacity 1, each expert admits at most one token and every
+    dropped token contributes zero combine weight (the residual stream
+    carries dropped tokens in a full model)."""
+    x, rw, wg, wu, wd = _moe_weights(jax.random.PRNGKey(1))
+    gates = jax.nn.softmax(
+        x.reshape(-1, x.shape[-1]).astype(jnp.float32) @ rw, axis=-1)
+    dispatch, combine, _ = moe_dispatch(gates, top_k=2, capacity=1)
+    assert float(dispatch.sum(axis=(0, 2)).max()) <= 1.0
+    # combine weights are zero exactly where dispatch dropped
+    assert float(jnp.abs(combine * (1.0 - dispatch)).max()) == 0.0
+    # and a token admitted nowhere gets zero total combine weight
+    per_token = combine.sum(axis=(1, 2))
+    admitted = dispatch.sum(axis=(1, 2)) > 0
+    assert float(jnp.abs(per_token * (~admitted)).max()) == 0.0
+
+
+def test_moe_ep_sharded_matches_unsharded(cpu_mesh8):
+    x, rw, wg, wu, wd = _moe_weights(jax.random.PRNGKey(2))
+    ref = moe_mlp_oracle(x, rw, wg, wu, wd, top_k=2)
+    mesh = build_mesh(MeshSpec(ep=4, dp=2), cpu_mesh8)
+    csl = partial(with_sharding_constraint_logical,
+                  rules=DEFAULT_RULES, mesh=mesh)
+    with mesh:
+        out, _ = jax.jit(lambda *a: moe_mlp(
+            *a, top_k=2, capacity_factor=8.0, csl=csl))(x, rw, wg, wu, wd)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_llama_trains_on_ep_mesh(cpu_mesh8):
+    """Full sharded train step with the MoE MLP: loss descends, experts
+    sharded over ep (the BASELINE expert-parallel requirement)."""
+    cfg = dataclasses.replace(LLAMA_CONFIGS["tiny"], n_experts=4, top_k=2)
+    mesh = build_mesh(MeshSpec(ep=4, dp=2), cpu_mesh8)
+    init_fn, step_fn, place_batch = make_train_step(
+        lambda p, b: lm_loss(p, b, cfg, mesh=mesh),
+        optax.adamw(1e-3), mesh, param_logical_axes(cfg))
+    state = init_fn(init_params(jax.random.PRNGKey(0), cfg))
+    # expert weights live sharded over ep
+    wg_shard = state.params["layers"]["w_gate"].sharding
+    assert "ep" in str(wg_shard.spec)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32),
+                                0, cfg.vocab, jnp.int32)
+    batch = place_batch({"tokens": tokens})
+    losses = []
+    for _ in range(5):
+        state, m = step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
+# ------------------------------------------------------------- pipeline
+
+
+def _toy_stack(L=8, D=16):
+    keys = jax.random.split(jax.random.PRNGKey(7), L)
+    return {
+        "w": jnp.stack([jax.random.normal(k, (D, D)) * (D ** -0.5)
+                        for k in keys]),
+        "b": jnp.zeros((L, D)),
+    }
+
+
+def _serial(params, x):
+    for i in range(params["w"].shape[0]):
+        x = jnp.tanh(x @ params["w"][i] + params["b"][i])
+    return x
+
+
+def _stage_fn(stage_params, x):
+    def body(c, lp):
+        return jnp.tanh(c @ lp["w"] + lp["b"]), None
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+def test_pipeline_forward_matches_serial(cpu_mesh8):
+    params = _toy_stack()
+    mesh = build_mesh(MeshSpec(pp=4, dp=2), cpu_mesh8)
+    x = jax.random.normal(jax.random.PRNGKey(9), (16, 16))
+    want = _serial(params, x)
+    got = pipeline_apply(mesh, _stage_fn, split_stages(params, 4), x,
+                         microbatches=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_backward_matches_serial(cpu_mesh8):
+    """The bwd pipeline falls out of autodiff through scan+ppermute."""
+    params = _toy_stack()
+    mesh = build_mesh(MeshSpec(pp=4, dp=2), cpu_mesh8)
+    x = jax.random.normal(jax.random.PRNGKey(9), (16, 16))
+    stages = split_stages(params, 4)
+
+    gp = jax.grad(lambda s: jnp.sum(
+        pipeline_apply(mesh, _stage_fn, s, x, microbatches=8) ** 2))(stages)
+    gs = jax.grad(lambda p: jnp.sum(_serial(p, x) ** 2))(params)
+    np.testing.assert_allclose(
+        np.asarray(gp["w"].reshape(8, 16, 16)), np.asarray(gs["w"]),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_llama_stage(cpu_mesh8):
+    """Llama layers pipelined: stage_fn scans its share of the stacked
+    layer params; pipeline output == plain scan over all layers."""
+    from ray_tpu.models.llama import forward
+
+    cfg = LLAMA_CONFIGS["tiny"]
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32),
+                                0, cfg.vocab, jnp.int32)
+    want = forward(params, tokens, cfg)
+
+    # pipeline just the layer stack; embed/head run replicated outside
+    from ray_tpu.models.llama import _attn, _mlp
+    from ray_tpu.ops import rms_norm, rope_frequencies
+
+    cos, sin = rope_frequencies(cfg.head_dim, 32, cfg.rope_theta,
+                                dtype=jnp.float32)
+
+    def stage_fn(stage_params, x):
+        def body(c, lp):
+            h = c + _attn(rms_norm(c, lp["attn_norm"], cfg.norm_eps),
+                          lp, cfg, cos, sin, None, None)
+            out_mlp, _ = _mlp(rms_norm(h, lp["mlp_norm"], cfg.norm_eps),
+                              lp, cfg, None)
+            return h + out_mlp, None
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    mesh = build_mesh(MeshSpec(pp=2, dp=4), cpu_mesh8)
+    x = jnp.take(params["embed"], tokens, axis=0)
+    stages = split_stages(params["layers"], 2)
+    piped = pipeline_apply(mesh, stage_fn, stages, x, microbatches=4)
+    x_out = rms_norm(piped, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x_out.astype(cfg.dtype),
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)

@@ -141,7 +141,10 @@ def test_remove_pg_kills_bundle_actor(cluster):
     remove_placement_group(pg)
     with pytest.raises(ray_tpu.exceptions.ActorDiedError):
         for _ in range(100):
-            ray_tpu.get(actor.ping.remote(), timeout=10)
+            # generous per-get timeout: under full-suite load the kill can
+            # land while a get is in flight, which must surface as
+            # ActorDiedError — not as a spurious GetTimeoutError
+            ray_tpu.get(actor.ping.remote(), timeout=30)
             time.sleep(0.05)
     # bundle resources restored to the node
     deadline = time.time() + 10
