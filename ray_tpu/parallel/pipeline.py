@@ -88,9 +88,9 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stage_params: Any,
 
         (state, outputs), _ = jax.lax.scan(
             tick, (state, outputs), jnp.arange(ticks))
-        # only the last stage holds real outputs; psum replicates them
-        outputs = jnp.where(s == n_stages - 1, outputs,
-                            jnp.zeros_like(outputs))
+        # only the last stage ever wrote into outputs (the cond above);
+        # every other stage's buffer is still zero, so psum replicates
+        # the last stage's results to all stages
         return jax.lax.psum(outputs, axis)
 
     spec_params = jax.tree.map(lambda _: P(axis), stage_params)

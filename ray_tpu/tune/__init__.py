@@ -1,0 +1,37 @@
+"""ray_tpu.tune: hyperparameter sweep library (ref: python/ray/tune/).
+
+Trials run as core-runtime actors; schedulers (ASHA, median stopping,
+PBT) early-stop and exploit across the population; results land in a
+ResultGrid. Search spaces mirror ray.tune's sample API.
+"""
+
+from .result_grid import Result, ResultGrid
+from .schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (
+    choice,
+    grid_search,
+    loguniform,
+    qloguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .session import get_checkpoint, get_context, get_trial_id, report
+from .trial import Trial, TrialStatus
+from .tuner import TuneConfig, Tuner
+
+__all__ = [
+    "Tuner", "TuneConfig", "Result", "ResultGrid", "Trial", "TrialStatus",
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+    "uniform", "quniform", "loguniform", "qloguniform", "randint",
+    "choice", "grid_search", "sample_from",
+    "report", "get_context", "get_checkpoint", "get_trial_id",
+]

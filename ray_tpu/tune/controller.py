@@ -1,0 +1,263 @@
+"""TuneController: the event loop over trial actors.
+
+Reference analog: python/ray/tune/execution/tune_controller.py:68 — launch
+trials up to the concurrency/resource cap, poll them, feed every result to
+the scheduler, apply CONTINUE/STOP/EXPLOIT decisions, retry errored trials
+per FailureConfig. Trials run as TrialRunner actors scheduled by the core
+runtime, so a multi-node cluster spreads trials exactly like any other
+actor load.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import random
+import shutil
+import tarfile
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ..train.config import RunConfig
+from .schedulers import FIFOScheduler, TrialScheduler
+from .search import BasicVariantGenerator
+from .trial import Trial, TrialStatus
+from .runner import TrialRunner
+
+logger = logging.getLogger("ray_tpu.tune")
+
+
+class TuneController:
+    POLL_INTERVAL_S = 0.1
+
+    def __init__(self, trainable, param_space: Dict[str, Any],
+                 tune_config, run_config: RunConfig):
+        self.trainable = trainable
+        self.tune_config = tune_config
+        self.run_config = run_config
+        self.scheduler: TrialScheduler = (
+            tune_config.scheduler or FIFOScheduler())
+        self.rng = random.Random(tune_config.seed)
+        name = run_config.name or f"tune_{int(time.time())}"
+        base = run_config.storage_path or "/tmp/ray_tpu_results"
+        self.experiment_dir = os.path.join(base, name)
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        generator = BasicVariantGenerator(
+            param_space, tune_config.num_samples, tune_config.seed)
+        self.trials = [
+            Trial(trial_id=f"{i:05d}", config=cfg,
+                  experiment_dir=self.experiment_dir)
+            for i, cfg in enumerate(generator)
+        ]
+        self._fn_blob = cloudpickle.dumps(trainable)
+        self._actors: Dict[str, Any] = {}
+        self._retries: Dict[str, int] = {}
+
+    # --- resource gating ---
+
+    def _max_concurrent(self) -> int:
+        if self.tune_config.max_concurrent_trials:
+            return self.tune_config.max_concurrent_trials
+        from .. import cluster_resources
+
+        cpus = cluster_resources().get("CPU", 1.0)
+        per_trial = self.tune_config.resources_per_trial.get("CPU", 1.0)
+        return max(1, int(cpus // max(per_trial, 0.001)))
+
+    # --- actor lifecycle ---
+
+    def _launch(self, trial: Trial,
+                restore_blob: Optional[bytes] = None) -> None:
+        from .. import remote
+
+        res = dict(self.tune_config.resources_per_trial)
+        cpus = res.pop("CPU", 1.0)
+        actor_cls = remote(TrialRunner)
+        actor = actor_cls.options(
+            num_cpus=cpus, resources=res or None, max_restarts=0,
+        ).remote(trial.trial_id, trial.local_dir)
+        from .. import get, kill
+
+        try:
+            get(actor.start.remote(self._fn_blob, trial.config, restore_blob),
+                timeout=120)
+        except Exception:
+            try:
+                kill(actor)  # don't leak a half-started runner
+            except Exception:
+                pass
+            raise
+        self._actors[trial.trial_id] = actor
+        trial.status = TrialStatus.RUNNING
+
+    def _teardown(self, trial: Trial) -> None:
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is None:
+            return
+        from .. import get, kill
+
+        try:
+            get(actor.request_stop.remote(), timeout=10)
+        except Exception:
+            pass
+        try:
+            kill(actor)
+        except Exception:
+            pass
+
+    # --- checkpoint persistence ---
+
+    def _persist_checkpoint(self, trial: Trial, path: str) -> None:
+        actor = self._actors.get(trial.trial_id)
+        if actor is None:
+            return
+        from .. import get
+
+        try:
+            blob = get(actor.pack_checkpoint.remote(path), timeout=60)
+        except Exception:
+            return
+        if blob is None:
+            return
+        target = os.path.join(trial.local_dir,
+                              f"checkpoint_{trial.iteration:06d}")
+        os.makedirs(target, exist_ok=True)
+        with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
+            tar.extractall(target, filter="data")
+        prev = trial.checkpoint_path
+        trial.checkpoint_path = target
+        if prev and prev != target and os.path.isdir(prev):
+            shutil.rmtree(prev, ignore_errors=True)  # keep latest only
+
+    def _checkpoint_blob(self, trial: Trial) -> Optional[bytes]:
+        if not trial.checkpoint_path or not os.path.isdir(trial.checkpoint_path):
+            return None
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            for name in sorted(os.listdir(trial.checkpoint_path)):
+                tar.add(os.path.join(trial.checkpoint_path, name),
+                        arcname=name)
+        return buf.getvalue()
+
+    # --- stop criteria (ref: air RunConfig(stop={...})) ---
+
+    def _hits_stop_criteria(self, result: Dict[str, Any]) -> bool:
+        stop = self.tune_config.stop or {}
+        for key, threshold in stop.items():
+            if key in result and float(result[key]) >= float(threshold):
+                return True
+        return False
+
+    # --- main loop ---
+
+    def run(self) -> List[Trial]:
+        try:
+            while True:
+                self._launch_pending()
+                if not self._actors:
+                    if all(t.status in (TrialStatus.TERMINATED,
+                                        TrialStatus.ERROR)
+                           for t in self.trials):
+                        break
+                self._poll_once()
+                time.sleep(self.POLL_INTERVAL_S)
+        finally:
+            for trial in self.trials:
+                self._teardown(trial)
+        return self.trials
+
+    def _launch_pending(self) -> None:
+        budget = self._max_concurrent() - len(self._actors)
+        for trial in self.trials:
+            if budget <= 0:
+                break
+            if trial.status == TrialStatus.PENDING:
+                try:
+                    # a retried trial resumes from its persisted checkpoint
+                    # (None for fresh trials)
+                    self._launch(trial,
+                                 restore_blob=self._checkpoint_blob(trial))
+                except Exception as e:  # actor start failed: a per-trial
+                    # failure, not a sweep abort — route through the same
+                    # retry policy as a mid-run crash
+                    self._on_trial_error(trial, f"trial start failed: {e}")
+                budget -= 1
+
+    def _poll_once(self) -> None:
+        from .. import get
+        from .. import exceptions as exc
+
+        running = [t for t in self.trials
+                   if t.trial_id in self._actors]
+        refs = [(t, self._actors[t.trial_id].poll.remote()) for t in running]
+        for trial, ref in refs:
+            try:
+                status = get(ref, timeout=60)
+            except (exc.ActorDiedError, exc.WorkerCrashedError,
+                    exc.TaskError, exc.GetTimeoutError) as e:
+                self._on_trial_error(trial, str(e))
+                continue
+            self._apply_status(trial, status)
+
+    def _apply_status(self, trial: Trial, status: Dict[str, Any]) -> None:
+        for rep in status["reports"]:
+            trial.iteration += 1
+            result = dict(rep["metrics"])
+            result.setdefault("training_iteration", trial.iteration)
+            trial.results.append(result)
+            trial.last_result = result
+            if rep.get("checkpoint_path"):
+                self._persist_checkpoint(trial, rep["checkpoint_path"])
+            if self._hits_stop_criteria(result):
+                self._finish_trial(trial)
+                return
+            decision = self.scheduler.on_result(self.trials, trial, result)
+            if decision == TrialScheduler.STOP:
+                self._finish_trial(trial)
+                return
+            if decision == TrialScheduler.EXPLOIT:
+                if self._exploit(trial):
+                    return  # relaunched: the old runner's queue is gone
+                # no viable donor: keep consuming this batch's reports
+        if status["status"] == "finished":
+            self._finish_trial(trial)
+        elif status["status"] == "errored":
+            self._on_trial_error(trial, status["error"])
+
+    def _finish_trial(self, trial: Trial) -> None:
+        self._teardown(trial)
+        trial.status = TrialStatus.TERMINATED
+
+    def _on_trial_error(self, trial: Trial, error: str) -> None:
+        self._teardown(trial)
+        retries = self._retries.get(trial.trial_id, 0)
+        if retries < self.run_config.failure_config.max_failures:
+            self._retries[trial.trial_id] = retries + 1
+            logger.warning("trial %s errored, retrying (%d): %s",
+                           trial.trial_id, retries + 1, error.strip()[-200:])
+            trial.status = TrialStatus.PENDING
+        else:
+            trial.status = TrialStatus.ERROR
+            trial.error = error
+
+    def _exploit(self, trial: Trial) -> bool:
+        """PBT exploit/explore: restart this trial from a donor's
+        checkpoint with a mutated clone of the donor's config
+        (ref: pbt.py _exploit). Returns False when no donor checkpoint is
+        available (the caller keeps the trial running)."""
+        donor = self.scheduler.choose_donor(self.trials, trial)
+        if donor is None or not donor.checkpoint_path:
+            return False
+        blob = self._checkpoint_blob(donor)
+        if blob is None:
+            return False
+        self._teardown(trial)
+        trial.config = self.scheduler.mutate_config(donor.config, self.rng)
+        trial.perturbations += 1
+        logger.info("PBT exploit: trial %s <- donor %s (perturbation %d)",
+                    trial.trial_id, donor.trial_id, trial.perturbations)
+        self._launch(trial, restore_blob=blob)
+        return True

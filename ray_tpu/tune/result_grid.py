@@ -1,0 +1,87 @@
+"""Results surface (ref: python/ray/tune/result_grid.py — ResultGrid wraps
+per-trial Results; get_best_result picks by metric/mode)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..train._checkpoint import Checkpoint
+from .trial import Trial, TrialStatus
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    config: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    path: str = ""
+    error: Optional[str] = None
+
+    @property
+    def metrics_dataframe(self):
+        raise NotImplementedError(
+            "per-iteration dataframes: use ResultGrid.trial_results")
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str] = None,
+                 mode: str = "max"):
+        self._trials = trials
+        self._metric, self._mode = metric, mode
+
+    def __len__(self) -> int:
+        return len(self._trials)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._to_result(self._trials[i])
+
+    def _to_result(self, trial: Trial) -> Result:
+        ckpt = (Checkpoint(trial.checkpoint_path)
+                if trial.checkpoint_path else None)
+        return Result(metrics=dict(trial.last_result),
+                      config=dict(trial.config),
+                      checkpoint=ckpt, path=trial.local_dir,
+                      error=trial.error)
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.error]
+
+    @property
+    def num_errors(self) -> int:
+        return len(self.errors)
+
+    @property
+    def num_terminated(self) -> int:
+        return sum(t.status == TrialStatus.TERMINATED for t in self._trials)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None,
+                        scope: str = "last") -> Result:
+        """Best trial by metric (ref: result_grid.py get_best_result).
+        ``scope``: 'last' compares final reported values, 'all' compares
+        each trial's best-ever value."""
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric is required (none set in TuneConfig)")
+        best_trial, best_val = None, None
+        for trial in self._trials:
+            if scope == "all":
+                val = trial.best_metric(metric, mode)
+            else:
+                val = trial.metric_value(metric)
+            if val is None:
+                continue
+            better = (best_val is None
+                      or (val > best_val if mode == "max" else val < best_val))
+            if better:
+                best_trial, best_val = trial, val
+        if best_trial is None:
+            raise RuntimeError(f"no trial reported metric {metric!r}")
+        return self._to_result(best_trial)
+
+    def trial_results(self, i: int) -> List[Dict[str, Any]]:
+        """All per-iteration results of trial ``i``."""
+        return [dict(r) for r in self._trials[i].results]

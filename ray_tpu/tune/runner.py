@@ -1,0 +1,120 @@
+"""Trial-runner actor: hosts one trial's trainable function.
+
+Reference analog: python/ray/tune/trainable/function_trainable.py
+(FunctionTrainable wraps the user fn on a thread and exchanges results
+through the session) + the trial-actor lifecycle TuneController drives
+(tune/execution/tune_controller.py).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ..train._checkpoint import Checkpoint
+from .session import TuneContext, TrialStopped, _init_session, _shutdown_session
+
+
+class TrialRunner:
+    """One actor per running trial (module-level for worker-side import)."""
+
+    def __init__(self, trial_id: str, trial_dir: str):
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+        self._thread: Optional[threading.Thread] = None
+        self._session = None
+        self._error: Optional[str] = None
+        self._finished = False
+        self._stopped = False
+
+    def start(self, fn_blob: bytes, config: Dict[str, Any],
+              restore_blob: Optional[bytes] = None) -> bool:
+        restored = None
+        if restore_blob is not None:
+            import io
+            import tarfile
+            import tempfile
+
+            local = tempfile.mkdtemp(prefix=f"trial_{self.trial_id}_ckpt_")
+            with tarfile.open(fileobj=io.BytesIO(restore_blob)) as tar:
+                tar.extractall(local, filter="data")
+            restored = Checkpoint(local)
+        context = TuneContext(trial_id=self.trial_id,
+                              trial_dir=self.trial_dir,
+                              restored_checkpoint=restored)
+        self._session = _init_session(context)
+        trainable = cloudpickle.loads(fn_blob)
+
+        def _run():
+            try:
+                if len(inspect.signature(trainable).parameters) >= 1:
+                    trainable(config)
+                else:
+                    trainable()
+                self._finished = True
+            except TrialStopped:
+                self._finished = True
+            except BaseException:  # noqa: BLE001 — surfaced via poll()
+                self._error = traceback.format_exc()
+
+        self._thread = threading.Thread(
+            target=_run, daemon=True, name=f"trial_{self.trial_id}")
+        self._thread.start()
+        return True
+
+    def poll(self) -> Dict[str, Any]:
+        # status snapshot BEFORE the drain: a report appended between the
+        # drain and the flag read would otherwise vanish — the controller
+        # sees 'finished', tears us down, and the final metrics are lost.
+        # Reading the flags first means that race surfaces as one extra
+        # 'running' poll instead.
+        error, finished = self._error, self._finished
+        reports = []
+        if self._session is not None:
+            for rep in self._session.drain():
+                reports.append({
+                    "metrics": rep.metrics,
+                    "checkpoint_path":
+                        rep.checkpoint.path if rep.checkpoint else None,
+                })
+        if error is not None:
+            status = "errored"
+        elif finished:
+            status = "finished"
+        elif self._thread is not None:
+            status = "running"
+        else:
+            status = "idle"
+        return {"trial_id": self.trial_id, "status": status,
+                "error": error, "reports": reports}
+
+    def request_stop(self) -> bool:
+        """Cooperative stop: the trainable's next report() raises
+        TrialStopped (the function-API analog of Trainable.stop)."""
+        self._stopped = True
+        if self._session is not None:
+            self._session.stop_requested = True
+        return True
+
+    def pack_checkpoint(self, path: str) -> Optional[bytes]:
+        """Tar a reported checkpoint dir so the controller can persist it
+        into trial storage regardless of which host the trial ran on."""
+        import io
+        import tarfile
+
+        if not os.path.isdir(path):
+            return None
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            for name in sorted(os.listdir(path)):
+                tar.add(os.path.join(path, name), arcname=name)
+        return buf.getvalue()
+
+    def shutdown(self) -> bool:
+        _shutdown_session()
+        return True

@@ -1,0 +1,224 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Reference analogs:
+  - FIFO / base interface: python/ray/tune/schedulers/trial_scheduler.py
+  - ASHA: python/ray/tune/schedulers/async_hyperband.py (AsyncHyperBand
+    rung bracket: record a trial's value when it crosses a rung, stop it
+    if it falls below the top 1/reduction_factor cutoff of that rung)
+  - Median stopping: python/ray/tune/schedulers/median_stopping_rule.py
+  - PBT: python/ray/tune/schedulers/pbt.py (exploit bottom-quantile trials
+    from top-quantile donors + explore by perturbing hyperparams)
+
+Schedulers are pure decision functions over controller state — they never
+touch actors; the TuneController applies the returned decision.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from .search import Domain, _walk, _set_path
+from .trial import Trial, TrialStatus
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+    EXPLOIT = "EXPLOIT"  # PBT only: clone a donor's config+checkpoint
+
+    def on_result(self, trials: List[Trial], trial: Trial,
+                  result: Dict[str, Any]) -> str:
+        return self.CONTINUE
+
+    def choose_donor(self, trials: List[Trial],
+                     trial: Trial) -> Optional[Trial]:
+        return None
+
+    def mutate_config(self, config: Dict[str, Any],
+                      rng: random.Random) -> Dict[str, Any]:
+        return dict(config)
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion in submission order."""
+
+
+class ASHAScheduler(TrialScheduler):
+    """Async successive halving (ref: async_hyperband.py:34 _Bracket).
+
+    Rungs sit at grace_period * reduction_factor^k for k = 0.. up to
+    max_t. When a trial's ``time_attr`` crosses a rung it records its
+    metric there; if it is not in the rung's top 1/reduction_factor it is
+    stopped. Asynchronous: decisions use whatever has been recorded so
+    far — no waiting for a full generation.
+    """
+
+    def __init__(self, metric: str, mode: str = "max", max_t: int = 100,
+                 grace_period: int = 1, reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric, self.mode = metric, mode
+        self.max_t, self.rf = max_t, reduction_factor
+        self.time_attr = time_attr
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(int(t))
+            t *= reduction_factor
+        # rung milestone -> {trial_id: recorded value}
+        self.recorded: Dict[int, Dict[str, float]] = {r: {} for r in self.rungs}
+
+    def _cutoff(self, rung_values: Dict[str, float]) -> Optional[float]:
+        """The (1 - 1/rf) percentile of the rung's recorded values
+        (ref: async_hyperband.py _Bracket.cutoff — np.nanpercentile with
+        linear interpolation), sign-flipped for mode=min."""
+        if not rung_values:
+            return None
+        vals = sorted(rung_values.values())
+        if self.mode == "min":
+            q = 1.0 / self.rf
+        else:
+            q = 1.0 - 1.0 / self.rf
+        pos = q * (len(vals) - 1)
+        lo = int(math.floor(pos))
+        hi = min(lo + 1, len(vals) - 1)
+        return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
+    def on_result(self, trials, trial, result) -> str:
+        if self.metric not in result:
+            return self.CONTINUE
+        t = int(result.get(self.time_attr, trial.iteration))
+        value = float(result[self.metric])
+        if t >= self.max_t:
+            return self.STOP
+        decision = self.CONTINUE
+        for rung in reversed(self.rungs):
+            if t < rung or trial.trial_id in self.recorded[rung]:
+                continue
+            self.recorded[rung][trial.trial_id] = value
+            cutoff = self._cutoff(self.recorded[rung])
+            if cutoff is not None and len(self.recorded[rung]) > 1:
+                below = (value < cutoff if self.mode == "max"
+                         else value > cutoff)
+                if below:
+                    decision = self.STOP
+            break  # record at the highest rung crossed only
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of the
+    running averages of completed/running trials at the same point
+    (ref: median_stopping_rule.py:18)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 grace_period: int = 5, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric, self.mode = metric, mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+
+    def on_result(self, trials, trial, result) -> str:
+        if self.metric not in result:
+            return self.CONTINUE
+        t = int(result.get(self.time_attr, trial.iteration))
+        if t < self.grace_period:
+            return self.CONTINUE
+        means = []
+        for other in trials:
+            if other.trial_id == trial.trial_id:
+                continue
+            vals = [float(r[self.metric]) for r in other.results
+                    if self.metric in r]
+            if vals:
+                means.append(sum(vals) / len(vals))
+        if len(means) < self.min_samples:
+            return self.CONTINUE
+        means.sort()
+        median = means[len(means) // 2]
+        best = trial.best_metric(self.metric, self.mode)
+        value = float(result[self.metric])
+        best = value if best is None else best
+        worse = (best < median if self.mode == "max" else best > median)
+        return self.STOP if worse else self.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (ref: pbt.py:304 PopulationBasedTraining._checkpoint_or_exploit):
+    every ``perturbation_interval`` iterations, a bottom-quantile trial
+    clones the config + latest checkpoint of a random top-quantile donor
+    (exploit) and perturbs the mutation hyperparams (explore: resample
+    with ``resample_probability``, else scale 0.8x/1.2x)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 time_attr: str = "training_iteration",
+                 seed: Optional[int] = None):
+        assert 0 < quantile_fraction <= 0.5
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile_fraction = quantile_fraction
+        self.resample_probability = resample_probability
+        self.time_attr = time_attr
+        self.rng = random.Random(seed)
+
+    def _quantiles(self, trials: List[Trial]) -> Tuple[List[Trial], List[Trial]]:
+        scored = [(t.metric_value(self.metric), t) for t in trials
+                  if t.metric_value(self.metric) is not None
+                  and t.status in (TrialStatus.RUNNING, TrialStatus.PENDING)]
+        if len(scored) < 2:
+            return [], []
+        scored.sort(key=lambda p: p[0], reverse=(self.mode == "max"))
+        k = max(1, int(math.ceil(len(scored) * self.quantile_fraction)))
+        top = [t for _, t in scored[:k]]
+        bottom = [t for _, t in scored[-k:] if t not in top]
+        return top, bottom
+
+    def on_result(self, trials, trial, result) -> str:
+        if self.metric not in result:
+            return self.CONTINUE
+        t = int(result.get(self.time_attr, trial.iteration))
+        if t - trial.last_perturbation_iter < self.interval:
+            return self.CONTINUE
+        trial.last_perturbation_iter = t
+        top, bottom = self._quantiles(trials)
+        if trial in bottom:
+            donor = self.choose_donor(trials, trial)
+            if donor is not None and donor.checkpoint_path:
+                return self.EXPLOIT
+        return self.CONTINUE
+
+    def choose_donor(self, trials, trial) -> Optional[Trial]:
+        top, _ = self._quantiles(trials)
+        candidates = [t for t in top if t.checkpoint_path]
+        return self.rng.choice(candidates) if candidates else None
+
+    def mutate_config(self, config: Dict[str, Any],
+                      rng: Optional[random.Random] = None) -> Dict[str, Any]:
+        rng = rng or self.rng
+        import copy
+
+        out = copy.deepcopy(config)
+        for path, leaf in _walk(self.mutations):
+            if isinstance(leaf, Domain):
+                node = out
+                try:
+                    for key in path[:-1]:
+                        node = node[key]
+                    current = node.get(path[-1])
+                except (KeyError, TypeError):
+                    current = None
+                if current is None or rng.random() < self.resample_probability:
+                    _set_path(out, path, leaf.sample(rng))
+                else:
+                    _set_path(out, path, leaf.perturb(current, rng))
+            elif isinstance(leaf, list):
+                _set_path(out, path, rng.choice(leaf))
+        return out

@@ -1,0 +1,186 @@
+"""Search spaces and trial-config generation.
+
+Reference analog: python/ray/tune/search/sample.py (Domain/Float/Integer/
+Categorical + sampling), python/ray/tune/search/basic_variant.py
+(BasicVariantGenerator — grid cross-product x num_samples random draws).
+Pure-Python and deterministic under a seed; no numpy dependency so config
+dicts stay pickle-friendly scalars.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+
+class Domain:
+    """A sampleable hyperparameter domain (ref: sample.py Domain)."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+    # PBT mutation support: perturb an existing value within the domain.
+    def perturb(self, value: Any, rng: random.Random) -> Any:
+        return self.sample(rng)
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False,
+                 q: Optional[float] = None):
+        if log and lower <= 0:
+            raise ValueError("loguniform requires lower > 0")
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng: random.Random) -> float:
+        if self.log:
+            val = math.exp(rng.uniform(math.log(self.lower),
+                                       math.log(self.upper)))
+        else:
+            val = rng.uniform(self.lower, self.upper)
+        if self.q:
+            val = round(val / self.q) * self.q
+        return min(self.upper, max(self.lower, val))
+
+    def perturb(self, value: Any, rng: random.Random) -> float:
+        factor = rng.choice([0.8, 1.2])
+        val = float(value) * factor
+        if self.q:
+            val = round(val / self.q) * self.q
+        return min(self.upper, max(self.lower, val))
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng: random.Random) -> int:
+        return rng.randint(self.lower, self.upper - 1)
+
+    def perturb(self, value: Any, rng: random.Random) -> int:
+        val = int(round(int(value) * rng.choice([0.8, 1.2])))
+        return min(self.upper - 1, max(self.lower, val))
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    """Arbitrary sample function (ref: sample.py sample_from)."""
+
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng: random.Random) -> Any:
+        return self.fn()
+
+
+class Grid:
+    """A grid_search axis: every value appears in the cross product
+    (ref: basic_variant.py grid handling)."""
+
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+# --- public constructors (ref: ray.tune.{uniform,choice,...}) ---
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def qloguniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, log=True, q=q)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable[[], Any]) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+def _walk(space: Any, path: Tuple[str, ...] = ()):
+    """Yield (path, leaf) for every Domain/Grid leaf in a nested dict."""
+    if isinstance(space, dict):
+        if set(space) == {"grid_search"}:
+            yield path, Grid(space["grid_search"])
+            return
+        for key, val in space.items():
+            yield from _walk(val, path + (str(key),))
+    elif isinstance(space, (Domain, Grid)):
+        yield path, space
+    else:
+        yield path, space  # constant leaf
+
+
+def _set_path(cfg: Dict[str, Any], path: Tuple[str, ...], value: Any):
+    node = cfg
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+class BasicVariantGenerator:
+    """Resolve a param_space into concrete trial configs: the cross product
+    of every grid axis, repeated ``num_samples`` times with fresh random
+    draws for the stochastic domains (ref: basic_variant.py:231)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        leaves = list(_walk(param_space))
+        self._grids = [(p, leaf) for p, leaf in leaves
+                       if isinstance(leaf, Grid)]
+        self._samplers = [(p, leaf) for p, leaf in leaves
+                          if isinstance(leaf, Domain)]
+        self._constants = [(p, leaf) for p, leaf in leaves
+                           if not isinstance(leaf, (Domain, Grid))]
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        grid_axes = [leaf.values for _, leaf in self._grids] or [[None]]
+        for _ in range(self.num_samples):
+            for combo in itertools.product(*grid_axes):
+                cfg: Dict[str, Any] = {}
+                for path, val in self._constants:
+                    _set_path(cfg, path, val)
+                if self._grids:
+                    for (path, _), val in zip(self._grids, combo):
+                        _set_path(cfg, path, val)
+                for path, dom in self._samplers:
+                    _set_path(cfg, path, dom.sample(self.rng))
+                yield cfg
+
+    def total(self) -> int:
+        n_grid = 1
+        for _, leaf in self._grids:
+            n_grid *= len(leaf.values)
+        return n_grid * self.num_samples
+
+    def domains(self) -> Dict[Tuple[str, ...], Domain]:
+        return {p: d for p, d in self._samplers}

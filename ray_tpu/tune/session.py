@@ -1,0 +1,99 @@
+"""Trial-side session: ``ray_tpu.tune.report`` inside a trainable
+(ref: python/ray/tune/trainable/function_trainable.py — the function-API
+session a trial's user code reports through).
+
+Mirrors ray_tpu.train.session but per-trial: one session per trial-runner
+actor process; reports carry metrics plus an optional checkpoint
+directory the controller packs into trial storage (PBT exploit needs it).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ..train._checkpoint import Checkpoint
+
+
+@dataclass
+class TuneContext:
+    trial_id: str
+    trial_dir: str
+    restored_checkpoint: Optional[Checkpoint] = None
+
+
+@dataclass
+class _Report:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint] = None
+
+
+class _Session:
+    def __init__(self, context: TuneContext):
+        self.context = context
+        self.reports: List[_Report] = []
+        self.lock = threading.Lock()
+        self.stop_requested = False
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint]) -> None:
+        with self.lock:
+            self.reports.append(_Report(dict(metrics), checkpoint))
+
+    def drain(self) -> List[_Report]:
+        with self.lock:
+            pending, self.reports = self.reports, []
+        return pending
+
+
+_session: Optional[_Session] = None
+
+
+def _init_session(context: TuneContext) -> _Session:
+    global _session
+    _session = _Session(context)
+    return _session
+
+
+def _shutdown_session() -> None:
+    global _session
+    _session = None
+
+
+def _require_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "ray_tpu.tune.report/get_context can only be called inside a "
+            "trainable launched by Tuner.fit()")
+    return _session
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report one iteration's metrics (ref: ray.tune.report). Raising
+    ``StopIteration``-like early exit: if the scheduler stopped this trial
+    the next report raises ``TrialStopped`` so user loops unwind."""
+    session = _require_session()
+    if session.stop_requested:
+        raise TrialStopped()
+    session.report(metrics, checkpoint)
+
+
+def get_context() -> TuneContext:
+    return _require_session().context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from (set on PBT exploit / trial restore)."""
+    return _require_session().context.restored_checkpoint
+
+
+def get_trial_id() -> str:
+    return _require_session().context.trial_id
+
+
+class TrialStopped(BaseException):
+    """Raised inside a trainable when the scheduler stopped the trial;
+    BaseException so a blanket ``except Exception`` in user code cannot
+    swallow the unwind (ref: tune's StopIteration-based session stop)."""

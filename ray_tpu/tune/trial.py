@@ -1,0 +1,53 @@
+"""Trial state (ref: python/ray/tune/experiment/trial.py — a Trial is the
+controller-side record: config, status, results, checkpoint)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class TrialStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    TERMINATED = "TERMINATED"
+    ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    experiment_dir: str
+    status: str = TrialStatus.PENDING
+    results: List[Dict[str, Any]] = field(default_factory=list)
+    last_result: Dict[str, Any] = field(default_factory=dict)
+    error: Optional[str] = None
+    checkpoint_path: Optional[str] = None   # latest packed checkpoint dir
+    iteration: int = 0                      # training_iteration counter
+    # PBT bookkeeping
+    last_perturbation_iter: int = 0
+    perturbations: int = 0
+
+    @property
+    def local_dir(self) -> str:
+        path = os.path.join(self.experiment_dir, self.trial_id)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def metric_value(self, metric: str) -> Optional[float]:
+        if metric in self.last_result:
+            return float(self.last_result[metric])
+        return None
+
+    def best_metric(self, metric: str, mode: str) -> Optional[float]:
+        vals = [float(r[metric]) for r in self.results if metric in r]
+        if not vals:
+            return None
+        return max(vals) if mode == "max" else min(vals)
+
+    def __repr__(self):
+        return (f"Trial({self.trial_id}, {self.status}, "
+                f"iter={self.iteration})")

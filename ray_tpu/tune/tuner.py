@@ -1,0 +1,56 @@
+"""Tuner: the public entry point (ref: python/ray/tune/tuner.py:312
+Tuner.fit; tune_config in python/ray/tune/tune_config.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..train.config import RunConfig
+from .controller import TuneController
+from .result_grid import ResultGrid
+from .schedulers import TrialScheduler
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    resources_per_trial: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1.0})
+    seed: Optional[int] = None
+    # stop criteria applied to every result, e.g. {"training_iteration": 50}
+    # (ref: air.RunConfig(stop=...); kept here so RunConfig stays shared
+    # with Train)
+    stop: Optional[Dict[str, float]] = None
+
+
+class Tuner:
+    """Run a hyperparameter sweep over a trainable.
+
+    The trainable is a function ``fn(config)`` that calls
+    ``ray_tpu.tune.report(metrics, checkpoint=...)`` each iteration — a
+    ray_tpu.train.Trainer can be nested inside it for distributed trials
+    (the reference's Train-in-Tune composition).
+    """
+
+    def __init__(self, trainable, *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        if not callable(trainable):
+            raise TypeError("trainable must be a callable fn(config)")
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        controller = TuneController(
+            self.trainable, self.param_space, self.tune_config,
+            self.run_config)
+        trials = controller.run()
+        return ResultGrid(trials, self.tune_config.metric,
+                          self.tune_config.mode)

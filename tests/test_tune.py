@@ -1,0 +1,224 @@
+"""Tune: search spaces, trial loop, ASHA early stopping, PBT exploit
+(ref: python/ray/tune/tests/ — test_tune_controller, test_schedulers,
+test_searchers suites)."""
+
+import json
+import os
+import random
+import tempfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig, FailureConfig
+from ray_tpu.tune import (
+    ASHAScheduler, MedianStoppingRule, PopulationBasedTraining,
+    TuneConfig, Tuner)
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+@pytest.fixture
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+# --- search spaces (no cluster needed) ---
+
+def test_basic_variant_grid_cross_product():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "layers": tune.grid_search([2, 4, 8]),
+        "act": "relu",
+    }
+    gen = BasicVariantGenerator(space, num_samples=1, seed=0)
+    configs = list(gen)
+    assert gen.total() == 6 and len(configs) == 6
+    assert {(c["lr"], c["layers"]) for c in configs} == {
+        (lr, nl) for lr in (0.1, 0.01) for nl in (2, 4, 8)}
+    assert all(c["act"] == "relu" for c in configs)
+
+
+def test_basic_variant_sampling_domains():
+    space = {
+        "lr": tune.loguniform(1e-5, 1e-1),
+        "dim": tune.choice([128, 256]),
+        "drop": tune.quniform(0.0, 0.5, 0.1),
+        "seed": tune.randint(0, 100),
+        "nested": {"wd": tune.uniform(0.0, 0.3)},
+    }
+    configs = list(BasicVariantGenerator(space, num_samples=20, seed=1))
+    assert len(configs) == 20
+    for c in configs:
+        assert 1e-5 <= c["lr"] <= 1e-1
+        assert c["dim"] in (128, 256)
+        assert abs(c["drop"] / 0.1 - round(c["drop"] / 0.1)) < 1e-9
+        assert 0 <= c["seed"] < 100
+        assert 0.0 <= c["nested"]["wd"] <= 0.3
+    # same seed -> same draws
+    again = list(BasicVariantGenerator(space, num_samples=20, seed=1))
+    assert configs == again
+
+
+# --- end-to-end sweeps ---
+
+def test_tuner_runs_grid_and_picks_best(ray_cluster, tmp_path):
+    def objective(config):
+        # quadratic bowl: best at x=3
+        score = -(config["x"] - 3) ** 2
+        tune.report({"score": score, "x": config["x"]})
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 5 and grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.config["x"] == 3 and best.metrics["score"] == 0
+
+
+def test_tuner_stop_criteria_and_multiple_reports(ray_cluster, tmp_path):
+    def objective(config):
+        for i in range(100):
+            tune.report({"value": i * config["slope"]})
+
+    grid = Tuner(
+        objective,
+        param_space={"slope": tune.grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="value", mode="max",
+                               stop={"training_iteration": 5}),
+        run_config=RunConfig(name="stop", storage_path=str(tmp_path)),
+    ).fit()
+    assert grid.num_errors == 0
+    for i in range(2):
+        assert len(grid.trial_results(i)) <= 6  # stopped promptly
+    best = grid.get_best_result()
+    assert best.config["slope"] == 2.0
+
+
+def test_trial_error_retried_then_surfaces(ray_cluster, tmp_path):
+    def objective(config):
+        tune.report({"ok": 1})
+        raise RuntimeError("boom")
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="ok", mode="max"),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert grid.num_errors == 1
+    assert "boom" in grid.errors[0]
+
+
+def test_asha_stops_bad_trials_early(ray_cluster, tmp_path):
+    def objective(config):
+        import time as _time
+
+        for i in range(1, 31):
+            # trial quality is its asymptote; bad trials are visibly bad.
+            # paced so the controller can stop a trial mid-run (a real
+            # training iteration is never sub-poll-interval fast)
+            _time.sleep(0.05)
+            tune.report({"acc": config["quality"] * (1 - 0.5 ** i)})
+
+    grid = Tuner(
+        objective,
+        param_space={"quality": tune.grid_search(
+            [1.0, 0.9, 0.3, 0.2, 0.1])},
+        tune_config=TuneConfig(
+            metric="acc", mode="max",
+            scheduler=ASHAScheduler(metric="acc", mode="max", max_t=30,
+                                    grace_period=2, reduction_factor=2),
+            max_concurrent_trials=4),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    ).fit()
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.config["quality"] >= 0.9
+    # at least one bad trial was cut before max_t
+    iters = [len(grid.trial_results(i)) for i in range(len(grid))]
+    assert min(iters) < 30
+
+
+def test_median_stopping_rule_decisions():
+    from ray_tpu.tune.trial import Trial
+
+    rule = MedianStoppingRule(metric="acc", mode="max", grace_period=2,
+                              min_samples_required=2)
+    trials = []
+    for i, acc in enumerate([0.9, 0.8, 0.1]):
+        t = Trial(trial_id=str(i), config={}, experiment_dir="/tmp")
+        t.results = [{"acc": acc, "training_iteration": 3}]
+        t.last_result = t.results[-1]
+        t.iteration = 3
+        trials.append(t)
+    # the bad trial is below the median of {0.9, 0.8} means
+    decision = rule.on_result(trials, trials[2],
+                              {"acc": 0.1, "training_iteration": 3})
+    assert decision == rule.STOP
+    # a good trial continues
+    decision = rule.on_result(trials, trials[0],
+                              {"acc": 0.9, "training_iteration": 3})
+    assert decision == rule.CONTINUE
+
+
+def test_pbt_exploits_checkpoint_and_mutates(ray_cluster, tmp_path):
+    def objective(config):
+        from ray_tpu.train import Checkpoint
+
+        ckpt = tune.get_checkpoint()
+        theta = 0.0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                theta = json.load(f)["theta"]
+        import time as _time
+
+        # long + paced enough that both population members overlap even
+        # when the second trial's worker process cold-starts (~1s)
+        for i in range(80):
+            _time.sleep(0.05)
+            theta += config["lr"]  # higher lr climbs faster
+            if i % 2 == 0:  # checkpoint every other step
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "state.json"), "w") as f:
+                    json.dump({"theta": theta}, f)
+                tune.report({"theta": theta}, Checkpoint(d))
+            else:
+                tune.report({"theta": theta})
+
+    pbt = PopulationBasedTraining(
+        metric="theta", mode="max", perturbation_interval=10,
+        hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=0)
+    grid = Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([1.0, 0.01])},
+        tune_config=TuneConfig(metric="theta", mode="max", scheduler=pbt,
+                               stop={"training_iteration": 60},
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    ).fit()
+    assert grid.num_errors == 0
+    # the slow trial was exploited at least once: its config's lr moved
+    # away from the original 0.01 grid value
+    lrs = sorted(r.config["lr"] for r in [grid[0], grid[1]])
+    assert lrs[0] > 0.01 or any(
+        t.perturbations > 0 for t in grid._trials)
+
+
+def test_pbt_mutate_config_bounds():
+    pbt = PopulationBasedTraining(
+        metric="m", mode="max",
+        hyperparam_mutations={"lr": tune.uniform(0.1, 1.0),
+                              "bs": [16, 32, 64]},
+        resample_probability=0.0, seed=0)
+    rng = random.Random(0)
+    out = pbt.mutate_config({"lr": 0.5, "bs": 32, "other": "keep"}, rng)
+    assert out["lr"] in (pytest.approx(0.4), pytest.approx(0.6))
+    assert out["bs"] in (16, 32, 64)
+    assert out["other"] == "keep"
