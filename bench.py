@@ -73,6 +73,71 @@ def _check_pallas_parity():
     return True
 
 
+def _bench_serving(name: str):
+    """Continuous-batching decode throughput + TTFT on the chip (the
+    BASELINE.json Serve north-star: req/s + p50 TTFT have no published
+    reference value; we report tokens/s/chip and TTFT directly)."""
+    import numpy as np
+    import jax
+
+    from ray_tpu.llm import EngineConfig, LLMEngine, SamplingParams
+    from ray_tpu.models import LLAMA_CONFIGS, init_params
+
+    cfg = LLAMA_CONFIGS[name]
+    params = init_params(jax.random.PRNGKey(7), cfg)
+    B = 16
+    max_seq = min(1024, cfg.max_seq)
+    page = 64 if max_seq >= 512 else 16
+    engine = LLMEngine(params, cfg, EngineConfig(
+        max_num_seqs=B, page_size=page,
+        num_pages=1 + B * ((max_seq + page - 1) // page),
+        max_seq_len=max_seq,
+        # the axon relay pays ~100ms RTT per dispatch; a deep burst
+        # amortizes it (a locally-attached TPU would not need this)
+        decode_burst=32))
+    rng = np.random.default_rng(0)
+    plen = max_seq // 2 - 1
+    greedy = SamplingParams(temperature=0.0, max_tokens=max_seq // 2)
+
+    def prompt(n):
+        return [int(t) for t in rng.integers(1, cfg.vocab, n)]
+
+    # warmup: compiles the prefill bucket and BOTH decode-burst widths
+    # (full burst while budget lasts, then the 1-step tail)
+    engine.add_request(prompt(plen), SamplingParams(
+        temperature=0.0, max_tokens=engine.ecfg.decode_burst + 2))
+    while engine.has_unfinished():
+        engine.step()
+
+    # TTFT: time from arrival to first sampled token (prefill only —
+    # step(skip_decode=True) stops once the first token is out)
+    t0 = time.perf_counter()
+    rid = engine.add_request(prompt(plen), greedy)
+    outs = engine.step(skip_decode=True)
+    assert any(o.request_id == rid for o in outs)
+    ttft_ms = 1e3 * (time.perf_counter() - t0)
+
+    # decode throughput: all slots busy, timed decode-only rounds;
+    # each round emits decode_burst tokens per slot (count the outputs,
+    # don't assume)
+    for _ in range(B - 1):
+        engine.add_request(prompt(plen // 4), greedy)
+    for _ in range(B):   # drain prefills (one admission per step)
+        engine.step()
+    steps = 16
+    t0 = time.perf_counter()
+    n_tokens = 0
+    for _ in range(steps):
+        n_tokens += len(engine.step())
+    dt = time.perf_counter() - t0
+    return {
+        "serve_decode_tokens_per_sec": round(n_tokens / dt, 1),
+        "serve_ttft_ms": round(ttft_ms, 2),
+        "serve_batch": B,
+        "serve_decode_burst": engine.ecfg.decode_burst,
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -125,6 +190,14 @@ def main():
     mfu = (tokens_per_sec * _model_flops_per_token(cfg, seq) / peak
            if peak else 0.0)
 
+    # release train state HBM before the serving bench
+    del state, data, params
+    serve_metrics = {}
+    try:
+        serve_metrics = _bench_serving(name)
+    except Exception as e:  # serving bench must not sink the train number
+        serve_metrics = {"serve_error": repr(e)[:200]}
+
     print(json.dumps({
         "metric": f"llama_{name}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -138,6 +211,7 @@ def main():
         "seq": seq,
         "pallas_parity": pallas_ok,
         "loss": round(float(metrics["loss"]), 4),
+        **serve_metrics,
     }))
 
 

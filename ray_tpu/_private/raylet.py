@@ -279,9 +279,14 @@ class Raylet:
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_STORE_DIR"] = self.store.dir
         # Pool workers run CPU-only jax: skip the TPU PJRT bootstrap entirely
-        # (it imports jax at interpreter start, ~2s). Dedicated TPU workers
-        # (mesh actor groups) are spawned with the device env preserved.
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # (it imports jax at interpreter start, ~2s). FORCE the pin — a
+        # driver launched under a sitecustomize that exports
+        # JAX_PLATFORMS="axon,cpu" would otherwise leak a device-plane
+        # platform into workers whose tunnel env we strip below, leaving
+        # jax pointed at a backend that cannot register (worker crash on
+        # first jax import). TPU gang workers reclaim the device plane
+        # explicitly (train/worker_group.py _maybe_init_jax_distributed).
+        env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],

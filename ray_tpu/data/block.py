@@ -46,8 +46,19 @@ def concat_blocks(blocks: List[Block]) -> Block:
         return []
     if is_columnar(blocks[0]):
         keys = blocks[0].keys()
-        return {k: np.concatenate([np.asarray(b[k]) for b in blocks])
-                for k in keys}
+        out = {}
+        for k in keys:
+            cols = [_np_column(b[k]) if isinstance(b[k], list)
+                    else np.asarray(b[k]) for b in blocks]
+            try:
+                out[k] = np.concatenate(cols)
+            except ValueError:
+                # rectangular within each block but ragged ACROSS blocks
+                # (e.g. every token list in block A is len 3, in block B
+                # len 2): fall back to one object row per element
+                out[k] = _np_column(
+                    [row for col in cols for row in list(col)])
+        return out
     out: List[Any] = []
     for b in blocks:
         out.extend(b)
@@ -113,11 +124,23 @@ def rows_of(block: Block) -> Iterator[Any]:
         yield from block
 
 
+def _np_column(values: List[Any]) -> np.ndarray:
+    """Column from python values; ragged rows (e.g. variable-length token
+    lists) fall back to a 1-D object array instead of raising."""
+    try:
+        return np.asarray(values)
+    except ValueError:
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
+
+
 def to_columnar(block: Block) -> Dict[str, np.ndarray]:
     """Best-effort conversion of a simple block to columnar form."""
     if is_columnar(block):
         return block
     if block and isinstance(block[0], dict):
         keys = block[0].keys()
-        return {k: np.asarray([row[k] for row in block]) for k in keys}
-    return {"item": np.asarray(block)}
+        return {k: _np_column([row[k] for row in block]) for k in keys}
+    return {"item": _np_column(block)}

@@ -1,0 +1,54 @@
+"""Batch LLM inference over Datasets (ref: python/ray/data/llm.py +
+llm/_internal/batch/processor/ — the vLLM engine stage; native here).
+
+The processor is a plain ``map_batches`` function; each executing worker
+process lazily builds ONE engine (per model/config) and reuses it across
+its batches, the analog of the reference's engine-stage actor reuse.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+_ENGINE_CACHE: Dict[Tuple, Any] = {}
+
+
+def _get_engine(model: str, ecfg_items: Tuple, seed: int):
+    key = (model, ecfg_items, seed)
+    engine = _ENGINE_CACHE.get(key)
+    if engine is None:
+        import jax
+
+        from ..llm import EngineConfig, LLMEngine
+        from ..models.llama import LLAMA_CONFIGS, init_params
+
+        cfg = LLAMA_CONFIGS[model]
+        params = init_params(jax.random.PRNGKey(seed), cfg)
+        engine = LLMEngine(params, cfg, EngineConfig(**dict(ecfg_items)))
+        _ENGINE_CACHE[key] = engine
+    return engine
+
+
+def build_llm_processor(model: str = "tiny", *,
+                        engine_config: Optional[dict] = None,
+                        sampling: Optional[dict] = None,
+                        prompt_column: str = "prompt_ids",
+                        output_column: str = "output_ids",
+                        seed: int = 0):
+    """A batch-format processor for ``Dataset.map_batches``: reads token
+    id lists from ``prompt_column``, generates with continuous batching,
+    writes ``output_column``."""
+    ecfg_items = tuple(sorted((engine_config or {}).items()))
+    sampling = dict(sampling or {})
+
+    def process(batch: Dict[str, List[Any]]) -> Dict[str, List[Any]]:
+        from ..llm import SamplingParams
+
+        engine = _get_engine(model, ecfg_items, seed)
+        prompts = [list(map(int, p)) for p in batch[prompt_column]]
+        outs = engine.generate(prompts, SamplingParams(**sampling))
+        out = dict(batch)
+        out[output_column] = outs
+        return out
+
+    return process

@@ -1,0 +1,14 @@
+"""ray_tpu.llm: TPU-native LLM inference — paged KV cache, continuous
+batching, serving (ref: python/ray/llm/ — which delegates to vLLM; here
+the engine is native jax/XLA, SURVEY §2.4)."""
+
+from .cache import KVCache, PageAllocator, SequenceTable, init_kv_cache
+from .engine import EngineConfig, LLMEngine, StepOutput
+from .sampling import SamplingParams
+from .serve import LLMServer, build_llm_deployment
+
+__all__ = [
+    "LLMEngine", "EngineConfig", "StepOutput", "SamplingParams",
+    "KVCache", "PageAllocator", "SequenceTable", "init_kv_cache",
+    "LLMServer", "build_llm_deployment",
+]

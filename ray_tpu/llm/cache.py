@@ -1,0 +1,115 @@
+"""Paged KV cache: device arrays + host-side page allocator.
+
+Reference analog: the vLLM engine the reference wraps for LLM serving
+(python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py) keeps
+its paged KV cache in CUDA; here the cache is a pair of jax arrays of
+STATIC shape [layers, pages, page_size, kv_heads, head_dim] living in HBM
+— XLA-friendly (no dynamic allocation inside jit) with all paging
+decisions made host-side by a free-list allocator.
+
+Page 0 is reserved as the *dump page*: padded scatter lanes write there so
+the jitted kernels never branch on validity; it is never handed out.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class KVCache:
+    """Device-side paged cache (one pair of stacked-layer arrays)."""
+
+    k: jax.Array  # [L, num_pages, page_size, kv_heads, head_dim]
+    v: jax.Array
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_kv_cache(cfg, num_pages: int, page_size: int,
+                  dtype=None) -> KVCache:
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+class PageAllocator:
+    """Host-side free list over the cache's page pool (page 0 reserved)."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return (n_tokens + self.page_size - 1) // self.page_size
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.pages_needed(n_tokens) <= len(self._free)
+
+    def allocate(self, n_pages: int) -> List[int]:
+        if n_pages > len(self._free):
+            raise MemoryError(
+                f"KV cache out of pages: want {n_pages}, "
+                f"free {len(self._free)}")
+        out = [self._free.pop() for _ in range(n_pages)]
+        return out
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if not 0 < p < self.num_pages:
+                raise ValueError(f"freeing invalid page {p}")
+        self._free.extend(pages)
+
+
+class SequenceTable:
+    """Per-sequence page bookkeeping: block table rows handed to the
+    jitted kernels (numpy host-side; copied to device per step)."""
+
+    def __init__(self, max_seqs: int, max_pages_per_seq: int):
+        self.block_tables = np.zeros((max_seqs, max_pages_per_seq), np.int32)
+        self.n_pages = np.zeros(max_seqs, np.int32)
+        # bumped on every mutation so the engine can cache the device copy
+        self.version = 0
+
+    def assign(self, slot: int, pages: List[int]) -> None:
+        self.block_tables[slot, :] = 0
+        self.block_tables[slot, :len(pages)] = pages
+        self.n_pages[slot] = len(pages)
+        self.version += 1
+
+    def append_page(self, slot: int, page: int) -> None:
+        idx = int(self.n_pages[slot])
+        if idx >= self.block_tables.shape[1]:
+            raise MemoryError(f"slot {slot}: sequence exceeds "
+                              f"max_pages_per_seq={self.block_tables.shape[1]}")
+        self.block_tables[slot, idx] = page
+        self.n_pages[slot] = idx + 1
+        self.version += 1
+
+    def pages_of(self, slot: int) -> List[int]:
+        return [int(p) for p in
+                self.block_tables[slot, :int(self.n_pages[slot])]]
+
+    def clear(self, slot: int) -> None:
+        self.block_tables[slot, :] = 0
+        self.n_pages[slot] = 0
+        self.version += 1

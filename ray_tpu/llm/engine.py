@@ -1,0 +1,385 @@
+"""LLMEngine: continuous batching over the paged-KV model runner.
+
+Reference analog: the vLLM engine loop ray.llm wraps
+(llm/_internal/serve/deployments/llm/vllm/vllm_engine.py) — request
+queue -> schedule -> {prefill | decode} -> sample -> stream. Rebuilt
+TPU-first:
+
+  * decode batch has a FIXED width (``max_num_seqs`` slots) so one
+    compiled decode executable serves the engine's whole lifetime —
+    continuous batching = host-side slot assignment, not shape changes;
+  * prefills are bucketed (power-of-2 padding) and run one request per
+    step between decode steps (chunked-prefill-lite: bounded TTFT impact
+    on running streams);
+  * all paging is host-side (PageAllocator); the device never sees an
+    allocation decision, only block tables.
+
+The engine is synchronous and single-threaded by design — an actor wraps
+it for serving (ray_tpu.llm.serve) the way vLLM's AsyncLLMEngine wraps
+its LLMEngine.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig
+from ..ops import rope_frequencies
+from .cache import KVCache, PageAllocator, SequenceTable, init_kv_cache
+from .runner import decode_burst, prefill_bucket, prefill_sample
+from .sampling import SamplingParams
+
+
+@dataclass
+class EngineConfig:
+    max_num_seqs: int = 8           # decode slots (static batch width)
+    page_size: int = 16
+    num_pages: int = 512            # incl. reserved dump page 0
+    max_seq_len: int = 2048
+    kv_dtype: Any = None            # default: model dtype
+    # decode steps fused into one device dispatch (multi-step
+    # scheduling); >1 amortizes host->device round trips at the cost of
+    # up to burst-1 wasted tokens past a stop token
+    decode_burst: int = 8
+    # finished RequestStates kept for inspection before FIFO eviction
+    # (callers that stream from step() outputs never need them)
+    finished_retention: int = 1024
+
+
+@dataclass
+class RequestState:
+    request_id: str
+    prompt: List[int]
+    params: SamplingParams
+    output: List[int] = field(default_factory=list)
+    slot: int = -1
+    ctx_len: int = 0
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    arrival_t: float = 0.0
+    first_token_t: float = 0.0
+
+
+@dataclass
+class StepOutput:
+    request_id: str
+    token: int
+    finished: bool
+    finish_reason: Optional[str] = None
+    text_offset: int = 0
+
+
+class LLMEngine:
+    def __init__(self, params, cfg: LlamaConfig,
+                 engine_config: Optional[EngineConfig] = None):
+        self.cfg = cfg
+        self.ecfg = engine_config or EngineConfig()
+        if self.ecfg.max_seq_len > cfg.max_seq:
+            raise ValueError("engine max_seq_len exceeds model max_seq")
+        usable = self.ecfg.num_pages - 1  # page 0 is the dump page
+        need = -(-self.ecfg.max_seq_len // self.ecfg.page_size)
+        if need > usable:
+            # guarantees a lone running sequence can always grow to
+            # max_seq_len, which keeps preemption deadlock-free
+            raise ValueError(
+                f"num_pages={self.ecfg.num_pages} cannot hold one "
+                f"max_seq_len={self.ecfg.max_seq_len} sequence "
+                f"({need} pages needed, {usable} usable)")
+        self.params = params
+        self.cache = init_kv_cache(cfg, self.ecfg.num_pages,
+                                   self.ecfg.page_size,
+                                   self.ecfg.kv_dtype)
+        self.allocator = PageAllocator(self.ecfg.num_pages,
+                                       self.ecfg.page_size)
+        max_pages = self.allocator.pages_needed(self.ecfg.max_seq_len)
+        self.seq_table = SequenceTable(self.ecfg.max_num_seqs, max_pages)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
+                                    cfg.rope_theta)
+        self.cos, self.sin = jax.device_put(cos), jax.device_put(sin)
+        self.waiting: Deque[RequestState] = collections.deque()
+        self.slots: List[Optional[RequestState]] = (
+            [None] * self.ecfg.max_num_seqs)
+        self.requests: Dict[str, RequestState] = {}
+        self._finished_order: Deque[str] = collections.deque()
+        self._seed = 0
+        self._id = itertools.count()
+        # device-side block-table cache, refreshed only when the host
+        # table mutates (saves one H2D upload per decode step)
+        self._bt_device = None
+        self._bt_version = -1
+
+    # --- public API ---
+
+    def add_request(self, prompt_tokens: List[int],
+                    params: Optional[SamplingParams] = None,
+                    request_id: Optional[str] = None) -> str:
+        if not prompt_tokens:
+            raise ValueError("empty prompt")
+        if len(prompt_tokens) >= self.ecfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(prompt_tokens)} >= max_seq_len "
+                f"{self.ecfg.max_seq_len}")
+        rid = request_id or f"req-{next(self._id)}"
+        state = RequestState(rid, list(prompt_tokens),
+                             params or SamplingParams(),
+                             arrival_t=time.perf_counter())
+        self.waiting.append(state)
+        self.requests[rid] = state
+        return rid
+
+    def abort_request(self, request_id: str) -> None:
+        state = self.requests.get(request_id)
+        if state is None or state.finished:
+            return
+        self._finish(state, "aborted")
+
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    def step(self, skip_decode: bool = False) -> List[StepOutput]:
+        """One scheduling round: admit + prefill at most one waiting
+        request, then one batched decode burst for every running slot.
+        ``skip_decode`` runs only the admission/prefill phase (TTFT
+        measurement, draining a prefill backlog before decoding)."""
+        outputs: List[StepOutput] = []
+        admitted = self._admit()
+        if admitted is not None:
+            outputs.extend(self._run_prefill(admitted))
+        if not skip_decode and any(s is not None for s in self.slots):
+            outputs.extend(self._run_decode())
+        return outputs
+
+    def generate(self, prompts: List[List[int]],
+                 params: Optional[SamplingParams] = None) -> List[List[int]]:
+        """Batch entry point: run all prompts to completion."""
+        ids = [self.add_request(p, params) for p in prompts]
+        while self.has_unfinished():
+            self.step()
+        return [self.requests[i].output for i in ids]
+
+    # --- scheduling internals ---
+
+    def _free_slot(self) -> int:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return -1
+
+    def _admit(self) -> Optional[RequestState]:
+        if not self.waiting:
+            return None
+        slot = self._free_slot()
+        if slot < 0:
+            return None
+        state = self.waiting[0]
+        # pages for the whole sequence so far (prompt + any tokens
+        # generated before a preemption) + the next generated token
+        seq_len = len(state.prompt) + len(state.output)
+        if not self.allocator.can_allocate(seq_len + 1):
+            return None
+        self.waiting.popleft()
+        pages = self.allocator.allocate(
+            self.allocator.pages_needed(seq_len + 1))
+        state.slot = slot
+        self.slots[slot] = state
+        self.seq_table.assign(slot, pages)
+        return state
+
+    # block-table span bucket width, in pages: bounds compiled decode
+    # variants to max_pages/span while letting KV reads scale with the
+    # longest ACTIVE context instead of max_seq_len
+    _SPAN_PAGES = 4
+
+    def _bt(self, span: Optional[int] = None):
+        key = (self.seq_table.version, span)
+        if self._bt_version != key:
+            table = self.seq_table.block_tables
+            if span is not None:
+                table = table[:, :span]
+            self._bt_device = jnp.asarray(table)
+            self._bt_version = key
+        return self._bt_device
+
+    def _active_span(self) -> int:
+        """Pages covering the longest active sequence, bucketed."""
+        width = self.seq_table.block_tables.shape[1]
+        longest = max((int(self.seq_table.n_pages[s.slot])
+                       for s in self.slots if s is not None), default=1)
+        b = self._SPAN_PAGES
+        while b < longest:
+            b *= 2
+        return min(b, width)
+
+    def _sampling_arrays(self, row_states, advance: int = 1):
+        n = len(row_states)
+        temp = np.ones(n, np.float32)
+        top_k = np.zeros(n, np.int32)
+        top_p = np.ones(n, np.float32)
+        for i, s in enumerate(row_states):
+            if s is None:
+                continue
+            temp[i] = s.params.temperature
+            top_k[i] = s.params.top_k
+            top_p[i] = s.params.top_p
+        seed = self._seed
+        self._seed += advance  # burst step i uses seed+i: no reuse
+        return (seed, jnp.asarray(temp), jnp.asarray(top_k),
+                jnp.asarray(top_p))
+
+    def _run_prefill(self, state: RequestState) -> List[StepOutput]:
+        """Prefill the sequence so far (prompt, plus prior output when
+        resuming after preemption — vLLM's recompute-preemption) and
+        sample the next token, all in one fused dispatch."""
+        seq = state.prompt + state.output
+        L = len(seq)
+        bucket = prefill_bucket(L, self.ecfg.max_seq_len)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :L] = seq
+        seed, temp, top_k, top_p = self._sampling_arrays([state])
+        toks, ck, cv = prefill_sample(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray([L], jnp.int32),
+            jnp.asarray(self.seq_table.block_tables[
+                state.slot:state.slot + 1]),
+            self.cos, self.sin, seed, temp, top_k, top_p, cfg=self.cfg)
+        self.cache = KVCache(ck, cv)
+        state.ctx_len = L
+        tok = int(np.asarray(toks)[0])
+        if not state.output:
+            state.first_token_t = time.perf_counter()
+        return [self._append_token(state, tok)]
+
+    def _preempt(self, state: RequestState) -> None:
+        """Recompute-preemption (vLLM style): release the sequence's
+        pages and put it back at the head of the waiting queue; its
+        generated-so-far tokens re-prefill on readmission."""
+        self.allocator.free(self.seq_table.pages_of(state.slot))
+        self.seq_table.clear(state.slot)
+        self.slots[state.slot] = None
+        state.slot = -1
+        self.waiting.appendleft(state)
+
+    def _pick_victim(self, exclude: RequestState) -> Optional[RequestState]:
+        candidates = [s for s in self.slots
+                      if s is not None and s is not exclude]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: s.arrival_t)  # youngest
+
+    def _burst_width(self) -> int:
+        """Fused steps this round: capped by every active slot's headroom
+        to max_seq_len and by its remaining token budget (don't burn a
+        full burst when everyone needs one more token)."""
+        K = self.ecfg.decode_burst
+        for s in self.slots:
+            if s is None:
+                continue
+            K = min(K, self.ecfg.max_seq_len - 1 - s.ctx_len + 1,
+                    s.params.max_tokens - len(s.output))
+        return max(1, K)
+
+    def _provision_pages(self, s: RequestState, upto: int) -> None:
+        """Ensure slot pages cover positions [0, upto); preempt youngest
+        others when the pool runs dry (init guarantees a lone sequence
+        always fits)."""
+        while int(self.seq_table.n_pages[s.slot]) * self.ecfg.page_size \
+                < upto:
+            if self.allocator.free_pages >= 1:
+                self.seq_table.append_page(
+                    s.slot, self.allocator.allocate(1)[0])
+                continue
+            victim = self._pick_victim(exclude=s)
+            if victim is None:
+                raise MemoryError(
+                    "single sequence exhausted the KV cache — "
+                    "num_pages/max_seq_len misconfigured")
+            self._preempt(victim)
+
+    def _run_decode(self) -> List[StepOutput]:
+        B = self.ecfg.max_num_seqs
+        K = self._burst_width()
+        for s in [s for s in self.slots if s is not None]:
+            if s.slot < 0:
+                continue  # preempted as a victim earlier this round
+            self._provision_pages(s, s.ctx_len + K)
+        active_states = [s for s in self.slots if s is not None]
+        if not active_states:
+            return []
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        active = np.zeros(B, bool)
+        for s in active_states:
+            last = s.output[-1] if s.output else s.prompt[-1]
+            tokens[s.slot] = last
+            positions[s.slot] = s.ctx_len
+            active[s.slot] = True
+        seed, temp, top_k, top_p = self._sampling_arrays(self.slots,
+                                                         advance=K)
+        toks, ck, cv = decode_burst(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            self._bt(self._active_span()),
+            jnp.asarray(active), self.cos, self.sin,
+            seed, temp, top_k, top_p, cfg=self.cfg, n_steps=K)
+        self.cache = KVCache(ck, cv)
+        sampled = np.asarray(toks)  # [K, B]
+        outs = []
+        for s in active_states:
+            for k in range(K):
+                s.ctx_len += 1
+                outs.append(self._append_token(s, int(sampled[k, s.slot])))
+                if s.finished:
+                    break
+        return outs
+
+    def _append_token(self, state: RequestState, token: int) -> StepOutput:
+        state.output.append(token)
+        reason = None
+        if token in state.params.stop_token_ids:
+            reason = "stop"
+        elif len(state.output) >= state.params.max_tokens:
+            reason = "length"
+        elif state.ctx_len + 1 >= self.ecfg.max_seq_len:
+            reason = "length"
+        if reason:
+            self._finish(state, reason)
+        return StepOutput(state.request_id, token, state.finished,
+                          state.finish_reason,
+                          text_offset=len(state.output) - 1)
+
+    def _finish(self, state: RequestState, reason: str) -> None:
+        state.finished = True
+        state.finish_reason = reason
+        if state.slot >= 0:
+            self.allocator.free(self.seq_table.pages_of(state.slot))
+            self.seq_table.clear(state.slot)
+            self.slots[state.slot] = None
+            state.slot = -1
+        elif state in self.waiting:
+            self.waiting.remove(state)
+        # bounded retention: a long-lived serving engine must not keep
+        # every finished request's token lists forever
+        self._finished_order.append(state.request_id)
+        while len(self._finished_order) > self.ecfg.finished_retention:
+            old = self._finished_order.popleft()
+            stale = self.requests.get(old)
+            if stale is not None and stale.finished:
+                del self.requests[old]
+
+    # --- metrics ---
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "running": sum(s is not None for s in self.slots),
+            "waiting": len(self.waiting),
+            "free_pages": self.allocator.free_pages,
+            "total_pages": self.allocator.num_pages - 1,
+        }

@@ -1,0 +1,312 @@
+"""Model runner: jitted prefill + single-token decode over a paged KV
+cache, for the Llama family.
+
+TPU-first shape discipline (everything static under jit):
+  * prefill pads the prompt to a power-of-2 bucket — one compiled
+    executable per bucket, reused across requests;
+  * decode runs the WHOLE slot batch [max_seqs] every step, inactive
+    slots masked (their writes land on dump page 0) — one executable for
+    the life of the engine;
+  * cache buffers are donated, so XLA updates pages in place (no
+    O(cache) copy per step).
+
+The decode attention gathers pages with jnp.take (XLA fuses the gather
+into the attention when it can); a Pallas in-place kernel is the upgrade
+path once shapes are pinned. Reference analog: the vLLM paged-attention
+CUDA kernels behind ray.llm's vllm_engine (SURVEY §2.4) — rebuilt here
+natively since the reference delegates all device work to vLLM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.llama import LlamaConfig
+from ..ops import apply_rotary, attention, rms_norm, rope_frequencies
+from .cache import KVCache
+
+
+def _write_pages(cache_layer, new, block_tables, positions, page_size):
+    """Scatter per-token K or V rows into their pages.
+
+    cache_layer: [P, page, kvh, hd]; new: [B, S, kvh, hd];
+    block_tables: [B, max_pages]; positions: [B, S] absolute positions
+    (negative = padding -> routed to dump page 0).
+    """
+    B, S = new.shape[:2]
+    page_idx = jnp.take_along_axis(
+        block_tables, jnp.maximum(positions, 0) // page_size, axis=1)
+    valid = positions >= 0
+    page_idx = jnp.where(valid, page_idx, 0)           # dump page
+    offset = jnp.where(valid, positions % page_size, 0)
+    flat_pages = page_idx.reshape(-1)                  # [B*S]
+    flat_off = offset.reshape(-1)
+    flat_new = new.reshape(B * S, *new.shape[2:])
+    return cache_layer.at[flat_pages, flat_off].set(
+        flat_new.astype(cache_layer.dtype), mode="drop")
+
+
+def _gather_kv(cache_layer, block_tables):
+    """[P, page, kvh, hd] + [B, max_pages] -> [B, max_pages*page, kvh, hd]."""
+    pages = jnp.take(cache_layer, block_tables, axis=0)
+    B, n_pages, page, kvh, hd = pages.shape
+    return pages.reshape(B, n_pages * page, kvh, hd)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
+                                                             "cache_v"))
+def prefill(params, cache_k, cache_v, tokens, prompt_lens, block_tables,
+            cos, sin, *, cfg: LlamaConfig):
+    """Process full prompts, fill their pages, return last-token logits.
+
+    tokens: [B, S] right-padded; prompt_lens: [B]; block_tables: [B, Pmax].
+    Returns (logits [B, vocab], cache_k, cache_v).
+    """
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    pos_grid = jnp.arange(S)[None, :].repeat(B, 0)
+    write_pos = jnp.where(pos_grid < prompt_lens[:, None], pos_grid, -1)
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+        ck = _write_pages(ck, k, block_tables, write_pos, ck.shape[1])
+        cv = _write_pages(cv, v, block_tables, write_pos, cv.shape[1])
+        # right padding is safe under the causal mask: a real position
+        # only attends to earlier (real) positions
+        o = attention(q, k, v, causal=True)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        g = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"])
+        u = jnp.einsum("bsd,dm->bsm", h, lp["w_up"])
+        x = x + jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
+        return x, (ck, cv)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache_k, cache_v))
+    x_last = jnp.take_along_axis(
+        x, jnp.maximum(prompt_lens - 1, 0)[:, None, None], axis=1)[:, 0]
+    x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x_last.astype(cfg.dtype),
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
+                                                             "cache_v"))
+def decode(params, cache_k, cache_v, tokens, positions, block_tables,
+           active, cos, sin, *, cfg: LlamaConfig):
+    """One decode step for the whole slot batch.
+
+    tokens: [B] last sampled token per slot; positions: [B] the absolute
+    position being written (== context length so far); active: [B] bool.
+    Returns (logits [B, vocab], cache_k, cache_v).
+    """
+    B = tokens.shape[0]
+    Smax = block_tables.shape[1] * cache_k.shape[2]
+    rep = cfg.n_heads // cfg.n_kv_heads
+    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,d]
+    write_pos = jnp.where(active, positions, -1)[:, None]      # [B,1]
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        # positions as [B, 1]: rotary gathers per (batch, seq) position
+        q = apply_rotary(q, cos, sin, positions=positions[:, None])[:, 0]
+        k = apply_rotary(k, cos, sin, positions=positions[:, None])
+        ck = _write_pages(ck, k, block_tables, write_pos, ck.shape[1])
+        cv = _write_pages(cv, v, block_tables, write_pos, cv.shape[1])
+        keys = _gather_kv(ck, block_tables)      # [B, Smax, kvh, hd]
+        vals = _gather_kv(cv, block_tables)
+        qg = q.reshape(B, cfg.n_kv_heads, rep, cfg.head_dim)
+        scores = jnp.einsum("bgrd,bsgd->bgrs", qg.astype(jnp.float32),
+                            keys.astype(jnp.float32))
+        scores = scores * (cfg.head_dim ** -0.5)
+        mask = (jnp.arange(Smax)[None, :] <= positions[:, None])
+        scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bgrs,bsgd->bgrd", probs,
+                       vals.astype(jnp.float32))
+        o = o.reshape(B, 1, cfg.n_heads, cfg.head_dim).astype(x.dtype)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        g = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"])
+        u = jnp.einsum("bsd,dm->bsm", h, lp["w_up"])
+        x = x + jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
+        return x, (ck, cv)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache_k, cache_v))
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x.astype(cfg.dtype),
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, cache_k, cache_v
+
+
+def prefill_bucket(seq_len: int, max_seq: int, floor: int = 16) -> int:
+    """Power-of-2 padding bucket — one compiled prefill per bucket."""
+    b = floor
+    while b < seq_len:
+        b *= 2
+    return min(b, max_seq)
+
+
+# --- fused step functions: model + sampler in ONE dispatch ------------------
+# Over the axon relay (remote TPU) every dispatch pays a network round
+# trip; fusing sampling into the step cuts per-token latency by ~the RTT.
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
+                                                             "cache_v"))
+def prefill_sample(params, cache_k, cache_v, tokens, prompt_lens,
+                   block_tables, cos, sin, seed, temperature, top_k,
+                   top_p, *, cfg: LlamaConfig):
+    from .sampling import sample_from_logits
+
+    logits, cache_k, cache_v = prefill.__wrapped__(
+        params, cache_k, cache_v, tokens, prompt_lens, block_tables,
+        cos, sin, cfg=cfg)
+    toks = sample_from_logits(logits, seed, temperature, top_k, top_p)
+    return toks, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
+                                                             "cache_v"))
+def decode_sample(params, cache_k, cache_v, tokens, positions,
+                  block_tables, active, cos, sin, seed, temperature,
+                  top_k, top_p, *, cfg: LlamaConfig):
+    from .sampling import sample_from_logits
+
+    logits, cache_k, cache_v = decode.__wrapped__(
+        params, cache_k, cache_v, tokens, positions, block_tables,
+        active, cos, sin, cfg=cfg)
+    toks = sample_from_logits(logits, seed, temperature, top_k, top_p)
+    return toks, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps"),
+         donate_argnames=("cache_k", "cache_v"))
+def decode_burst(params, cache_k, cache_v, tokens, positions,
+                 block_tables, active, cos, sin, seed, temperature,
+                 top_k, top_p, *, cfg: LlamaConfig, n_steps: int):
+    """n_steps fused decode+sample steps, sampled tokens fed back
+    ON-DEVICE (multi-step scheduling, vLLM's --num-scheduler-steps
+    analog). One host round trip yields n_steps tokens per slot — the
+    decisive win when the host⇄TPU link has real latency (axon relay),
+    and it also hides per-step dispatch overhead locally.
+
+    HBM discipline: the big cache never rides the step-scan carry (that
+    would copy it every step). The burst's new KV rows accumulate in a
+    [L, B, K] scratch; attention runs over (pages gathered once per
+    burst) + (scratch, causally masked per step); the scratch scatters
+    into the paged cache ONCE at the end. ``block_tables`` may be a
+    narrowed slice of the full table — the engine buckets it to the
+    longest active context, so KV read traffic scales with real context,
+    not max_seq_len.
+
+    Returns (tokens [n_steps, B], cache_k, cache_v). The host must have
+    pre-provisioned pages for positions .. positions+n_steps-1.
+    """
+    from .sampling import sample_from_logits
+
+    B = tokens.shape[0]
+    K = n_steps
+    L = cfg.n_layers
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
+    rep = cfg.n_heads // cfg.n_kv_heads
+    page_size = cache_k.shape[2]
+    Sold = block_tables.shape[1] * page_size
+    # old context gathered ONCE per burst (read-only during the burst)
+    old_k = jnp.take(cache_k, block_tables, axis=1).reshape(
+        L, B, Sold, kvh, hd)
+    old_v = jnp.take(cache_v, block_tables, axis=1).reshape(
+        L, B, Sold, kvh, hd)
+    scratch_k = jnp.zeros((L, B, K, kvh, hd), cache_k.dtype)
+    scratch_v = jnp.zeros((L, B, K, kvh, hd), cache_v.dtype)
+    old_mask = jnp.arange(Sold)[None, :] < positions[:, None]  # [B, Sold]
+
+    def step(carry, i):
+        toks, sk, sv = carry
+        pos_i = positions + i
+        x = jnp.take(params["embed"], toks, axis=0)[:, None, :]
+        new_mask = jnp.arange(K)[None, :] <= i                 # [1, K]
+
+        def layer(x, inputs):
+            lp, ok, ov, nk, nv = inputs
+            h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+            q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+            k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+            v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+            q = apply_rotary(q, cos, sin, positions=pos_i[:, None])[:, 0]
+            k = apply_rotary(k, cos, sin, positions=pos_i[:, None])[:, 0]
+            nk = jax.lax.dynamic_update_index_in_dim(
+                nk, k.astype(nk.dtype), i, 1)
+            nv = jax.lax.dynamic_update_index_in_dim(
+                nv, v[:, 0].astype(nv.dtype), i, 1)
+            qg = q.reshape(B, kvh, rep, hd)
+            # bf16 operands straight onto the MXU, f32 accumulation
+            s_old = jnp.einsum("bgrd,bsgd->bgrs", qg, ok,
+                               preferred_element_type=jnp.float32)
+            s_new = jnp.einsum("bgrd,bkgd->bgrk", qg, nk,
+                               preferred_element_type=jnp.float32)
+            scale = hd ** -0.5
+            s_old = jnp.where(old_mask[:, None, None, :], s_old * scale,
+                              -jnp.inf)
+            s_new = jnp.where(new_mask[None, None, :, :], s_new * scale,
+                              -jnp.inf)
+            s_all = jnp.concatenate([s_old, s_new], axis=-1)
+            p_all = jax.nn.softmax(s_all, axis=-1).astype(ok.dtype)
+            o = (jnp.einsum("bgrs,bsgd->bgrd", p_all[..., :Sold], ov,
+                            preferred_element_type=jnp.float32)
+                 + jnp.einsum("bgrk,bkgd->bgrd", p_all[..., Sold:], nv,
+                              preferred_element_type=jnp.float32))
+            o = o.reshape(B, 1, cfg.n_heads, hd).astype(x.dtype)
+            x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+            h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+            g = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"])
+            u = jnp.einsum("bsd,dm->bsm", h, lp["w_up"])
+            x = x + jnp.einsum("bsm,md->bsd",
+                               jax.nn.silu(g) * u, lp["w_down"])
+            return x, (nk, nv)
+
+        x, (sk, sv) = jax.lax.scan(
+            layer, x, (params["layers"], old_k, old_v, sk, sv))
+        h = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+        logits = jnp.einsum("bd,dv->bv", h.astype(cfg.dtype),
+                            params["lm_head"].astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        newt = sample_from_logits(logits, seed + i, temperature, top_k,
+                                  top_p)
+        newt = jnp.where(active, newt, toks)
+        return (newt, sk, sv), newt
+
+    (_, scratch_k, scratch_v), out = jax.lax.scan(
+        step, (tokens, scratch_k, scratch_v), jnp.arange(K))
+
+    # one scatter of the whole burst into the paged cache (donated ->
+    # in-place); inactive slots land on dump page 0
+    p_grid = positions[:, None] + jnp.arange(K)[None, :]       # [B, K]
+    page_idx = jnp.take_along_axis(block_tables, p_grid // page_size,
+                                   axis=1)
+    valid = active[:, None]
+    page_idx = jnp.where(valid, page_idx, 0)
+    offset = jnp.where(valid, p_grid % page_size, 0)
+    fp, fo = page_idx.reshape(-1), offset.reshape(-1)          # [B*K]
+    cache_k = cache_k.at[:, fp, fo].set(
+        scratch_k.reshape(L, B * K, kvh, hd), mode="drop")
+    cache_v = cache_v.at[:, fp, fo].set(
+        scratch_v.reshape(L, B * K, kvh, hd), mode="drop")
+    return out, cache_k, cache_v

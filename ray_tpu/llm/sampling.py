@@ -1,0 +1,73 @@
+"""Token sampling: greedy / temperature / top-k / top-p, batched per-slot.
+
+Reference analog: vLLM SamplingParams (the surface ray.llm exposes through
+vllm_models.py). One jitted sampler runs for the whole slot batch with
+per-slot parameter arrays — no retrace when requests with different
+settings share a decode step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# static cap on per-request top_k so the lax.top_k width stays compiled-in
+TOP_K_CAP = 128
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 1.0      # 0 => greedy
+    top_k: int = 0                # 0 => disabled (full vocab)
+    top_p: float = 1.0            # nucleus mass; 1.0 => disabled
+    max_tokens: int = 128
+    stop_token_ids: tuple = ()
+    logprobs: bool = False
+
+    def __post_init__(self):
+        if self.top_k > TOP_K_CAP:
+            object.__setattr__(self, "top_k", TOP_K_CAP)
+
+
+def sample_from_logits(logits, seed, temperature, top_k, top_p):
+    """Trace-level sampler: called inside the runner's fused
+    prefill/decode jits (one device dispatch per engine step).
+
+    logits: [B, V] f32; seed: scalar i32 (stepped by the engine each
+    decode); temperature/top_p: [B] f32; top_k: [B] i32 (0 = off).
+    Greedy rows (temperature == 0) ignore the PRNG entirely.
+    """
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # restrict to the TOP_K_CAP best logits once; both top-k and top-p
+    # operate inside this window (exact for top_k <= cap, and nucleus
+    # mass beyond the top-128 tokens is negligible for real models)
+    kcap = min(TOP_K_CAP, V)
+    top_vals, top_idx = jax.lax.top_k(logits, kcap)        # [B, kcap] sorted
+    ranks = jnp.arange(kcap)[None, :]
+    k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, kcap), kcap)
+    masked = jnp.where(ranks < k_eff[:, None], top_vals, -jnp.inf)
+
+    # nucleus: keep the smallest prefix of the sorted probs whose mass
+    # reaches top_p (always at least the first token)
+    probs = jax.nn.softmax(masked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]
+    masked = jnp.where(keep, masked, -jnp.inf)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    # one key per step: categorical draws independent gumbel noise per
+    # row, so slots don't correlate
+    key = jax.random.PRNGKey(seed)
+    sampled_pos = jax.random.categorical(key, masked / temp, axis=-1)
+    sampled = jnp.take_along_axis(top_idx, sampled_pos[:, None],
+                                  axis=1)[:, 0]
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+sample_tokens = jax.jit(sample_from_logits)

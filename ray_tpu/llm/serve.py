@@ -1,0 +1,178 @@
+"""LLM serving deployment: the engine behind an async serve replica.
+
+Reference analog: ray.llm's serve deployments
+(llm/_internal/serve/deployments/llm/llm_server.py wrapping vLLM's async
+engine, + the OpenAI router in _internal/serve/deployments/routers/).
+Here the continuous-batching engine runs on a replica-side thread; each
+request registers an asyncio queue that the engine pump feeds, so many
+HTTP streams multiplex over ONE decode batch — the continuous-batching
+payoff serve exists to deliver.
+
+Usage:
+    app = build_llm_deployment("tiny", init="random")   # or params blob
+    handle = serve.run(app)
+    out = await handle.completions.remote({"prompt_ids": [...]})
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List, Optional
+
+from ..models.llama import LLAMA_CONFIGS, LlamaConfig, init_params
+from .engine import EngineConfig, LLMEngine
+from .sampling import SamplingParams
+
+
+class LLMServer:
+    """Serve deployment class hosting one engine replica."""
+
+    def __init__(self, model: str = "tiny", *, init: str = "random",
+                 params_path: Optional[str] = None,
+                 engine_config: Optional[dict] = None,
+                 tokenizer: Optional[str] = None, seed: int = 0):
+        import jax
+
+        cfg = LLAMA_CONFIGS[model]
+        if params_path:
+            import pickle
+
+            with open(params_path, "rb") as f:
+                params = pickle.load(f)
+            params = jax.device_put(params)
+        elif init == "random":
+            params = init_params(jax.random.PRNGKey(seed), cfg)
+        else:
+            raise ValueError(f"unknown init {init!r}")
+        ecfg = EngineConfig(**(engine_config or {}))
+        self.engine = LLMEngine(params, cfg, ecfg)
+        self.tokenizer = None
+        if tokenizer:
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(tokenizer)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._pump_task: Optional[asyncio.Task] = None
+
+    # --- engine pump: one thread-hop per step, fan-out to request queues ---
+
+    def _ensure_pump(self) -> None:
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.get_event_loop().create_task(
+                self._pump())
+
+    async def _pump(self) -> None:
+        loop = asyncio.get_event_loop()
+        while self.engine.has_unfinished():
+            outs = await loop.run_in_executor(None, self.engine.step)
+            for out in outs:
+                q = self._queues.get(out.request_id)
+                if q is not None:
+                    q.put_nowait(out)
+                if out.finished:
+                    # the reader holds its queue reference; drop ours and
+                    # the engine's state so a long-lived replica doesn't
+                    # accumulate every past request
+                    self._queues.pop(out.request_id, None)
+                    self.engine.requests.pop(out.request_id, None)
+            if not outs:
+                await asyncio.sleep(0.002)
+
+    async def _submit(self, prompt_ids: List[int],
+                      params: SamplingParams) -> asyncio.Queue:
+        rid = self.engine.add_request(prompt_ids, params)
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[rid] = q
+        self._ensure_pump()
+        return q
+
+    def _parse(self, payload: Dict[str, Any]):
+        if "prompt_ids" in payload:
+            prompt_ids = [int(t) for t in payload["prompt_ids"]]
+        elif "prompt" in payload and self.tokenizer is not None:
+            prompt_ids = self.tokenizer.encode(payload["prompt"])
+        else:
+            raise ValueError(
+                "need 'prompt_ids' (or 'prompt' with a tokenizer configured)")
+        params = SamplingParams(
+            temperature=float(payload.get("temperature", 1.0)),
+            top_k=int(payload.get("top_k", 0)),
+            top_p=float(payload.get("top_p", 1.0)),
+            max_tokens=int(payload.get("max_tokens", 64)),
+            stop_token_ids=tuple(payload.get("stop_token_ids", ())))
+        return prompt_ids, params
+
+    def _detok(self, token_ids: List[int]) -> Optional[str]:
+        if self.tokenizer is None:
+            return None
+        return self.tokenizer.decode(token_ids)
+
+    # --- API methods (serve routes by method name; HTTP hits __call__) ---
+
+    async def __call__(self, payload: Dict[str, Any]):
+        """HTTP entry: chat if 'messages' present, else completions."""
+        if isinstance(payload, dict) and "messages" in payload:
+            return await self.chat(payload)
+        return await self.completions(payload or {})
+
+    async def completions(self, payload: Dict[str, Any]):
+        """OpenAI-completions-shaped endpoint (ref: ray.llm's OpenAI
+        router). ``stream=True`` returns an async generator serve turns
+        into chunked HTTP (SSE-style ``data:`` lines)."""
+        prompt_ids, params = self._parse(payload)
+        queue = await self._submit(prompt_ids, params)
+        if payload.get("stream"):
+            return self._stream_from(queue)
+        tokens: List[int] = []
+        finish_reason = None
+        while True:
+            out = await queue.get()
+            tokens.append(out.token)
+            if out.finished:
+                finish_reason = out.finish_reason
+                break
+        body = {"object": "text_completion",
+                "choices": [{"token_ids": tokens,
+                             "finish_reason": finish_reason}]}
+        text = self._detok(tokens)
+        if text is not None:
+            body["choices"][0]["text"] = text
+        return body
+
+    async def _stream_from(self, queue: asyncio.Queue):
+        while True:
+            out = await queue.get()
+            chunk = {"token": out.token, "finished": out.finished}
+            if out.finished:
+                chunk["finish_reason"] = out.finish_reason
+            yield f"data: {json.dumps(chunk)}\n\n"
+            if out.finished:
+                return
+
+    async def chat(self, payload: Dict[str, Any]):
+        """Chat-completions shim: template the messages through the
+        tokenizer (requires one) then run completions."""
+        if self.tokenizer is None:
+            raise ValueError("chat endpoint requires a tokenizer")
+        msgs = payload["messages"]
+        prompt_ids = self.tokenizer.apply_chat_template(
+            msgs, add_generation_prompt=True)
+        body = dict(payload)
+        body.pop("messages")
+        body["prompt_ids"] = prompt_ids
+        return await self.completions(body)
+
+    async def stats(self, _payload=None) -> Dict[str, Any]:
+        return self.engine.stats()
+
+
+def build_llm_deployment(model: str = "tiny", *, num_replicas: int = 1,
+                         name: str = "llm", **server_kwargs):
+    """An Application running LLMServer replicas (ref: ray.llm
+    build_openai_app)."""
+    from .. import serve
+
+    dep = serve.deployment(LLMServer, name=name,
+                           num_replicas=num_replicas)
+    return dep.bind(model, **server_kwargs)

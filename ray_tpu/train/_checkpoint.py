@@ -16,6 +16,31 @@ from contextlib import contextmanager
 from typing import Iterator, Optional
 
 
+def pack_dir(path: str) -> bytes:
+    """Tar a checkpoint directory into a blob for cross-host transfer
+    (the fsspec-upload role of the reference storage context)."""
+    import io
+    import tarfile
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        for name in sorted(os.listdir(path)):
+            tar.add(os.path.join(path, name), arcname=name)
+    return buf.getvalue()
+
+
+def unpack_blob(blob: bytes, target: Optional[str] = None) -> str:
+    """Extract a pack_dir() blob into ``target`` (or a fresh temp dir)."""
+    import io
+    import tarfile
+
+    target = target or tempfile.mkdtemp(prefix="ckpt_")
+    os.makedirs(target, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
+        tar.extractall(target, filter="data")
+    return target
+
+
 class Checkpoint:
     """Handle to a checkpoint directory."""
 

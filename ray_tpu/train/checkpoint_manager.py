@@ -62,11 +62,11 @@ class CheckpointManager:
     def register_bytes(self, blob: bytes, step: int) -> Checkpoint:
         """Persist a checkpoint shipped as a tar blob (cross-node path: the
         worker's filesystem is not ours)."""
+        from ._checkpoint import unpack_blob
+
         staging = os.path.join(self.storage_dir, f"_staging_{step:06d}")
         shutil.rmtree(staging, ignore_errors=True)
-        os.makedirs(staging)
-        with tarfile.open(fileobj=io.BytesIO(blob), mode="r") as tar:
-            tar.extractall(staging, filter="data")
+        unpack_blob(blob, staging)
         target = os.path.join(self.storage_dir, f"checkpoint_{step:06d}")
         if os.path.exists(target):
             shutil.rmtree(target)

@@ -64,14 +64,9 @@ class TrainWorker:
         if restore_blob is not None:
             # the blob is ground truth from the controller — a same-named
             # local directory could be stale state from a previous run
-            import io
-            import tarfile
-            import tempfile
+            from ._checkpoint import unpack_blob
 
-            local = tempfile.mkdtemp(prefix="restore_ckpt_")
-            with tarfile.open(fileobj=io.BytesIO(restore_blob)) as tar:
-                tar.extractall(local, filter="data")
-            restored = Checkpoint(local)
+            restored = Checkpoint(unpack_blob(restore_blob))
         elif restore_path and os.path.isdir(restore_path):
             restored = Checkpoint(restore_path)
         context = TrainContext(
@@ -174,16 +169,10 @@ class TrainWorker:
 
     def pack_checkpoint(self, path: str) -> bytes:
         """Tar a reported checkpoint directory for a controller on another
-        filesystem (the fsspec-upload role of the reference storage
-        context)."""
-        import io
-        import tarfile
+        filesystem."""
+        from ._checkpoint import pack_dir
 
-        buf = io.BytesIO()
-        with tarfile.open(fileobj=buf, mode="w") as tar:
-            for name in sorted(os.listdir(path)):
-                tar.add(os.path.join(path, name), arcname=name)
-        return buf.getvalue()
+        return pack_dir(path)
 
     def shutdown(self) -> bool:
         _shutdown_session()
@@ -246,14 +235,9 @@ class WorkerGroup:
         remote_ranks = {i for i, inf in enumerate(infos)
                         if inf["node_id"] != local_node}
         if restore_path and os.path.isdir(restore_path) and remote_ranks:
-            import io
-            import tarfile
+            from ._checkpoint import pack_dir
 
-            buf = io.BytesIO()
-            with tarfile.open(fileobj=buf, mode="w") as tar:
-                for name in sorted(os.listdir(restore_path)):
-                    tar.add(os.path.join(restore_path, name), arcname=name)
-            restore_blob = buf.getvalue()
+            restore_blob = pack_dir(restore_path)
         blob = cloudpickle.dumps(train_fn)
         get([
             w.start.remote(blob, train_config, self.scaling.num_workers,

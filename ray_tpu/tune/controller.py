@@ -10,17 +10,16 @@ actor load.
 
 from __future__ import annotations
 
-import io
 import logging
 import os
 import random
 import shutil
-import tarfile
 import time
 from typing import Any, Dict, List, Optional
 
 import cloudpickle
 
+from ..train._checkpoint import pack_dir, unpack_blob
 from ..train.config import RunConfig
 from .schedulers import FIFOScheduler, TrialScheduler
 from .search import BasicVariantGenerator
@@ -124,9 +123,7 @@ class TuneController:
             return
         target = os.path.join(trial.local_dir,
                               f"checkpoint_{trial.iteration:06d}")
-        os.makedirs(target, exist_ok=True)
-        with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
-            tar.extractall(target, filter="data")
+        unpack_blob(blob, target)
         prev = trial.checkpoint_path
         trial.checkpoint_path = target
         if prev and prev != target and os.path.isdir(prev):
@@ -135,12 +132,7 @@ class TuneController:
     def _checkpoint_blob(self, trial: Trial) -> Optional[bytes]:
         if not trial.checkpoint_path or not os.path.isdir(trial.checkpoint_path):
             return None
-        buf = io.BytesIO()
-        with tarfile.open(fileobj=buf, mode="w") as tar:
-            for name in sorted(os.listdir(trial.checkpoint_path)):
-                tar.add(os.path.join(trial.checkpoint_path, name),
-                        arcname=name)
-        return buf.getvalue()
+        return pack_dir(trial.checkpoint_path)
 
     # --- stop criteria (ref: air RunConfig(stop={...})) ---
 
@@ -238,6 +230,18 @@ class TuneController:
             self._retries[trial.trial_id] = retries + 1
             logger.warning("trial %s errored, retrying (%d): %s",
                            trial.trial_id, retries + 1, error.strip()[-200:])
+            # roll counters/results back to what the retry actually
+            # resumes from (the checkpoint's iteration, embedded in its
+            # dir name; zero without one) so the failed attempt's extra
+            # reports don't skew stop criteria, ASHA rungs, or the grid
+            resume_at = 0
+            if trial.checkpoint_path:
+                tail = os.path.basename(trial.checkpoint_path)
+                resume_at = int(tail.rsplit("_", 1)[-1])
+            trial.iteration = resume_at
+            trial.results = trial.results[:resume_at]
+            trial.last_result = (dict(trial.results[-1])
+                                 if trial.results else {})
             trial.status = TrialStatus.PENDING
         else:
             trial.status = TrialStatus.ERROR
@@ -259,5 +263,8 @@ class TuneController:
         trial.perturbations += 1
         logger.info("PBT exploit: trial %s <- donor %s (perturbation %d)",
                     trial.trial_id, donor.trial_id, trial.perturbations)
-        self._launch(trial, restore_blob=blob)
+        try:
+            self._launch(trial, restore_blob=blob)
+        except Exception as e:  # same per-trial policy as _launch_pending
+            self._on_trial_error(trial, f"exploit relaunch failed: {e}")
         return True

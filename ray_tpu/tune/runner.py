@@ -36,14 +36,9 @@ class TrialRunner:
               restore_blob: Optional[bytes] = None) -> bool:
         restored = None
         if restore_blob is not None:
-            import io
-            import tarfile
-            import tempfile
+            from ..train._checkpoint import unpack_blob
 
-            local = tempfile.mkdtemp(prefix=f"trial_{self.trial_id}_ckpt_")
-            with tarfile.open(fileobj=io.BytesIO(restore_blob)) as tar:
-                tar.extractall(local, filter="data")
-            restored = Checkpoint(local)
+            restored = Checkpoint(unpack_blob(restore_blob))
         context = TuneContext(trial_id=self.trial_id,
                               trial_dir=self.trial_dir,
                               restored_checkpoint=restored)
@@ -104,16 +99,11 @@ class TrialRunner:
     def pack_checkpoint(self, path: str) -> Optional[bytes]:
         """Tar a reported checkpoint dir so the controller can persist it
         into trial storage regardless of which host the trial ran on."""
-        import io
-        import tarfile
+        from ..train._checkpoint import pack_dir
 
         if not os.path.isdir(path):
             return None
-        buf = io.BytesIO()
-        with tarfile.open(fileobj=buf, mode="w") as tar:
-            for name in sorted(os.listdir(path)):
-                tar.add(os.path.join(path, name), arcname=name)
-        return buf.getvalue()
+        return pack_dir(path)
 
     def shutdown(self) -> bool:
         _shutdown_session()
