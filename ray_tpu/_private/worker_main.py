@@ -38,6 +38,21 @@ from .task_spec import ArgKind, TaskSpec
 from .. import exceptions as exc
 
 
+def _resolve_actor_method(instance, name: str):
+    """Bound method lookup with a fallback for the injected dynamic-call
+    entry point: classes pickled BY REFERENCE re-import without the
+    driver-side ActorClass injection, so the compiled-DAG loop method
+    must resolve from ray_tpu.actor here."""
+    try:
+        return getattr(instance, name)
+    except AttributeError:
+        if name == "_rtpu_dyn_call":
+            from ..actor import _rtpu_dyn_call
+
+            return lambda *a, **k: _rtpu_dyn_call(instance, *a, **k)
+        raise
+
+
 class _GenBudget:
     """Producer-side backpressure (ref: generator_waiter.h): the generator
     thread blocks while produced - consumed >= threshold."""
@@ -280,7 +295,8 @@ class TaskExecutor:
                 # run_coroutine_threadsafe gave this task its own Context,
                 # so the binding is visible to this coroutine only
                 self.core.set_async_task_context(spec.task_id)
-                method = getattr(self.actor_instance, spec.function.method_name)
+                method = _resolve_actor_method(
+                    self.actor_instance, spec.function.method_name)
                 args, kwargs = await loop.run_in_executor(
                     self.pool, self._resolve_args, spec)
                 values = method(*args, **kwargs)
@@ -306,7 +322,8 @@ class TaskExecutor:
 
     def _execute_actor_task(self, spec: TaskSpec) -> dict:
         try:
-            method = getattr(self.actor_instance, spec.function.method_name)
+            method = _resolve_actor_method(
+                self.actor_instance, spec.function.method_name)
             args, kwargs = self._resolve_args(spec)
             self.core.set_task_context(spec.task_id)
             try:

@@ -55,11 +55,26 @@ class ActorHandle:
         return self._actor_id
 
 
+def _rtpu_dyn_call(self, fn_blob: bytes, *args, **kwargs):
+    """Injected universal method: run a pickled function against the
+    actor instance (the compiled-DAG exec-loop entry point; ref:
+    actor.py __ray_call__ injection in the reference)."""
+    import cloudpickle
+
+    fn = cloudpickle.loads(fn_blob)
+    return fn(self, *args, **kwargs)
+
+
 class ActorClass:
     def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
         self._cls = cls
         self._options = dict(options or {})
         self.__name__ = getattr(cls, "__name__", "ActorClass")
+        if not hasattr(cls, "_rtpu_dyn_call"):
+            try:
+                cls._rtpu_dyn_call = _rtpu_dyn_call
+            except (AttributeError, TypeError):
+                pass  # frozen/extension classes: compiled DAGs unsupported
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

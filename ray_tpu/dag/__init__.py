@@ -1,0 +1,25 @@
+"""ray_tpu.dag: DAG IR + compiled graphs (aDAG) — ref: python/ray/dag/.
+
+Build with ``actor.method.bind(...)`` under an ``InputNode`` context;
+``.execute()`` runs interpreted (normal actor tasks);
+``.experimental_compile()`` returns a CompiledDAG whose actors run
+standing channel-fed loops (SURVEY §2.4 Compiled Graphs)."""
+
+from .compiled import CompiledDAG, CompiledDAGRef
+from .nodes import (
+    AttributeNode,
+    ClassMethodNode,
+    ClassNode,
+    CollectiveNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+    collective,
+)
+
+__all__ = [
+    "DAGNode", "InputNode", "InputAttributeNode", "AttributeNode",
+    "ClassMethodNode", "ClassNode", "MultiOutputNode", "CollectiveNode",
+    "collective", "CompiledDAG", "CompiledDAGRef",
+]

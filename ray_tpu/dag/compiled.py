@@ -1,0 +1,492 @@
+"""Compiled DAG execution (ref: python/ray/dag/compiled_dag_node.py:806
+CompiledDAG — allocate typed channels, start per-actor exec loops,
+execute:2552).
+
+Compilation turns submission-per-task into a standing dataflow machine:
+every actor that owns DAG nodes runs ONE long-lived loop that reads its
+input channels, runs its methods back-to-back, and writes its output
+channels — zero scheduler involvement per execution. Channels are the
+mutable shm buffers of ray_tpu.experimental.channel (the reference's
+mutable plasma objects / N13).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ..experimental.channel import Channel, ChannelClosed, ChannelTimeout
+from .nodes import (
+    AttributeNode,
+    ClassMethodNode,
+    CollectiveNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+
+def _dag_exec_loop(actor_self, spec_blob: bytes):
+    """Runs ON the actor (injected via the dynamic-call method): the
+    standing execution loop of this actor's DAG partition
+    (ref: compiled_dag_node.py _execute_until / do_exec_tasks)."""
+    spec = cloudpickle.loads(spec_blob)
+    readers: Dict[str, Channel] = {}
+    writers: Dict[str, Channel] = {}
+    for path in spec["read_paths"]:
+        readers[path] = Channel(path)
+    for path in spec["write_paths"]:
+        writers[path] = Channel(path)
+
+    def shutdown():
+        for ch in writers.values():
+            try:
+                ch.close_write()
+            except Exception:
+                pass
+
+    while True:
+        results: Dict[int, Any] = {}
+        chan_cache: Dict[str, Any] = {}
+
+        def fetch(path: str, slot: int):
+            if path not in chan_cache:
+                chan_cache[path] = readers[path].read(slot)
+            return chan_cache[path]
+
+        def resolve(argspec):
+            kind = argspec[0]
+            if kind == "const":
+                return argspec[1]
+            if kind == "local":
+                return results[argspec[1]]
+            if kind == "local_attr":
+                return _apply_key(results[argspec[1]], argspec[2])
+            if kind == "chan":
+                _, path, slot, key = argspec
+                value = fetch(path, slot)
+                return value if key is None else _apply_key(value, key)
+            raise ValueError(argspec)
+
+        try:
+            for step in spec["steps"]:
+                if step["kind"] == "call":
+                    args = [resolve(a) for a in step["args"]]
+                    kwargs = {k: resolve(v)
+                              for k, v in step["kwargs"].items()}
+                    value = getattr(actor_self, step["method"])(
+                        *args, **kwargs)
+                elif step["kind"] == "collective_root":
+                    value = results[step["src"]]
+                    for path in step["contrib_paths"]:
+                        value = value + fetch(path, 0)
+                    if step["bcast_path"]:
+                        writers[step["bcast_path"]].write(value)
+                elif step["kind"] == "collective_leaf":
+                    writers[step["contrib_path"]].write(
+                        results[step["src"]])
+                    value = fetch(step["bcast_path"], step["bcast_slot"])
+                else:
+                    raise ValueError(step["kind"])
+                results[step["node_id"]] = value
+                if step.get("out_path"):
+                    writers[step["out_path"]].write(value)
+        except ChannelClosed:
+            shutdown()
+            return True
+        except BaseException as e:  # surface through result channels
+            err = _WrappedError(repr(e))
+            for path in spec["result_paths"]:
+                try:
+                    writers[path].write(err)
+                except Exception:
+                    pass
+            shutdown()
+            raise
+
+
+def _apply_key(value, key):
+    """Index into a node result / DAG input. A mixed positional+keyword
+    input rides the channel as {"*args": args, **kwargs} (mirroring
+    interpreted execution), so integer keys index the tuple inside."""
+    if (isinstance(key, int) and isinstance(value, dict)
+            and "*args" in value):
+        return value["*args"][key]
+    return value[key]
+
+
+class _WrappedError:
+    def __init__(self, msg: str):
+        self.msg = msg
+
+
+class CompiledDAGRef:
+    """Result handle of one compiled execution
+    (ref: compiled_dag_ref.py). ``get`` reads the DAG's output
+    channel(s); results arrive in execution order."""
+
+    def __init__(self, dag: "CompiledDAG", index: int):
+        self._dag = dag
+        self._index = index
+        self._value = None
+        self._fetched = False
+
+    def get(self, timeout: Optional[float] = 60.0):
+        if not self._fetched:
+            self._dag._fetch_until(self._index, timeout)
+        return self._value
+
+    def __repr__(self):
+        return f"CompiledDAGRef(exec={self._index})"
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, *, buffer_size_bytes: int = 1 << 20,
+                 max_inflight: int = 2):
+        self.buffer_size = buffer_size_bytes
+        self.max_inflight = max_inflight
+        self._lock = threading.Lock()
+        self._exec_count = 0
+        self._next_fetch = 0
+        self._row_vals: List[Any] = []
+        self._pending: Dict[int, CompiledDAGRef] = {}
+        self._torn_down = False
+        self._build(root)
+
+    # --- compilation ---
+
+    def _build(self, root: DAGNode) -> None:
+        outputs = (root.outputs if isinstance(root, MultiOutputNode)
+                   else [root])
+        self._multi = isinstance(root, MultiOutputNode)
+
+        # topological node list (post-order DFS)
+        order: List[DAGNode] = []
+        seen: Dict[int, int] = {}
+
+        def visit(node: DAGNode):
+            if id(node) in seen:
+                return
+            seen[id(node)] = 1
+            for dep in _deps(node):
+                visit(dep)
+            order.append(node)
+
+        for out in outputs:
+            visit(out)
+
+        self._input_node = next(
+            (n for n in order if isinstance(n, InputNode)), None)
+        node_ids = {id(n): i for i, n in enumerate(order)}
+        actor_of: Dict[int, Any] = {}
+        for n in order:
+            if isinstance(n, ClassMethodNode):
+                actor_of[node_ids[id(n)]] = n.handle
+            elif isinstance(n, CollectiveNode):
+                actor_of[node_ids[id(n)]] = n.group.inputs[n.index].handle
+
+        def actor_key(handle):
+            return handle.actor_id.hex()
+
+        # consumers per producer node: which OTHER actors read it + driver
+        remote_consumers: Dict[int, List[str]] = {}
+        driver_reads: Dict[int, bool] = {}
+
+        def note_consumer(producer: DAGNode, consumer_actor: Optional[str]):
+            if isinstance(producer, (InputAttributeNode,)):
+                producer = producer.input_node
+            if isinstance(producer, AttributeNode):
+                producer = producer.upstream
+            pid = node_ids[id(producer)]
+            p_actor = (None if isinstance(producer, InputNode)
+                       else actor_key(actor_of[pid]))
+            if consumer_actor is not None and consumer_actor == p_actor:
+                return  # same actor: local variable, no channel
+            if consumer_actor is None:
+                driver_reads[pid] = True
+            else:
+                remote_consumers.setdefault(pid, [])
+                if consumer_actor not in remote_consumers[pid]:
+                    remote_consumers[pid].append(consumer_actor)
+
+        for n in order:
+            if isinstance(n, ClassMethodNode):
+                me = actor_key(n.handle)
+                for a in list(n.args) + list(n.kwargs.values()):
+                    if isinstance(a, DAGNode):
+                        note_consumer(a, me)
+        for out in outputs:
+            note_consumer(out, None)
+
+        # channels: one per produced value that crosses a process boundary
+        self._channels: List[Channel] = []
+        chan_of: Dict[int, Channel] = {}
+        slot_of: Dict[Tuple[int, str], int] = {}
+        for n in order:
+            pid = node_ids[id(n)]
+            consumers = remote_consumers.get(pid, [])
+            n_readers = len(consumers) + (1 if driver_reads.get(pid) else 0)
+            if n_readers == 0:
+                continue
+            if not isinstance(n, (InputNode, ClassMethodNode,
+                                  CollectiveNode)):
+                continue
+            ch = Channel(num_readers=n_readers, capacity=self.buffer_size)
+            self._channels.append(ch)
+            chan_of[pid] = ch
+            for slot, actor in enumerate(consumers):
+                slot_of[(pid, actor)] = slot
+            if driver_reads.get(pid):
+                slot_of[(pid, "__driver__")] = len(consumers)
+
+        # collective plumbing
+        coll_channels: Dict[int, Dict[str, Any]] = {}
+        groups = {}
+        for n in order:
+            if isinstance(n, CollectiveNode) and id(n.group) not in groups:
+                groups[id(n.group)] = n.group
+        for group in groups.values():
+            handles = [inp.handle for inp in group.inputs]
+            contribs = [Channel(num_readers=1, capacity=self.buffer_size)
+                        for _ in handles[1:]]
+            # single-participant allreduce is the identity: no broadcast
+            # channel (a reader-less channel would block on execution 2)
+            bcast = (Channel(num_readers=len(handles) - 1,
+                             capacity=self.buffer_size)
+                     if len(handles) > 1 else None)
+            self._channels.extend(contribs + ([bcast] if bcast else []))
+            coll_channels[id(group)] = {
+                "contribs": contribs, "bcast": bcast}
+
+        # per-actor step specs
+        specs: Dict[str, Dict[str, Any]] = {}
+
+        def spec_for(handle) -> Dict[str, Any]:
+            key = actor_key(handle)
+            if key not in specs:
+                specs[key] = {"handle": handle, "steps": [],
+                              "read_paths": set(), "write_paths": set(),
+                              "result_paths": set()}
+            return specs[key]
+
+        def argspec(a, me: str):
+            if not isinstance(a, DAGNode):
+                return ("const", a)
+            key = None
+            producer = a
+            if isinstance(a, InputAttributeNode):
+                producer, key = a.input_node, a.key
+            elif isinstance(a, AttributeNode):
+                producer, key = a.upstream, a.key
+            pid = node_ids[id(producer)]
+            p_actor = (None if isinstance(producer, InputNode)
+                       else actor_key(actor_of[pid]))
+            if p_actor == me:
+                if key is None:
+                    return ("local", pid)
+                # local + attribute: wrap as local then index — encode as
+                # chan-style with no channel via small shim
+                return ("local_attr", pid, key)
+            ch = chan_of[pid]
+            slot = slot_of[(pid, me)]
+            return ("chan", ch.path, slot, key)
+
+        for n in order:
+            pid = node_ids[id(n)]
+            if isinstance(n, ClassMethodNode):
+                me = actor_key(n.handle)
+                spec = spec_for(n.handle)
+                out_ch = chan_of.get(pid)
+                step = {
+                    "kind": "call", "node_id": pid,
+                    "method": n.method_name,
+                    "args": [argspec(a, me) for a in n.args],
+                    "kwargs": {k: argspec(v, me)
+                               for k, v in n.kwargs.items()},
+                    "out_path": out_ch.path if out_ch else None,
+                }
+                spec["steps"].append(step)
+            elif isinstance(n, CollectiveNode):
+                group = n.group
+                plumb = coll_channels[id(group)]
+                handle = group.inputs[n.index].handle
+                me = actor_key(handle)
+                spec = spec_for(handle)
+                src = node_ids[id(group.inputs[n.index])]
+                out_ch = chan_of.get(pid)
+                if n.index == 0:
+                    step = {
+                        "kind": "collective_root", "node_id": pid,
+                        "src": src,
+                        "contrib_paths": [c.path
+                                          for c in plumb["contribs"]],
+                        "bcast_path": (plumb["bcast"].path
+                                       if plumb["bcast"] else None),
+                        "out_path": out_ch.path if out_ch else None,
+                    }
+                else:
+                    step = {
+                        "kind": "collective_leaf", "node_id": pid,
+                        "src": src,
+                        "contrib_path": plumb["contribs"][n.index - 1].path,
+                        "bcast_path": plumb["bcast"].path,
+                        "bcast_slot": n.index - 1,
+                        "out_path": out_ch.path if out_ch else None,
+                    }
+                spec["steps"].append(step)
+
+        # read/write path sets per spec
+        for spec in specs.values():
+            for step in spec["steps"]:
+                if step.get("out_path"):
+                    spec["write_paths"].add(step["out_path"])
+                if step["kind"] == "call":
+                    for a in (list(step["args"])
+                              + list(step["kwargs"].values())):
+                        if a[0] == "chan":
+                            spec["read_paths"].add(a[1])
+                elif step["kind"] == "collective_root":
+                    spec["read_paths"].update(step["contrib_paths"])
+                    if step["bcast_path"]:
+                        spec["write_paths"].add(step["bcast_path"])
+                elif step["kind"] == "collective_leaf":
+                    spec["write_paths"].add(step["contrib_path"])
+                    spec["read_paths"].add(step["bcast_path"])
+
+        # driver-side output bindings
+        self._outputs: List[Tuple[Channel, int, Any]] = []
+        for out in outputs:
+            key = None
+            producer = out
+            if isinstance(out, AttributeNode):
+                producer, key = out.upstream, out.key
+            elif isinstance(out, InputAttributeNode):
+                producer, key = out.input_node, out.key
+            pid = node_ids[id(producer)]
+            ch = chan_of[pid]
+            self._outputs.append((ch, slot_of[(pid, "__driver__")], key))
+        for spec in specs.values():
+            spec["result_paths"] = {ch.path for ch, _, _ in self._outputs
+                                    if ch.path in spec["write_paths"]}
+
+        # driver-side input binding
+        self._input_channel = None
+        if self._input_node is not None:
+            ipid = node_ids[id(self._input_node)]
+            self._input_channel = chan_of.get(ipid)
+            for spec in specs.values():
+                for step in spec["steps"]:
+                    if step["kind"] != "call":
+                        continue
+                    for a in (list(step["args"])
+                              + list(step["kwargs"].values())):
+                        if (a[0] == "chan" and self._input_channel
+                                and a[1] == self._input_channel.path):
+                            spec["read_paths"].add(a[1])
+
+        # launch the loops (fire-and-forget)
+        from ..actor import ActorMethod
+
+        self._loop_refs = []
+        loop_blob = cloudpickle.dumps(_dag_exec_loop)
+        for spec in specs.values():
+            handle = spec.pop("handle")
+            payload = dict(spec)
+            payload["read_paths"] = sorted(payload["read_paths"])
+            payload["write_paths"] = sorted(payload["write_paths"])
+            payload["result_paths"] = sorted(payload["result_paths"])
+            method = ActorMethod(handle, "_rtpu_dyn_call")
+            self._loop_refs.append(
+                method.remote(loop_blob, cloudpickle.dumps(payload)))
+
+    # --- execution ---
+
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        with self._lock:
+            if self._torn_down:
+                raise RuntimeError("CompiledDAG was torn down")
+            if (self._exec_count - self._next_fetch) >= self.max_inflight:
+                raise RuntimeError(
+                    f"too many in-flight executions "
+                    f"(max_inflight={self.max_inflight}); get() pending "
+                    f"results first")
+            if self._input_channel is not None:
+                if kwargs or len(args) != 1:
+                    value = ({"*args": args, **kwargs} if kwargs
+                             else args)
+                else:
+                    value = args[0]
+                self._input_channel.write(value, timeout=60.0)
+            ref = CompiledDAGRef(self, self._exec_count)
+            self._pending[self._exec_count] = ref
+            self._exec_count += 1
+            return ref
+
+    def _fetch_until(self, index: int, timeout: Optional[float]) -> None:
+        with self._lock:
+            while self._next_fetch <= index:
+                # resume a partially-read output row (a ChannelTimeout
+                # mid-row must not desync channels whose cursor already
+                # advanced), hence the persistent _row_vals cursor
+                while len(self._row_vals) < len(self._outputs):
+                    ch, slot, key = self._outputs[len(self._row_vals)]
+                    v = ch.read(slot, timeout=timeout)
+                    if isinstance(v, _WrappedError):
+                        self.teardown()
+                        raise RuntimeError(
+                            f"compiled DAG task failed: {v.msg}")
+                    self._row_vals.append(
+                        v if key is None else _apply_key(v, key))
+                vals, self._row_vals = self._row_vals, []
+                ref = self._pending.pop(self._next_fetch)
+                ref._value = vals if self._multi else vals[0]
+                ref._fetched = True
+                self._next_fetch += 1
+
+    # --- lifecycle ---
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        if self._input_channel is not None:
+            try:
+                self._input_channel.close_write()
+            except Exception:
+                pass
+        # drain leftover outputs so mid-pipeline writers unblock
+        for ch, slot, _ in self._outputs:
+            for _ in range(self.max_inflight + 1):
+                try:
+                    ch.read(slot, timeout=0.2)
+                except (ChannelClosed, ChannelTimeout):
+                    break
+                except Exception:
+                    break
+        for ch in self._channels:
+            ch.close()
+            ch.unlink()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+def _deps(node: DAGNode) -> List[DAGNode]:
+    if isinstance(node, ClassMethodNode):
+        return [a for a in list(node.args) + list(node.kwargs.values())
+                if isinstance(a, DAGNode)]
+    if isinstance(node, (InputAttributeNode,)):
+        return [node.input_node]
+    if isinstance(node, AttributeNode):
+        return [node.upstream]
+    if isinstance(node, CollectiveNode):
+        return list(node.group.inputs)
+    if isinstance(node, MultiOutputNode):
+        return list(node.outputs)
+    return []

@@ -1,0 +1,208 @@
+"""DAG IR (ref: python/ray/dag/ — dag_node.py, input_node.py,
+class_node.py, output_node.py). Nodes are built with ``.bind`` on actor
+methods, executed either interpreted (normal actor tasks, dependencies as
+ObjectRefs) or compiled (ray_tpu/dag/compiled.py — channel loops)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    """Base: something that produces a value per DAG execution."""
+
+    def experimental_compile(self, *, buffer_size_bytes: int = 1 << 20,
+                             max_inflight: int = 2):
+        from .compiled import CompiledDAG
+
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes,
+                           max_inflight=max_inflight)
+
+    def execute(self, *args, **kwargs):
+        """Interpreted execution: one actor task per node, dependencies
+        passed as ObjectRefs (ref: dag_node.py execute)."""
+        cache: Dict[int, Any] = {}
+        return _exec_interpreted(self, args, kwargs, cache)
+
+    # composition sugar
+    def __getitem__(self, key):
+        return AttributeNode(self, key)
+
+
+class InputNode(DAGNode):
+    """The DAG's per-execution input (ref: input_node.py). Use as a
+    context manager:  with InputNode() as inp: dag = a.f.bind(inp)"""
+
+    _local = threading.local()
+
+    def __enter__(self):
+        stack = getattr(InputNode._local, "stack", None)
+        if stack is None:
+            stack = InputNode._local.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        InputNode._local.stack.pop()
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key)
+
+
+class InputAttributeNode(DAGNode):
+    """inp[0] / inp["key"]: positional or keyword piece of the input."""
+
+    def __init__(self, input_node: InputNode, key):
+        self.input_node = input_node
+        self.key = key
+
+
+class AttributeNode(DAGNode):
+    """node[key]: index into an upstream node's result."""
+
+    def __init__(self, upstream: DAGNode, key):
+        self.upstream = upstream
+        self.key = key
+
+
+class ClassMethodNode(DAGNode):
+    """actor.method.bind(*args) (ref: class_node.py ClassMethodNode)."""
+
+    def __init__(self, handle, method_name: str, args: tuple,
+                 kwargs: dict, options: dict):
+        self.handle = handle
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+        self.options = options
+
+
+class ClassNode(DAGNode):
+    """ActorClass.bind(...): lazily-created actor in a DAG
+    (ref: class_node.py ClassNode). Interpreted-only convenience: the
+    actor is created on first execute."""
+
+    def __init__(self, actor_cls, args: tuple, kwargs: dict, options: dict):
+        self.actor_cls = actor_cls
+        self.args = args
+        self.kwargs = kwargs
+        self._handle = None
+
+    def _resolve(self):
+        if self._handle is None:
+            self._handle = self.actor_cls.remote(*self.args, **self.kwargs)
+        return self._handle
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        node = self
+
+        class _BoundMethod:
+            def bind(self, *args, **kwargs):
+                handle = node._resolve()
+                return ClassMethodNode(handle, name, args, kwargs, {})
+
+        return _BoundMethod()
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several leaves into one DAG output (ref: output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        self.outputs = list(outputs)
+
+
+class CollectiveNode(DAGNode):
+    """One participant's output of a cross-actor collective
+    (ref: dag/collective_node.py). Built via dag.collective.allreduce."""
+
+    def __init__(self, group: "_CollectiveGroup", index: int):
+        self.group = group
+        self.index = index
+
+
+class _CollectiveGroup:
+    def __init__(self, inputs: List[DAGNode], op: str):
+        for n in inputs:
+            if not isinstance(n, ClassMethodNode):
+                raise TypeError(
+                    "collective inputs must be actor-method nodes")
+        self.inputs = inputs
+        self.op = op
+        self.nodes = [CollectiveNode(self, i) for i in range(len(inputs))]
+
+
+class _AllReduce:
+    def bind(self, inputs: List[DAGNode], op: str = "sum"):
+        """allreduce.bind([n1, n2, ...]) -> [r1, r2, ...] where every ri
+        is the elementwise reduction of all inputs, living on ni's actor
+        (ref: experimental/collective/allreduce.py:56)."""
+        return _CollectiveGroup(inputs, op).nodes
+
+
+class _Collective:
+    allreduce = _AllReduce()
+
+
+collective = _Collective()
+
+
+# --- interpreted execution ------------------------------------------------
+
+
+def _exec_interpreted(node: DAGNode, args: tuple, kwargs: dict,
+                      cache: Dict[int, Any]):
+    key = id(node)
+    if key in cache:
+        return cache[key]
+    if isinstance(node, InputNode):
+        if kwargs or len(args) != 1:
+            result = {"*args": args, **kwargs} if kwargs else args
+        else:
+            result = args[0]
+    elif isinstance(node, InputAttributeNode):
+        base = _exec_interpreted(node.input_node, args, kwargs, cache)
+        if isinstance(node.key, str) and isinstance(base, dict):
+            result = base[node.key]
+        elif isinstance(base, dict) and "*args" in base:
+            result = base["*args"][node.key]
+        else:
+            result = base[node.key]
+    elif isinstance(node, AttributeNode):
+        from .. import get
+
+        base = _exec_interpreted(node.upstream, args, kwargs, cache)
+        from .._private.object_ref import ObjectRef
+
+        if isinstance(base, ObjectRef):
+            base = get(base)
+        result = base[node.key]
+    elif isinstance(node, ClassMethodNode):
+        from ..actor import ActorMethod
+
+        call_args = [_exec_interpreted(a, args, kwargs, cache)
+                     if isinstance(a, DAGNode) else a for a in node.args]
+        call_kwargs = {k: _exec_interpreted(v, args, kwargs, cache)
+                       if isinstance(v, DAGNode) else v
+                       for k, v in node.kwargs.items()}
+        method = ActorMethod(node.handle, node.method_name, node.options)
+        result = method.remote(*call_args, **call_kwargs)
+    elif isinstance(node, CollectiveNode):
+        from .. import get, put
+
+        vals = [_exec_interpreted(n, args, kwargs, cache)
+                for n in node.group.inputs]
+        resolved = get(list(vals))
+        total = resolved[0]
+        for v in resolved[1:]:
+            total = total + v
+        result = put(total)
+    elif isinstance(node, MultiOutputNode):
+        result = [_exec_interpreted(n, args, kwargs, cache)
+                  for n in node.outputs]
+    else:
+        raise TypeError(f"cannot execute {type(node).__name__}")
+    cache[key] = result
+    return result

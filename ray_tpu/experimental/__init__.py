@@ -1,0 +1,6 @@
+"""ray_tpu.experimental: mutable-object channels (the aDAG data plane;
+ref: python/ray/experimental/channel/)."""
+
+from .channel import Channel, ChannelClosed, ChannelTimeout
+
+__all__ = ["Channel", "ChannelClosed", "ChannelTimeout"]

@@ -1,0 +1,163 @@
+"""Mutable shared-memory channels: the aDAG data plane.
+
+Reference analog: src/ray/core_worker/experimental_mutable_object_manager.h
+(MutableObjectBuffer acquire/release) + python/ray/experimental/channel/
+shared_memory_channel.py. A channel is a fixed-capacity mmap ring slot
+with single-writer / N-reader semantics: the writer blocks until every
+registered reader consumed the previous value, readers block until the
+next value arrives. No locks — cross-process coordination rides on
+monotonic u64 sequence counters in the mapped header (a store-release /
+load-acquire pattern; CPython's mmap writes are atomic enough for u64
+on x86/ARM given the GIL releases around syscalls, and the counters only
+ever move forward).
+
+Layout:  [magic u32][num_readers u32][write_seq u64]
+         [read_seq u64 x num_readers][payload_len u64][payload ...]
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import time
+import uuid
+from typing import Any, List, Optional
+
+_MAGIC = 0x52435400  # "RCT\0"
+_HDR = struct.Struct("<II")          # magic, num_readers
+_U64 = struct.Struct("<Q")
+_STOP_LEN = (1 << 64) - 1            # payload_len sentinel: channel closed
+
+DEFAULT_CAPACITY = 1 << 20
+
+
+class ChannelClosed(Exception):
+    """The writer closed the channel (DAG teardown)."""
+
+
+class ChannelTimeout(Exception):
+    pass
+
+
+def _default_dir() -> str:
+    for d in ("/dev/shm", "/tmp"):
+        if os.path.isdir(d):
+            return d
+    return "/tmp"
+
+
+class Channel:
+    """One writer, ``num_readers`` readers, capacity-bounded payloads."""
+
+    def __init__(self, path: Optional[str] = None, *, num_readers: int = 1,
+                 capacity: int = DEFAULT_CAPACITY, create: bool = False):
+        if path is None:
+            create = True
+            path = os.path.join(_default_dir(),
+                                f"rtpu_chan_{uuid.uuid4().hex[:12]}")
+        self.path = path
+        self.capacity = capacity
+        self.num_readers = num_readers
+        if create:
+            size = _HDR.size + 8 + 8 * num_readers + 8 + capacity
+            with open(path, "wb") as f:
+                f.truncate(size)
+            with open(path, "r+b") as f:
+                mm = mmap.mmap(f.fileno(), size)
+            _HDR.pack_into(mm, 0, _MAGIC, num_readers)
+            self._mm = mm
+        else:
+            with open(path, "r+b") as f:
+                mm = mmap.mmap(f.fileno(), os.path.getsize(path))
+            magic, nr = _HDR.unpack_from(mm, 0)
+            if magic != _MAGIC:
+                raise ValueError(f"{path}: not a channel file")
+            self.num_readers = nr
+            self.capacity = len(mm) - (_HDR.size + 8 + 8 * nr + 8)
+            self._mm = mm
+        self._w_off = _HDR.size
+        self._r_off = _HDR.size + 8
+        self._len_off = self._r_off + 8 * self.num_readers
+        self._data_off = self._len_off + 8
+
+    # --- low-level counter access ---
+
+    def _write_seq(self) -> int:
+        return _U64.unpack_from(self._mm, self._w_off)[0]
+
+    def _read_seq(self, slot: int) -> int:
+        return _U64.unpack_from(self._mm, self._r_off + 8 * slot)[0]
+
+    def _wait(self, cond, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin = 0
+        while not cond():
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeout(self.path)
+            spin += 1
+            if spin < 200:
+                continue                      # hot spin: latency path
+            time.sleep(0.0002 if spin < 2000 else 0.002)
+
+    # --- writer API ---
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        payload = pickle.dumps(value, protocol=5)
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"channel payload {len(payload)}B exceeds capacity "
+                f"{self.capacity}B (recompile with a larger buffer)")
+        seq = self._write_seq()
+        self._wait(lambda: all(self._read_seq(i) >= seq
+                               for i in range(self.num_readers)), timeout)
+        self._mm[self._data_off:self._data_off + len(payload)] = payload
+        _U64.pack_into(self._mm, self._len_off, len(payload))
+        _U64.pack_into(self._mm, self._w_off, seq + 1)
+
+    def close_write(self) -> None:
+        """Publish the STOP sentinel; readers raise ChannelClosed."""
+        seq = self._write_seq()
+        try:
+            self._wait(lambda: all(self._read_seq(i) >= seq
+                                   for i in range(self.num_readers)), 5.0)
+        except ChannelTimeout:
+            pass  # force-close: a stuck reader must still see STOP
+        _U64.pack_into(self._mm, self._len_off, _STOP_LEN)
+        _U64.pack_into(self._mm, self._w_off, seq + 1)
+
+    # --- reader API ---
+
+    def read(self, slot: int = 0, timeout: Optional[float] = None) -> Any:
+        seq = self._read_seq(slot)
+        self._wait(lambda: self._write_seq() > seq, timeout)
+        length = _U64.unpack_from(self._mm, self._len_off)[0]
+        if length == _STOP_LEN:
+            raise ChannelClosed(self.path)
+        value = pickle.loads(
+            self._mm[self._data_off:self._data_off + length])
+        _U64.pack_into(self._mm, self._r_off + 8 * slot, seq + 1)
+        return value
+
+    # --- lifecycle ---
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        return (Channel, (self.path,),
+                {"capacity": self.capacity,
+                 "num_readers": self.num_readers})
+
+    def __setstate__(self, state):
+        pass  # __init__(path) already remapped from the file header

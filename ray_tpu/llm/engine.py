@@ -159,11 +159,16 @@ class LLMEngine:
 
     def generate(self, prompts: List[List[int]],
                  params: Optional[SamplingParams] = None) -> List[List[int]]:
-        """Batch entry point: run all prompts to completion."""
+        """Batch entry point: run all prompts to completion. Outputs are
+        collected from step() results, so batches larger than the
+        finished-request retention window work fine."""
         ids = [self.add_request(p, params) for p in prompts]
+        collected: Dict[str, List[int]] = {rid: [] for rid in ids}
         while self.has_unfinished():
-            self.step()
-        return [self.requests[i].output for i in ids]
+            for out in self.step():
+                if out.request_id in collected:
+                    collected[out.request_id].append(out.token)
+        return [collected[rid] for rid in ids]
 
     # --- scheduling internals ---
 

@@ -50,13 +50,6 @@ def _write_pages(cache_layer, new, block_tables, positions, page_size):
         flat_new.astype(cache_layer.dtype), mode="drop")
 
 
-def _gather_kv(cache_layer, block_tables):
-    """[P, page, kvh, hd] + [B, max_pages] -> [B, max_pages*page, kvh, hd]."""
-    pages = jnp.take(cache_layer, block_tables, axis=0)
-    B, n_pages, page, kvh, hd = pages.shape
-    return pages.reshape(B, n_pages * page, kvh, hd)
-
-
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
                                                              "cache_v"))
 def prefill(params, cache_k, cache_v, tokens, prompt_lens, block_tables,
@@ -102,61 +95,6 @@ def prefill(params, cache_k, cache_v, tokens, prompt_lens, block_tables,
     return logits, cache_k, cache_v
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
-                                                             "cache_v"))
-def decode(params, cache_k, cache_v, tokens, positions, block_tables,
-           active, cos, sin, *, cfg: LlamaConfig):
-    """One decode step for the whole slot batch.
-
-    tokens: [B] last sampled token per slot; positions: [B] the absolute
-    position being written (== context length so far); active: [B] bool.
-    Returns (logits [B, vocab], cache_k, cache_v).
-    """
-    B = tokens.shape[0]
-    Smax = block_tables.shape[1] * cache_k.shape[2]
-    rep = cfg.n_heads // cfg.n_kv_heads
-    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,d]
-    write_pos = jnp.where(active, positions, -1)[:, None]      # [B,1]
-
-    def layer(x, inputs):
-        lp, ck, cv = inputs
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
-        # positions as [B, 1]: rotary gathers per (batch, seq) position
-        q = apply_rotary(q, cos, sin, positions=positions[:, None])[:, 0]
-        k = apply_rotary(k, cos, sin, positions=positions[:, None])
-        ck = _write_pages(ck, k, block_tables, write_pos, ck.shape[1])
-        cv = _write_pages(cv, v, block_tables, write_pos, cv.shape[1])
-        keys = _gather_kv(ck, block_tables)      # [B, Smax, kvh, hd]
-        vals = _gather_kv(cv, block_tables)
-        qg = q.reshape(B, cfg.n_kv_heads, rep, cfg.head_dim)
-        scores = jnp.einsum("bgrd,bsgd->bgrs", qg.astype(jnp.float32),
-                            keys.astype(jnp.float32))
-        scores = scores * (cfg.head_dim ** -0.5)
-        mask = (jnp.arange(Smax)[None, :] <= positions[:, None])
-        scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bgrs,bsgd->bgrd", probs,
-                       vals.astype(jnp.float32))
-        o = o.reshape(B, 1, cfg.n_heads, cfg.head_dim).astype(x.dtype)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
-        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        g = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"])
-        u = jnp.einsum("bsd,dm->bsm", h, lp["w_up"])
-        x = x + jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
-        return x, (ck, cv)
-
-    x, (cache_k, cache_v) = jax.lax.scan(
-        layer, x, (params["layers"], cache_k, cache_v))
-    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bd,dv->bv", x.astype(cfg.dtype),
-                        params["lm_head"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
-    return logits, cache_k, cache_v
-
-
 def prefill_bucket(seq_len: int, max_seq: int, floor: int = 16) -> int:
     """Power-of-2 padding bucket — one compiled prefill per bucket."""
     b = floor
@@ -179,20 +117,6 @@ def prefill_sample(params, cache_k, cache_v, tokens, prompt_lens,
     logits, cache_k, cache_v = prefill.__wrapped__(
         params, cache_k, cache_v, tokens, prompt_lens, block_tables,
         cos, sin, cfg=cfg)
-    toks = sample_from_logits(logits, seed, temperature, top_k, top_p)
-    return toks, cache_k, cache_v
-
-
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
-                                                             "cache_v"))
-def decode_sample(params, cache_k, cache_v, tokens, positions,
-                  block_tables, active, cos, sin, seed, temperature,
-                  top_k, top_p, *, cfg: LlamaConfig):
-    from .sampling import sample_from_logits
-
-    logits, cache_k, cache_v = decode.__wrapped__(
-        params, cache_k, cache_v, tokens, positions, block_tables,
-        active, cos, sin, cfg=cfg)
     toks = sample_from_logits(logits, seed, temperature, top_k, top_p)
     return toks, cache_k, cache_v
 

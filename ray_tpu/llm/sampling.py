@@ -53,21 +53,21 @@ def sample_from_logits(logits, seed, temperature, top_k, top_p):
     k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, kcap), kcap)
     masked = jnp.where(ranks < k_eff[:, None], top_vals, -jnp.inf)
 
-    # nucleus: keep the smallest prefix of the sorted probs whose mass
-    # reaches top_p (always at least the first token)
-    probs = jax.nn.softmax(masked, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = (cum - probs) < top_p[:, None]
-    masked = jnp.where(keep, masked, -jnp.inf)
-
+    # temperature FIRST, nucleus second (vLLM/HF semantics: top_p is a
+    # mass cut on the temperature-scaled distribution — a hot
+    # distribution admits more tokens into the nucleus)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = masked / temp
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep the smallest prefix reaching top_p (always >= 1 token)
+    keep = (cum - probs) < top_p[:, None]
+    scaled = jnp.where(keep, scaled, -jnp.inf)
+
     # one key per step: categorical draws independent gumbel noise per
     # row, so slots don't correlate
     key = jax.random.PRNGKey(seed)
-    sampled_pos = jax.random.categorical(key, masked / temp, axis=-1)
+    sampled_pos = jax.random.categorical(key, scaled, axis=-1)
     sampled = jnp.take_along_axis(top_idx, sampled_pos[:, None],
                                   axis=1)[:, 0]
     return jnp.where(temperature <= 0.0, greedy, sampled)
-
-
-sample_tokens = jax.jit(sample_from_logits)

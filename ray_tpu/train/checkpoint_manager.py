@@ -7,8 +7,7 @@ from __future__ import annotations
 
 import os
 import shutil
-import tarfile
-import io
+
 from typing import List, Optional
 
 from ._checkpoint import Checkpoint

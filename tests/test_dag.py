@@ -1,0 +1,286 @@
+"""aDAG / compiled graphs: channels, DAG IR, compiled exec loops
+(ref: python/ray/dag/tests/experimental/ — test_accelerated_dag.py)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode, collective
+from ray_tpu.experimental.channel import (
+    Channel, ChannelClosed, ChannelTimeout)
+
+
+@pytest.fixture
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+# --- channel unit tests ---
+
+def test_channel_spsc_roundtrip():
+    ch = Channel(num_readers=1, capacity=1 << 16)
+    try:
+        ch.write({"x": 1})
+        assert ch.read(0) == {"x": 1}
+        ch.write([1, 2, 3])
+        assert ch.read(0) == [1, 2, 3]
+    finally:
+        ch.close()
+        ch.unlink()
+
+
+def test_channel_backpressure_and_threads():
+    ch = Channel(num_readers=1, capacity=1 << 16)
+    got = []
+
+    def reader():
+        for _ in range(20):
+            got.append(ch.read(0))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for i in range(20):
+        ch.write(i, timeout=10)
+    t.join(timeout=10)
+    assert got == list(range(20))
+    ch.close()
+    ch.unlink()
+
+
+def test_channel_multi_reader_broadcast():
+    ch = Channel(num_readers=3, capacity=1 << 16)
+    results = {i: [] for i in range(3)}
+
+    def reader(slot):
+        for _ in range(5):
+            results[slot].append(ch.read(slot, timeout=10))
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for i in range(5):
+        ch.write(i, timeout=10)
+    for t in threads:
+        t.join(timeout=10)
+    assert all(results[i] == [0, 1, 2, 3, 4] for i in range(3))
+    ch.close()
+    ch.unlink()
+
+
+def test_channel_close_raises():
+    ch = Channel(num_readers=1)
+    ch.write(1)
+    assert ch.read(0) == 1
+    ch.close_write()
+    with pytest.raises(ChannelClosed):
+        ch.read(0)
+    ch.close()
+    ch.unlink()
+
+
+def test_channel_timeout_and_capacity():
+    ch = Channel(num_readers=1, capacity=128)
+    with pytest.raises(ChannelTimeout):
+        ch.read(0, timeout=0.1)
+    with pytest.raises(ValueError):
+        ch.write(b"x" * 1024)
+    ch.close()
+    ch.unlink()
+
+
+# --- DAG actors ---
+
+class Adder:
+    def __init__(self, inc):
+        self.inc = inc
+        self.calls = 0
+
+    def add(self, x):
+        self.calls += 1
+        return x + self.inc
+
+    def add2(self, x, y):
+        return x + y
+
+    def pair(self, x):
+        return {"a": x, "b": x * 10}
+
+    def count(self):
+        return self.calls
+
+
+# --- interpreted DAG ---
+
+def test_interpreted_dag_chain(ray_cluster):
+    a = ray_tpu.remote(Adder).remote(1)
+    b = ray_tpu.remote(Adder).remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    ref = dag.execute(5)
+    assert ray_tpu.get(ref, timeout=60) == 16  # 5 + 1 + 10
+
+
+def test_interpreted_multi_output_and_input_attr(ray_cluster):
+    a = ray_tpu.remote(Adder).remote(1)
+    b = ray_tpu.remote(Adder).remote(2)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.add.bind(inp[0]), b.add.bind(inp[1])])
+    refs = dag.execute(10, 20)
+    assert ray_tpu.get(refs, timeout=60) == [11, 22]
+
+
+# --- compiled DAG ---
+
+def test_compiled_chain_parity_and_reuse(ray_cluster):
+    a = ray_tpu.remote(Adder).remote(1)
+    b = ray_tpu.remote(Adder).remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(10):
+            assert compiled.execute(i).get(timeout=30) == i + 11
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_fan_out_multi_output(ray_cluster):
+    a = ray_tpu.remote(Adder).remote(1)
+    b = ray_tpu.remote(Adder).remote(2)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(5):
+            assert compiled.execute(i).get(timeout=30) == [i + 1, i + 2]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_same_actor_locality(ray_cluster):
+    """Two chained methods on ONE actor: values stay local (no channel),
+    and the actor really ran both methods."""
+    a = ray_tpu.remote(Adder).remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(a.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(0).get(timeout=30) == 2
+        assert compiled.execute(40).get(timeout=30) == 42
+    finally:
+        compiled.teardown()
+    # after teardown the actor serves normal calls again, and its state
+    # shows 2 add() calls per execution
+    assert ray_tpu.get(a.count.remote(), timeout=60) == 4
+
+
+def test_compiled_attribute_node(ray_cluster):
+    a = ray_tpu.remote(Adder).remote(1)
+    b = ray_tpu.remote(Adder).remote(0)
+    with InputNode() as inp:
+        pair = a.pair.bind(inp)            # {"a": x, "b": 10x}
+        dag = b.add2.bind(pair["a"], pair["b"])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(3).get(timeout=30) == 33
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_multi_arg_input(ray_cluster):
+    a = ray_tpu.remote(Adder).remote(0)
+    with InputNode() as inp:
+        dag = a.add2.bind(inp[0], inp[1])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(4, 5).get(timeout=30) == 9
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_mixed_args_kwargs_input(ray_cluster):
+    a = ray_tpu.remote(Adder).remote(0)
+    with InputNode() as inp:
+        dag = a.add2.bind(inp[0], inp["y"])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(4, y=5).get(timeout=30) == 9
+        assert compiled.execute(1, y=2).get(timeout=30) == 3
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_single_participant_allreduce(ray_cluster):
+    a = ray_tpu.remote(Adder).remote(5)
+    with InputNode() as inp:
+        reduced = collective.allreduce.bind([a.add.bind(inp)])
+        dag = MultiOutputNode(reduced)
+    compiled = dag.experimental_compile()
+    try:
+        # identity reduction; must not deadlock on repeated executions
+        assert compiled.execute(1).get(timeout=30) == [6]
+        assert compiled.execute(2).get(timeout=30) == [7]
+        assert compiled.execute(3).get(timeout=30) == [8]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_allreduce(ray_cluster):
+    actors = [ray_tpu.remote(Adder).remote(i) for i in (1, 2, 3)]
+    with InputNode() as inp:
+        pieces = [a.add.bind(inp) for a in actors]
+        reduced = collective.allreduce.bind(pieces)
+        dag = MultiOutputNode(reduced)
+    compiled = dag.experimental_compile()
+    try:
+        # x+1, x+2, x+3 -> every rank sees 3x+6
+        assert compiled.execute(1).get(timeout=30) == [9, 9, 9]
+        assert compiled.execute(10).get(timeout=30) == [36, 36, 36]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_error_propagates(ray_cluster):
+    class Boom:
+        def go(self, x):
+            if x == 3:
+                raise ValueError("kaboom")
+            return x
+
+    a = ray_tpu.remote(Boom).remote()
+    with InputNode() as inp:
+        dag = a.go.bind(inp)
+    compiled = dag.experimental_compile()
+    assert compiled.execute(1).get(timeout=30) == 1
+    with pytest.raises(RuntimeError, match="kaboom"):
+        compiled.execute(3).get(timeout=30)
+
+
+def test_compiled_throughput_beats_interpreted(ray_cluster):
+    """The point of compiling: standing loops skip per-call submission.
+    Compare wall time of N chained 2-actor round trips."""
+    a = ray_tpu.remote(Adder).remote(1)
+    b = ray_tpu.remote(Adder).remote(1)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    n = 50
+    t0 = time.perf_counter()
+    for i in range(n):
+        ray_tpu.get(dag.execute(i), timeout=60)
+    interp = time.perf_counter() - t0
+
+    compiled = dag.experimental_compile()
+    try:
+        compiled.execute(0).get(timeout=30)  # loops warm
+        t0 = time.perf_counter()
+        for i in range(n):
+            assert compiled.execute(i).get(timeout=30) == i + 2
+        comp = time.perf_counter() - t0
+    finally:
+        compiled.teardown()
+    # not a tight perf bound — just asserts compiled isn't slower
+    assert comp < interp, (comp, interp)
