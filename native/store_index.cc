@@ -1,0 +1,345 @@
+// Shared-memory object-store index: the native core of the node's
+// object store (TPU-native analog of the plasma object table + eviction
+// policy + capacity accounting; ref: src/ray/object_manager/plasma/
+// object_store.h, eviction_policy.h, object_lifecycle_manager.h).
+//
+// One mmap'd index file per node, opened by every process. Slots form an
+// open-addressed hash table keyed by 28-byte ObjectIDs; a process-shared
+// ROBUST pthread mutex serializes mutations (a client dying mid-critical-
+// section leaves the lock recoverable, not poisoned). Capacity accounting
+// and LRU eviction therefore become node-global facts instead of the
+// per-process approximations a pure-Python store is limited to. The data
+// plane stays per-object tmpfs files (zero-copy mmap with inode-lifetime
+// safety); this index is the authority on existence, size, seal state,
+// pins and eviction order.
+//
+// Build: g++ -O2 -shared -fPIC -o libray_tpu_store.so store_index.cc -lpthread
+// (driven by ray_tpu/_native/__init__.py).
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055494E4458ULL;  // "RTPUINDX"
+constexpr uint32_t kIdLen = 28;
+
+enum SlotState : uint32_t {
+  kEmpty = 0,
+  kCreating = 1,
+  kSealed = 2,
+  kTombstone = 3,
+};
+
+struct Slot {
+  uint32_t state;
+  uint32_t pin;
+  uint64_t size;
+  uint64_t last_access;  // logical clock tick, not wall time
+  uint8_t id[kIdLen];
+  uint8_t pad[4];
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;
+  uint64_t nslots;
+  uint64_t used;
+  uint64_t clock;
+  uint64_t live;  // occupied (creating|sealed) slot count
+  pthread_mutex_t mutex;
+};
+
+struct Index {
+  Header* hdr;
+  Slot* slots;
+  size_t map_len;
+};
+
+uint64_t hash_id(const uint8_t* id) {
+  uint64_t h = 1469598103934665603ULL;  // FNV-1a
+  for (uint32_t i = 0; i < kIdLen; ++i) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// Robust lock: a holder that died leaves EOWNERDEAD; mark consistent and
+// proceed — slot states are each updated atomically enough that the
+// worst stale artifact is a kCreating slot whose owner is gone (aborted
+// by later eviction pressure via idx_abort from the node manager).
+int lock(Index* ix) {
+  int rc = pthread_mutex_lock(&ix->hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&ix->hdr->mutex);
+    rc = 0;
+  }
+  return rc;
+}
+
+void unlock(Index* ix) { pthread_mutex_unlock(&ix->hdr->mutex); }
+
+Slot* find(Index* ix, const uint8_t* id) {
+  uint64_t n = ix->hdr->nslots;
+  uint64_t i = hash_id(id) % n;
+  for (uint64_t probes = 0; probes < n; ++probes) {
+    Slot* s = &ix->slots[i];
+    if (s->state == kEmpty) return nullptr;
+    if (s->state != kTombstone && memcmp(s->id, id, kIdLen) == 0) return s;
+    i = (i + 1) % n;
+  }
+  return nullptr;
+}
+
+Slot* find_insert(Index* ix, const uint8_t* id) {
+  uint64_t n = ix->hdr->nslots;
+  uint64_t i = hash_id(id) % n;
+  Slot* grave = nullptr;
+  for (uint64_t probes = 0; probes < n; ++probes) {
+    Slot* s = &ix->slots[i];
+    if (s->state == kEmpty) return grave ? grave : s;
+    if (s->state == kTombstone) {
+      if (!grave) grave = s;
+    } else if (memcmp(s->id, id, kIdLen) == 0) {
+      return s;  // existing entry
+    }
+    i = (i + 1) % n;
+  }
+  return grave;
+}
+
+void erase(Index* ix, Slot* s) {
+  s->state = kTombstone;
+  s->pin = 0;
+  s->size = 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open (or create) the index file. For openers of an EXISTING file the
+// geometry (capacity, nslots, mapping length) comes from the on-disk
+// header — the caller's arguments only shape a fresh creation, so
+// processes configured differently still agree on the creator's truth.
+void* rtpu_idx_open(const char* path, uint64_t capacity, uint64_t nslots) {
+  size_t len = sizeof(Header) + sizeof(Slot) * nslots;
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  bool creator = fd >= 0;
+  if (!creator) {
+    if (errno != EEXIST) return nullptr;
+    fd = open(path, O_RDWR);
+    if (fd < 0) return nullptr;
+    // the creator ftruncates to full length in one call before
+    // publishing magic, so any nonzero size is the final size
+    struct stat st;
+    st.st_size = 0;
+    for (int spin = 0;
+         spin < 100000 && (fstat(fd, &st) != 0
+                           || (size_t)st.st_size < sizeof(Header));
+         ++spin)
+      usleep(100);
+    if ((size_t)st.st_size < sizeof(Header)) {
+      close(fd);
+      return nullptr;
+    }
+    len = (size_t)st.st_size;
+  } else {
+    if (ftruncate(fd, (off_t)len) != 0) {
+      close(fd);
+      unlink(path);
+      return nullptr;
+    }
+  }
+  void* mem =
+      mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Index* ix = new Index{(Header*)mem, (Slot*)((char*)mem + sizeof(Header)),
+                        len};
+  if (creator) {
+    ix->hdr->capacity = capacity;
+    ix->hdr->nslots = nslots;
+    ix->hdr->used = 0;
+    ix->hdr->clock = 1;
+    ix->hdr->live = 0;
+    pthread_mutexattr_t at;
+    pthread_mutexattr_init(&at);
+    pthread_mutexattr_setpshared(&at, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&at, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&ix->hdr->mutex, &at);
+    pthread_mutexattr_destroy(&at);
+    __sync_synchronize();
+    ix->hdr->magic = kMagic;  // publish: openers spin on this
+  } else {
+    for (int spin = 0; spin < 100000 && ix->hdr->magic != kMagic; ++spin)
+      usleep(100);
+    if (ix->hdr->magic != kMagic
+        || len < sizeof(Header) + sizeof(Slot) * ix->hdr->nslots) {
+      munmap(mem, len);
+      delete ix;
+      return nullptr;
+    }
+  }
+  return ix;
+}
+
+void rtpu_idx_close(void* h) {
+  Index* ix = (Index*)h;
+  munmap((void*)ix->hdr, ix->map_len);
+  delete ix;
+}
+
+// Reserve capacity for a new object, evicting LRU sealed+unpinned
+// entries if needed. Evicted ids are written into victims_out
+// (max_victims * 28 bytes); *n_victims receives the count — the caller
+// owns unlinking their data files. Returns:
+//   0  reserved
+//  -1  impossible (even evicting everything evictable won't fit)
+//  -2  id already exists
+//  -3  table full
+int rtpu_idx_reserve(void* h, const uint8_t* id, uint64_t size,
+                     uint8_t* victims_out, uint32_t max_victims,
+                     uint32_t* n_victims) {
+  Index* ix = (Index*)h;
+  Header* hd = ix->hdr;
+  *n_victims = 0;
+  if (lock(ix) != 0) return -4;
+  Slot* s = find_insert(ix, id);
+  if (!s) {
+    unlock(ix);
+    return -3;
+  }
+  if (s->state == kCreating || s->state == kSealed) {
+    unlock(ix);
+    return -2;
+  }
+  if (hd->used + size > hd->capacity) {
+    // plan the full eviction FIRST — a reservation that turns out
+    // infeasible must not have destroyed anything. LRU order: collect
+    // every sealed+unpinned slot, sort oldest-first, take a prefix.
+    std::vector<Slot*> cands;
+    cands.reserve(256);
+    for (uint64_t i = 0; i < hd->nslots; ++i) {
+      Slot* c = &ix->slots[i];
+      if (c->state == kSealed && c->pin == 0) cands.push_back(c);
+    }
+    std::sort(cands.begin(), cands.end(), [](Slot* a, Slot* b) {
+      return a->last_access < b->last_access;
+    });
+    uint64_t reclaimed = 0;
+    uint32_t count = 0;
+    while (hd->used - reclaimed + size > hd->capacity) {
+      if (count >= cands.size() || count >= max_victims) {
+        unlock(ix);
+        return -1;  // infeasible: index untouched
+      }
+      reclaimed += cands[count]->size;
+      count++;
+    }
+    for (uint32_t j = 0; j < count; ++j) {
+      memcpy(victims_out + (*n_victims) * kIdLen, cands[j]->id, kIdLen);
+      (*n_victims)++;
+      hd->used -= cands[j]->size;
+      hd->live--;
+      erase(ix, cands[j]);
+    }
+  }
+  s->state = kCreating;
+  s->pin = 0;
+  s->size = size;
+  s->last_access = hd->clock++;
+  memcpy(s->id, id, kIdLen);
+  hd->used += size;
+  hd->live++;
+  unlock(ix);
+  return 0;
+}
+
+int rtpu_idx_seal(void* h, const uint8_t* id) {
+  Index* ix = (Index*)h;
+  if (lock(ix) != 0) return -4;
+  Slot* s = find(ix, id);
+  int rc = 0;
+  if (!s)
+    rc = -1;
+  else
+    s->state = kSealed;
+  unlock(ix);
+  return rc;
+}
+
+int rtpu_idx_abort(void* h, const uint8_t* id) {
+  Index* ix = (Index*)h;
+  if (lock(ix) != 0) return -4;
+  Slot* s = find(ix, id);
+  if (s) {
+    ix->hdr->used -= s->size;
+    ix->hdr->live--;
+    erase(ix, s);
+  }
+  unlock(ix);
+  return s ? 0 : -1;
+}
+
+// Lookup + LRU touch. Returns 0 sealed (size filled), 1 absent,
+// 2 still creating.
+int rtpu_idx_lookup(void* h, const uint8_t* id, uint64_t* size_out) {
+  Index* ix = (Index*)h;
+  if (lock(ix) != 0) return -4;
+  Slot* s = find(ix, id);
+  int rc;
+  if (!s) {
+    rc = 1;
+  } else if (s->state == kCreating) {
+    rc = 2;
+  } else {
+    *size_out = s->size;
+    s->last_access = ix->hdr->clock++;
+    rc = 0;
+  }
+  unlock(ix);
+  return rc;
+}
+
+int rtpu_idx_pin(void* h, const uint8_t* id, int delta) {
+  Index* ix = (Index*)h;
+  if (lock(ix) != 0) return -4;
+  Slot* s = find(ix, id);
+  int rc = -1;
+  if (s) {
+    if (delta > 0 || s->pin > 0) s->pin += delta;
+    rc = 0;
+  }
+  unlock(ix);
+  return rc;
+}
+
+int rtpu_idx_delete(void* h, const uint8_t* id) {
+  Index* ix = (Index*)h;
+  if (lock(ix) != 0) return -4;
+  Slot* s = find(ix, id);
+  if (s) {
+    ix->hdr->used -= s->size;
+    ix->hdr->live--;
+    erase(ix, s);
+  }
+  unlock(ix);
+  return s ? 0 : -1;
+}
+
+uint64_t rtpu_idx_used(void* h) { return ((Index*)h)->hdr->used; }
+uint64_t rtpu_idx_live(void* h) { return ((Index*)h)->hdr->live; }
+uint64_t rtpu_idx_capacity(void* h) { return ((Index*)h)->hdr->capacity; }
+
+}  // extern "C"

@@ -1,0 +1,146 @@
+"""Native (C++) substrate loader: builds and binds libray_tpu_store.so
+(ref: SURVEY §2.1 — native components get C++ equivalents, not Python
+stand-ins; this module is the N17 Python⇄native bridge for them).
+
+The library is compiled on demand with g++ into ray_tpu/_native/build/
+(cached by source mtime); loading failures degrade gracefully — callers
+fall back to pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
+_BUILD = os.path.join(_HERE, "build")
+_LOCK = threading.Lock()
+_LIB = None
+_LIB_ERR: Optional[str] = None
+
+ID_LEN = 28
+
+
+def _build_lib() -> str:
+    src = os.path.join(_SRC, "store_index.cc")
+    out = os.path.join(_BUILD, "libray_tpu_store.so")
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    os.makedirs(_BUILD, exist_ok=True)
+    tmp = out + f".tmp.{os.getpid()}"
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src,
+         "-lpthread"],
+        check=True, capture_output=True, timeout=120)
+    os.replace(tmp, out)  # atomic: concurrent builders race safely
+    return out
+
+
+def get_lib():
+    """The loaded native library, or None (with the reason recorded)."""
+    global _LIB, _LIB_ERR
+    with _LOCK:
+        if _LIB is not None or _LIB_ERR is not None:
+            return _LIB
+        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") == "0":
+            _LIB_ERR = "disabled via RAY_TPU_NATIVE_STORE=0"
+            return None
+        try:
+            lib = ctypes.CDLL(_build_lib())
+        except Exception as e:  # no g++ / bad toolchain: pure-Python path
+            _LIB_ERR = repr(e)
+            return None
+        lib.rtpu_idx_open.restype = ctypes.c_void_p
+        lib.rtpu_idx_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_uint64]
+        lib.rtpu_idx_close.argtypes = [ctypes.c_void_p]
+        lib.rtpu_idx_reserve.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.rtpu_idx_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_idx_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_idx_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_uint64)]
+        lib.rtpu_idx_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+        lib.rtpu_idx_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        for fn in ("rtpu_idx_used", "rtpu_idx_live", "rtpu_idx_capacity"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def native_unavailable_reason() -> Optional[str]:
+    get_lib()
+    return _LIB_ERR
+
+
+class NativeIndex:
+    """ctypes handle over the shared store index (one per store dir)."""
+
+    MAX_VICTIMS = 4096
+
+    def __init__(self, path: str, capacity: int, nslots: int = 1 << 16):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native store unavailable: {_LIB_ERR}")
+        self._lib = lib
+        self._h = lib.rtpu_idx_open(path.encode(), capacity, nslots)
+        if not self._h:
+            raise RuntimeError(f"cannot open native index at {path}")
+        self._victims = ctypes.create_string_buffer(
+            ID_LEN * self.MAX_VICTIMS)
+
+    def reserve(self, oid: bytes, size: int) -> Tuple[int, List[bytes]]:
+        """(rc, evicted_ids): rc 0 ok, -1 impossible, -2 exists,
+        -3 table full. Caller unlinks the evicted ids' data files."""
+        n = ctypes.c_uint32(0)
+        rc = self._lib.rtpu_idx_reserve(
+            self._h, oid, size, self._victims, self.MAX_VICTIMS,
+            ctypes.byref(n))
+        raw = self._victims.raw
+        victims = [raw[i * ID_LEN:(i + 1) * ID_LEN]
+                   for i in range(n.value)]
+        return rc, victims
+
+    def seal(self, oid: bytes) -> int:
+        return self._lib.rtpu_idx_seal(self._h, oid)
+
+    def abort(self, oid: bytes) -> int:
+        return self._lib.rtpu_idx_abort(self._h, oid)
+
+    def lookup(self, oid: bytes) -> Tuple[int, int]:
+        """(state, size): state 0 sealed, 1 absent, 2 creating."""
+        size = ctypes.c_uint64(0)
+        rc = self._lib.rtpu_idx_lookup(self._h, oid, ctypes.byref(size))
+        return rc, size.value
+
+    def pin(self, oid: bytes) -> None:
+        self._lib.rtpu_idx_pin(self._h, oid, 1)
+
+    def unpin(self, oid: bytes) -> None:
+        self._lib.rtpu_idx_pin(self._h, oid, -1)
+
+    def delete(self, oid: bytes) -> int:
+        return self._lib.rtpu_idx_delete(self._h, oid)
+
+    def used(self) -> int:
+        return self._lib.rtpu_idx_used(self._h)
+
+    def live(self) -> int:
+        return self._lib.rtpu_idx_live(self._h)
+
+    def capacity(self) -> int:
+        return self._lib.rtpu_idx_capacity(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rtpu_idx_close(self._h)
+            self._h = None

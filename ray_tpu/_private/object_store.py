@@ -44,6 +44,12 @@ class _Entry:
     pin_count: int = 0
     sealed: bool = True
     last_access: float = field(default_factory=time.monotonic)
+    # per-process unsealed staging file (pid-suffixed: two processes
+    # re-creating the same object must not write the same tmp file)
+    tmp_path: str = ""
+    # whether THIS handle reserved the index entry (abort must not
+    # release someone else's live reservation)
+    owns_reservation: bool = True
 
 
 class SharedObjectStore:
@@ -62,21 +68,68 @@ class SharedObjectStore:
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._lock = threading.Lock()
         self._used = 0
+        # Native index (C++ shared table, ray_tpu/_native): makes seal
+        # state, capacity accounting, pins and LRU order node-global
+        # facts across every process sharing this dir. Pure-Python
+        # per-process accounting remains the fallback.
+        self._idx = None
+        try:
+            from .._native import NativeIndex
+
+            os.makedirs(self.dir, exist_ok=True)
+            self._idx = NativeIndex(os.path.join(self.dir, "index.bin"),
+                                    capacity_bytes)
+        except Exception:
+            self._idx = None
 
     # ---- paths ----
     def _path(self, oid: ObjectID) -> str:
         return os.path.join(self.dir, oid.hex())
 
     # ---- write path ----
+    def _reserve_native(self, oid: ObjectID, size: int) -> bool:
+        """Node-global reservation through the C++ index; evicted victims'
+        data files are unlinked here (the index already dropped them).
+        Returns False when the object already exists in the index (a
+        re-create: another process reserved or sealed it) — the caller
+        still writes its own staging file and seal() renames it into
+        place atomically, but this handle does NOT own the reservation."""
+        rc, victims = self._idx.reserve(oid.binary(), size)
+        if rc == -2:
+            return False
+        if rc != 0:
+            raise ObjectStoreFullError(
+                f"object store over capacity: need {size}, used "
+                f"{self._idx.used()}, capacity {self._idx.capacity()} "
+                f"(rc={rc})")
+        for vid in victims:
+            voi = ObjectID(vid)
+            with self._lock:
+                entry = self._entries.pop(voi, None)
+                if entry is not None and entry.mm is not None:
+                    try:
+                        entry.mm.close()
+                    except BufferError:
+                        pass
+            try:
+                os.unlink(self._path(voi))
+            except FileNotFoundError:
+                pass
+        return True
+
     def create(self, oid: ObjectID, size: int) -> memoryview:
         """Allocate an unsealed buffer; returns a writable view. Caller must
         seal() (or abort()) exactly once."""
-        with self._lock:
-            self._maybe_evict(size)
-            # Reserve capacity before dropping the lock so concurrent
-            # creates can't collectively overshoot it.
-            self._used += size
-        tmp = self._path(oid) + ".tmp"
+        owns = True
+        if self._idx is not None:
+            owns = self._reserve_native(oid, size)
+        else:
+            with self._lock:
+                self._maybe_evict(size)
+                # Reserve capacity before dropping the lock so concurrent
+                # creates can't collectively overshoot it.
+                self._used += size
+        tmp = f"{self._path(oid)}.tmp.{os.getpid()}"
         try:
             fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
             try:
@@ -85,11 +138,17 @@ class SharedObjectStore:
             finally:
                 os.close(fd)
         except BaseException:
-            with self._lock:
-                self._used -= size
+            if self._idx is not None:
+                if owns:
+                    self._idx.abort(oid.binary())
+            else:
+                with self._lock:
+                    self._used -= size
             raise
         with self._lock:
-            self._entries[oid] = _Entry(path=self._path(oid), size=size, mm=mm, sealed=False)
+            self._entries[oid] = _Entry(
+                path=self._path(oid), size=size, mm=mm, sealed=False,
+                tmp_path=tmp, owns_reservation=owns)
         return memoryview(mm)[:size]
 
     def put(self, oid: ObjectID, data: bytes) -> None:
@@ -101,18 +160,28 @@ class SharedObjectStore:
         with self._lock:
             entry = self._entries[oid]
             entry.mm.flush()
-            os.rename(entry.path + ".tmp", entry.path)
+            os.rename(entry.tmp_path or entry.path + ".tmp", entry.path)
             entry.sealed = True
+        if self._idx is not None:
+            self._idx.seal(oid.binary())
 
     def abort(self, oid: ObjectID) -> None:
         with self._lock:
             entry = self._entries.pop(oid, None)
             if entry is None:
                 return
-            self._used -= entry.size
+            if self._idx is not None:
+                if entry.owns_reservation:
+                    self._idx.abort(oid.binary())
+            else:
+                self._used -= entry.size
             if entry.mm is not None:
                 entry.mm.close()
-            for p in (entry.path + ".tmp", entry.path):
+            paths = [entry.tmp_path] if entry.tmp_path else []
+            # only the reservation owner may take down the sealed file
+            if entry.owns_reservation:
+                paths.append(entry.path)
+            for p in paths:
                 try:
                     os.unlink(p)
                 except FileNotFoundError:
@@ -121,6 +190,24 @@ class SharedObjectStore:
     # ---- read path ----
     def get(self, oid: ObjectID) -> Optional[memoryview]:
         """Map a sealed object; zero-copy view. None if absent/unsealed."""
+        if self._idx is not None:
+            # index is the authority (and the lookup is the LRU touch):
+            # a locally-cached mmap whose entry another process evicted
+            # must not serve stale data
+            state, _ = self._idx.lookup(oid.binary())
+            if state != 0:
+                with self._lock:
+                    entry = self._entries.get(oid)
+                    # keep our own not-yet-sealed create mapping; drop
+                    # anything else the index no longer knows
+                    if entry is not None and entry.sealed:
+                        self._entries.pop(oid, None)
+                        if entry.mm is not None:
+                            try:
+                                entry.mm.close()
+                            except BufferError:
+                                pass
+                return None
         with self._lock:
             entry = self._entries.get(oid)
             if entry is not None and entry.sealed and entry.mm is not None:
@@ -145,21 +232,26 @@ class SharedObjectStore:
             if entry is not None and entry.mm is not None:
                 mm.close()
             else:
-                # Mapping a foreign-sealed object grows the store too:
-                # evict LRU victims (or raise) before accounting it.
-                try:
-                    self._maybe_evict(size)
-                except ObjectStoreFullError:
-                    mm.close()
-                    raise
+                if self._idx is None:
+                    # Mapping a foreign-sealed object grows the store
+                    # too: evict LRU victims (or raise) first. (With the
+                    # native index the object was accounted node-globally
+                    # at creation — mapping it adds nothing.)
+                    try:
+                        self._maybe_evict(size)
+                    except ObjectStoreFullError:
+                        mm.close()
+                        raise
+                    self._used += size
                 entry = _Entry(path=path, size=size, mm=mm)
                 self._entries[oid] = entry
-                self._used += size
             entry.last_access = time.monotonic()
             self._entries.move_to_end(oid)
             return memoryview(entry.mm)[: entry.size]
 
     def contains(self, oid: ObjectID) -> bool:
+        if self._idx is not None:
+            return self._idx.lookup(oid.binary())[0] == 0
         with self._lock:
             entry = self._entries.get(oid)
             if entry is not None and entry.sealed:
@@ -167,12 +259,17 @@ class SharedObjectStore:
         return os.path.exists(self._path(oid))
 
     def pin(self, oid: ObjectID) -> None:
+        if self._idx is not None:
+            self._idx.pin(oid.binary())  # node-global: protects from
+            # evictions by ANY process sharing the store
         with self._lock:
             entry = self._entries.get(oid)
             if entry is not None:
                 entry.pin_count += 1
 
     def unpin(self, oid: ObjectID) -> None:
+        if self._idx is not None:
+            self._idx.unpin(oid.binary())
         with self._lock:
             entry = self._entries.get(oid)
             if entry is not None and entry.pin_count > 0:
@@ -182,12 +279,15 @@ class SharedObjectStore:
         with self._lock:
             entry = self._entries.pop(oid, None)
             if entry is not None:
-                self._used -= entry.size
+                if self._idx is None:
+                    self._used -= entry.size
                 if entry.mm is not None:
                     try:
                         entry.mm.close()
                     except BufferError:
                         pass  # live memoryviews; file unlink still reclaims on close
+        if self._idx is not None:
+            self._idx.delete(oid.binary())
         try:
             os.unlink(self._path(oid))
         except FileNotFoundError:
@@ -195,6 +295,8 @@ class SharedObjectStore:
 
     # ---- accounting / eviction ----
     def used_bytes(self) -> int:
+        if self._idx is not None:
+            return self._idx.used()
         return self._used
 
     def _maybe_evict(self, incoming: int) -> None:
@@ -245,6 +347,9 @@ class SharedObjectStore:
                         pass
             self._entries.clear()
             self._used = 0
+        if self._idx is not None:
+            self._idx.close()
+            self._idx = None
         import shutil
 
         shutil.rmtree(self.dir, ignore_errors=True)

@@ -64,11 +64,14 @@ def _dag_exec_loop(actor_self, spec_blob: bytes):
             if kind == "local":
                 return results[argspec[1]]
             if kind == "local_attr":
-                return _apply_key(results[argspec[1]], argspec[2])
+                return _apply_keys(results[argspec[1]], argspec[2])
             if kind == "chan":
-                _, path, slot, key = argspec
+                _, path, slot, keys = argspec
                 value = fetch(path, slot)
-                return value if key is None else _apply_key(value, key)
+                if isinstance(value, _WrappedError):
+                    # an upstream actor failed: forward the error
+                    raise _Propagated(value)
+                return _apply_keys(value, keys)
             raise ValueError(argspec)
 
         try:
@@ -97,30 +100,48 @@ def _dag_exec_loop(actor_self, spec_blob: bytes):
         except ChannelClosed:
             shutdown()
             return True
-        except BaseException as e:  # surface through result channels
-            err = _WrappedError(repr(e))
-            for path in spec["result_paths"]:
+        except BaseException as e:
+            # surface through EVERY out channel, so the error travels the
+            # dataflow graph hop by hop until the driver's result read
+            # raises it (mid-chain failures included)
+            err = e.err if isinstance(e, _Propagated) else \
+                _WrappedError(repr(e))
+            for path in spec["write_paths"]:
                 try:
-                    writers[path].write(err)
+                    writers[path].write(err, timeout=5.0)
                 except Exception:
                     pass
             shutdown()
+            if isinstance(e, _Propagated):
+                return False  # upstream already raised the original
             raise
 
 
-def _apply_key(value, key):
-    """Index into a node result / DAG input. A mixed positional+keyword
-    input rides the channel as {"*args": args, **kwargs} (mirroring
-    interpreted execution), so integer keys index the tuple inside."""
-    if (isinstance(key, int) and isinstance(value, dict)
-            and "*args" in value):
-        return value["*args"][key]
-    return value[key]
+def _apply_keys(value, keys):
+    """Apply a chain of index keys (node["a"]["b"] nests) to a node
+    result / DAG input. A mixed positional+keyword input rides the
+    channel as {"*args": args, **kwargs} (mirroring interpreted
+    execution), so integer keys index the tuple inside."""
+    for key in keys or ():
+        if (isinstance(key, int) and isinstance(value, dict)
+                and "*args" in value):
+            value = value["*args"][key]
+        else:
+            value = value[key]
+    return value
 
 
 class _WrappedError:
     def __init__(self, msg: str):
         self.msg = msg
+
+
+class _Propagated(Exception):
+    """Wrapper for an upstream _WrappedError read off a channel."""
+
+    def __init__(self, err: _WrappedError):
+        super().__init__(err.msg)
+        self.err = err
 
 
 class CompiledDAGRef:
@@ -180,6 +201,12 @@ class CompiledDAG:
 
         self._input_node = next(
             (n for n in order if isinstance(n, InputNode)), None)
+        if self._input_node is None:
+            # without an input channel the actor loops would free-run,
+            # producing results decoupled from execute() calls
+            raise ValueError(
+                "experimental_compile requires the DAG to read from an "
+                "InputNode (build it under `with InputNode() as inp:`)")
         node_ids = {id(n): i for i, n in enumerate(order)}
         actor_of: Dict[int, Any] = {}
         for n in order:
@@ -195,11 +222,22 @@ class CompiledDAG:
         remote_consumers: Dict[int, List[str]] = {}
         driver_reads: Dict[int, bool] = {}
 
+        def unwrap(node: DAGNode):
+            """Peel (possibly nested) attribute access down to the real
+            producer; returns (producer, key_chain)."""
+            keys: List[Any] = []
+            while True:
+                if isinstance(node, InputAttributeNode):
+                    keys.append(node.key)
+                    node = node.input_node
+                elif isinstance(node, AttributeNode):
+                    keys.append(node.key)
+                    node = node.upstream
+                else:
+                    return node, tuple(reversed(keys))
+
         def note_consumer(producer: DAGNode, consumer_actor: Optional[str]):
-            if isinstance(producer, (InputAttributeNode,)):
-                producer = producer.input_node
-            if isinstance(producer, AttributeNode):
-                producer = producer.upstream
+            producer, _ = unwrap(producer)
             pid = node_ids[id(producer)]
             p_actor = (None if isinstance(producer, InputNode)
                        else actor_key(actor_of[pid]))
@@ -268,31 +306,23 @@ class CompiledDAG:
             key = actor_key(handle)
             if key not in specs:
                 specs[key] = {"handle": handle, "steps": [],
-                              "read_paths": set(), "write_paths": set(),
-                              "result_paths": set()}
+                              "read_paths": set(), "write_paths": set()}
             return specs[key]
 
         def argspec(a, me: str):
             if not isinstance(a, DAGNode):
                 return ("const", a)
-            key = None
-            producer = a
-            if isinstance(a, InputAttributeNode):
-                producer, key = a.input_node, a.key
-            elif isinstance(a, AttributeNode):
-                producer, key = a.upstream, a.key
+            producer, keys = unwrap(a)
             pid = node_ids[id(producer)]
             p_actor = (None if isinstance(producer, InputNode)
                        else actor_key(actor_of[pid]))
             if p_actor == me:
-                if key is None:
+                if not keys:
                     return ("local", pid)
-                # local + attribute: wrap as local then index — encode as
-                # chan-style with no channel via small shim
-                return ("local_attr", pid, key)
+                return ("local_attr", pid, keys)
             ch = chan_of[pid]
             slot = slot_of[(pid, me)]
-            return ("chan", ch.path, slot, key)
+            return ("chan", ch.path, slot, keys)
 
         for n in order:
             pid = node_ids[id(n)]
@@ -359,18 +389,10 @@ class CompiledDAG:
         # driver-side output bindings
         self._outputs: List[Tuple[Channel, int, Any]] = []
         for out in outputs:
-            key = None
-            producer = out
-            if isinstance(out, AttributeNode):
-                producer, key = out.upstream, out.key
-            elif isinstance(out, InputAttributeNode):
-                producer, key = out.input_node, out.key
+            producer, keys = unwrap(out)
             pid = node_ids[id(producer)]
             ch = chan_of[pid]
-            self._outputs.append((ch, slot_of[(pid, "__driver__")], key))
-        for spec in specs.values():
-            spec["result_paths"] = {ch.path for ch, _, _ in self._outputs
-                                    if ch.path in spec["write_paths"]}
+            self._outputs.append((ch, slot_of[(pid, "__driver__")], keys))
 
         # driver-side input binding
         self._input_channel = None
@@ -397,7 +419,6 @@ class CompiledDAG:
             payload = dict(spec)
             payload["read_paths"] = sorted(payload["read_paths"])
             payload["write_paths"] = sorted(payload["write_paths"])
-            payload["result_paths"] = sorted(payload["result_paths"])
             method = ActorMethod(handle, "_rtpu_dyn_call")
             self._loop_refs.append(
                 method.remote(loop_blob, cloudpickle.dumps(payload)))
@@ -432,14 +453,19 @@ class CompiledDAG:
                 # mid-row must not desync channels whose cursor already
                 # advanced), hence the persistent _row_vals cursor
                 while len(self._row_vals) < len(self._outputs):
-                    ch, slot, key = self._outputs[len(self._row_vals)]
-                    v = ch.read(slot, timeout=timeout)
+                    ch, slot, keys = self._outputs[len(self._row_vals)]
+                    try:
+                        v = ch.read(slot, timeout=timeout)
+                    except ChannelClosed:
+                        self.teardown()
+                        raise RuntimeError(
+                            "compiled DAG channels closed unexpectedly "
+                            "(an actor loop exited)") from None
                     if isinstance(v, _WrappedError):
                         self.teardown()
                         raise RuntimeError(
                             f"compiled DAG task failed: {v.msg}")
-                    self._row_vals.append(
-                        v if key is None else _apply_key(v, key))
+                    self._row_vals.append(_apply_keys(v, keys))
                 vals, self._row_vals = self._row_vals, []
                 ref = self._pending.pop(self._next_fetch)
                 ref._value = vals if self._multi else vals[0]

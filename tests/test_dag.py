@@ -260,6 +260,46 @@ def test_compiled_error_propagates(ray_cluster):
         compiled.execute(3).get(timeout=30)
 
 
+def test_compiled_mid_chain_error_reaches_driver(ray_cluster):
+    class Boom:
+        def go(self, x):
+            if x == 3:
+                raise ValueError("mid-chain kaboom")
+            return x
+
+    a = ray_tpu.remote(Boom).remote()
+    b = ray_tpu.remote(Adder).remote(1)   # downstream of the failer
+    with InputNode() as inp:
+        dag = b.add.bind(a.go.bind(inp))
+    compiled = dag.experimental_compile()
+    assert compiled.execute(1).get(timeout=30) == 2
+    with pytest.raises(RuntimeError, match="kaboom"):
+        compiled.execute(3).get(timeout=30)
+
+
+def test_compiled_nested_attribute_access(ray_cluster):
+    class Nester:
+        def make(self, x):
+            return {"outer": {"inner": x * 2}}
+
+    a = ray_tpu.remote(Nester).remote()
+    b = ray_tpu.remote(Adder).remote(1)
+    with InputNode() as inp:
+        dag = b.add.bind(a.make.bind(inp)["outer"]["inner"])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(5).get(timeout=30) == 11
+    finally:
+        compiled.teardown()
+
+
+def test_compile_requires_input_node(ray_cluster):
+    a = ray_tpu.remote(Adder).remote(1)
+    dag = a.add.bind(5)
+    with pytest.raises(ValueError, match="InputNode"):
+        dag.experimental_compile()
+
+
 def test_compiled_throughput_beats_interpreted(ray_cluster):
     """The point of compiling: standing loops skip per-call submission.
     Compare wall time of N chained 2-actor round trips."""

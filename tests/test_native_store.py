@@ -1,0 +1,129 @@
+"""Native (C++) store index: shared table, node-global accounting,
+LRU eviction, robust-mutex survival (ref: plasma object_store/
+eviction_policy C++ unit tests, SURVEY §4.1)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from ray_tpu._native import ID_LEN, NativeIndex, native_unavailable_reason
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import (
+    ObjectStoreFullError, SharedObjectStore)
+
+pytestmark = pytest.mark.skipif(
+    native_unavailable_reason() is not None,
+    reason=f"native lib unavailable: {native_unavailable_reason()}")
+
+
+def _id(ch: bytes) -> bytes:
+    return ch * ID_LEN
+
+
+def test_index_reserve_seal_lookup_delete(tmp_path):
+    ix = NativeIndex(str(tmp_path / "ix.bin"), capacity=1000)
+    rc, victims = ix.reserve(_id(b"a"), 300)
+    assert rc == 0 and victims == []
+    assert ix.lookup(_id(b"a")) == (2, 0)          # creating
+    ix.seal(_id(b"a"))
+    assert ix.lookup(_id(b"a")) == (0, 300)        # sealed
+    assert ix.used() == 300 and ix.live() == 1
+    assert ix.delete(_id(b"a")) == 0
+    assert ix.lookup(_id(b"a"))[0] == 1            # absent
+    assert ix.used() == 0
+    ix.close()
+
+
+def test_index_lru_eviction_order(tmp_path):
+    ix = NativeIndex(str(tmp_path / "ix.bin"), capacity=1000)
+    for ch in (b"a", b"b", b"c"):
+        assert ix.reserve(_id(ch), 300)[0] == 0
+        ix.seal(_id(ch))
+    ix.lookup(_id(b"a"))  # touch a: now b is LRU
+    rc, victims = ix.reserve(_id(b"d"), 500)
+    assert rc == 0
+    assert victims == [_id(b"b"), _id(b"c")]       # LRU first, a kept
+    assert ix.lookup(_id(b"a"))[0] == 0
+    ix.close()
+
+
+def test_index_pin_blocks_eviction(tmp_path):
+    ix = NativeIndex(str(tmp_path / "ix.bin"), capacity=600)
+    ix.reserve(_id(b"a"), 500)
+    ix.seal(_id(b"a"))
+    ix.pin(_id(b"a"))
+    rc, _ = ix.reserve(_id(b"b"), 500)
+    assert rc == -1                                 # pinned: impossible
+    ix.unpin(_id(b"a"))
+    rc, victims = ix.reserve(_id(b"b"), 500)
+    assert rc == 0 and victims == [_id(b"a")]
+    ix.close()
+
+
+def test_index_shared_across_processes(tmp_path):
+    """A second PROCESS sees reservations and contributes to accounting —
+    the property the pure-Python store cannot provide."""
+    path = str(tmp_path / "ix.bin")
+    ix = NativeIndex(path, capacity=1000)
+    ix.reserve(_id(b"a"), 400)
+    ix.seal(_id(b"a"))
+    code = f"""
+import sys
+from ray_tpu._native import NativeIndex, ID_LEN
+ix = NativeIndex({path!r}, capacity=1000)
+assert ix.lookup(b"a" * ID_LEN) == (0, 400), "peer must see the seal"
+rc, victims = ix.reserve(b"b" * ID_LEN, 400)
+assert rc == 0 and victims == [], (rc, victims)
+ix.seal(b"b" * ID_LEN)
+assert ix.used() == 800
+ix.close()
+print("CHILD_OK")
+"""
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120,
+                         env={**os.environ, "PYTHONPATH": os.getcwd()})
+    assert "CHILD_OK" in out.stdout, out.stderr[-2000:]
+    # the child's reservation is visible and counted here
+    assert ix.used() == 800
+    assert ix.lookup(_id(b"b")) == (0, 400)
+    ix.close()
+
+
+def test_store_uses_native_index_for_eviction(tmp_path):
+    store = SharedObjectStore(str(tmp_path / "store"), capacity_bytes=1000)
+    assert store._idx is not None
+    a, b = ObjectID.from_random(), ObjectID.from_random()
+    store.put(a, b"x" * 600)
+    store.put(b, b"y" * 300)
+    assert store.used_bytes() == 900
+    c = ObjectID.from_random()
+    store.put(c, b"z" * 500)        # evicts a (LRU)
+    assert store.get(a) is None
+    assert bytes(store.get(c)) == b"z" * 500
+    # pinned objects survive pressure; unpinnable request raises
+    store.pin(b)
+    store.pin(c)
+    with pytest.raises(ObjectStoreFullError):
+        store.put(ObjectID.from_random(), b"w" * 900)
+    store.destroy()
+
+
+def test_store_cross_handle_accounting(tmp_path):
+    """Two store handles over the same dir (the per-process client view)
+    share used_bytes and see each other's seals instantly."""
+    d = str(tmp_path / "store")
+    s1 = SharedObjectStore(d, capacity_bytes=10_000)
+    s2 = SharedObjectStore(d, capacity_bytes=10_000, create_dir=False)
+    assert s2._idx is not None
+    oid = ObjectID.from_random()
+    s1.put(oid, b"hello world")
+    assert s2.contains(oid)
+    assert bytes(s2.get(oid)) == b"hello world"
+    assert s2.used_bytes() == s1.used_bytes() == 11
+    # deletion through the second handle is visible to the first
+    s2.delete(oid)
+    assert s1.get(oid) is None and s1.used_bytes() == 0
+    s1.destroy()
