@@ -20,7 +20,11 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <string>
 #include <vector>
+
+#include <cstdio>
+#include <ctime>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -45,9 +49,20 @@ struct Slot {
   uint32_t pin;
   uint64_t size;
   uint64_t last_access;  // logical clock tick, not wall time
+  uint64_t ctime_ms;     // CLOCK_REALTIME ms at reservation: lets any
+                         // process reclaim kCreating slots whose owner
+                         // died mid-write (stale after kStaleCreatingMs)
   uint8_t id[kIdLen];
   uint8_t pad[4];
 };
+
+constexpr uint64_t kStaleCreatingMs = 60'000;
+
+uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (uint64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
 
 struct Header {
   uint64_t magic;
@@ -63,7 +78,19 @@ struct Index {
   Header* hdr;
   Slot* slots;
   size_t map_len;
+  std::string data_dir;  // per-object data files live here (hex names);
+                         // victims are unlinked UNDER the index mutex so
+                         // an eviction cannot race a re-create's seal
 };
+
+void unlink_data(const Index* ix, const uint8_t* id) {
+  if (ix->data_dir.empty()) return;
+  char name[kIdLen * 2 + 1];
+  for (uint32_t i = 0; i < kIdLen; ++i)
+    snprintf(name + 2 * i, 3, "%02x", id[i]);
+  std::string path = ix->data_dir + "/" + name;
+  unlink(path.c_str());
+}
 
 uint64_t hash_id(const uint8_t* id) {
   uint64_t h = 1469598103934665603ULL;  // FNV-1a
@@ -132,7 +159,8 @@ extern "C" {
 // geometry (capacity, nslots, mapping length) comes from the on-disk
 // header — the caller's arguments only shape a fresh creation, so
 // processes configured differently still agree on the creator's truth.
-void* rtpu_idx_open(const char* path, uint64_t capacity, uint64_t nslots) {
+void* rtpu_idx_open(const char* path, uint64_t capacity, uint64_t nslots,
+                    const char* data_dir) {
   size_t len = sizeof(Header) + sizeof(Slot) * nslots;
   int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
   bool creator = fd >= 0;
@@ -166,7 +194,7 @@ void* rtpu_idx_open(const char* path, uint64_t capacity, uint64_t nslots) {
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
   Index* ix = new Index{(Header*)mem, (Slot*)((char*)mem + sizeof(Header)),
-                        len};
+                        len, data_dir ? std::string(data_dir) : std::string()};
   if (creator) {
     ix->hdr->capacity = capacity;
     ix->hdr->nslots = nslots;
@@ -230,11 +258,19 @@ int rtpu_idx_reserve(void* h, const uint8_t* id, uint64_t size,
     // every sealed+unpinned slot, sort oldest-first, take a prefix.
     std::vector<Slot*> cands;
     cands.reserve(256);
+    uint64_t now = now_ms();
     for (uint64_t i = 0; i < hd->nslots; ++i) {
       Slot* c = &ix->slots[i];
       if (c->state == kSealed && c->pin == 0) cands.push_back(c);
+      // a creation whose owner died mid-write: reclaimable garbage
+      else if (c->state == kCreating
+               && now - c->ctime_ms > kStaleCreatingMs)
+        cands.push_back(c);
     }
     std::sort(cands.begin(), cands.end(), [](Slot* a, Slot* b) {
+      // stale creations first (they hold no useful data), then LRU
+      bool sa = a->state == kCreating, sb = b->state == kCreating;
+      if (sa != sb) return sa;
       return a->last_access < b->last_access;
     });
     uint64_t reclaimed = 0;
@@ -252,6 +288,7 @@ int rtpu_idx_reserve(void* h, const uint8_t* id, uint64_t size,
       (*n_victims)++;
       hd->used -= cands[j]->size;
       hd->live--;
+      unlink_data(ix, cands[j]->id);  // under the mutex: no seal race
       erase(ix, cands[j]);
     }
   }
@@ -259,6 +296,7 @@ int rtpu_idx_reserve(void* h, const uint8_t* id, uint64_t size,
   s->pin = 0;
   s->size = size;
   s->last_access = hd->clock++;
+  s->ctime_ms = now_ms();
   memcpy(s->id, id, kIdLen);
   hd->used += size;
   hd->live++;
@@ -292,9 +330,11 @@ int rtpu_idx_abort(void* h, const uint8_t* id) {
   return s ? 0 : -1;
 }
 
-// Lookup + LRU touch. Returns 0 sealed (size filled), 1 absent,
-// 2 still creating.
-int rtpu_idx_lookup(void* h, const uint8_t* id, uint64_t* size_out) {
+// Lookup. Returns 0 sealed (size filled), 1 absent, 2 still creating.
+// ``touch`` != 0 refreshes LRU recency — existence probes (contains)
+// pass 0 so polling cannot distort eviction order.
+int rtpu_idx_lookup(void* h, const uint8_t* id, uint64_t* size_out,
+                    int touch) {
   Index* ix = (Index*)h;
   if (lock(ix) != 0) return -4;
   Slot* s = find(ix, id);
@@ -305,7 +345,7 @@ int rtpu_idx_lookup(void* h, const uint8_t* id, uint64_t* size_out) {
     rc = 2;
   } else {
     *size_out = s->size;
-    s->last_access = ix->hdr->clock++;
+    if (touch) s->last_access = ix->hdr->clock++;
     rc = 0;
   }
   unlock(ix);

@@ -57,7 +57,7 @@ def get_lib():
             return None
         lib.rtpu_idx_open.restype = ctypes.c_void_p
         lib.rtpu_idx_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
-                                      ctypes.c_uint64]
+                                      ctypes.c_uint64, ctypes.c_char_p]
         lib.rtpu_idx_close.argtypes = [ctypes.c_void_p]
         lib.rtpu_idx_reserve.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
@@ -66,7 +66,8 @@ def get_lib():
         lib.rtpu_idx_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rtpu_idx_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rtpu_idx_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                        ctypes.POINTER(ctypes.c_uint64)]
+                                        ctypes.POINTER(ctypes.c_uint64),
+                                        ctypes.c_int]
         lib.rtpu_idx_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_int]
         lib.rtpu_idx_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -87,12 +88,18 @@ class NativeIndex:
 
     MAX_VICTIMS = 4096
 
-    def __init__(self, path: str, capacity: int, nslots: int = 1 << 16):
+    def __init__(self, path: str, capacity: int, nslots: int = 1 << 16,
+                 data_dir: Optional[str] = None):
+        """``data_dir``: directory of per-object data files (hex names);
+        when given, eviction unlinks victims' files under the index
+        mutex, closing the evict-vs-recreate race."""
         lib = get_lib()
         if lib is None:
             raise RuntimeError(f"native store unavailable: {_LIB_ERR}")
         self._lib = lib
-        self._h = lib.rtpu_idx_open(path.encode(), capacity, nslots)
+        self._h = lib.rtpu_idx_open(
+            path.encode(), capacity, nslots,
+            data_dir.encode() if data_dir else None)
         if not self._h:
             raise RuntimeError(f"cannot open native index at {path}")
         self._victims = ctypes.create_string_buffer(
@@ -116,10 +123,12 @@ class NativeIndex:
     def abort(self, oid: bytes) -> int:
         return self._lib.rtpu_idx_abort(self._h, oid)
 
-    def lookup(self, oid: bytes) -> Tuple[int, int]:
-        """(state, size): state 0 sealed, 1 absent, 2 creating."""
+    def lookup(self, oid: bytes, touch: bool = True) -> Tuple[int, int]:
+        """(state, size): state 0 sealed, 1 absent, 2 creating.
+        ``touch=False`` for existence probes (no LRU refresh)."""
         size = ctypes.c_uint64(0)
-        rc = self._lib.rtpu_idx_lookup(self._h, oid, ctypes.byref(size))
+        rc = self._lib.rtpu_idx_lookup(self._h, oid, ctypes.byref(size),
+                                       1 if touch else 0)
         return rc, size.value
 
     def pin(self, oid: bytes) -> None:

@@ -153,6 +153,7 @@ class CoreWorker:
         # lineage: resubmittable specs for owned objects (recorded, replayed by
         # the recovery manager milestone)
         self._lineage: Dict[TaskID, TaskSpec] = {}
+        self._runtime_env_cache: Dict[Any, Optional[dict]] = {}
         self._pg_rr = 0  # round-robin over bundles for wildcard PG leases
         self._pg_cache: Dict[Any, list] = {}  # pg_id -> bundle (node, addr)
         # object recovery (ref: object_recovery_manager.h): reconstruction
@@ -556,6 +557,43 @@ class CoreWorker:
         return ResourceSet(res)
 
     # ------------------------------------------------------ normal tasks
+    def _prepare_runtime_env(self, opts: dict) -> Optional[dict]:
+        """Pack a runtime_env option for the wire (ref: runtime envs,
+        SURVEY §2.2). Cached per (env-spec, dir mtimes): re-tarring a
+        working_dir on every one of thousands of submissions would
+        dominate the submit path. The mtime key means edits *inside* an
+        already-uploaded directory tree are only picked up when a
+        top-level entry changes — the reference's URI-cache has the same
+        refresh granularity."""
+        env = opts.get("runtime_env")
+        if not env:
+            return None
+        import json
+        import os as _os
+
+        dirs = [env.get("working_dir") or ""] + list(
+            env.get("py_modules") or [])
+        try:
+            mtimes = tuple(
+                _os.path.getmtime(d) if d else 0.0 for d in dirs)
+        except OSError:
+            mtimes = ()
+        try:
+            cache_key = (json.dumps(env, sort_keys=True, default=str),
+                         mtimes)
+        except TypeError:
+            cache_key = None
+        if cache_key is not None:
+            cached = self._runtime_env_cache.get(cache_key)
+            if cached is not None:
+                return cached
+        from .runtime_env import prepare_runtime_env
+
+        wire = prepare_runtime_env(self, env)
+        if cache_key is not None:
+            self._runtime_env_cache[cache_key] = wire
+        return wire
+
     def submit_task(self, func: Any, args: tuple, kwargs: dict, opts: dict):
         # validate options BEFORE packing args: _pack_args pins dependencies
         # that are only released through the submit coroutine's finally
@@ -581,6 +619,7 @@ class CoreWorker:
             backpressure_items=opts.get(
                 "generator_backpressure_num_objects", 0) or 0,
             owner_address=self.address,
+            runtime_env=self._prepare_runtime_env(opts),
         )
         # registered before the submit coroutine runs, so an immediate
         # cancel() cannot race past the bookkeeping
@@ -988,6 +1027,7 @@ class CoreWorker:
             actor_max_concurrency=opts.get("max_concurrency") or 0,
             actor_name=opts.get("name") or "",
             owner_address=self.address,
+            runtime_env=self._prepare_runtime_env(opts),
         )
         state = _ActorState(actor_id=actor_id)
         state.creation_spec = spec

@@ -48,6 +48,9 @@ class NodeInfo:
     host_index: int = 0
     resource_seq: int = 0     # last-applied availability report sequence
     store_dir: str = ""       # shm namespace (same-host drivers attach to it)
+    # resource shapes of leases queued on this raylet (the autoscaler's
+    # demand signal; ref: autoscaler v2 cluster-status resource demands)
+    pending_demands: list = field(default_factory=list)
 
 
 @dataclass
@@ -322,6 +325,7 @@ class GcsServer:
                 return True  # stale retry of an older report — ignore
             info.resource_seq = seq
             info.resources_available = payload["available"]
+            info.pending_demands = payload.get("pending", [])
             await self._publish("resources", {
                 "node_id": node_id, "available": payload["available"],
             })

@@ -130,6 +130,10 @@ class Node:
                 await self.gcs_server.start()
                 self.gcs_address = self.gcs_server.server.address
                 self.raylet.gcs_address = self.gcs_address
+                # remote joiners (CLI worker nodes) fetch the session
+                # name through the KV instead of a side channel
+                self.gcs_server.storage.put(
+                    "cluster", "session_name", self.session_name.encode())
             await self.raylet.start()
             self.raylet_address = self.raylet.server.address
 

@@ -70,16 +70,17 @@ class SharedObjectStore:
         self._used = 0
         # Native index (C++ shared table, ray_tpu/_native): makes seal
         # state, capacity accounting, pins and LRU order node-global
-        # facts across every process sharing this dir. Pure-Python
-        # per-process accounting remains the fallback.
-        self._idx = None
-        try:
-            from .._native import NativeIndex
+        # facts across every process sharing this dir. Falls back to
+        # pure-Python per-process accounting ONLY when the native lib is
+        # unavailable — a failure to open an index that should exist is
+        # loud, because mixed native/fallback handles on one dir would
+        # fight over eviction authority.
+        from .._native import NativeIndex, native_unavailable_reason
 
-            os.makedirs(self.dir, exist_ok=True)
+        if native_unavailable_reason() is None:
             self._idx = NativeIndex(os.path.join(self.dir, "index.bin"),
-                                    capacity_bytes)
-        except Exception:
+                                    capacity_bytes, data_dir=self.dir)
+        else:
             self._idx = None
 
     # ---- paths ----
@@ -88,12 +89,14 @@ class SharedObjectStore:
 
     # ---- write path ----
     def _reserve_native(self, oid: ObjectID, size: int) -> bool:
-        """Node-global reservation through the C++ index; evicted victims'
-        data files are unlinked here (the index already dropped them).
-        Returns False when the object already exists in the index (a
-        re-create: another process reserved or sealed it) — the caller
-        still writes its own staging file and seal() renames it into
-        place atomically, but this handle does NOT own the reservation."""
+        """Node-global reservation through the C++ index. Victims' data
+        files were already unlinked by the index UNDER ITS MUTEX (no
+        race with a concurrent re-create's seal); here we only drop this
+        process's stale mappings. Returns False when the object already
+        exists in the index (a re-create: another process reserved or
+        sealed it) — the caller still writes its own staging file and
+        seal() renames it into place atomically, but this handle does
+        NOT own the reservation."""
         rc, victims = self._idx.reserve(oid.binary(), size)
         if rc == -2:
             return False
@@ -111,10 +114,6 @@ class SharedObjectStore:
                         entry.mm.close()
                     except BufferError:
                         pass
-            try:
-                os.unlink(self._path(voi))
-            except FileNotFoundError:
-                pass
         return True
 
     def create(self, oid: ObjectID, size: int) -> memoryview:
@@ -251,7 +250,9 @@ class SharedObjectStore:
 
     def contains(self, oid: ObjectID) -> bool:
         if self._idx is not None:
-            return self._idx.lookup(oid.binary())[0] == 0
+            # existence probe: no LRU touch (polling must not distort
+            # node-global eviction order)
+            return self._idx.lookup(oid.binary(), touch=False)[0] == 0
         with self._lock:
             entry = self._entries.get(oid)
             if entry is not None and entry.sealed:

@@ -234,12 +234,16 @@ class Raylet:
         entry = self._remote_nodes.get(node_id)
         if entry is not None:
             self._remote_nodes[node_id] = (entry[0], ResourceSet(avail))
+            if self._pending_leases:  # capacity elsewhere: try spillback
+                asyncio.ensure_future(self._pump_pending())
 
     def _on_node_event(self, payload):
         if payload["event"] == "added":
             info = payload["node"]
             if info.node_id != self.node_id:
                 self._remote_nodes[info.node_id] = (info.address, ResourceSet(info.resources_available))
+                if self._pending_leases:  # a new node may fit queued work
+                    asyncio.ensure_future(self._pump_pending())
         elif payload["event"] == "removed":
             self._remote_nodes.pop(payload.get("node_id"), None)
 
@@ -253,6 +257,9 @@ class Raylet:
             "node_id": self.node_id,
             "available": self.resources.available.to_dict(),
             "seq": self._resource_seq,
+            # queued lease shapes: the autoscaler's scale-up signal
+            "pending": [p.resources.to_dict()
+                        for p in self._pending_leases],
         }
 
         async def _send():
@@ -382,9 +389,11 @@ class Raylet:
         if grant is not None:
             self._record_rid_grant(rid, grant)
             return grant
-        # queue until a worker/resources free up
+        # queue until a worker/resources free up; report immediately so
+        # the GCS (and the autoscaler watching it) sees the new demand
         fut = asyncio.get_event_loop().create_future()
         self._pending_leases.append(_PendingLease(payload, fut, resources))
+        await self._report_resources()
         if rid is not None:
             self._lease_rid_pending[rid] = fut
         try:
@@ -517,6 +526,20 @@ class Raylet:
                         continue
                     grant = await self._try_grant(pending.resources, pending.payload)
                     if grant is None:
+                        # spillback: a node that joined (autoscaler) or
+                        # freed up since this lease queued may fit it now
+                        target = self._pick_node(
+                            pending.resources,
+                            pending.payload.get("strategy"))
+                        if (target is not None and target != self.node_id
+                                and target in self._remote_nodes):
+                            addr, _ = self._remote_nodes[target]
+                            self._pending_leases.pop(i)
+                            if not pending.future.done():
+                                pending.future.set_result(
+                                    {"granted": False,
+                                     "retry_at": (target, addr)})
+                            continue
                         i += 1
                         continue
                     self._pending_leases.pop(i)

@@ -1,0 +1,131 @@
+"""Runtime environments: per-task/actor env_vars, working_dir, py_modules
+(ref: python/ray/_private/runtime_env/ — plugin.py, working_dir.py,
+py_modules; the URI-cached packing model, minus conda/pip which require
+network access).
+
+Packing happens on the submitting driver: directories tar into the GCS
+KV under a content hash (the reference's URI cache — identical dirs
+upload once). Application happens in the executing worker: blobs extract
+under the session dir, keyed by hash, and the process adopts the env
+(env vars exported, working_dir becomes cwd + sys.path head, py_modules
+prepended to sys.path).
+
+Worker-granularity caveat (documented, reference-faithful in spirit):
+the reference dedicates pool workers to one runtime env via lease
+matching; here a shared pool worker adopts the env of the task it
+executes, so mixing different runtime envs in one session works but
+leaks env vars between tasks that share a worker.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import tarfile
+from typing import Any, Dict, List, Optional
+
+_KV_NS = "runtime_envs"
+_ALLOWED = {"env_vars", "working_dir", "py_modules", "config"}
+
+
+def _pack_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        for name in sorted(os.listdir(path)):
+            if name in ("__pycache__",):
+                continue
+            tar.add(os.path.join(path, name), arcname=name)
+    return buf.getvalue()
+
+
+def prepare_runtime_env(core, runtime_env: Optional[dict]) -> Optional[dict]:
+    """Driver side: validate, upload directory payloads, return the wire
+    form stored on the TaskSpec."""
+    if not runtime_env:
+        return None
+    unknown = set(runtime_env) - _ALLOWED
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys: {sorted(unknown)} "
+            f"(supported: {sorted(_ALLOWED)})")
+    wire: Dict[str, Any] = {}
+    hasher = hashlib.sha256()
+    env_vars = runtime_env.get("env_vars") or {}
+    if env_vars:
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in env_vars.items()):
+            raise TypeError("env_vars must be Dict[str, str]")
+        wire["env_vars"] = dict(env_vars)
+        hasher.update(repr(sorted(env_vars.items())).encode())
+
+    def upload(path: str) -> str:
+        path = os.path.abspath(path)
+        if not os.path.isdir(path):
+            raise ValueError(f"runtime_env path {path!r} is not a directory")
+        blob = _pack_dir(path)
+        key = hashlib.sha256(blob).hexdigest()
+        if core.io.run(core.gcs.call(
+                "kv_get", {"ns": _KV_NS, "key": key})) is None:
+            core.io.run(core.gcs.call(
+                "kv_put", {"ns": _KV_NS, "key": key, "value": blob}))
+        hasher.update(key.encode())
+        return key
+
+    if runtime_env.get("working_dir"):
+        hasher.update(b"working_dir:")  # field-tagged: {"working_dir": X}
+        # and {"py_modules": [X]} must hash differently
+        wire["working_dir_key"] = upload(runtime_env["working_dir"])
+    for path in runtime_env.get("py_modules") or []:
+        hasher.update(b"py_module:")
+        wire.setdefault("py_module_keys", []).append(upload(path))
+    if not wire:
+        return None
+    wire["hash"] = hasher.hexdigest()[:16]
+    return wire
+
+
+def apply_runtime_env(core, wire: Optional[dict],
+                      applied: Dict[str, str]) -> None:
+    """Worker side: adopt the env (idempotent per wire-hash; ``applied``
+    is the executor's cache of already-materialized hashes)."""
+    if not wire:
+        return
+    env_hash = wire.get("hash", "")
+    if applied.get("hash") == env_hash:
+        return
+
+    def materialize(key: str) -> str:
+        root = os.path.join("/tmp/ray_tpu_runtime_envs", key)
+        marker = os.path.join(root, ".ready")
+        if not os.path.exists(marker):
+            blob = core.io.run(core.gcs.call(
+                "kv_get", {"ns": _KV_NS, "key": key}))
+            if blob is None:
+                raise RuntimeError(f"runtime_env blob {key} missing from GCS")
+            tmp = root + f".tmp.{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
+            with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
+                tar.extractall(tmp, filter="data")
+            open(os.path.join(tmp, ".ready"), "w").close()
+            try:
+                os.rename(tmp, root)  # atomic; loser cleans up
+            except OSError:
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        return root
+
+    for key, value in (wire.get("env_vars") or {}).items():
+        os.environ[key] = value
+    for key in wire.get("py_module_keys") or []:
+        path = materialize(key)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    if wire.get("working_dir_key"):
+        path = materialize(wire["working_dir_key"])
+        if path not in sys.path:
+            sys.path.insert(0, path)
+        os.chdir(path)
+    applied["hash"] = env_hash

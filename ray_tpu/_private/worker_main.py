@@ -80,6 +80,7 @@ class TaskExecutor:
         self.core = core
         self.raylet = raylet
         self.pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="task_exec")
+        self._applied_env: dict = {}  # runtime-env hash this worker adopted
         # actor runtime
         self.actor_instance: Any = None
         self.actor_id = None
@@ -160,8 +161,14 @@ class TaskExecutor:
         return data
 
     # ------------------------------------------------------------ execution
+    def _ensure_runtime_env(self, spec: TaskSpec) -> None:
+        from .runtime_env import apply_runtime_env
+
+        apply_runtime_env(self.core, spec.runtime_env, self._applied_env)
+
     def execute_normal(self, spec: TaskSpec) -> dict:
         try:
+            self._ensure_runtime_env(spec)
             func = self.core.load_function(spec.function.blob_id)
             args, kwargs = self._resolve_args(spec)
             self.core.set_task_context(spec.task_id)
@@ -217,6 +224,7 @@ class TaskExecutor:
 
         try:
             try:
+                self._ensure_runtime_env(spec)
                 func = self.core.load_function(spec.function.blob_id)
                 args, kwargs = self._resolve_args(spec)
                 self.core.set_task_context(spec.task_id)
@@ -242,6 +250,7 @@ class TaskExecutor:
         try:
             import inspect
 
+            self._ensure_runtime_env(spec)
             cls = self.core.load_function(spec.function.blob_id)
             if hasattr(cls, "__ray_tpu_actor_class__"):
                 cls = cls.__ray_tpu_actor_class__

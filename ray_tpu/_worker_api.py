@@ -63,6 +63,12 @@ def init(
             raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
         if _system_config:
             global_config().apply_overrides(_system_config)
+        # RAY_TPU_ADDRESS: set for job-submission drivers so a bare
+        # init() joins the submitting cluster (ref: RAY_ADDRESS)
+        if address is None:
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
+        if address == "local":
+            address = None
         if address is not None:
             return _connect_to_address(address)
         res = dict(resources or {})
@@ -259,6 +265,7 @@ def nodes() -> List[dict]:
             "Available": n.resources_available,
             "Labels": n.labels,
             "Address": n.address,
+            "PendingDemands": getattr(n, "pending_demands", []),
         }
         for n in infos
     ]

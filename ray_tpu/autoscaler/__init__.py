@@ -1,0 +1,216 @@
+"""Autoscaler: demand-driven node scale-up / idle scale-down
+(ref: python/ray/autoscaler/v2/ — autoscaler.py:42 Autoscaler,
+v2/scheduler.py demand binpacking, v2/instance_manager/; SURVEY §2.2).
+
+The demand signal is the queued-lease shapes every raylet reports with
+its resource heartbeats (GcsServer NodeInfo.pending_demands) plus
+explicit ``request_resources`` bundles in the GCS KV. Providers abstract
+"where nodes come from": the in-process provider backs tests and
+single-host elasticity; a cloud/pod provider implements the same three
+methods against its control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_KV_NS = "autoscaler"
+_REQUESTS_KEY = "explicit_requests"
+
+
+def request_resources(*, num_cpus: Optional[float] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None) -> None:
+    """Pin a demand floor (ref: ray.autoscaler.sdk.request_resources):
+    the autoscaler scales as if these bundles were always queued."""
+    from .. import _worker_api
+
+    shapes: List[Dict[str, float]] = list(bundles or [])
+    if num_cpus:
+        shapes.append({"CPU": float(num_cpus)})
+    core = _worker_api.core()
+    core.io.run(core.gcs.call("kv_put", {
+        "ns": _KV_NS, "key": _REQUESTS_KEY,
+        "value": json.dumps(shapes).encode()}))
+
+
+class NodeProvider:
+    """Minimal provider surface (ref: autoscaler/node_provider.py)."""
+
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        raise NotImplementedError
+
+    def terminate_node(self, handle: Any) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[Any]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Adds/removes in-process worker nodes on the current cluster —
+    the cluster_utils-backed provider used by tests and the fake
+    multi-node mode (ref: autoscaler/_private/fake_multi_node)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster  # ray_tpu.cluster_utils.Cluster
+        self._nodes: List[Any] = []
+
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        node = self.cluster.add_node(resources=dict(resources))
+        self._nodes.append(node)
+        return node
+
+    def terminate_node(self, handle: Any) -> None:
+        if handle in self._nodes:
+            self._nodes.remove(handle)
+        self.cluster.remove_node(handle, allow_graceful=True)
+
+    def non_terminated_nodes(self) -> List[Any]:
+        return list(self._nodes)
+
+
+@dataclass
+class AutoscalerConfig:
+    worker_resources: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1.0})
+    max_workers: int = 8
+    min_workers: int = 0
+    idle_timeout_s: float = 30.0
+    reconcile_interval_s: float = 1.0
+
+
+class Autoscaler:
+    """One reconcile loop: pending demands -> launch; idle -> terminate.
+
+    Runs wherever the head runs (a thread here; the reference runs it in
+    the monitor process). Call update() manually in tests, or start().
+    """
+
+    def __init__(self, provider: NodeProvider,
+                 config: Optional[AutoscalerConfig] = None):
+        self.provider = provider
+        self.config = config or AutoscalerConfig()
+        self._idle_since: Dict[str, float] = {}
+        self._handle_by_node_id: Dict[str, Any] = {}
+        self._launched = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # --- cluster view ---
+
+    def _nodes(self) -> List[dict]:
+        from .. import nodes
+
+        return nodes()
+
+    def _explicit_requests(self) -> List[Dict[str, float]]:
+        from .. import _worker_api
+
+        core = _worker_api.core()
+        raw = core.io.run(core.gcs.call(
+            "kv_get", {"ns": _KV_NS, "key": _REQUESTS_KEY}))
+        return json.loads(raw) if raw else []
+
+    @staticmethod
+    def _fits(shape: Dict[str, float], avail: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) >= v for k, v in shape.items())
+
+    # --- one reconcile round ---
+
+    def update(self) -> Dict[str, int]:
+        """Returns {"launched": n, "terminated": m} for observability."""
+        view = [n for n in self._nodes() if n["Alive"]]
+        launched = terminated = 0
+
+        # 1. collect unmet demand: queued lease shapes + explicit floor
+        demands: List[Dict[str, float]] = []
+        for n in view:
+            demands.extend(n.get("PendingDemands", []))
+        demands.extend(self._explicit_requests())
+        # simulate packing demands onto current availability; whatever
+        # doesn't fit drives scale-up (ref: v2/scheduler.py binpacking)
+        avails = [dict(n["Available"]) for n in view]
+        unmet: List[Dict[str, float]] = []
+        for shape in demands:
+            placed = False
+            for av in avails:
+                if self._fits(shape, av):
+                    for k, v in shape.items():
+                        av[k] = av.get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(shape)
+
+        # bin-pack the unmet shapes onto hypothetical new worker nodes
+        # of the configured template; launch exactly that many
+        workers = self.provider.non_terminated_nodes()
+        planned: List[Dict[str, float]] = []
+        for shape in unmet:
+            if not self._fits(shape, self.config.worker_resources):
+                continue  # can never fit on this worker type
+            for av in planned:
+                if self._fits(shape, av):
+                    for k, v in shape.items():
+                        av[k] = av.get(k, 0.0) - v
+                    break
+            else:
+                if len(workers) + len(planned) >= self.config.max_workers:
+                    break
+                av = dict(self.config.worker_resources)
+                for k, v in shape.items():
+                    av[k] = av.get(k, 0.0) - v
+                planned.append(av)
+        for _ in planned:
+            self.provider.create_node(dict(self.config.worker_resources))
+            launched += 1
+
+        # 2. idle scale-down (never below min_workers; never the head)
+        now = time.monotonic()
+        provider_nodes = self.provider.non_terminated_nodes()
+        by_id = {getattr(h, "node_id", None) and h.node_id.hex(): h
+                 for h in provider_nodes}
+        for n in view:
+            handle = by_id.get(n["NodeID"])
+            if handle is None:
+                continue  # head or externally-managed node
+            idle = (n["Available"] == n["Resources"]
+                    and not n.get("PendingDemands"))
+            if not idle:
+                self._idle_since.pop(n["NodeID"], None)
+                continue
+            since = self._idle_since.setdefault(n["NodeID"], now)
+            if (now - since >= self.config.idle_timeout_s
+                    and len(provider_nodes) - terminated
+                    > self.config.min_workers):
+                self.provider.terminate_node(handle)
+                self._idle_since.pop(n["NodeID"], None)
+                terminated += 1
+        return {"launched": launched, "terminated": terminated}
+
+    # --- background loop ---
+
+    def start(self) -> None:
+        def _loop():
+            while not self._stop.wait(self.config.reconcile_interval_s):
+                try:
+                    self.update()
+                except Exception:
+                    pass  # a transient RPC failure must not kill the loop
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="ray_tpu_autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "NodeProvider",
+           "LocalNodeProvider", "request_resources"]

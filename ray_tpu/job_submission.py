@@ -1,0 +1,221 @@
+"""Job submission: run an entrypoint command on the cluster under a
+supervisor actor (ref: python/ray/dashboard/modules/job/ —
+JobSubmissionClient sdk.py:35, submit_job:125, job supervisor/manager;
+the REST head is replaced by direct GCS-backed state + a detached
+supervisor actor, which fits the socket-RPC control plane).
+
+Status lives in the GCS KV (ns "jobs"), so any driver on the cluster can
+list/poll jobs regardless of which driver submitted them and whether the
+submitter is still alive (supervisors are detached).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+_NS = "jobs"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclass
+class JobInfo:
+    submission_id: str
+    status: str
+    entrypoint: str
+    message: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "JobInfo":
+        return cls(**json.loads(raw))
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+
+class _JobSupervisor:
+    """Detached actor owning one job subprocess (ref: job supervisor
+    actor in dashboard/modules/job/job_manager.py)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 env_vars: Optional[Dict[str, str]] = None):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.env_vars = env_vars or {}
+        self.log_path = os.path.join(
+            "/tmp/ray_tpu_jobs", f"{submission_id}.log")
+        os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+        self._proc: Optional[subprocess.Popen] = None
+        self._thread: Optional[threading.Thread] = None
+        self._status = JobStatus.PENDING
+        self._message = ""
+        self._start = 0.0
+        self._end = 0.0
+
+    def _put_status(self) -> None:
+        from . import _worker_api
+
+        info = JobInfo(self.submission_id, self._status, self.entrypoint,
+                       self._message, self._start, self._end)
+        core = _worker_api.core()
+        core.io.run(core.gcs.call("kv_put", {
+            "ns": _NS, "key": self.submission_id, "value": info.to_json()}))
+
+    def start(self) -> bool:
+        env = dict(os.environ)
+        env.update(self.env_vars)
+        # the job's driver joins THIS cluster
+        from . import _worker_api
+
+        core = _worker_api.core()
+        env["RAY_TPU_ADDRESS"] = core.gcs.address
+        self._start = time.time()
+        self._status = JobStatus.RUNNING
+        self._put_status()
+        log = open(self.log_path, "wb")
+        self._proc = subprocess.Popen(
+            self.entrypoint, shell=True, stdout=log, stderr=log, env=env,
+            start_new_session=True)
+
+        def _wait():
+            rc = self._proc.wait()
+            log.close()
+            self._end = time.time()
+            if self._status != JobStatus.STOPPED:
+                self._status = (JobStatus.SUCCEEDED if rc == 0
+                                else JobStatus.FAILED)
+                self._message = f"exit code {rc}"
+            self._put_status()
+
+        self._thread = threading.Thread(target=_wait, daemon=True)
+        self._thread.start()
+        return True
+
+    def stop(self) -> bool:
+        if self._proc is not None and self._proc.poll() is None:
+            self._status = JobStatus.STOPPED
+            self._message = "stopped by user"
+            try:
+                os.killpg(os.getpgid(self._proc.pid), 15)
+            except ProcessLookupError:
+                pass
+        return True
+
+    def logs(self, tail_bytes: int = 1 << 20) -> bytes:
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read()
+        except FileNotFoundError:
+            return b""
+
+    def ping(self) -> bool:
+        return True
+
+
+class JobSubmissionClient:
+    """Submit/inspect jobs (ref: sdk.py:35 JobSubmissionClient). The
+    ``address`` is the cluster GCS address; constructing the client
+    attaches this process as a driver if it isn't one already."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address or
+                         os.environ.get("RAY_TPU_ADDRESS"))
+
+    def _kv(self, method: str, payload: dict):
+        from . import _worker_api
+
+        core = _worker_api.core()
+        return core.io.run(core.gcs.call(method, payload))
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None) -> str:
+        import ray_tpu
+
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        if self._kv("kv_get", {"ns": _NS, "key": submission_id}) is not None:
+            raise ValueError(f"job {submission_id!r} already exists")
+        env_vars = (runtime_env or {}).get("env_vars") or {}
+        info = JobInfo(submission_id, JobStatus.PENDING, entrypoint)
+        self._kv("kv_put", {"ns": _NS, "key": submission_id,
+                            "value": info.to_json()})
+        supervisor = ray_tpu.remote(_JobSupervisor).options(
+            name=f"_job_supervisor:{submission_id}",
+            lifetime="detached", num_cpus=0.1,
+        ).remote(submission_id, entrypoint, env_vars)
+        ray_tpu.get(supervisor.start.remote(), timeout=60)
+        return submission_id
+
+    def _supervisor(self, submission_id: str):
+        import ray_tpu
+
+        return ray_tpu.get_actor(f"_job_supervisor:{submission_id}")
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id).status
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        raw = self._kv("kv_get", {"ns": _NS, "key": submission_id})
+        if raw is None:
+            raise ValueError(f"no such job {submission_id!r}")
+        return JobInfo.from_json(raw)
+
+    def list_jobs(self) -> List[JobInfo]:
+        keys = self._kv("kv_keys", {"ns": _NS}) or []
+        out = []
+        for key in keys:
+            raw = self._kv("kv_get", {"ns": _NS, "key": key})
+            if raw:
+                out.append(JobInfo.from_json(raw))
+        return sorted(out, key=lambda j: j.start_time)
+
+    def get_job_logs(self, submission_id: str) -> str:
+        import ray_tpu
+
+        sup = self._supervisor(submission_id)
+        return ray_tpu.get(sup.logs.remote(), timeout=60).decode(
+            errors="replace")
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+
+        sup = self._supervisor(submission_id)
+        return ray_tpu.get(sup.stop.remote(), timeout=60)
+
+    def tail_job_logs(self, submission_id: str, *, poll_s: float = 0.5):
+        """Generator yielding log increments until the job terminates."""
+        offset = 0
+        while True:
+            text = self.get_job_logs(submission_id)
+            if len(text) > offset:
+                yield text[offset:]
+                offset = len(text)
+            if self.get_job_status(submission_id) in JobStatus.TERMINAL:
+                text = self.get_job_logs(submission_id)
+                if len(text) > offset:
+                    yield text[offset:]
+                return
+            time.sleep(poll_s)

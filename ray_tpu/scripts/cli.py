@@ -1,0 +1,310 @@
+"""ray-tpu CLI (ref: python/ray/scripts/scripts.py — `ray start/stop/
+status` + dashboard/modules/job/cli.py — `ray job submit/...`; SURVEY
+§1 L8). argparse instead of click; same verbs.
+
+    python -m ray_tpu.scripts.cli start --head --port 6380
+    python -m ray_tpu.scripts.cli start --address HOST:PORT
+    python -m ray_tpu.scripts.cli status [--address ...]
+    python -m ray_tpu.scripts.cli stop
+    python -m ray_tpu.scripts.cli job submit [--address ...] -- CMD...
+    python -m ray_tpu.scripts.cli job {list,status,logs,stop} ...
+    python -m ray_tpu.scripts.cli state {nodes,actors,tasks,objects}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+_RUN_DIR = "/tmp/ray_tpu"
+_ADDR_FILE = os.path.join(_RUN_DIR, "current_address")
+
+
+def _write_runfile(address: str, pid: int) -> None:
+    os.makedirs(_RUN_DIR, exist_ok=True)
+    with open(_ADDR_FILE, "w") as f:
+        json.dump({"address": address, "pid": pid}, f)
+
+
+def _read_runfile() -> Optional[dict]:
+    try:
+        with open(_ADDR_FILE) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _resolve_address(args) -> str:
+    if getattr(args, "address", None):
+        return args.address
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    run = _read_runfile()
+    if run:
+        return run["address"]
+    raise SystemExit("no cluster address: pass --address, set "
+                     "RAY_TPU_ADDRESS, or `start --head` on this host")
+
+
+# ------------------------------------------------------------------ start
+
+def cmd_start(args) -> int:
+    if args.block:
+        return _start_blocking(args)
+    # detach: re-exec ourselves with --block in a new session, wait for
+    # the address file (ref: `ray start` daemonization)
+    os.makedirs(_RUN_DIR, exist_ok=True)
+    if os.path.exists(_ADDR_FILE):
+        os.unlink(_ADDR_FILE)
+    cmd = [sys.executable, "-m", "ray_tpu.scripts.cli", "start", "--block"]
+    for flag in ("head",):
+        if getattr(args, flag):
+            cmd.append(f"--{flag}")
+    if args.address:
+        cmd += ["--address", args.address]
+    if args.port is not None:
+        cmd += ["--port", str(args.port)]
+    if args.num_cpus is not None:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.object_store_memory is not None:
+        cmd += ["--object-store-memory", str(args.object_store_memory)]
+    proc = subprocess.Popen(cmd, start_new_session=True,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        run = _read_runfile()
+        if run and run.get("pid") == proc.pid:
+            print(f"started: {run['address']} (pid {proc.pid})")
+            if args.head:
+                print(f"join workers with:\n  python -m ray_tpu.scripts.cli "
+                      f"start --address {run['address']}")
+            return 0
+        if proc.poll() is not None:
+            raise SystemExit(f"node process exited rc={proc.returncode}")
+        time.sleep(0.1)
+    raise SystemExit("timed out waiting for the node to come up")
+
+
+def _start_blocking(args) -> int:
+    from ray_tpu._private.node import Node, default_resources
+
+    resources = default_resources()
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.head:
+        node = Node(head=True, port=args.port if args.port is not None else 0,
+                    resources=resources, node_ip=args.node_ip,
+                    object_store_memory=args.object_store_memory)
+    else:
+        if not args.address:
+            raise SystemExit("worker start needs --address HOST:PORT")
+        # session name rides the GCS KV (written at head start)
+        from ray_tpu._private.rpc import RpcClient
+
+        client = RpcClient(args.address)
+        import asyncio
+
+        async def _session():
+            await client.connect()
+            raw = await client.call(
+                "kv_get", {"ns": "cluster", "key": "session_name"})
+            await client.close()
+            if raw is None:
+                raise SystemExit(f"no cluster at {args.address}")
+            return raw.decode()
+
+        session = asyncio.run(_session())
+        node = Node(head=False, session_name=session,
+                    gcs_address=args.address, resources=resources,
+                    node_ip=args.node_ip,
+                    object_store_memory=args.object_store_memory)
+    node.start()
+    address = node.gcs_address if args.head else args.address
+    if args.head and address.startswith("0.0.0.0"):
+        address = f"{node.node_ip}:{address.rsplit(':', 1)[1]}"
+    _write_runfile(address, os.getpid())
+    print(f"node up: {address}", flush=True)
+    stop = {"flag": False}
+
+    def _sig(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    while not stop["flag"]:
+        time.sleep(0.2)
+    node.stop()
+    return 0
+
+
+def cmd_stop(args) -> int:
+    run = _read_runfile()
+    if not run:
+        print("no tracked node on this host")
+        return 0
+    try:
+        os.kill(run["pid"], signal.SIGTERM)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                os.kill(run["pid"], 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        print(f"stopped pid {run['pid']}")
+    except ProcessLookupError:
+        print(f"pid {run['pid']} already gone")
+    try:
+        os.unlink(_ADDR_FILE)
+    except FileNotFoundError:
+        pass
+    return 0
+
+
+# ------------------------------------------------------------------ status
+
+def cmd_status(args) -> int:
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+    nodes = ray_tpu.nodes()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print(f"nodes: {len(nodes)}")
+    for n in nodes:
+        state = "ALIVE" if n.get("Alive", True) else "DEAD"
+        print(f"  {n['NodeID'][:16]}  {state}  {n.get('Resources', {})}")
+    print("resources:")
+    for key in sorted(total):
+        print(f"  {key}: {avail.get(key, 0):g}/{total[key]:g} available")
+    ray_tpu.shutdown()
+    return 0
+
+
+# ------------------------------------------------------------------ jobs
+
+def cmd_job(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    if args.job_cmd == "submit":
+        entrypoint = " ".join(args.entrypoint)
+        runtime_env = None
+        if args.env_json:
+            runtime_env = json.loads(args.env_json)
+        sid = client.submit_job(entrypoint=entrypoint,
+                                submission_id=args.submission_id,
+                                runtime_env=runtime_env)
+        print(sid)
+        if args.follow:
+            for chunk in client.tail_job_logs(sid):
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+            status = client.get_job_status(sid)
+            print(f"\njob {sid}: {status}")
+            return 0 if status == "SUCCEEDED" else 1
+    elif args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(f"{info.submission_id}  {info.status:9s}  "
+                  f"{info.entrypoint}")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.submission_id))
+    elif args.job_cmd == "stop":
+        client.stop_job(args.submission_id)
+        print("stopped")
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    return 0
+
+
+# ------------------------------------------------------------------ state
+
+def cmd_state(args) -> int:
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(address=_resolve_address(args))
+    fn = {
+        "nodes": state_api.list_nodes,
+        "actors": state_api.list_actors,
+        "tasks": state_api.list_tasks,
+        "objects": state_api.list_objects,
+    }[args.kind]
+    for row in fn():
+        print(json.dumps(row, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
+# ------------------------------------------------------------------ main
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None,
+                    help="existing cluster GCS (worker mode)")
+    sp.add_argument("--port", type=int, default=None,
+                    help="head GCS TCP port (default ephemeral)")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--object-store-memory", type=int, default=None)
+    sp.add_argument("--node-ip", default=None)
+    sp.add_argument("--block", action="store_true",
+                    help="run in the foreground")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the node started on this host")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster nodes + resources")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("job")
+    sp.add_argument("--address", default=None)
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--submission-id", default=None)
+    js.add_argument("--env-json", default=None,
+                    help='runtime env, e.g. \'{"env_vars":{"A":"1"}}\'')
+    js.add_argument("--follow", action="store_true",
+                    help="stream logs until the job finishes")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="command to run (prefix with --)")
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("submission_id")
+    jsub.add_parser("list")
+    sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("state")
+    sp.add_argument("kind", choices=["nodes", "actors", "tasks", "objects"])
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_state)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if getattr(args, "entrypoint", None):
+        # strip the leading "--" REMAINDER keeps
+        if args.entrypoint and args.entrypoint[0] == "--":
+            args.entrypoint = args.entrypoint[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,225 @@
+"""Cluster services: runtime envs, job submission, CLI, autoscaler
+(ref: python/ray/tests/test_runtime_env*.py, dashboard job tests,
+test_cli.py, autoscaler v2 tests)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+CLI = [sys.executable, "-m", "ray_tpu.scripts.cli"]
+
+
+@pytest.fixture
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ runtime envs
+
+def test_runtime_env_env_vars(ray_cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "42"}})
+    def read_env():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "42"
+
+
+def test_runtime_env_py_modules(ray_cluster, tmp_path):
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir()
+    (mod_dir / "rtpu_testmod.py").write_text("MAGIC = 'from-py-module'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_module():
+        import rtpu_testmod
+
+        return rtpu_testmod.MAGIC
+
+    assert ray_tpu.get(use_module.remote(), timeout=60) == "from-py-module"
+
+
+def test_runtime_env_working_dir(ray_cluster, tmp_path):
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "data.txt").write_text("working-dir-payload")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_file.remote(), timeout=60) == "working-dir-payload"
+
+
+def test_runtime_env_on_actor(ray_cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_ACTOR_ENV": "yes"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("RTPU_ACTOR_ENV")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "yes"
+
+
+def test_runtime_env_rejects_unknown_keys(ray_cluster):
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        @ray_tpu.remote(runtime_env={"conda": "env"})
+        def f():
+            return 1
+
+        f.remote()
+
+
+# ------------------------------------------------------------ job submission
+
+def test_job_submit_roundtrip(ray_cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=(f"{sys.executable} -c \"import os; "
+                    f"print('job says', os.environ.get('J_VAR'))\""),
+        runtime_env={"env_vars": {"J_VAR": "hello"}})
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.get_job_status(sid) in JobStatus.TERMINAL:
+            break
+        time.sleep(0.2)
+    assert client.get_job_status(sid) == JobStatus.SUCCEEDED
+    assert "job says hello" in client.get_job_logs(sid)
+    jobs = client.list_jobs()
+    assert any(j.submission_id == sid for j in jobs)
+
+
+def test_job_driver_joins_cluster(ray_cluster, tmp_path):
+    """The job's entrypoint uses a bare ray_tpu.init() and lands on the
+    SAME cluster (RAY_TPU_ADDRESS injection)."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "info = ray_tpu.init()\n"
+        "@ray_tpu.remote\n"
+        "def f(): return sum(range(10))\n"
+        "print('result', ray_tpu.get(f.remote(), timeout=60))\n"
+    )
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        if client.get_job_status(sid) in JobStatus.TERMINAL:
+            break
+        time.sleep(0.2)
+    logs = client.get_job_logs(sid)
+    assert client.get_job_status(sid) == JobStatus.SUCCEEDED, logs
+    assert "result 45" in logs
+
+
+def test_job_stop(ray_cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    time.sleep(1.0)
+    client.stop_job(sid)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client.get_job_status(sid) in JobStatus.TERMINAL:
+            break
+        time.sleep(0.2)
+    assert client.get_job_status(sid) == JobStatus.STOPPED
+
+
+# ------------------------------------------------------------ CLI
+
+def test_cli_start_status_worker_stop(tmp_path):
+    env = {**os.environ, "RAY_TPU_NATIVE_STORE": "1"}
+    env.pop("RAY_TPU_ADDRESS", None)
+    head = subprocess.run(
+        CLI + ["start", "--head", "--num-cpus", "2"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert head.returncode == 0, head.stderr
+    address = head.stdout.split("started: ")[1].split(" ")[0].strip()
+    try:
+        # worker joins over TCP
+        worker = subprocess.run(
+            CLI + ["start", "--address", address, "--num-cpus", "3"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert worker.returncode == 0, worker.stderr
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status = subprocess.run(
+                CLI + ["status", "--address", address],
+                capture_output=True, text=True, timeout=120, env=env)
+            if status.returncode == 0 and "nodes: 2" in status.stdout:
+                break
+            time.sleep(0.5)
+        assert "nodes: 2" in status.stdout, status.stdout + status.stderr
+        assert "CPU: 5/5 available" in status.stdout
+
+        # a driver can join and run work across the CLI-started cluster
+        ray_tpu.init(address=address)
+        @ray_tpu.remote
+        def who():
+            return os.getpid()
+        pids = set(ray_tpu.get([who.remote() for _ in range(8)], timeout=120))
+        assert pids
+        ray_tpu.shutdown()
+    finally:
+        subprocess.run(CLI + ["stop"], capture_output=True, timeout=60,
+                       env=env)
+
+
+# ------------------------------------------------------------ autoscaler
+
+def test_autoscaler_scales_up_and_down():
+    from ray_tpu.autoscaler import (
+        Autoscaler, AutoscalerConfig, LocalNodeProvider)
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"resources": {"CPU": 1}})
+    cluster.connect()
+    try:
+        provider = LocalNodeProvider(cluster)
+        scaler = Autoscaler(provider, AutoscalerConfig(
+            worker_resources={"CPU": 2.0}, max_workers=2,
+            idle_timeout_s=1.0))
+
+        # saturate the head, then demand more than it has
+        @ray_tpu.remote(num_cpus=2)
+        def heavy():
+            return os.getpid()
+
+        ref = heavy.remote()  # cannot fit on the 1-CPU head: queues
+        deadline = time.time() + 20
+        launched = 0
+        while time.time() < deadline and launched == 0:
+            time.sleep(0.5)   # raylet heartbeat must carry the demand
+            launched = scaler.update()["launched"]
+        assert launched == 1
+        assert ray_tpu.get(ref, timeout=60) > 0
+        assert len(provider.non_terminated_nodes()) == 1
+
+        # idle: the worker scales back down after the timeout
+        deadline = time.time() + 30
+        terminated = 0
+        while time.time() < deadline and terminated == 0:
+            time.sleep(0.5)
+            terminated = scaler.update()["terminated"]
+        assert terminated == 1
+        assert provider.non_terminated_nodes() == []
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
