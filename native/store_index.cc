@@ -262,8 +262,10 @@ int rtpu_idx_reserve(void* h, const uint8_t* id, uint64_t size,
     for (uint64_t i = 0; i < hd->nslots; ++i) {
       Slot* c = &ix->slots[i];
       if (c->state == kSealed && c->pin == 0) cands.push_back(c);
-      // a creation whose owner died mid-write: reclaimable garbage
-      else if (c->state == kCreating
+      // a creation whose owner died mid-write: reclaimable garbage.
+      // now > ctime guard: a backward wall-clock step must not wrap
+      // the unsigned diff and reclaim a LIVE in-progress creation
+      else if (c->state == kCreating && now > c->ctime_ms
                && now - c->ctime_ms > kStaleCreatingMs)
         cands.push_back(c);
     }

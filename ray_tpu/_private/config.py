@@ -31,6 +31,7 @@ def _coerce(value: str, ty: type) -> Any:
 class Config:
     # --- scheduling ---
     scheduler_spread_threshold: float = 0.5   # hybrid policy: pack below, spread above
+    lease_spill_min_queue_s: float = 0.5      # queued-lease settle time before spillback
     scheduler_top_k_fraction: float = 0.2     # top-k random choice among best nodes
     max_pending_lease_requests_per_scheduling_class: int = 10
     worker_lease_timeout_ms: int = 500

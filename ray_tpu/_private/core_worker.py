@@ -583,10 +583,8 @@ class CoreWorker:
                          mtimes)
         except TypeError:
             cache_key = None
-        if cache_key is not None:
-            cached = self._runtime_env_cache.get(cache_key)
-            if cached is not None:
-                return cached
+        if cache_key is not None and cache_key in self._runtime_env_cache:
+            return self._runtime_env_cache[cache_key]  # may be None
         from .runtime_env import prepare_runtime_env
 
         wire = prepare_runtime_env(self, env)
@@ -758,11 +756,17 @@ class CoreWorker:
                 address = await self._pg_bundle_address(strategy)
                 raylet = await self._raylet_client_for(address)
             try:
-                for _ in range(16):  # bounded spillback chain
+                for hop in range(16):  # bounded spillback chain
                     if info is not None:
                         # remembered so cancel() can reach the raylet
                         # currently queueing this lease request
                         info["lease_raylet"] = raylet
+                    if hop == 15:
+                        # mutually-stale availability views can bounce a
+                        # lease between saturated raylets; pin it to the
+                        # current raylet's queue instead of erroring (it
+                        # waits exactly as it would have pre-spillback)
+                        payload["no_spill"] = True
                     reply = await self._lease_call(raylet, payload)
                     if reply.get("granted"):
                         reply["_raylet"] = raylet

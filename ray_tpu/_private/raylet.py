@@ -67,6 +67,7 @@ class _PendingLease:
     payload: dict
     future: asyncio.Future
     resources: ResourceSet
+    queued_at: float = 0.0  # monotonic; damps queue->spillback bouncing
 
 
 class NodeResources:
@@ -377,7 +378,8 @@ class Raylet:
                 return await pending
         resources = ResourceSet(payload.get("resources", {}))
         strategy = payload.get("strategy")
-        target = self._pick_node(resources, strategy)
+        target = (None if payload.get("no_spill")
+                  else self._pick_node(resources, strategy))
         if target is not None and target != self.node_id:
             addr, _ = self._remote_nodes[target]
             return {"granted": False, "retry_at": (target, addr)}
@@ -392,7 +394,9 @@ class Raylet:
         # queue until a worker/resources free up; report immediately so
         # the GCS (and the autoscaler watching it) sees the new demand
         fut = asyncio.get_event_loop().create_future()
-        self._pending_leases.append(_PendingLease(payload, fut, resources))
+        self._pending_leases.append(
+            _PendingLease(payload, fut, resources,
+                          queued_at=time.monotonic()))
         await self._report_resources()
         if rid is not None:
             self._lease_rid_pending[rid] = fut
@@ -527,7 +531,16 @@ class Raylet:
                     grant = await self._try_grant(pending.resources, pending.payload)
                     if grant is None:
                         # spillback: a node that joined (autoscaler) or
-                        # freed up since this lease queued may fit it now
+                        # freed up since this lease queued may fit it
+                        # now. Damped: never for no_spill leases (chain
+                        # cap reached) and only after a settle period so
+                        # two saturated raylets with stale views of each
+                        # other don't bounce a lease back and forth.
+                        if (pending.payload.get("no_spill")
+                                or time.monotonic() - pending.queued_at
+                                < self.cfg.lease_spill_min_queue_s):
+                            i += 1
+                            continue
                         target = self._pick_node(
                             pending.resources,
                             pending.payload.get("strategy"))

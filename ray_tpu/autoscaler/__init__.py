@@ -169,7 +169,25 @@ class Autoscaler:
             self.provider.create_node(dict(self.config.worker_resources))
             launched += 1
 
-        # 2. idle scale-down (never below min_workers; never the head)
+        # 2. idle scale-down (never below min_workers; never the head;
+        # never below the node count the explicit-request floor packs
+        # onto — terminating those would flap: relaunch next round)
+        floor_nodes = 0
+        floor_avail: List[Dict[str, float]] = []
+        for shape in self._explicit_requests():
+            if not self._fits(shape, self.config.worker_resources):
+                continue
+            for av in floor_avail:
+                if self._fits(shape, av):
+                    for k, v in shape.items():
+                        av[k] = av.get(k, 0.0) - v
+                    break
+            else:
+                av = dict(self.config.worker_resources)
+                for k, v in shape.items():
+                    av[k] = av.get(k, 0.0) - v
+                floor_avail.append(av)
+        floor_nodes = len(floor_avail)
         now = time.monotonic()
         provider_nodes = self.provider.non_terminated_nodes()
         by_id = {getattr(h, "node_id", None) and h.node_id.hex(): h
@@ -186,7 +204,7 @@ class Autoscaler:
             since = self._idle_since.setdefault(n["NodeID"], now)
             if (now - since >= self.config.idle_timeout_s
                     and len(provider_nodes) - terminated
-                    > self.config.min_workers):
+                    > max(self.config.min_workers, floor_nodes)):
                 self.provider.terminate_node(handle)
                 self._idle_since.pop(n["NodeID"], None)
                 terminated += 1

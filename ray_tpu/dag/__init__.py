@@ -12,6 +12,7 @@ from .nodes import (
     ClassNode,
     CollectiveNode,
     DAGNode,
+    FunctionNode,
     InputAttributeNode,
     InputNode,
     MultiOutputNode,
@@ -20,6 +21,6 @@ from .nodes import (
 
 __all__ = [
     "DAGNode", "InputNode", "InputAttributeNode", "AttributeNode",
-    "ClassMethodNode", "ClassNode", "MultiOutputNode", "CollectiveNode",
-    "collective", "CompiledDAG", "CompiledDAGRef",
+    "ClassMethodNode", "ClassNode", "FunctionNode", "MultiOutputNode",
+    "CollectiveNode", "collective", "CompiledDAG", "CompiledDAGRef",
 ]

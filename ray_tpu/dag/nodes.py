@@ -78,6 +78,17 @@ class ClassMethodNode(DAGNode):
         self.options = options
 
 
+class FunctionNode(DAGNode):
+    """remote_fn.bind(*args) (ref: function_node.py FunctionNode) —
+    interpreted/workflow execution only (compiled DAGs are actor
+    pipelines)."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+
+
 class ClassNode(DAGNode):
     """ActorClass.bind(...): lazily-created actor in a DAG
     (ref: class_node.py ClassNode). Interpreted-only convenience: the
@@ -189,6 +200,13 @@ def _exec_interpreted(node: DAGNode, args: tuple, kwargs: dict,
                        for k, v in node.kwargs.items()}
         method = ActorMethod(node.handle, node.method_name, node.options)
         result = method.remote(*call_args, **call_kwargs)
+    elif isinstance(node, FunctionNode):
+        call_args = [_exec_interpreted(a, args, kwargs, cache)
+                     if isinstance(a, DAGNode) else a for a in node.args]
+        call_kwargs = {k: _exec_interpreted(v, args, kwargs, cache)
+                       if isinstance(v, DAGNode) else v
+                       for k, v in node.kwargs.items()}
+        result = node.remote_fn.remote(*call_args, **call_kwargs)
     elif isinstance(node, CollectiveNode):
         from .. import get, put
 

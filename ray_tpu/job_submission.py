@@ -128,6 +128,16 @@ class _JobSupervisor:
         except FileNotFoundError:
             return b""
 
+    def read_from(self, offset: int, limit: int = 1 << 20) -> bytes:
+        """Absolute-offset read (log followers track a file offset, so
+        output beyond any tail window is never dropped or garbled)."""
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(offset)
+                return f.read(limit)
+        except FileNotFoundError:
+            return b""
+
     def ping(self) -> bool:
         return True
 
@@ -158,6 +168,15 @@ class JobSubmissionClient:
         submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
         if self._kv("kv_get", {"ns": _NS, "key": submission_id}) is not None:
             raise ValueError(f"job {submission_id!r} already exists")
+        unsupported = set(runtime_env or {}) - {"env_vars"}
+        if unsupported:
+            # silently running without the requested working_dir/modules
+            # would fail far from the cause; tasks/actors support the
+            # full runtime_env — the job subprocess supports env_vars
+            raise ValueError(
+                f"job runtime_env supports only 'env_vars' "
+                f"(got {sorted(unsupported)}); use task/actor "
+                f"runtime_env inside the job for working_dir/py_modules")
         env_vars = (runtime_env or {}).get("env_vars") or {}
         info = JobInfo(submission_id, JobStatus.PENDING, entrypoint)
         self._kv("kv_put", {"ns": _NS, "key": submission_id,
@@ -206,16 +225,27 @@ class JobSubmissionClient:
         return ray_tpu.get(sup.stop.remote(), timeout=60)
 
     def tail_job_logs(self, submission_id: str, *, poll_s: float = 0.5):
-        """Generator yielding log increments until the job terminates."""
+        """Generator yielding log increments until the job terminates.
+        Follows an absolute file offset, so logs larger than any tail
+        window stream completely."""
+        import ray_tpu
+
+        sup = self._supervisor(submission_id)
         offset = 0
+
+        def _drain():
+            nonlocal offset
+            while True:
+                chunk = ray_tpu.get(sup.read_from.remote(offset),
+                                    timeout=60)
+                if not chunk:
+                    return
+                offset += len(chunk)
+                yield chunk.decode(errors="replace")
+
         while True:
-            text = self.get_job_logs(submission_id)
-            if len(text) > offset:
-                yield text[offset:]
-                offset = len(text)
+            yield from _drain()
             if self.get_job_status(submission_id) in JobStatus.TERMINAL:
-                text = self.get_job_logs(submission_id)
-                if len(text) > offset:
-                    yield text[offset:]
+                yield from _drain()
                 return
             time.sleep(poll_s)

@@ -18,6 +18,12 @@ class RemoteFunction:
             f"use {self.__name__}.remote(...)"
         )
 
+    def bind(self, *args, **kwargs):
+        """DAG/workflow composition (ref: remote_function bind)."""
+        from .dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         from . import _worker_api
 
@@ -34,8 +40,3 @@ class RemoteFunction:
         merged.update(new_options)
         return RemoteFunction(self._function, merged)
 
-    def bind(self, *args, **kwargs):
-        """Build a DAG node for compiled execution (ray_tpu.dag)."""
-        from .dag import FunctionNode
-
-        return FunctionNode(self._function, args, kwargs, self._options)

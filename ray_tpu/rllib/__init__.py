@@ -1,0 +1,7 @@
+"""ray_tpu.rllib: RL training library (ref: rllib/ — new API stack:
+EnvRunner sampling actors + a jitted jax Learner; SURVEY §2.4)."""
+
+from .env import CartPole, make_env
+from .ppo import PPO, PPOConfig, EnvRunner
+
+__all__ = ["PPO", "PPOConfig", "EnvRunner", "CartPole", "make_env"]

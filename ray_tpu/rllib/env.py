@@ -1,0 +1,90 @@
+"""Built-in environments (ref: rllib's env layer; gym is not a baked-in
+dependency, so the classic control task used by the smoke tests is
+implemented directly — standard CartPole dynamics).
+
+API mirrors gymnasium: reset() -> (obs, info), step(a) ->
+(obs, reward, terminated, truncated, info).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class CartPole:
+    """Classic cart-pole balancing (the CartPole-v1 task: physics per
+    Barto, Sutton & Anderson 1983; episode caps at 500 steps)."""
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * math.pi / 180
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    observation_dim = 4
+    action_dim = 2
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.default_rng(seed)
+        self.state = np.zeros(4, np.float64)
+        self.steps = 0
+
+    def reset(self, seed: Optional[int] = None) -> Tuple[np.ndarray, Dict]:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.state = self.rng.uniform(-0.05, 0.05, size=4)
+        self.steps = 0
+        return self.state.astype(np.float32), {}
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self.state
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pole_ml = self.POLE_MASS * self.POLE_HALF_LEN
+        cos_t, sin_t = math.cos(theta), math.sin(theta)
+        temp = (force + pole_ml * theta_dot ** 2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_LEN
+            * (4.0 / 3.0 - self.POLE_MASS * cos_t ** 2 / total_mass))
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+        x += self.DT * x_dot
+        x_dot += self.DT * x_acc
+        theta += self.DT * theta_dot
+        theta_dot += self.DT * theta_acc
+        self.state = np.array([x, x_dot, theta, theta_dot])
+        self.steps += 1
+        terminated = bool(abs(x) > self.X_LIMIT
+                          or abs(theta) > self.THETA_LIMIT)
+        truncated = self.steps >= self.MAX_STEPS
+        return (self.state.astype(np.float32), 1.0, terminated, truncated,
+                {})
+
+
+_REGISTRY = {"CartPole-v1": CartPole}
+
+
+def make_env(name_or_fn: Any, seed: Optional[int] = None):
+    if callable(name_or_fn):
+        # pass the per-runner seed through when the factory accepts one —
+        # otherwise every runner would sample identical episodes
+        import inspect
+
+        try:
+            sig = inspect.signature(name_or_fn)
+            if "seed" in sig.parameters:
+                return name_or_fn(seed=seed)
+        except (TypeError, ValueError):
+            pass
+        return name_or_fn()
+    cls = _REGISTRY.get(name_or_fn)
+    if cls is None:
+        raise ValueError(f"unknown env {name_or_fn!r}; register a factory "
+                         f"callable or use one of {sorted(_REGISTRY)}")
+    return cls(seed=seed)

@@ -1,0 +1,367 @@
+"""PPO on jax: the new-API-stack shape (ref: rllib/algorithms/ppo/,
+core/learner/learner.py:107, core/rl_module/, env/env_runner_group.py:71)
+rebuilt TPU-first — the learner update is ONE jitted function (GAE +
+clipped surrogate + value/entropy losses + adam), so the math compiles
+onto the device while sampling stays on CPU actors.
+
+    config = (PPOConfig().environment("CartPole-v1")
+              .env_runners(num_env_runners=2)
+              .training(lr=3e-4, train_batch_size=2000))
+    algo = config.build()
+    for _ in range(10):
+        metrics = algo.train()
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .env import make_env
+
+# ---------------------------------------------------------------------------
+# Policy/value network: a functional MLP RLModule.
+# ---------------------------------------------------------------------------
+
+
+def init_policy(key, obs_dim: int, act_dim: int, hidden: Tuple[int, ...]):
+    import jax
+    import jax.numpy as jnp
+
+    sizes = (obs_dim,) + hidden
+    params = {"layers": [], "pi": None, "vf": None}
+    keys = jax.random.split(key, len(hidden) + 2)
+    for i in range(len(hidden)):
+        params["layers"].append({
+            "w": jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+            * np.sqrt(2.0 / sizes[i]),
+            "b": jnp.zeros(sizes[i + 1]),
+        })
+    params["pi"] = {
+        "w": jax.random.normal(keys[-2], (sizes[-1], act_dim)) * 0.01,
+        "b": jnp.zeros(act_dim),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0,
+        "b": jnp.zeros(1),
+    }
+    return params
+
+
+def policy_forward(params, obs):
+    import jax
+    import jax.numpy as jnp
+
+    x = obs
+    for layer in params["layers"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+# ---------------------------------------------------------------------------
+# Env runner: one sampling actor (ref: single_agent_env_runner.py).
+# ---------------------------------------------------------------------------
+
+
+class EnvRunner:
+    def __init__(self, env_spec, hidden: Tuple[int, ...], seed: int):
+        self.env = make_env(env_spec, seed=seed)
+        self.hidden = tuple(hidden)
+        self.seed = seed
+        self.rng = np.random.default_rng(seed)
+        self._params = None
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._completed: List[float] = []
+
+    def set_params(self, params) -> bool:
+        self._params = params
+        return True
+
+    def _act(self, obs: np.ndarray) -> Tuple[int, float, float]:
+        import jax.numpy as jnp
+
+        logits, value = policy_forward(self._params,
+                                       jnp.asarray(obs[None, :]))
+        logits = np.asarray(logits)[0].astype(np.float64)
+        logits -= logits.max()
+        probs = np.exp(logits)
+        probs /= probs.sum()
+        action = int(self.rng.choice(len(probs), p=probs))
+        return action, float(np.log(probs[action])), float(value[0])
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect a fixed-size rollout fragment (episodes continue
+        across calls; the fragment carries bootstrap values)."""
+        obs_buf = np.zeros((num_steps, len(self._obs)), np.float32)
+        act_buf = np.zeros(num_steps, np.int32)
+        rew_buf = np.zeros(num_steps, np.float32)
+        done_buf = np.zeros(num_steps, np.float32)
+        logp_buf = np.zeros(num_steps, np.float32)
+        val_buf = np.zeros(num_steps, np.float32)
+        for t in range(num_steps):
+            action, logp, value = self._act(self._obs)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = logp
+            val_buf[t] = value
+            obs, reward, terminated, truncated, _ = self.env.step(action)
+            rew_buf[t] = reward
+            self._episode_return += reward
+            done = terminated or truncated
+            done_buf[t] = float(done)
+            if done:
+                self._completed.append(self._episode_return)
+                self._episode_return = 0.0
+                obs, _ = self.env.reset()
+            self._obs = obs
+        _, bootstrap = self._act(self._obs)[1:]
+        completed, self._completed = self._completed, []
+        return {"obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+                "dones": done_buf, "logp": logp_buf, "values": val_buf,
+                "bootstrap_value": np.float32(bootstrap),
+                "episode_returns": np.asarray(completed, np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Learner: the jitted PPO update (ref: core/learner/learner.py — here the
+# whole epoch loop is device-side).
+# ---------------------------------------------------------------------------
+
+
+def _gae(rewards, values, dones, bootstrap, gamma, lam):
+    """Generalized advantage estimation over one fragment (host side —
+    trivially cheap next to the update)."""
+    T = len(rewards)
+    adv = np.zeros(T, np.float32)
+    last = 0.0
+    next_value = bootstrap
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last = delta + gamma * lam * nonterminal * last
+        adv[t] = last
+        next_value = values[t]
+    return adv, adv + values
+
+
+_PPO_UPDATE_JIT = None
+
+
+def ppo_update(params, opt_state, batch, key, lr, *, clip: float,
+               vf_coef: float, ent_coef: float, n_minibatches: int,
+               n_epochs: int):
+    """All epochs and minibatches of one PPO iteration in a single
+    compiled program (lax.scan over shuffled minibatch slices). Jitted
+    lazily on first call — EnvRunner actor processes that only run
+    policy_forward never pay jax-compile startup for the update."""
+    global _PPO_UPDATE_JIT
+    if _PPO_UPDATE_JIT is None:
+        import jax
+
+        _PPO_UPDATE_JIT = jax.jit(
+            _ppo_update_impl,
+            static_argnames=("clip", "vf_coef", "ent_coef",
+                             "n_minibatches", "n_epochs"))
+    return _PPO_UPDATE_JIT(params, opt_state, batch, key, lr, clip=clip,
+                           vf_coef=vf_coef, ent_coef=ent_coef,
+                           n_minibatches=n_minibatches, n_epochs=n_epochs)
+
+
+def _ppo_update_impl(params, opt_state, batch, key, lr, *, clip: float,
+                     vf_coef: float, ent_coef: float, n_minibatches: int,
+                     n_epochs: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.adam(lr)
+    N = batch["obs"].shape[0]
+    mb = N // n_minibatches
+
+    def loss_fn(p, idx):
+        obs = batch["obs"][idx]
+        logits, value = policy_forward(p, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][idx][:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp"][idx])
+        adv = batch["advantages"][idx]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+        pi_loss = -surr.mean()
+        vf_loss = jnp.square(value - batch["returns"][idx]).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pi_loss + vf_coef * vf_loss - ent_coef * entropy
+        return total, (pi_loss, vf_loss, entropy)
+
+    def epoch(carry, ekey):
+        p, opt = carry
+        perm = jax.random.permutation(ekey, N)
+
+        def minibatch(carry, i):
+            p, opt = carry
+            idx = jax.lax.dynamic_slice_in_dim(perm, i * mb, mb)
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, idx)
+            updates, opt = optimizer.update(grads, opt, p)
+            p = optax.apply_updates(p, updates)
+            return (p, opt), (loss, *aux)
+
+        (p, opt), metrics = jax.lax.scan(
+            minibatch, (p, opt), jnp.arange(n_minibatches))
+        return (p, opt), metrics
+
+    keys = jax.random.split(key, n_epochs)
+    (params, opt_state), metrics = jax.lax.scan(
+        epoch, (params, opt_state), keys)
+    flat = jax.tree.map(lambda m: m.mean(), metrics)
+    return params, opt_state, {
+        "total_loss": flat[0], "policy_loss": flat[1],
+        "vf_loss": flat[2], "entropy": flat[3]}
+
+
+# ---------------------------------------------------------------------------
+# Config + Algorithm (ref: algorithm_config.py builder / algorithm.py).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PPOConfig:
+    env: Any = "CartPole-v1"
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 512
+    train_batch_size: int = 1024          # derived check only
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    clip_param: float = 0.2
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_epochs: int = 8
+    num_minibatches: int = 8
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    # builder-style setters (ref: AlgorithmConfig fluent API)
+    def environment(self, env) -> "PPOConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "PPOConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "PPOConfig":
+        for key, val in kwargs.items():
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training option {key!r}")
+            setattr(self, key, val)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    """The Algorithm: env-runner actors sample in parallel, the jitted
+    learner updates, new weights broadcast (ref: algorithm.py
+    training_step:1749)."""
+
+    def __init__(self, config: PPOConfig):
+        import jax
+
+        self.config = config
+        probe = make_env(config.env, seed=0)
+        self.obs_dim = probe.observation_dim
+        self.act_dim = probe.action_dim
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_policy(key, self.obs_dim, self.act_dim,
+                                  config.hidden)
+        import optax
+
+        self.opt_state = optax.adam(config.lr).init(self.params)
+        self._key = jax.random.PRNGKey(config.seed + 1)
+        self.iteration = 0
+
+        import ray_tpu
+
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            runner_cls.remote(config.env, config.hidden,
+                              config.seed + 100 + i)
+            for i in range(config.num_env_runners)
+        ]
+        self._broadcast()
+
+    def _broadcast(self) -> None:
+        import ray_tpu
+
+        host_params = __import__("jax").tree.map(np.asarray, self.params)
+        ray_tpu.get([r.set_params.remote(host_params)
+                     for r in self.runners], timeout=120)
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+        import ray_tpu
+
+        cfg = self.config
+        frags = ray_tpu.get(
+            [r.sample.remote(cfg.rollout_fragment_length)
+             for r in self.runners], timeout=300)
+        # GAE per fragment, then concatenate into the train batch
+        obs, acts, logps, advs, rets, ep_returns = [], [], [], [], [], []
+        for frag in frags:
+            adv, ret = _gae(frag["rewards"], frag["values"], frag["dones"],
+                            frag["bootstrap_value"], cfg.gamma, cfg.lambda_)
+            obs.append(frag["obs"])
+            acts.append(frag["actions"])
+            logps.append(frag["logp"])
+            advs.append(adv)
+            rets.append(ret)
+            ep_returns.extend(frag["episode_returns"].tolist())
+        batch = {
+            "obs": jnp.asarray(np.concatenate(obs)),
+            "actions": jnp.asarray(np.concatenate(acts)),
+            "logp": jnp.asarray(np.concatenate(logps)),
+            "advantages": jnp.asarray(np.concatenate(advs)),
+            "returns": jnp.asarray(np.concatenate(rets)),
+        }
+        self._key, subkey = jax.random.split(self._key)
+        self.params, self.opt_state, losses = ppo_update(
+            self.params, self.opt_state, batch, subkey, cfg.lr,
+            clip=cfg.clip_param, vf_coef=cfg.vf_loss_coeff,
+            ent_coef=cfg.entropy_coeff,
+            n_minibatches=cfg.num_minibatches, n_epochs=cfg.num_epochs)
+        self._broadcast()
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(ep_returns))
+                                    if ep_returns else float("nan")),
+            "episodes_this_iter": len(ep_returns),
+            "timesteps_this_iter": int(batch["obs"].shape[0]),
+            **{k: float(v) for k, v in losses.items()},
+        }
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for runner in self.runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:
+                pass
+        self.runners = []

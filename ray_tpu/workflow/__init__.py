@@ -1,0 +1,173 @@
+"""ray_tpu.workflow: durable DAG execution (ref: python/ray/workflow/ —
+api.py, task_executor.py, workflow_access.py; SURVEY §2.4).
+
+Each step of a ``fn.bind(...)`` DAG runs as a normal task whose result
+persists to storage before the next step starts; a crashed run resumes
+from the last completed step. Step identity is positional (topological
+index + function name), so resume requires the same DAG shape — the
+reference's static-workflow contract.
+
+    @ray_tpu.remote
+    def add(a, b): return a + b
+    out = workflow.run(add.bind(add.bind(1, 2), 3), workflow_id="w1")
+    # crash mid-run -> workflow.resume("w1") skips completed steps
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+from ..dag.nodes import AttributeNode, DAGNode, FunctionNode
+
+_DEFAULT_STORAGE = "/tmp/ray_tpu_workflows"
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+    TERMINAL = (SUCCEEDED, FAILED)
+
+
+def _wf_dir(workflow_id: str, storage: Optional[str]) -> str:
+    return os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
+
+
+def _write_status(wf_dir: str, status: str, error: str = "") -> None:
+    with open(os.path.join(wf_dir, "status.json"), "w") as f:
+        json.dump({"status": status, "error": error,
+                   "updated_at": time.time()}, f)
+
+
+def _step_names(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic step names by topological position."""
+    order: List[DAGNode] = []
+    seen = set()
+
+    def visit(node: DAGNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        if isinstance(node, FunctionNode):
+            for a in list(node.args) + list(node.kwargs.values()):
+                if isinstance(a, DAGNode):
+                    visit(a)
+        elif isinstance(node, AttributeNode):
+            visit(node.upstream)
+        order.append(node)
+
+    visit(dag)
+    names = {}
+    for i, node in enumerate(order):
+        if isinstance(node, FunctionNode):
+            names[id(node)] = f"{i:04d}_{node.remote_fn.__name__}"
+    return names
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None) -> Any:
+    """Execute a FunctionNode DAG durably; returns the final result."""
+    import ray_tpu
+
+    workflow_id = workflow_id or f"workflow_{int(time.time() * 1000)}"
+    wf_dir = _wf_dir(workflow_id, storage)
+    os.makedirs(wf_dir, exist_ok=True)
+    with open(os.path.join(wf_dir, "meta.json"), "w") as f:
+        json.dump({"workflow_id": workflow_id,
+                   "created_at": time.time()}, f)
+    _write_status(wf_dir, WorkflowStatus.RUNNING)
+    names = _step_names(dag)
+    cache: Dict[int, Any] = {}
+
+    def eval_node(node: Any) -> Any:
+        if not isinstance(node, DAGNode):
+            return node
+        if id(node) in cache:
+            return cache[id(node)]
+        if isinstance(node, AttributeNode):
+            value = eval_node(node.upstream)[node.key]
+        elif isinstance(node, FunctionNode):
+            step = names[id(node)]
+            path = os.path.join(wf_dir, f"{step}.pkl")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    value = pickle.load(f)  # completed in a prior run
+            else:
+                args = [eval_node(a) for a in node.args]
+                kwargs = {k: eval_node(v)
+                          for k, v in node.kwargs.items()}
+                value = ray_tpu.get(
+                    node.remote_fn.remote(*args, **kwargs))
+                tmp = path + f".tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    pickle.dump(value, f)
+                os.replace(tmp, path)  # durable BEFORE dependents run
+        else:
+            raise TypeError(
+                f"workflows execute FunctionNode DAGs; got "
+                f"{type(node).__name__}")
+        cache[id(node)] = value
+        return value
+
+    try:
+        result = eval_node(dag)
+    except BaseException as e:
+        _write_status(wf_dir, WorkflowStatus.FAILED, repr(e))
+        raise
+    with open(os.path.join(wf_dir, "result.pkl"), "wb") as f:
+        pickle.dump(result, f)
+    _write_status(wf_dir, WorkflowStatus.SUCCEEDED)
+    return result
+
+
+def resume(workflow_id: str, dag: DAGNode, *,
+           storage: Optional[str] = None) -> Any:
+    """Re-run a workflow: completed steps load from storage, the rest
+    execute (ref: workflow.resume — the reference persists the DAG too;
+    here the caller re-supplies it, keeping storage pickle-portable)."""
+    return run(dag, workflow_id=workflow_id, storage=storage)
+
+
+def get_status(workflow_id: str, *,
+               storage: Optional[str] = None) -> Optional[str]:
+    try:
+        with open(os.path.join(_wf_dir(workflow_id, storage),
+                               "status.json")) as f:
+            return json.load(f)["status"]
+    except (FileNotFoundError, json.JSONDecodeError, KeyError):
+        return None
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    path = os.path.join(_wf_dir(workflow_id, storage), "result.pkl")
+    if not os.path.exists(path):
+        raise ValueError(f"workflow {workflow_id!r} has no stored result")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def list_all(*, storage: Optional[str] = None) -> List[Dict[str, Any]]:
+    root = storage or _DEFAULT_STORAGE
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for wf_id in sorted(os.listdir(root)):
+        status = get_status(wf_id, storage=storage)
+        if status is not None:
+            out.append({"workflow_id": wf_id, "status": status})
+    return out
+
+
+def delete(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    import shutil
+
+    shutil.rmtree(_wf_dir(workflow_id, storage), ignore_errors=True)
+
+
+__all__ = ["run", "resume", "get_status", "get_output", "list_all",
+           "delete", "WorkflowStatus"]

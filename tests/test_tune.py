@@ -181,8 +181,8 @@ def test_pbt_exploits_checkpoint_and_mutates(ray_cluster, tmp_path):
 
         # long + paced enough that both population members overlap even
         # when the second trial's worker process cold-starts (~1s)
-        for i in range(80):
-            _time.sleep(0.05)
+        for i in range(100):
+            _time.sleep(0.06)
             theta += config["lr"]  # higher lr climbs faster
             if i % 2 == 0:  # checkpoint every other step
                 d = tempfile.mkdtemp()
@@ -192,23 +192,32 @@ def test_pbt_exploits_checkpoint_and_mutates(ray_cluster, tmp_path):
             else:
                 tune.report({"theta": theta})
 
-    pbt = PopulationBasedTraining(
-        metric="theta", mode="max", perturbation_interval=10,
-        hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=0)
-    grid = Tuner(
-        objective,
-        param_space={"lr": tune.grid_search([1.0, 0.01])},
-        tune_config=TuneConfig(metric="theta", mode="max", scheduler=pbt,
-                               stop={"training_iteration": 60},
-                               max_concurrent_trials=2),
-        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
-    ).fit()
-    assert grid.num_errors == 0
-    # the slow trial was exploited at least once: its config's lr moved
-    # away from the original 0.01 grid value
-    lrs = sorted(r.config["lr"] for r in [grid[0], grid[1]])
-    assert lrs[0] > 0.01 or any(
-        t.perturbations > 0 for t in grid._trials)
+    # trial overlap depends on worker cold-start timing; under heavy
+    # parallel-suite load a round can miss the perturbation window, so
+    # allow one retry before calling it a failure
+    for attempt in range(2):
+        pbt = PopulationBasedTraining(
+            metric="theta", mode="max", perturbation_interval=10,
+            hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=0)
+        grid = Tuner(
+            objective,
+            param_space={"lr": tune.grid_search([1.0, 0.01])},
+            tune_config=TuneConfig(metric="theta", mode="max",
+                                   scheduler=pbt,
+                                   stop={"training_iteration": 80},
+                                   max_concurrent_trials=2),
+            run_config=RunConfig(name=f"pbt{attempt}",
+                                 storage_path=str(tmp_path)),
+        ).fit()
+        assert grid.num_errors == 0
+        # the slow trial was exploited at least once: its config's lr
+        # moved away from the original 0.01 grid value
+        lrs = sorted(r.config["lr"] for r in [grid[0], grid[1]])
+        exploited = lrs[0] > 0.01 or any(
+            t.perturbations > 0 for t in grid._trials)
+        if exploited:
+            break
+    assert exploited
 
 
 def test_pbt_mutate_config_bounds():

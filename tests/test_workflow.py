@@ -1,0 +1,82 @@
+"""Workflow: durable DAG execution + resume (ref: python/ray/workflow/
+tests — test_basic_workflows.py, recovery tests)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_workflow_runs_dag(ray_cluster, tmp_path):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    dag = mul.bind(add.bind(1, 2), add.bind(3, 4))
+    out = workflow.run(dag, workflow_id="w_basic", storage=str(tmp_path))
+    assert out == 21
+    assert workflow.get_status("w_basic", storage=str(tmp_path)) == \
+        workflow.WorkflowStatus.SUCCEEDED
+    assert workflow.get_output("w_basic", storage=str(tmp_path)) == 21
+    assert {"workflow_id": "w_basic", "status": "SUCCEEDED"} in \
+        workflow.list_all(storage=str(tmp_path))
+
+
+def test_workflow_failure_then_resume_skips_done_steps(ray_cluster,
+                                                       tmp_path):
+    marker = tmp_path / "side_effects"
+    marker.mkdir()
+
+    @ray_tpu.remote
+    def record(tag, value):
+        # one file per EXECUTION of this step: resume must not re-run
+        (marker / f"{tag}_{len(list(marker.iterdir()))}").write_text("x")
+        return value
+
+    @ray_tpu.remote
+    def fail_once(x):
+        flag = marker / "fail_once_done"
+        if not flag.exists():
+            flag.write_text("x")
+            raise RuntimeError("transient step failure")
+        return x * 10
+
+    dag = fail_once.bind(record.bind("a", 4))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w_resume", storage=str(tmp_path))
+    assert workflow.get_status("w_resume", storage=str(tmp_path)) == \
+        workflow.WorkflowStatus.FAILED
+    executions_of_a = [p for p in marker.iterdir()
+                       if p.name.startswith("a_")]
+    assert len(executions_of_a) == 1
+
+    out = workflow.resume("w_resume", dag, storage=str(tmp_path))
+    assert out == 40
+    # the completed step 'record' did NOT re-execute on resume
+    executions_of_a = [p for p in marker.iterdir()
+                       if p.name.startswith("a_")]
+    assert len(executions_of_a) == 1
+    assert workflow.get_status("w_resume", storage=str(tmp_path)) == \
+        workflow.WorkflowStatus.SUCCEEDED
+
+
+def test_interpreted_function_dag(ray_cluster):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    dag = inc.bind(inc.bind(inc.bind(0)))
+    assert ray_tpu.get(dag.execute()) == 3
