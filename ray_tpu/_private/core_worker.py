@@ -755,6 +755,10 @@ class CoreWorker:
             if pg_strategy:
                 address = await self._pg_bundle_address(strategy)
                 raylet = await self._raylet_client_for(address)
+            # a fresh attempt gets a fresh spillback budget — no_spill
+            # sticking from a previous attempt's chain cap would pin the
+            # lease to a saturated raylet forever
+            payload.pop("no_spill", None)
             try:
                 for hop in range(16):  # bounded spillback chain
                     if info is not None:

@@ -119,6 +119,39 @@ class Autoscaler:
     def _fits(shape: Dict[str, float], avail: Dict[str, float]) -> bool:
         return all(avail.get(k, 0.0) >= v for k, v in shape.items())
 
+    @classmethod
+    def _pack(cls, shapes: List[Dict[str, float]],
+              bins: List[Dict[str, float]],
+              template: Optional[Dict[str, float]] = None,
+              max_new_bins: Optional[int] = None):
+        """First-fit packing: place each shape into an existing bin,
+        else open a new ``template`` bin (when allowed). Mutates
+        ``bins`` in place; returns (n_bins_opened, unplaced_shapes)."""
+        opened = 0
+        unplaced: List[Dict[str, float]] = []
+        for shape in shapes:
+            placed = False
+            for av in bins:
+                if cls._fits(shape, av):
+                    for k, v in shape.items():
+                        av[k] = av.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            can_open = (template is not None
+                        and cls._fits(shape, template)
+                        and (max_new_bins is None or opened < max_new_bins))
+            if can_open:
+                av = dict(template)
+                for k, v in shape.items():
+                    av[k] = av.get(k, 0.0) - v
+                bins.append(av)
+                opened += 1
+            else:
+                unplaced.append(shape)
+        return opened, unplaced
+
     # --- one reconcile round ---
 
     def update(self) -> Dict[str, int]:
@@ -134,60 +167,24 @@ class Autoscaler:
         # simulate packing demands onto current availability; whatever
         # doesn't fit drives scale-up (ref: v2/scheduler.py binpacking)
         avails = [dict(n["Available"]) for n in view]
-        unmet: List[Dict[str, float]] = []
-        for shape in demands:
-            placed = False
-            for av in avails:
-                if self._fits(shape, av):
-                    for k, v in shape.items():
-                        av[k] = av.get(k, 0.0) - v
-                    placed = True
-                    break
-            if not placed:
-                unmet.append(shape)
+        _, unmet = self._pack(demands, avails)
 
         # bin-pack the unmet shapes onto hypothetical new worker nodes
         # of the configured template; launch exactly that many
         workers = self.provider.non_terminated_nodes()
-        planned: List[Dict[str, float]] = []
-        for shape in unmet:
-            if not self._fits(shape, self.config.worker_resources):
-                continue  # can never fit on this worker type
-            for av in planned:
-                if self._fits(shape, av):
-                    for k, v in shape.items():
-                        av[k] = av.get(k, 0.0) - v
-                    break
-            else:
-                if len(workers) + len(planned) >= self.config.max_workers:
-                    break
-                av = dict(self.config.worker_resources)
-                for k, v in shape.items():
-                    av[k] = av.get(k, 0.0) - v
-                planned.append(av)
-        for _ in planned:
+        opened, _ = self._pack(
+            unmet, [], template=self.config.worker_resources,
+            max_new_bins=max(0, self.config.max_workers - len(workers)))
+        for _ in range(opened):
             self.provider.create_node(dict(self.config.worker_resources))
             launched += 1
 
         # 2. idle scale-down (never below min_workers; never the head;
         # never below the node count the explicit-request floor packs
         # onto — terminating those would flap: relaunch next round)
-        floor_nodes = 0
-        floor_avail: List[Dict[str, float]] = []
-        for shape in self._explicit_requests():
-            if not self._fits(shape, self.config.worker_resources):
-                continue
-            for av in floor_avail:
-                if self._fits(shape, av):
-                    for k, v in shape.items():
-                        av[k] = av.get(k, 0.0) - v
-                    break
-            else:
-                av = dict(self.config.worker_resources)
-                for k, v in shape.items():
-                    av[k] = av.get(k, 0.0) - v
-                floor_avail.append(av)
-        floor_nodes = len(floor_avail)
+        floor_nodes, _ = self._pack(
+            self._explicit_requests(), [],
+            template=self.config.worker_resources)
         now = time.monotonic()
         provider_nodes = self.provider.non_terminated_nodes()
         by_id = {getattr(h, "node_id", None) and h.node_id.hex(): h

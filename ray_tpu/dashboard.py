@@ -1,0 +1,121 @@
+"""Dashboard head: a JSON API over cluster state (ref:
+python/ray/dashboard/head.py:65 + modules/* REST routes; the aiohttp app
+serves the same state the reference UI reads — nodes, actors, tasks,
+objects, jobs, metrics — without shipping a frontend bundle).
+
+    port = ray_tpu.dashboard.start_dashboard()
+    GET /api/nodes /api/actors /api/tasks /api/objects /api/jobs
+        /api/cluster_status /api/metrics
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict, Optional
+
+_runner = None
+_loop: Optional[asyncio.AbstractEventLoop] = None
+_port: Optional[int] = None
+
+
+def _routes():
+    from aiohttp import web
+
+    from . import available_resources, cluster_resources, nodes
+    from .util import state as state_api
+
+    def _json(data):
+        return web.json_response(data, dumps=_dumps)
+
+    def _dumps(obj):
+        import json
+
+        return json.dumps(obj, default=str)
+
+    async def api_nodes(_req):
+        return _json(nodes())
+
+    async def api_actors(_req):
+        return _json(state_api.list_actors())
+
+    async def api_tasks(_req):
+        return _json(state_api.list_tasks())
+
+    async def api_objects(_req):
+        return _json(state_api.list_objects())
+
+    async def api_metrics(_req):
+        return _json(state_api.get_metrics())
+
+    async def api_jobs(_req):
+        from .job_submission import JobSubmissionClient
+
+        client = JobSubmissionClient()
+        return _json([j.__dict__ for j in client.list_jobs()])
+
+    async def api_cluster_status(_req):
+        return _json({
+            "nodes": len([n for n in nodes() if n["Alive"]]),
+            "resources_total": cluster_resources(),
+            "resources_available": available_resources(),
+            "task_summary": state_api.summarize_tasks(),
+        })
+
+    app = web.Application()
+    app.router.add_get("/api/nodes", api_nodes)
+    app.router.add_get("/api/actors", api_actors)
+    app.router.add_get("/api/tasks", api_tasks)
+    app.router.add_get("/api/objects", api_objects)
+    app.router.add_get("/api/jobs", api_jobs)
+    app.router.add_get("/api/metrics", api_metrics)
+    app.router.add_get("/api/cluster_status", api_cluster_status)
+    return app
+
+
+def start_dashboard(port: int = 0) -> int:
+    """Serve the API from a background thread; returns the bound port."""
+    global _runner, _loop, _port
+    if _port is not None:
+        return _port
+    from aiohttp import web
+
+    started = threading.Event()
+
+    def _serve():
+        global _runner, _loop, _port
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        _loop = loop
+
+        async def _up():
+            global _runner, _port
+            _runner = web.AppRunner(_routes())
+            await _runner.setup()
+            site = web.TCPSite(_runner, "127.0.0.1", port)
+            await site.start()
+            _port = site._server.sockets[0].getsockname()[1]
+            started.set()
+
+        loop.run_until_complete(_up())
+        loop.run_forever()
+
+    threading.Thread(target=_serve, daemon=True,
+                     name="ray_tpu_dashboard").start()
+    if not started.wait(timeout=30):
+        raise RuntimeError("dashboard failed to start")
+    return _port
+
+
+def stop_dashboard() -> None:
+    global _runner, _loop, _port
+    if _loop is not None:
+        loop, runner = _loop, _runner
+
+        async def _down():
+            if runner is not None:
+                await runner.cleanup()
+            loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_down(), loop)
+    _runner = _loop = _port = None

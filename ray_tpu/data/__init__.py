@@ -12,6 +12,7 @@ from typing import Any, List, Optional
 from .block import Block
 from .dataset import DataIterator, Dataset, _LogicalOp
 from .datasource import (
+    CSVDatasource,
     Datasource,
     ItemsDatasource,
     JSONLinesDatasource,
@@ -20,6 +21,7 @@ from .datasource import (
     RangeDatasource,
     ReadTask,
 )
+from .grouped import GroupedData
 
 _DEFAULT_PARALLELISM = 8
 
@@ -55,8 +57,13 @@ def read_numpy(paths, *, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
     return read_datasource(NumpyDatasource(paths), parallelism=parallelism)
 
 
+def read_csv(paths, *, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    return read_datasource(CSVDatasource(paths), parallelism=parallelism)
+
+
 __all__ = [
     "Block", "Dataset", "DataIterator", "Datasource", "ReadTask",
+    "GroupedData",
     "read_datasource", "range", "from_items", "read_parquet", "read_json",
-    "read_numpy",
+    "read_numpy", "read_csv",
 ]

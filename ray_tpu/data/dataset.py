@@ -130,6 +130,175 @@ class Dataset:
         return self._append(_LogicalOp(
             "shuffle", "random_shuffle", {"seed": seed}, {"num_cpus": 1}))
 
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Re-slice the stream into exactly ``num_blocks`` near-equal
+        blocks (all-to-all exchange; ref: dataset.py:1366)."""
+
+        def exchange(refs):
+            from .. import get, put
+            from .block import (block_num_rows, concat_blocks, slice_block)
+
+            blocks = [get(r) for r in refs]
+            blocks = [b for b in blocks if block_num_rows(b) > 0]
+            if not blocks:
+                return []
+            whole = concat_blocks(blocks)
+            total = block_num_rows(whole)
+            out = []
+            for i in range(num_blocks):
+                start = i * total // num_blocks
+                end = (i + 1) * total // num_blocks
+                out.append(put(slice_block(whole, start, end)))
+            return out
+
+        return self._append(_LogicalOp(
+            "all_to_all", f"repartition({num_blocks})", {"fn": exchange}))
+
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        """Global sort by a column/row key (all-to-all; ref:
+        dataset.py sort → sort exchange). Block count is preserved."""
+
+        def exchange(refs):
+            import numpy as np
+
+            from .. import get, put
+            from .block import (block_num_rows, concat_blocks, is_columnar,
+                                slice_block)
+
+            blocks = [get(r) for r in refs]
+            blocks = [b for b in blocks if block_num_rows(b) > 0]
+            if not blocks:
+                return []
+            whole = concat_blocks(blocks)
+            if is_columnar(whole):
+                order = np.argsort(np.asarray(whole[key]), kind="stable")
+                if descending:
+                    order = order[::-1]
+                whole = {k: np.asarray(v)[order] for k, v in whole.items()}
+            else:
+                whole = sorted(whole, key=lambda r: r[key],
+                               reverse=descending)
+            total = block_num_rows(whole)
+            n_out = max(1, len(blocks))
+            out = []
+            for i in range(n_out):
+                start = i * total // n_out
+                end = (i + 1) * total // n_out
+                out.append(put(slice_block(whole, start, end)))
+            return out
+
+        return self._append(_LogicalOp(
+            "all_to_all", f"sort({key})", {"fn": exchange}))
+
+    def groupby(self, key: str) -> "GroupedData":
+        """Group rows by a key column (ref: dataset.py:2188 → GroupedData
+        aggregations)."""
+        from .grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (materializes block refs of every input;
+        ref: dataset.py union)."""
+        refs = list(self.iter_block_refs())
+        for other in others:
+            refs.extend(other.iter_block_refs())
+        return Dataset([_LogicalOp("refs", "union", {"refs": refs})],
+                       self._parallelism)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two datasets with equal row counts
+        (ref: dataset.py zip). Blocks are realigned to the left side's
+        boundaries."""
+        from .. import get, put
+        from .block import (block_num_rows, concat_blocks, slice_block,
+                            to_columnar)
+
+        left_refs = list(self.iter_block_refs())
+        right_all = concat_blocks(
+            [get(r) for r in other.iter_block_refs()])
+        offset = 0
+        refs = []
+        for ref in left_refs:
+            left = to_columnar(get(ref))
+            n = block_num_rows(left)
+            right = to_columnar(slice_block(right_all, offset, offset + n))
+            offset += n
+            merged = dict(left)
+            for k, v in right.items():
+                merged[k if k not in merged else f"{k}_1"] = v
+            refs.append(put(merged))
+        if offset != block_num_rows(right_all):
+            raise ValueError(
+                f"zip requires equal row counts: left {offset}, right "
+                f"{block_num_rows(right_all)}")
+        return Dataset([_LogicalOp("refs", "zip", {"refs": refs})],
+                       self._parallelism)
+
+    # ---------------------------------------------------------- aggregates
+    def _column(self, key: str):
+        import numpy as np
+
+        parts = []
+        for block in self.iter_blocks():
+            col = to_columnar(block).get(key)
+            if col is not None and len(col):
+                parts.append(np.asarray(col))
+        if not parts:
+            return None
+        return np.concatenate(parts)
+
+    def sum(self, key: str):
+        col = self._column(key)
+        return None if col is None else col.sum().item()
+
+    def min(self, key: str):
+        col = self._column(key)
+        return None if col is None else col.min().item()
+
+    def max(self, key: str):
+        col = self._column(key)
+        return None if col is None else col.max().item()
+
+    def mean(self, key: str):
+        col = self._column(key)
+        return None if col is None else col.mean().item()
+
+    def std(self, key: str):
+        col = self._column(key)
+        return None if col is None else col.std().item()
+
+    def column_stats(self, columns: List[str]) -> Dict[str, Dict[str, float]]:
+        """count/mean/std/min/max for many columns in ONE pass over the
+        stream (preprocessor fitting; per-column aggregate calls would
+        re-execute the whole plan per statistic)."""
+        import numpy as np
+
+        acc = {c: {"count": 0, "sum": 0.0, "sumsq": 0.0,
+                   "min": float("inf"), "max": float("-inf")}
+               for c in columns}
+        for block in self.iter_blocks():
+            cols = to_columnar(block)
+            for c in columns:
+                if c not in cols or not len(cols[c]):
+                    continue
+                arr = np.asarray(cols[c], np.float64)
+                a = acc[c]
+                a["count"] += arr.size
+                a["sum"] += float(arr.sum())
+                a["sumsq"] += float(np.square(arr).sum())
+                a["min"] = min(a["min"], float(arr.min()))
+                a["max"] = max(a["max"], float(arr.max()))
+        out = {}
+        for c, a in acc.items():
+            n = a["count"]
+            mean = a["sum"] / n if n else 0.0
+            var = max(a["sumsq"] / n - mean * mean, 0.0) if n else 0.0
+            out[c] = {"count": n, "mean": mean, "std": var ** 0.5,
+                      "min": a["min"] if n else None,
+                      "max": a["max"] if n else None}
+        return out
+
     # ------------------------------------------------------------ execution
     def _execute(self):
         from .executor import build_executor
@@ -156,6 +325,34 @@ class Dataset:
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_blocks():
             yield from rows_of(block)
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = None,
+                         device=None, drop_last: bool = False):
+        """Batches as jax arrays with one-batch device prefetch — the
+        Data→HBM path (ref: iter_torch_batches:4287, rebuilt for jax:
+        the NEXT batch's host→device copy overlaps the current batch's
+        compute)."""
+        import jax
+
+        pending = None
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            placed = {k: jax.device_put(v, device)
+                      for k, v in batch.items()}
+            if pending is not None:
+                yield pending
+            pending = placed
+        if pending is not None:
+            yield pending
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = None,
+                           drop_last: bool = False):
+        """Batches as torch CPU tensors (ref: iter_torch_batches)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
 
     def take(self, n: int = 20) -> List[Any]:
         out = []
@@ -230,6 +427,21 @@ class Dataset:
         for i, block in enumerate(self.iter_blocks()):
             table = pa.table(to_columnar(block))
             pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str) -> None:
+        import csv
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            cols = to_columnar(block)
+            keys = list(cols.keys())
+            with open(os.path.join(path, f"part-{i:05d}.csv"), "w",
+                      newline="") as f:
+                writer = csv.writer(f)
+                writer.writerow(keys)
+                for row in zip(*(cols[k] for k in keys)):
+                    writer.writerow(row)
 
     def write_json(self, path: str) -> None:
         import json

@@ -150,6 +150,46 @@ class JSONLinesDatasource(Datasource):
         return tasks
 
 
+class CSVDatasource(Datasource):
+    """read_csv: one task per file, header row -> columnar block with
+    numeric columns auto-converted (ref: _internal/datasource/
+    csv_datasource.py, pyarrow-free)."""
+
+    def __init__(self, paths):
+        self.files = _expand_paths(paths, (".csv",))
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.files:
+            def _read(path=path):
+                import csv
+
+                with open(path, newline="") as f:
+                    reader = csv.reader(f)
+                    header = next(reader, None)
+                    if header is None:
+                        yield []
+                        return
+                    cols: List[List[Any]] = [[] for _ in header]
+                    for row in reader:
+                        for i, val in enumerate(row):
+                            cols[i].append(val)
+                out = {}
+                for name, col in zip(header, cols):
+                    arr = np.asarray(col)
+                    for dtype in (np.int64, np.float64):
+                        try:
+                            arr = np.asarray(col, dtype)
+                            break
+                        except ValueError:
+                            continue
+                    out[name] = arr
+                yield out
+
+            tasks.append(ReadTask(_read))
+        return tasks
+
+
 class NumpyDatasource(Datasource):
     """read_numpy: one .npy file per task as a 'data' column."""
 

@@ -271,6 +271,33 @@ class ShuffleStage(_Stage):
             self.stats.blocks_out += 1
 
 
+class AllToAllStage(_Stage):
+    """Generic barrier stage: gather every upstream block ref, hand the
+    full list to ``fn(refs) -> iterable of refs`` (ref: the all-to-all
+    physical operators — repartition/sort/aggregate exchanges)."""
+
+    def __init__(self, name: str, in_q, out_q, fn: Callable):
+        super().__init__(name, out_q, in_q)
+        self.fn = fn
+
+    def _run(self):
+        refs = []
+        while True:
+            try:
+                item = self.in_q.get(timeout=0.5)
+            except queue.Empty:
+                if self.stop_event.is_set():
+                    return
+                continue
+            if item is _SENTINEL:
+                break
+            refs.append(item)
+        for out in self.fn(refs):
+            if not self._put_out(out):
+                return
+            self.stats.blocks_out += 1
+
+
 class LimitStage(_Stage):
     """Truncate the stream to n rows (ref: operators/limit_operator.py).
     Row counts come from tiny metadata tasks so blocks stay remote."""
@@ -386,6 +413,9 @@ def build_executor(plan, parallelism: int) -> StreamingExecutor:
         elif op.kind == "shuffle":
             stages.append(ShuffleStage(q, next_q, op.args.get("seed"),
                                        op.remote_args))
+        elif op.kind == "all_to_all":
+            stages.append(AllToAllStage(op.name, q, next_q,
+                                        op.args["fn"]))
         elif op.kind == "limit":
             limit_stage = LimitStage(q, next_q, op.args["n"], op.remote_args)
             limit_stage.upstream = list(stages)

@@ -1,0 +1,105 @@
+"""Grouped aggregations (ref: python/ray/data/grouped_data.py —
+GroupedData.count/sum/mean/min/max/map_groups over a groupby key).
+
+The exchange is a single barrier stage: rows partition by key on the
+driver-side reducer task; per-group aggregates come back as one columnar
+block sorted by key (matching the reference's sorted-groupby output).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str):
+        self._ds = dataset
+        self._key = key
+
+    def _aggregate(self, name: str,
+                   agg_fn: Callable, value_key: Optional[str]):
+        from .dataset import Dataset, _LogicalOp
+
+        key = self._key
+
+        def exchange(refs):
+            import numpy as np
+
+            from .. import get, put
+            from .block import rows_of
+
+            groups: Dict[Any, List[Any]] = {}
+            for ref in refs:
+                for row in rows_of(get(ref)):
+                    k = row[key]
+                    k = k.item() if hasattr(k, "item") else k
+                    groups.setdefault(k, []).append(row)
+            keys_sorted = sorted(groups)
+            col_name = (f"{name}({value_key})" if value_key else "count()")
+            values = []
+            for k in keys_sorted:
+                rows = groups[k]
+                if value_key is None:
+                    values.append(len(rows))
+                else:
+                    values.append(agg_fn(
+                        np.asarray([row[value_key] for row in rows])))
+            block = {key: np.asarray(keys_sorted),
+                     col_name: np.asarray(values)}
+            return [put(block)]
+
+        return self._ds._append(_LogicalOp(
+            "all_to_all", f"groupby({key}).{name}", {"fn": exchange}))
+
+    def count(self):
+        return self._aggregate("count", None, None)
+
+    def sum(self, value_key: str):
+        import numpy as np
+
+        return self._aggregate("sum", np.sum, value_key)
+
+    def mean(self, value_key: str):
+        import numpy as np
+
+        return self._aggregate("mean", np.mean, value_key)
+
+    def min(self, value_key: str):
+        import numpy as np
+
+        return self._aggregate("min", np.min, value_key)
+
+    def max(self, value_key: str):
+        import numpy as np
+
+        return self._aggregate("max", np.max, value_key)
+
+    def std(self, value_key: str):
+        import numpy as np
+
+        return self._aggregate("std", np.std, value_key)
+
+    def map_groups(self, fn: Callable):
+        """Apply ``fn(rows) -> rows`` per group (ref: map_groups)."""
+        from .dataset import Dataset, _LogicalOp
+
+        key = self._key
+
+        def exchange(refs):
+            from .. import get, put
+            from .block import rows_of
+
+            groups: Dict[Any, List[Any]] = {}
+            for ref in refs:
+                for row in rows_of(get(ref)):
+                    k = row[key]
+                    k = k.item() if hasattr(k, "item") else k
+                    groups.setdefault(k, []).append(row)
+            out = []
+            for k in sorted(groups):
+                result = fn(groups[k])
+                out.append(put(list(result)))
+            return out
+
+        return self._ds._append(_LogicalOp(
+            "all_to_all", f"groupby({key}).map_groups", {"fn": exchange}))

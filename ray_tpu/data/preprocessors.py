@@ -1,0 +1,151 @@
+"""Preprocessors: fit statistics on a Dataset, transform as map_batches
+(ref: python/ray/data/preprocessors/ — scaler.py StandardScaler/
+MinMaxScaler, encoder.py LabelEncoder, concatenator.py Concatenator).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Preprocessor:
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        if not self._fitted:
+            raise RuntimeError(f"{type(self).__name__} is not fitted")
+        fn = self._transform_batch_fn()
+        return ds.map_batches(fn)
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def _fit(self, ds) -> None:
+        raise NotImplementedError
+
+    def _transform_batch_fn(self):
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        stats = ds.column_stats(self.columns)  # one pass for all columns
+        for col in self.columns:
+            self.stats_[col] = (stats[col]["mean"],
+                                stats[col]["std"] or 1.0)
+
+    def _transform_batch_fn(self):
+        stats = dict(self.stats_)
+        columns = list(self.columns)
+
+        def fn(batch):
+            out = dict(batch)
+            for col in columns:
+                mean, std = stats[col]
+                out[col] = (np.asarray(batch[col], np.float64) - mean) \
+                    / (std or 1.0)
+            return out
+
+        return fn
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        stats = ds.column_stats(self.columns)  # one pass for all columns
+        for col in self.columns:
+            self.stats_[col] = (stats[col]["min"], stats[col]["max"])
+
+    def _transform_batch_fn(self):
+        stats = dict(self.stats_)
+        columns = list(self.columns)
+
+        def fn(batch):
+            out = dict(batch)
+            for col in columns:
+                lo, hi = stats[col]
+                span = (hi - lo) or 1.0
+                out[col] = (np.asarray(batch[col], np.float64) - lo) / span
+            return out
+
+        return fn
+
+
+class LabelEncoder(Preprocessor):
+    """Categorical column -> dense int codes (sorted label order)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: List = []
+
+    def _fit(self, ds) -> None:
+        seen = set()
+        for row in ds.iter_rows():
+            val = row[self.label_column]
+            seen.add(val.item() if hasattr(val, "item") else val)
+        self.classes_ = sorted(seen)
+
+    def _transform_batch_fn(self):
+        mapping = {c: i for i, c in enumerate(self.classes_)}
+        col = self.label_column
+
+        def fn(batch):
+            out = dict(batch)
+            out[col] = np.asarray(
+                [mapping[v.item() if hasattr(v, "item") else v]
+                 for v in batch[col]], np.int64)
+            return out
+
+        return fn
+
+
+class Concatenator(Preprocessor):
+    """Merge feature columns into one float matrix column (the model-
+    input shape for jax training)."""
+
+    def __init__(self, columns: List[str], output_column: str = "features",
+                 drop: bool = True):
+        self.columns = list(columns)
+        self.output_column = output_column
+        self.drop = drop
+
+    def _fit(self, ds) -> None:
+        pass
+
+    def _transform_batch_fn(self):
+        columns = list(self.columns)
+        out_col = self.output_column
+        drop = self.drop
+
+        def fn(batch):
+            mat = np.stack(
+                [np.asarray(batch[c], np.float64) for c in columns],
+                axis=1)
+            out = {k: v for k, v in batch.items()
+                   if not (drop and k in columns)}
+            out[out_col] = mat
+            return out
+
+        return fn
+
+
+__all__ = ["Preprocessor", "StandardScaler", "MinMaxScaler",
+           "LabelEncoder", "Concatenator"]

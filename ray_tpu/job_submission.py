@@ -228,10 +228,15 @@ class JobSubmissionClient:
         """Generator yielding log increments until the job terminates.
         Follows an absolute file offset, so logs larger than any tail
         window stream completely."""
+        import codecs
+
         import ray_tpu
 
         sup = self._supervisor(submission_id)
         offset = 0
+        # incremental decoder: a multibyte char split at a read boundary
+        # must not decode as replacement characters
+        decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
 
         def _drain():
             nonlocal offset
@@ -241,7 +246,9 @@ class JobSubmissionClient:
                 if not chunk:
                     return
                 offset += len(chunk)
-                yield chunk.decode(errors="replace")
+                text = decoder.decode(chunk)
+                if text:
+                    yield text
 
         while True:
             yield from _drain()

@@ -1,0 +1,55 @@
+"""joblib backend over the cluster (ref: python/ray/util/joblib/ —
+register_ray + RayBackend, which rides joblib's MultiprocessingBackend
+over the ray multiprocessing Pool shim; same construction here).
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=4)(delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .multiprocessing import Pool
+
+
+def register_ray() -> None:
+    from joblib import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", _RayTpuBackend)
+
+
+from joblib._parallel_backends import MultiprocessingBackend  # noqa: E402
+
+
+class _RayTpuBackend(MultiprocessingBackend):
+    """joblib batches dispatch through the cluster-backed Pool; joblib's
+    own pool-management protocol (apply_async + callbacks, terminate)
+    drives it unchanged."""
+
+    supports_sharedmem = False
+
+    def effective_n_jobs(self, n_jobs: Optional[int]) -> int:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if n_jobs is None or n_jobs == -1:
+            return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 has no meaning")
+        return max(1, n_jobs)
+
+    def configure(self, n_jobs: int = 1, parallel=None, prefer=None,
+                  require=None, **memmapping_pool_args) -> int:
+        n_jobs = self.effective_n_jobs(n_jobs)
+        self.parallel = parallel
+        self._pool = Pool(n_jobs)
+        return n_jobs
+
+    def terminate(self) -> None:
+        if getattr(self, "_pool", None) is not None:
+            self._pool.terminate()
+            self._pool = None

@@ -1,0 +1,193 @@
+"""multiprocessing.Pool drop-in over the cluster (ref:
+python/ray/util/multiprocessing/pool.py — map/starmap/apply/imap on
+remote tasks instead of local fork workers)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool,
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
+        self._refs = refs
+        self._single = single
+        if callback is not None or error_callback is not None:
+            import threading
+
+            def _notify():
+                try:
+                    value = self.get()
+                except BaseException as e:  # noqa: BLE001
+                    if error_callback is not None:
+                        error_callback(e)
+                    return
+                if callback is not None:
+                    callback(value)
+
+            threading.Thread(target=_notify, daemon=True).start()
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+
+class Pool:
+    """Tasks run on the cluster. ``processes`` shapes the default map
+    chunksize; actual parallelism is bounded by the cluster's resource
+    scheduler (every chunk is submitted immediately and queues there),
+    not by a local worker count."""
+
+    def __init__(self, processes: Optional[int] = None, *,
+                 ray_remote_args: Optional[dict] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes or 8
+        self._remote_args = ray_remote_args or {"num_cpus": 1}
+        self._closed = False
+
+    def _task(self, fn: Callable):
+        import ray_tpu
+
+        return ray_tpu.remote(**self._remote_args)(fn)
+
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    # --- apply ---
+
+    def apply(self, fn, args=(), kwds=None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args=(), kwds=None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        self._check()
+        import cloudpickle
+
+        task = self._task(_call_runner)
+        blob = cloudpickle.dumps(fn)
+        return AsyncResult(
+            [task.remote(blob, tuple(args), dict(kwds or {}))],
+            single=True, callback=callback, error_callback=error_callback)
+
+    # --- map family ---
+
+    def map(self, fn, iterable: Iterable, chunksize: Optional[int] = None):
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        self._check()
+        items = list(iterable)
+        task = self._task(_chunk_runner)
+        chunksize = chunksize or max(
+            1, len(items) // (self._processes * 4) or 1)
+        import cloudpickle
+
+        blob = cloudpickle.dumps(fn)
+        refs = [task.remote(blob, items[i:i + chunksize], False)
+                for i in range(0, len(items), chunksize)]
+        return _FlattenResult(refs)
+
+    def starmap(self, fn, iterable: Iterable,
+                chunksize: Optional[int] = None):
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn, iterable: Iterable,
+                      chunksize: Optional[int] = None) -> AsyncResult:
+        self._check()
+        items = [tuple(x) for x in iterable]
+        task = self._task(_chunk_runner)
+        chunksize = chunksize or max(
+            1, len(items) // (self._processes * 4) or 1)
+        import cloudpickle
+
+        blob = cloudpickle.dumps(fn)
+        refs = [task.remote(blob, items[i:i + chunksize], True)
+                for i in range(0, len(items), chunksize)]
+        return _FlattenResult(refs)
+
+    def imap(self, fn, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        """Ordered lazy iteration (results stream as chunks finish)."""
+        import ray_tpu
+
+        result = self.map_async(fn, iterable, chunksize)
+        for ref in result._refs:
+            for value in ray_tpu.get(ref):
+                yield value
+
+    def imap_unordered(self, fn, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        import ray_tpu
+
+        result = self.map_async(fn, iterable, chunksize)
+        pending = list(result._refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            for value in ray_tpu.get(ready[0]):
+                yield value
+
+    # --- lifecycle ---
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+class _FlattenResult(AsyncResult):
+    def __init__(self, refs):
+        super().__init__(refs, single=False)
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        chunks = ray_tpu.get(self._refs, timeout=timeout)
+        return list(itertools.chain.from_iterable(chunks))
+
+
+def _chunk_runner(fn_blob: bytes, chunk: List[Any], star: bool):
+    import cloudpickle
+
+    fn = cloudpickle.loads(fn_blob)
+    if star:
+        return [fn(*item) for item in chunk]
+    return [fn(item) for item in chunk]
+
+
+def _call_runner(fn_blob: bytes, args: tuple, kwds: dict):
+    import cloudpickle
+
+    return cloudpickle.loads(fn_blob)(*args, **kwds)

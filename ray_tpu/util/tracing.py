@@ -1,0 +1,67 @@
+"""Tracing / profiling (ref: SURVEY §5.1 — the reference's opentelemetry
+hooks + `ray timeline` chrome-trace export; device-plane profiling maps
+to jax.profiler, whose traces open in Perfetto/XProf).
+
+    ray_tpu.util.tracing.timeline("/tmp/timeline.json")  # chrome trace
+    with ray_tpu.util.tracing.profile("/tmp/jax_trace"):  # device trace
+        train_step(...)
+"""
+
+from __future__ import annotations
+
+import json
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Export task events as a chrome://tracing / Perfetto JSON array
+    (ref: ray.timeline — dashboard's chrome-trace exporter). Rows group
+    by task name; each completed task becomes a duration event."""
+    from . import state as state_api
+
+    events = []
+    for task in state_api.list_tasks():
+        start, end = task["start_time"], task["end_time"]
+        if not start:
+            continue
+        event = {
+            "name": task["name"],
+            "cat": "task",
+            "ph": "X",                        # complete (duration) event
+            "ts": start * 1e6,                # chrome trace wants us
+            "dur": max(((end or start) - start) * 1e6, 1.0),
+            "pid": "ray_tpu",
+            "tid": task["name"],
+            "args": {"task_id": task["task_id"], "state": task["state"],
+                     **({"error": task["error"]} if task["error"] else {})},
+        }
+        events.append(event)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+@contextmanager
+def profile(log_dir: str):
+    """Device-plane profiler pass-through: traces XLA execution on the
+    chip (open in XProf/Perfetto). Host-side events still come from
+    timeline()."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextmanager
+def span(name: str):
+    """Annotate a host-side region so it shows up in device traces
+    (jax.profiler.TraceAnnotation passthrough)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,91 @@
+"""Aux services: timeline tracing, dashboard API, multiprocessing/joblib
+shims (ref: test_advanced timeline test, dashboard module tests,
+util/multiprocessing + joblib tests)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_timeline_exports_chrome_trace(ray_cluster, tmp_path):
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def traced_task():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([traced_task.remote() for _ in range(3)], timeout=60)
+    out = tmp_path / "timeline.json"
+    events = tracing.timeline(str(out))
+    assert out.exists()
+    loaded = json.loads(out.read_text())
+    named = [e for e in loaded if "traced_task" in e["name"]]
+    assert len(named) >= 3
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in named)
+
+
+def test_dashboard_api(ray_cluster):
+    from ray_tpu import dashboard
+
+    @ray_tpu.remote
+    def touch():
+        return 1
+
+    ray_tpu.get(touch.remote(), timeout=60)
+    port = dashboard.start_dashboard()
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+                return json.loads(resp.read())
+
+        nodes = fetch("/api/nodes")
+        assert len(nodes) == 1 and nodes[0]["Alive"]
+        status = fetch("/api/cluster_status")
+        assert status["nodes"] == 1
+        assert status["resources_total"]["CPU"] == 4.0
+        tasks = fetch("/api/tasks")
+        assert any("touch" in t["name"] for t in tasks)
+        assert isinstance(fetch("/api/actors"), list)
+        assert isinstance(fetch("/api/metrics"), list)
+    finally:
+        dashboard.stop_dashboard()
+
+
+def test_multiprocessing_pool(ray_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(4) as pool:
+        assert pool.map(lambda x: x * x, range(20)) == \
+            [x * x for x in range(20)]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(lambda a: a + 1, (41,)) == 42
+        async_res = pool.apply_async(lambda: "ok")
+        assert async_res.get(timeout=60) == "ok"
+        assert sorted(pool.imap_unordered(lambda x: x * 2, range(6))) == \
+            [0, 2, 4, 6, 8, 10]
+        assert list(pool.imap(lambda x: x + 1, range(5))) == [1, 2, 3, 4, 5]
+
+
+def test_joblib_backend(ray_cluster):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=4)(
+            joblib.delayed(lambda x: x ** 2)(i) for i in range(12))
+    assert out == [i ** 2 for i in range(12)]

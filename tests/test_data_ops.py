@@ -1,0 +1,136 @@
+"""Data widening: repartition/sort/groupby/union/zip, csv io,
+preprocessors, device-feed iterators (ref: python/ray/data/tests/ —
+test_sort, test_all_to_all, test_csv, preprocessor suites)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data.preprocessors import (
+    Concatenator, LabelEncoder, MinMaxScaler, StandardScaler)
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_repartition(ray_cluster):
+    ds = rdata.range(100, parallelism=8).repartition(3)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 3
+    rows = [r["id"] for r in ds.iter_rows()]
+    assert sorted(rows) == list(range(100))
+
+
+def test_sort(ray_cluster):
+    items = [{"k": v} for v in [5, 3, 9, 1, 7, 2, 8]]
+    ds = rdata.from_items(items, parallelism=3).sort("k")
+    assert [r["k"] for r in ds.iter_rows()] == [1, 2, 3, 5, 7, 8, 9]
+    dsd = rdata.from_items(items, parallelism=3).sort("k", descending=True)
+    assert [r["k"] for r in dsd.iter_rows()] == [9, 8, 7, 5, 3, 2, 1]
+
+
+def test_groupby_aggregations(ray_cluster):
+    items = [{"g": i % 3, "v": float(i)} for i in range(12)]
+    ds = rdata.from_items(items, parallelism=4)
+    counts = {r["g"]: r["count()"]
+              for r in ds.groupby("g").count().iter_rows()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+    sums = {r["g"]: r["sum(v)"]
+            for r in ds.groupby("g").sum("v").iter_rows()}
+    assert sums == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+    means = {r["g"]: r["mean(v)"]
+             for r in ds.groupby("g").mean("v").iter_rows()}
+    assert means[0] == pytest.approx(4.5)
+
+
+def test_groupby_map_groups(ray_cluster):
+    items = [{"g": i % 2, "v": i} for i in range(6)]
+    ds = rdata.from_items(items, parallelism=2)
+    out = ds.groupby("g").map_groups(
+        lambda rows: [{"g": rows[0]["g"],
+                       "vmax": max(r["v"] for r in rows)}])
+    got = {r["g"]: r["vmax"] for r in out.iter_rows()}
+    assert got == {0: 4, 1: 5}
+
+
+def test_union_and_zip(ray_cluster):
+    a = rdata.from_items([{"x": i} for i in range(5)], parallelism=2)
+    b = rdata.from_items([{"x": i + 100} for i in range(3)], parallelism=1)
+    u = a.union(b)
+    assert sorted(r["x"] for r in u.iter_rows()) == [0, 1, 2, 3, 4, 100,
+                                                     101, 102]
+    left = rdata.from_items([{"x": i} for i in range(4)], parallelism=2)
+    right = rdata.from_items([{"y": i * 10} for i in range(4)],
+                             parallelism=1)
+    z = left.zip(right)
+    rows = sorted(z.iter_rows(), key=lambda r: r["x"])
+    assert [(r["x"], r["y"]) for r in rows] == [(0, 0), (1, 10), (2, 20),
+                                                (3, 30)]
+
+
+def test_dataset_aggregates(ray_cluster):
+    ds = rdata.from_items([{"v": float(i)} for i in range(10)],
+                          parallelism=3)
+    assert ds.sum("v") == 45.0
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 9.0
+    assert ds.mean("v") == pytest.approx(4.5)
+
+
+def test_csv_roundtrip(ray_cluster, tmp_path):
+    ds = rdata.from_items(
+        [{"a": i, "b": i * 0.5, "name": f"row{i}"} for i in range(10)],
+        parallelism=2)
+    ds.write_csv(str(tmp_path / "out"))
+    back = rdata.read_csv(str(tmp_path / "out"))
+    rows = sorted(back.iter_rows(), key=lambda r: r["a"])
+    assert len(rows) == 10
+    assert rows[3]["a"] == 3 and rows[3]["b"] == 1.5
+    assert rows[3]["name"] == "row3"
+
+
+def test_preprocessors(ray_cluster):
+    items = [{"f1": float(i), "f2": float(i * 2), "label": "ab"[i % 2]}
+             for i in range(8)]
+    ds = rdata.from_items(items, parallelism=2)
+
+    scaled = StandardScaler(["f1"]).fit_transform(ds)
+    col = np.asarray([r["f1"] for r in scaled.iter_rows()])
+    assert abs(col.mean()) < 1e-9 and col.std() == pytest.approx(1.0)
+
+    mm = MinMaxScaler(["f2"]).fit_transform(ds)
+    col = np.asarray([r["f2"] for r in mm.iter_rows()])
+    assert col.min() == 0.0 and col.max() == 1.0
+
+    enc = LabelEncoder("label").fit_transform(ds)
+    labels = sorted(set(int(r["label"]) for r in enc.iter_rows()))
+    assert labels == [0, 1]
+
+    cat = Concatenator(["f1", "f2"]).fit_transform(ds)
+    row = cat.take(1)[0]
+    assert row["features"].shape == (2,)
+    assert "f1" not in row
+
+
+def test_iter_jax_batches_prefetch(ray_cluster):
+    import jax
+
+    ds = rdata.range(64, parallelism=4)
+    batches = list(ds.iter_jax_batches(batch_size=16))
+    assert len(batches) == 4
+    assert all(isinstance(b["id"], jax.Array) for b in batches)
+    assert int(batches[0]["id"].sum()) == sum(range(16))
+
+
+def test_iter_torch_batches(ray_cluster):
+    import torch
+
+    ds = rdata.range(32, parallelism=2)
+    batches = list(ds.iter_torch_batches(batch_size=8))
+    assert len(batches) == 4
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
