@@ -30,6 +30,21 @@ from ..ops import apply_rotary, attention, rms_norm, rope_frequencies
 from .cache import KVCache
 
 
+def _mlp(h, lp, cfg: LlamaConfig):
+    """Serving MLP: dense SwiGLU, or EXACT top-k MoE for expert configs.
+    Inference routes drop-free (moe_mlp_oracle semantics) — the training
+    path's capacity-factor dispatch drops tokens under load, which at
+    serving time would silently change generations with batch shape."""
+    if cfg.n_experts:
+        from ..ops.moe import moe_mlp_oracle
+
+        return moe_mlp_oracle(h, lp["router"], lp["w_gate"], lp["w_up"],
+                              lp["w_down"], top_k=cfg.top_k)
+    g = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"])
+    u = jnp.einsum("bsd,dm->bsm", h, lp["w_up"])
+    return jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
+
+
 def _write_pages(cache_layer, new, block_tables, positions, page_size):
     """Scatter per-token K or V rows into their pages.
 
@@ -79,9 +94,7 @@ def prefill(params, cache_k, cache_v, tokens, prompt_lens, block_tables,
         o = attention(q, k, v, causal=True)
         x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        g = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"])
-        u = jnp.einsum("bsd,dm->bsm", h, lp["w_up"])
-        x = x + jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
+        x = x + _mlp(h, lp, cfg)
         return x, (ck, cv)
 
     x, (cache_k, cache_v) = jax.lax.scan(
@@ -200,10 +213,7 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
             o = o.reshape(B, 1, cfg.n_heads, hd).astype(x.dtype)
             x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
             h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-            g = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"])
-            u = jnp.einsum("bsd,dm->bsm", h, lp["w_up"])
-            x = x + jnp.einsum("bsm,md->bsd",
-                               jax.nn.silu(g) * u, lp["w_down"])
+            x = x + _mlp(h, lp, cfg)
             return x, (nk, nv)
 
         x, (sk, sv) = jax.lax.scan(
