@@ -18,6 +18,14 @@ from typing import Any, Dict
 
 _ENV_PREFIX = "RAY_TPU_"
 
+# session roots live here (node session dirs, worker logs); single source
+# of truth for every module that derives session paths
+TEMP_ROOT = "/tmp/ray_tpu"
+
+
+def session_log_dir(session_name: str) -> str:
+    return os.path.join(TEMP_ROOT, session_name, "logs")
+
 
 def _coerce(value: str, ty: type) -> Any:
     if ty is bool:

@@ -24,7 +24,7 @@ from .object_store import SharedObjectStore
 from .raylet import Raylet
 from .rpc import EventLoopThread
 
-_TEMP_ROOT = "/tmp/ray_tpu"
+from .config import TEMP_ROOT as _TEMP_ROOT
 
 
 def default_resources() -> Dict[str, float]:

@@ -28,7 +28,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from .config import global_config
+from .config import global_config, session_log_dir
 from .ids import ActorID, NodeID, ObjectID, WorkerID
 from .object_store import SharedObjectStore
 from .rpc import RpcClient, RpcServer, ServerConnection
@@ -136,6 +136,7 @@ class Raylet:
         self._leases: Dict[int, Lease] = {}
         self._next_lease_id = 1
         self._pending_leases: List[_PendingLease] = []
+        self._worker_seq = 0  # names this node's worker log files
         # lease-request dedup by client request id, so a retried request
         # (reply lost, injected chaos, flaky DCN) returns the SAME grant
         # instead of leaking a second worker (ref: retryable_grpc_client.h +
@@ -296,13 +297,23 @@ class Raylet:
         # explicitly (train/worker_group.py _maybe_init_jax_distributed).
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
+        # worker stdout/stderr land in per-worker session log files (the
+        # reference's log_monitor capture; surfaced via the state API's
+        # list_logs/get_log raylet RPCs)
+        log_dir = session_log_dir(self.session_name)
+        os.makedirs(log_dir, exist_ok=True)
+        self._worker_seq += 1
+        log_path = os.path.join(
+            log_dir, f"worker-{self.node_id.hex()[:8]}-{self._worker_seq}.log")
+        log_file = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env,
-            stdout=None,
-            stderr=None,
+            stdout=log_file,
+            stderr=log_file,
             start_new_session=True,
         )
+        log_file.close()  # the child holds its own fd
         self._subprocs.append(proc)
 
     async def handle_register_worker(self, payload, conn):
@@ -487,6 +498,33 @@ class Raylet:
                     exc.TaskCancelledError("lease request cancelled"))
                 hit = True
         return hit
+
+    async def handle_list_logs(self, payload, conn):
+        """THIS node's captured worker logs (log-monitor surface). The
+        session log dir is shared by co-hosted raylets, so filter to our
+        own node-id prefix."""
+        prefix = f"worker-{self.node_id.hex()[:8]}-"
+        try:
+            return sorted(n for n in os.listdir(
+                session_log_dir(self.session_name))
+                if n.startswith(prefix))
+        except FileNotFoundError:
+            return []
+
+    async def handle_tail_log(self, payload, conn):
+        """Last ``tail_bytes`` of one captured log (basename only — no
+        path traversal out of the session log dir)."""
+        name = os.path.basename(payload["name"])
+        tail_bytes = int(payload.get("tail_bytes", 1 << 16))
+        path = os.path.join(session_log_dir(self.session_name), name)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read()
+        except FileNotFoundError:
+            return b""
 
     async def handle_return_worker(self, payload, conn):
         lease = self._leases.pop(payload["lease_id"], None)

@@ -32,14 +32,16 @@ from .cache import KVCache
 
 def _mlp(h, lp, cfg: LlamaConfig):
     """Serving MLP: dense SwiGLU, or EXACT top-k MoE for expert configs.
-    Inference routes drop-free (moe_mlp_oracle semantics) — the training
-    path's capacity-factor dispatch drops tokens under load, which at
-    serving time would silently change generations with batch shape."""
+    Inference must route drop-free (capacity-factor dispatch drops
+    tokens batch-dependently, silently changing generations), so MoE
+    uses the dense all-expert mixture — E-fold MLP FLOPs, the right
+    trade at small E / decode batch sizes; see ops.moe.moe_mlp_dense
+    for the large-E upgrade path."""
     if cfg.n_experts:
-        from ..ops.moe import moe_mlp_oracle
+        from ..ops.moe import moe_mlp_dense
 
-        return moe_mlp_oracle(h, lp["router"], lp["w_gate"], lp["w_up"],
-                              lp["w_down"], top_k=cfg.top_k)
+        return moe_mlp_dense(h, lp["router"], lp["w_gate"], lp["w_up"],
+                             lp["w_down"], top_k=cfg.top_k)
     g = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"])
     u = jnp.einsum("bsd,dm->bsm", h, lp["w_up"])
     return jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])

@@ -16,8 +16,18 @@ from .llama import (
     forward,
     lm_loss,
 )
+from .vision import (
+    RESNET_CONFIGS,
+    ResNetConfig,
+    image_loss,
+    init_resnet,
+    resnet_forward,
+    resnet_param_axes,
+)
 
 __all__ = [
     "LlamaConfig", "LLAMA_CONFIGS", "init_params", "param_logical_axes",
     "forward", "lm_loss",
+    "ResNetConfig", "RESNET_CONFIGS", "init_resnet", "resnet_forward",
+    "image_loss", "resnet_param_axes",
 ]

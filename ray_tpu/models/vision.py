@@ -1,0 +1,135 @@
+"""Vision models: functional jax ResNet for the image-training path
+(ref: the reference's Train image benchmarks — torch ResNet at
+doc/source/train/benchmarks.rst:36-44; here the model is native jax so
+the same make_train_step / Data streaming_split machinery drives it).
+
+TPU choices: GroupNorm instead of BatchNorm (stateless — no running
+statistics to thread through pjit or sync across data-parallel
+replicas), NHWC layout (XLA's preferred conv layout on TPU), bf16
+params with f32 normalization/loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 10
+    # channels per stage; depths = residual blocks per stage
+    channels: Tuple[int, ...] = (64, 128, 256, 512)
+    depths: Tuple[int, ...] = (2, 2, 2, 2)       # ResNet-18 shape
+    groups: int = 8                              # GroupNorm groups
+    stem_kernel: int = 3                         # 3 for CIFAR-size, 7 ImageNet
+    dtype: Any = jnp.bfloat16
+
+    def n_params(self) -> int:
+        leaves = jax.tree.leaves(
+            jax.eval_shape(lambda: init_resnet(jax.random.PRNGKey(0), self)))
+        return sum(int(jnp.prod(jnp.asarray(l.shape))) for l in leaves)
+
+
+RESNET_CONFIGS: Dict[str, ResNetConfig] = {
+    "tiny": ResNetConfig(channels=(8, 16), depths=(1, 1), groups=4,
+                         dtype=jnp.float32),
+    "resnet18": ResNetConfig(),
+    "resnet34": ResNetConfig(depths=(3, 4, 6, 3)),
+}
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+            * (2.0 / fan_in) ** 0.5).astype(dtype)
+
+
+def init_resnet(key, cfg: ResNetConfig, in_channels: int = 3):
+    keys = iter(jax.random.split(key, 4 + 4 * sum(cfg.depths)))
+    params: Dict[str, Any] = {
+        "stem": _conv_init(next(keys), cfg.stem_kernel, cfg.stem_kernel,
+                           in_channels, cfg.channels[0], cfg.dtype),
+        "stem_scale": jnp.ones(cfg.channels[0], cfg.dtype),
+        "stages": [],
+    }
+    cin = cfg.channels[0]
+    for stage, (cout, depth) in enumerate(zip(cfg.channels, cfg.depths)):
+        blocks: List[Dict[str, Any]] = []
+        for b in range(depth):
+            block = {
+                "conv1": _conv_init(next(keys), 3, 3, cin, cout, cfg.dtype),
+                "scale1": jnp.ones(cout, cfg.dtype),
+                "conv2": _conv_init(next(keys), 3, 3, cout, cout, cfg.dtype),
+                "scale2": jnp.ones(cout, cfg.dtype),
+            }
+            if cin != cout:
+                block["proj"] = _conv_init(next(keys), 1, 1, cin, cout,
+                                           cfg.dtype)
+            blocks.append(block)
+            cin = cout
+        params["stages"].append(blocks)
+    params["head"] = (jax.random.normal(
+        next(keys), (cfg.channels[-1], cfg.num_classes), jnp.float32)
+        * (cfg.channels[-1] ** -0.5)).astype(cfg.dtype)
+    params["head_b"] = jnp.zeros(cfg.num_classes, cfg.dtype)
+    return params
+
+
+def _group_norm(x, scale, groups: int):
+    # f32 statistics regardless of activation dtype
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    xf = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + 1e-5)
+    return (xf.reshape(B, H, W, C) * scale.astype(jnp.float32)).astype(
+        x.dtype)
+
+
+def _conv(x, w, stride: int = 1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def resnet_forward(params, images, cfg: ResNetConfig):
+    """images (B, H, W, C) float in [0,1] -> logits (B, num_classes) f32."""
+    x = _conv(images.astype(cfg.dtype), params["stem"])
+    x = jax.nn.relu(_group_norm(x, params["stem_scale"], cfg.groups))
+    for stage, blocks in enumerate(params["stages"]):
+        for b, block in enumerate(blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            h = _conv(x, block["conv1"], stride)
+            h = jax.nn.relu(_group_norm(h, block["scale1"], cfg.groups))
+            h = _conv(h, block["conv2"])
+            h = _group_norm(h, block["scale2"], cfg.groups)
+            shortcut = x
+            if "proj" in block:
+                shortcut = _conv(x, block["proj"], stride)
+            elif stride != 1:
+                shortcut = x[:, ::stride, ::stride, :]
+            x = jax.nn.relu(h + shortcut)
+    x = x.mean(axis=(1, 2))  # global average pool
+    logits = jnp.einsum("bc,cn->bn", x.astype(cfg.dtype), params["head"],
+                        preferred_element_type=jnp.float32)
+    return logits + params["head_b"].astype(jnp.float32)
+
+
+def image_loss(params, batch, cfg: ResNetConfig, **_):
+    """Cross-entropy over {"images": (B,H,W,C), "labels": (B,)}."""
+    logits = resnet_forward(params, batch["images"], cfg)
+    labels = batch["labels"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return (logz - tgt).mean()
+
+
+def resnet_param_axes(params):
+    """Logical axes: everything replicated (vision models this size are
+    pure data-parallel; batch sharding comes from the train step)."""
+    return jax.tree.map(lambda _: (), params)

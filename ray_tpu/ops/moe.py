@@ -104,7 +104,8 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, w_gate: jnp.ndarray,
 
 def moe_mlp_oracle(x, router_w, w_gate, w_up, w_down, *, top_k=2):
     """Per-token reference (no capacity drops): for each token, sum over
-    its top-k experts of renormalized_prob * SwiGLU_e(x). Test oracle."""
+    its top-k experts of renormalized_prob * SwiGLU_e(x). Test oracle —
+    and the serving path's exact dense mixture (see moe_mlp_dense)."""
     B, S, D = x.shape
     xt = x.reshape(-1, D).astype(jnp.float32)
     gates = jax.nn.softmax(xt @ router_w.astype(jnp.float32), axis=-1)
@@ -118,3 +119,13 @@ def moe_mlp_oracle(x, router_w, w_gate, w_up, w_down, *, top_k=2):
     outs = jnp.einsum("etm,emd->etd", h, w_down.astype(jnp.float32))
     out = jnp.einsum("te,etd->td", weights, outs)
     return out.reshape(B, S, D).astype(x.dtype)
+
+
+# Inference alias: exact (drop-free) routing via a dense all-expert
+# mixture. Deliberate tradeoff: for small expert counts this keeps the
+# MXU on large dense matmuls (a gather/segment dispatch beats it only
+# when E >> top_k); for large-E serving the upgrade path is a ragged
+# all-to-all dispatch kernel without the training path's capacity cap —
+# capacity-based dispatch is unusable at inference because drops change
+# generations batch-dependently.
+moe_mlp_dense = moe_mlp_oracle

@@ -33,7 +33,8 @@ class Deployment:
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
-                ray_actor_options: Optional[dict] = None) -> "Deployment":
+                ray_actor_options: Optional[dict] = None,
+                autoscaling_config: Optional[dict] = None) -> "Deployment":
         config = dict(self.config)
         if num_replicas is not None:
             config["num_replicas"] = num_replicas
@@ -41,6 +42,8 @@ class Deployment:
             config["max_ongoing_requests"] = max_ongoing_requests
         if ray_actor_options is not None:
             config["ray_actor_options"] = ray_actor_options
+        if autoscaling_config is not None:
+            config["autoscaling_config"] = autoscaling_config
         return Deployment(self._cls, name or self.name, config)
 
 
@@ -48,13 +51,21 @@ def deployment(cls: Optional[type] = None, *,
                name: Optional[str] = None,
                num_replicas: int = 1,
                max_ongoing_requests: int = 100,
-               ray_actor_options: Optional[dict] = None):
-    """@serve.deployment — turn a class into a deployable unit."""
+               ray_actor_options: Optional[dict] = None,
+               autoscaling_config: Optional[dict] = None):
+    """@serve.deployment — turn a class into a deployable unit.
+
+    ``autoscaling_config`` (ref: serve AutoscalingConfig):
+    {"min_replicas", "max_replicas", "target_ongoing_requests",
+    "downscale_ticks"} — replica count then tracks live queue lengths
+    instead of num_replicas."""
     def _wrap(target: type) -> Deployment:
         return Deployment(target, name or target.__name__, {
             "num_replicas": num_replicas,
             "max_ongoing_requests": max_ongoing_requests,
             "ray_actor_options": ray_actor_options,
+            **({"autoscaling_config": autoscaling_config}
+               if autoscaling_config else {}),
         })
 
     if cls is not None:

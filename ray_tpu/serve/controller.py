@@ -108,8 +108,48 @@ class ServeController:
         except Exception:
             pass
 
+    async def _autoscale_target(self, dep: dict, auto: dict) -> int:
+        """Queue-length-driven replica target (ref: serve/_private/
+        autoscaling_state.py + serve/autoscaling_policy.py): desired =
+        ceil(total ongoing / target_ongoing_requests), clamped to
+        [min, max]. Upscale applies immediately; downscale waits for
+        ``downscale_ticks`` consecutive low observations so a burst lull
+        doesn't thrash replicas."""
+        import math
+
+        min_r = int(auto.get("min_replicas", 1))
+        max_r = int(auto.get("max_replicas", max(min_r, 1)))
+        per = float(auto.get("target_ongoing_requests", 2))
+        ticks_needed = int(auto.get("downscale_ticks", 3))
+
+        async def _qlen(entry):
+            try:
+                return await asyncio.wait_for(
+                    _await_ref(entry[0].queue_len.remote()), 5)
+            except Exception:
+                return 0
+
+        lens = await asyncio.gather(*[_qlen(e) for e in dep["replicas"]])
+        total = sum(lens)
+        desired = max(min_r, min(max_r,
+                                 math.ceil(total / per) if total else min_r))
+        current = len(dep["replicas"])
+        if desired >= current:
+            dep["_low_ticks"] = 0
+            return desired
+        dep["_low_ticks"] = dep.get("_low_ticks", 0) + 1
+        if dep["_low_ticks"] >= ticks_needed:
+            dep["_low_ticks"] = 0
+            return desired
+        return current
+
     async def _reconcile_deployment(self, dep: dict) -> None:
-        target = dep["config"].get("num_replicas", 1)
+        auto = dep["config"].get("autoscaling_config")
+        if auto:
+            target = await self._autoscale_target(dep, auto)
+            dep["_auto_target"] = target
+        else:
+            target = dep["config"].get("num_replicas", 1)
         code_version = dep["code_version"]
 
         # concurrent health checks: one hung replica must not stall the
@@ -138,8 +178,27 @@ class ServeController:
             dep["replicas"].append(
                 (await self._make_replica(dep), code_version))
             changed = True
-        while len(dep["replicas"]) > target:
-            await self._stop_replica(dep["replicas"].pop()[0])
+        if len(dep["replicas"]) > target:
+            # downscale the IDLEST replicas first: killing a replica
+            # fails its in-flight requests, so pick by live queue depth
+            async def _depth(entry):
+                try:
+                    return await asyncio.wait_for(
+                        _await_ref(entry[0].queue_len.remote()), 5)
+                except Exception:
+                    return -1  # unreachable sorts lowest: drop it first
+            depths = await asyncio.gather(
+                *[_depth(e) for e in dep["replicas"]])
+            ranked = sorted(zip(depths, range(len(dep["replicas"]))),
+                            key=lambda p: p[0])
+            drop = {i for _, i in ranked[:len(dep["replicas"]) - target]}
+            keep = []
+            for i, entry in enumerate(dep["replicas"]):
+                if i in drop:
+                    await self._stop_replica(entry[0])
+                else:
+                    keep.append(entry)
+            dep["replicas"] = keep
             changed = True
         if changed:
             self._version += 1
@@ -177,7 +236,12 @@ class ServeController:
         return [
             {"name": d["name"],
              "num_replicas": len(d["replicas"]),
-             "target_replicas": d["config"].get("num_replicas", 1)}
+             # autoscaled deployments report their last computed target,
+             # not the static num_replicas default
+             "target_replicas": (
+                 d.get("_auto_target", len(d["replicas"]))
+                 if d["config"].get("autoscaling_config")
+                 else d["config"].get("num_replicas", 1))}
             for d in self._deployments.values()
         ]
 

@@ -87,3 +87,31 @@ def get_metrics(name: Optional[str] = None) -> List[Dict[str, Any]]:
     """Aggregated application metrics (see ray_tpu.util.metrics)."""
     core = _core()
     return core.io.run(core.gcs.call("get_metrics", {"name": name}))
+
+
+def _raylet_call(node_id: Optional[str], method: str, payload: dict):
+    """RPC a node's raylet (this node's by default) — the log-monitor
+    access path (ref: util/state log APIs backed by per-node agents)."""
+    core = _core()
+    if node_id is None:
+        client = core.raylet
+    else:
+        infos = core.io.run(core.gcs.call("get_all_nodes", {}))
+        match = [n for n in infos if n.node_id.hex().startswith(node_id)]
+        if not match:
+            raise ValueError(f"no node {node_id!r}")
+        client = core.io.run(core._raylet_client_for(match[0].address))
+    return core.io.run(client.call(method, payload))
+
+
+def list_logs(node_id: Optional[str] = None) -> List[str]:
+    """Captured worker log files on a node (ref: ray.util.state.list_logs)."""
+    return _raylet_call(node_id, "list_logs", {})
+
+
+def get_log(filename: str, node_id: Optional[str] = None,
+            tail_bytes: int = 1 << 16) -> str:
+    """Tail one captured worker log (ref: ray.util.state.get_log)."""
+    raw = _raylet_call(node_id, "tail_log",
+                       {"name": filename, "tail_bytes": tail_bytes})
+    return raw.decode(errors="replace")

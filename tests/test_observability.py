@@ -143,3 +143,27 @@ def test_list_placement_groups(ray_cluster):
     pgs = {p["name"]: p for p in state.list_placement_groups()}
     assert pgs["obs_pg"]["state"] == "CREATED"
     remove_placement_group(pg)
+
+
+def test_worker_log_capture(ray_cluster):
+    """Worker stdout/stderr land in session log files, accessible via
+    the state API (the log-monitor surface, ref: SURVEY L6)."""
+    import time as _time
+
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def shout():
+        print("OBS_LOG_MARKER_42")
+        return 1
+
+    ray_tpu.get([shout.remote() for _ in range(2)], timeout=60)
+    deadline = _time.time() + 10
+    joined = ""
+    while _time.time() < deadline:
+        logs = state.list_logs()
+        joined = "".join(state.get_log(name) for name in logs)
+        if "OBS_LOG_MARKER_42" in joined:
+            break
+        _time.sleep(0.3)
+    assert "OBS_LOG_MARKER_42" in joined

@@ -176,3 +176,47 @@ def test_replica_death_recovers(serve_cluster):
             time.sleep(0.5)
     else:
         raise AssertionError(f"service never recovered: {last_err}")
+
+
+def test_autoscaling_scales_with_load(serve_cluster):
+    """Queue-driven replica autoscaling (ref: serve autoscaling tests):
+    a burst of slow requests grows the replica set toward max_replicas;
+    idleness shrinks it back to min_replicas."""
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1, "downscale_ticks": 2})
+    class Slow:
+        async def __call__(self, _=None):
+            import asyncio
+
+            await asyncio.sleep(1.0)
+            return os.getpid()
+
+    handle = serve.run(Slow.bind())
+    # sustained burst: keep ~8 requests in flight so reconcile rounds
+    # observe queue depth
+    refs = [handle.remote() for _ in range(8)]
+    grew = 0
+    deadline = time.time() + 40
+    while time.time() < deadline:
+        status = serve.status()
+        dep = next(d for d in status if d["name"] == "Slow")
+        grew = max(grew, dep["num_replicas"])
+        if grew >= 2:
+            break
+        refs = [r for r in refs] + [handle.remote() for _ in range(2)]
+        time.sleep(0.5)
+    assert grew >= 2, f"never scaled past 1 replica (saw {grew})"
+    ray_tpu.get(refs, timeout=120)
+
+    # idle: shrink back to min
+    deadline = time.time() + 60
+    shrunk = 99
+    while time.time() < deadline:
+        status = serve.status()
+        dep = next(d for d in status if d["name"] == "Slow")
+        shrunk = dep["num_replicas"]
+        if shrunk == 1:
+            break
+        time.sleep(1.0)
+    assert shrunk == 1

@@ -1,0 +1,101 @@
+"""Vision training path: jax ResNet + Data pipeline + train step
+(ref: the reference's image-training Train benchmarks)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def test_resnet_forward_shapes():
+    from ray_tpu.models.vision import (
+        RESNET_CONFIGS, init_resnet, resnet_forward)
+
+    cfg = RESNET_CONFIGS["tiny"]
+    params = init_resnet(jax.random.PRNGKey(0), cfg)
+    images = jax.random.uniform(jax.random.PRNGKey(1), (4, 16, 16, 3))
+    logits = resnet_forward(params, images, cfg)
+    assert logits.shape == (4, cfg.num_classes)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_resnet_trains_on_separable_data():
+    """Loss falls decisively on a synthetic separable image task using
+    the SAME make_train_step machinery as the Llama path."""
+    import optax
+
+    from ray_tpu.models.vision import (
+        RESNET_CONFIGS, image_loss, init_resnet, resnet_param_axes)
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.train import make_train_step
+
+    cfg = RESNET_CONFIGS["tiny"]
+    rng = np.random.default_rng(0)
+    B = 32
+    labels = rng.integers(0, cfg.num_classes, B)
+    # GroupNorm removes per-sample mean shifts, so encode the class as a
+    # zero-mean stripe pattern (normalization-proof separability)
+    xx = np.arange(16)[None, :, None, None]
+    images = (rng.uniform(0, 0.2, (B, 16, 16, 3))
+              + 0.5 * np.sin(2 * np.pi * (labels[:, None, None, None] + 1)
+                             * xx / 16))
+
+    mesh = build_mesh(MeshSpec(dp=8), jax.devices("cpu")[:8])
+    params = init_resnet(jax.random.PRNGKey(0), cfg)
+    init_fn, step_fn, place_batch = make_train_step(
+        lambda p, b: image_loss(p, b, cfg),
+        optax.adam(3e-3), mesh, resnet_param_axes(params))
+    state = init_fn(params)
+    batch = place_batch({"images": jnp.asarray(images, jnp.float32),
+                         "labels": jnp.asarray(labels, jnp.int32)})
+    losses = []
+    for _ in range(60):
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.25, (losses[0], losses[-1])
+
+
+def test_image_pipeline_feeds_training(tmp_path):
+    """Data pipeline -> iter_jax_batches -> train step (the Train image
+    benchmark shape: dataset streaming into the step)."""
+    import optax
+
+    import ray_tpu
+    from ray_tpu import data as rdata
+    from ray_tpu.models.vision import (
+        RESNET_CONFIGS, image_loss, init_resnet, resnet_param_axes)
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.train import make_train_step
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        cfg = RESNET_CONFIGS["tiny"]
+        rng = np.random.default_rng(1)
+        items = []
+        for i in range(64):
+            label = int(rng.integers(0, cfg.num_classes))
+            img = (rng.uniform(0, 0.2, (8, 8, 3))
+                   + label / cfg.num_classes).astype(np.float32)
+            items.append({"images": img, "labels": label})
+        ds = rdata.from_items(items, parallelism=4)
+
+        mesh = build_mesh(MeshSpec(dp=8), jax.devices("cpu")[:8])
+        params = init_resnet(jax.random.PRNGKey(0), cfg)
+        init_fn, step_fn, place_batch = make_train_step(
+            lambda p, b: image_loss(p, b, cfg),
+            optax.adam(1e-3), mesh, resnet_param_axes(params))
+        state = init_fn(params)
+        steps = 0
+        for batch in ds.iter_jax_batches(batch_size=16, drop_last=True):
+            placed = place_batch({
+                "images": jnp.asarray(np.stack(list(batch["images"])),
+                                      jnp.float32),
+                "labels": jnp.asarray(batch["labels"], jnp.int32)})
+            state, metrics = step_fn(state, placed)
+            steps += 1
+        assert steps == 4
+        assert np.isfinite(metrics["loss"])
+    finally:
+        ray_tpu.shutdown()
