@@ -302,6 +302,9 @@ class Raylet:
         # list_logs/get_log raylet RPCs)
         log_dir = session_log_dir(self.session_name)
         os.makedirs(log_dir, exist_ok=True)
+        # redirected-to-file stdout is block-buffered by default: a live
+        # pooled worker's prints would sit in the 8KB buffer forever
+        env["PYTHONUNBUFFERED"] = "1"
         self._worker_seq += 1
         log_path = os.path.join(
             log_dir, f"worker-{self.node_id.hex()[:8]}-{self._worker_seq}.log")
@@ -523,7 +526,7 @@ class Raylet:
                 size = f.tell()
                 f.seek(max(0, size - tail_bytes))
                 return f.read()
-        except FileNotFoundError:
+        except OSError:  # missing, or '.'/'..' resolving to a directory
             return b""
 
     async def handle_return_worker(self, payload, conn):

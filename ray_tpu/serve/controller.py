@@ -122,15 +122,9 @@ class ServeController:
         per = float(auto.get("target_ongoing_requests", 2))
         ticks_needed = int(auto.get("downscale_ticks", 3))
 
-        async def _qlen(entry):
-            try:
-                return await asyncio.wait_for(
-                    _await_ref(entry[0].queue_len.remote()), 5)
-            except Exception:
-                return 0
-
-        lens = await asyncio.gather(*[_qlen(e) for e in dep["replicas"]])
-        total = sum(lens)
+        lens = await self._queue_lens(dep["replicas"])
+        dep["_last_qlens"] = lens  # reused by this round's downscale
+        total = sum(max(q, 0) for q in lens)
         desired = max(min_r, min(max_r,
                                  math.ceil(total / per) if total else min_r))
         current = len(dep["replicas"])
@@ -142,6 +136,18 @@ class ServeController:
             dep["_low_ticks"] = 0
             return desired
         return current
+
+    async def _queue_lens(self, replicas) -> list:
+        """Concurrent queue-depth sample; unreachable replicas read -1
+        (sorts first for downscale victim selection, counts as 0 load)."""
+        async def _one(entry):
+            try:
+                return await asyncio.wait_for(
+                    _await_ref(entry[0].queue_len.remote()), 5)
+            except Exception:
+                return -1
+
+        return list(await asyncio.gather(*[_one(e) for e in replicas]))
 
     async def _reconcile_deployment(self, dep: dict) -> None:
         auto = dep["config"].get("autoscaling_config")
@@ -180,15 +186,12 @@ class ServeController:
             changed = True
         if len(dep["replicas"]) > target:
             # downscale the IDLEST replicas first: killing a replica
-            # fails its in-flight requests, so pick by live queue depth
-            async def _depth(entry):
-                try:
-                    return await asyncio.wait_for(
-                        _await_ref(entry[0].queue_len.remote()), 5)
-                except Exception:
-                    return -1  # unreachable sorts lowest: drop it first
-            depths = await asyncio.gather(
-                *[_depth(e) for e in dep["replicas"]])
+            # fails its in-flight requests, so rank by queue depth
+            # (sampled this round by _autoscale_target when autoscaling;
+            # unreachable replicas read -1 and drop first)
+            depths = dep.pop("_last_qlens", None)
+            if depths is None or len(depths) != len(dep["replicas"]):
+                depths = await self._queue_lens(dep["replicas"])
             ranked = sorted(zip(depths, range(len(dep["replicas"]))),
                             key=lambda p: p[0])
             drop = {i for _, i in ranked[:len(dep["replicas"]) - target]}
