@@ -1,0 +1,340 @@
+"""Ray-client analog: thin remote drivers over TCP
+(ref: python/ray/util/client/ + protobuf/ray_client.proto — a proxy
+server runs INSIDE a real driver on the cluster; thin clients hold no
+object store or core worker, every API call is an RPC).
+
+Server (on a cluster host, inside a connected driver):
+    port = ray_tpu.util.client.enable_client_server(port=0)
+
+Thin client (any host that can reach the port):
+    client = ray_tpu.util.client.connect(f"{host}:{port}")
+    sq = client.remote(lambda x: x * x)
+    assert client.get(sq.remote(7)) == 49
+    Counter = client.remote(CounterClass)
+    c = Counter.remote()
+    client.get(c.incr.remote())
+    client.disconnect()
+
+Top-level task/actor arguments may be ClientObjectRefs; nested refs
+inside containers are not traversed (same shape as the core API's
+top-level dependency packing).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+_REF_MARK = "__rtpu_client_ref__"
+_ACTOR_MARK = "__rtpu_client_actor__"
+
+
+# ---------------------------------------------------------------------------
+# Server side: executes API calls in this (real) driver process.
+# ---------------------------------------------------------------------------
+
+
+class _ClientServer:
+    def __init__(self):
+        self._refs: Dict[str, Any] = {}        # ref id -> ObjectRef
+        self._actors: Dict[str, Any] = {}      # actor id -> ActorHandle
+        self._lock = threading.Lock()
+
+    def _track(self, ref) -> str:
+        rid = uuid.uuid4().hex
+        with self._lock:
+            self._refs[rid] = ref
+        return rid
+
+    def _resolve_args(self, blob: bytes) -> Tuple[list, dict]:
+        args, kwargs = cloudpickle.loads(blob)
+
+        def sub(a):
+            if isinstance(a, dict) and _REF_MARK in a:
+                with self._lock:
+                    return self._refs[a[_REF_MARK]]
+            if isinstance(a, dict) and _ACTOR_MARK in a:
+                with self._lock:
+                    return self._actors[a[_ACTOR_MARK]]
+            return a
+
+        return [sub(a) for a in args], {k: sub(v) for k, v in kwargs.items()}
+
+    async def _offload(self, fn, *args):
+        """Blocking core-API calls leave the RPC event loop."""
+        import asyncio
+
+        return await asyncio.get_event_loop().run_in_executor(
+            None, fn, *args)
+
+    async def handle_client_put(self, payload, conn):
+        import ray_tpu
+
+        value = cloudpickle.loads(payload["data"])
+        ref = await self._offload(ray_tpu.put, value)
+        return {"ref": self._track(ref)}
+
+    async def handle_client_get(self, payload, conn):
+        import ray_tpu
+
+        with self._lock:
+            refs = [self._refs[r] for r in payload["refs"]]
+
+        def _get():
+            return ray_tpu.get(refs, timeout=payload.get("timeout"))
+
+        values = await self._offload(_get)
+        return {"data": cloudpickle.dumps(values)}
+
+    async def handle_client_task(self, payload, conn):
+        import ray_tpu
+
+        fn = cloudpickle.loads(payload["fn"])
+        args, kwargs = self._resolve_args(payload["args"])
+        opts = payload.get("opts") or {}
+        task = ray_tpu.remote(**opts)(fn) if opts else ray_tpu.remote(fn)
+
+        def _submit():
+            return task.remote(*args, **kwargs)
+
+        refs = await self._offload(_submit)
+        refs = refs if isinstance(refs, list) else [refs]
+        return {"refs": [self._track(r) for r in refs]}
+
+    async def handle_client_actor_new(self, payload, conn):
+        import ray_tpu
+
+        cls = cloudpickle.loads(payload["cls"])
+        args, kwargs = self._resolve_args(payload["args"])
+        opts = payload.get("opts") or {}
+        actor_cls = (ray_tpu.remote(**opts)(cls) if opts
+                     else ray_tpu.remote(cls))
+
+        def _create():
+            return actor_cls.remote(*args, **kwargs)
+
+        handle = await self._offload(_create)
+        aid = uuid.uuid4().hex
+        with self._lock:
+            self._actors[aid] = handle
+        return {"actor": aid}
+
+    async def handle_client_actor_call(self, payload, conn):
+        with self._lock:
+            handle = self._actors[payload["actor"]]
+        args, kwargs = self._resolve_args(payload["args"])
+        method = getattr(handle, payload["method"])
+
+        def _call():
+            return method.remote(*args, **kwargs)
+
+        ref = await self._offload(_call)
+        return {"refs": [self._track(ref)]}
+
+    async def handle_client_kill(self, payload, conn):
+        import ray_tpu
+
+        with self._lock:
+            handle = self._actors.pop(payload["actor"], None)
+        if handle is not None:
+            await self._offload(ray_tpu.kill, handle)
+        return True
+
+    async def handle_client_release(self, payload, conn):
+        with self._lock:
+            for rid in payload["refs"]:
+                self._refs.pop(rid, None)
+        return True
+
+
+_server = None
+_server_rpc = None
+
+
+def enable_client_server(port: int = 0, host: str = "0.0.0.0") -> int:
+    """Start the client proxy inside the CURRENT driver; returns the
+    bound TCP port (ref: ray client server on the head node)."""
+    global _server, _server_rpc
+    import ray_tpu
+    from .. import _worker_api
+    from .._private.rpc import RpcServer
+
+    if not ray_tpu.is_initialized():
+        raise RuntimeError("enable_client_server requires ray_tpu.init()")
+    if _server_rpc is not None:
+        return int(_server_rpc.address.rsplit(":", 1)[1])
+    core = _worker_api.core()
+    _server = _ClientServer()
+    _server_rpc = RpcServer(f"{host}:{port}", name="client_server")
+    _server_rpc.register_all(_server)
+    core.io.run(_server_rpc.start())
+    return int(_server_rpc.address.rsplit(":", 1)[1])
+
+
+# ---------------------------------------------------------------------------
+# Thin client side.
+# ---------------------------------------------------------------------------
+
+
+class ClientObjectRef:
+    def __init__(self, ctx: "ClientContext", rid: str):
+        self._ctx = ctx
+        self._rid = rid
+
+    def __del__(self):
+        ctx = getattr(self, "_ctx", None)
+        if ctx is not None and not ctx._closed:
+            ctx._release(self._rid)
+
+
+class ClientRemoteFunction:
+    def __init__(self, ctx: "ClientContext", fn, opts: Optional[dict] = None):
+        self._ctx = ctx
+        self._fn_blob = cloudpickle.dumps(fn)
+        self._opts = opts or {}
+
+    def options(self, **opts) -> "ClientRemoteFunction":
+        out = ClientRemoteFunction.__new__(ClientRemoteFunction)
+        out._ctx, out._fn_blob = self._ctx, self._fn_blob
+        out._opts = {**self._opts, **opts}
+        return out
+
+    def remote(self, *args, **kwargs):
+        reply = self._ctx._call("client_task", {
+            "fn": self._fn_blob,
+            "args": self._ctx._pack_args(args, kwargs),
+            "opts": self._opts,
+        })
+        refs = [ClientObjectRef(self._ctx, r) for r in reply["refs"]]
+        if self._opts.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+
+class _ClientActorMethod:
+    def __init__(self, ctx, actor_id: str, name: str):
+        self._ctx, self._actor_id, self._name = ctx, actor_id, name
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        reply = self._ctx._call("client_actor_call", {
+            "actor": self._actor_id, "method": self._name,
+            "args": self._ctx._pack_args(args, kwargs),
+        })
+        return ClientObjectRef(self._ctx, reply["refs"][0])
+
+
+class ClientActorHandle:
+    def __init__(self, ctx: "ClientContext", actor_id: str):
+        self._ctx = ctx
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> _ClientActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientActorMethod(self._ctx, self._actor_id, name)
+
+
+class ClientActorClass:
+    def __init__(self, ctx: "ClientContext", cls, opts: Optional[dict] = None):
+        self._ctx = ctx
+        self._cls_blob = cloudpickle.dumps(cls)
+        self._opts = opts or {}
+
+    def options(self, **opts) -> "ClientActorClass":
+        out = ClientActorClass.__new__(ClientActorClass)
+        out._ctx, out._cls_blob = self._ctx, self._cls_blob
+        out._opts = {**self._opts, **opts}
+        return out
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        reply = self._ctx._call("client_actor_new", {
+            "cls": self._cls_blob,
+            "args": self._ctx._pack_args(args, kwargs),
+            "opts": self._opts,
+        })
+        return ClientActorHandle(self._ctx, reply["actor"])
+
+
+class ClientContext:
+    """The thin driver: mirrors the core API over RPC."""
+
+    def __init__(self, address: str):
+        from .._private.rpc import EventLoopThread, RpcClient
+
+        self._io = EventLoopThread(name="ray_tpu_client")
+        self._rpc = RpcClient(address)
+        self._io.run(self._rpc.connect(timeout=10))
+        self._closed = False
+        # GC'd refs buffer here; releases piggyback on the next RPC
+        # instead of one blocking round trip per collected ref
+        self._release_buf: List[str] = []
+        self._release_lock = threading.Lock()
+
+    def _call(self, method: str, payload: dict):
+        self._flush_releases()
+        return self._io.run(self._rpc.call(method, payload))
+
+    def _flush_releases(self) -> None:
+        with self._release_lock:
+            pending, self._release_buf = self._release_buf, []
+        if pending and not self._closed:
+            try:
+                self._io.run(self._rpc.call("client_release",
+                                            {"refs": pending}))
+            except Exception:
+                pass
+
+    def _pack_args(self, args, kwargs) -> bytes:
+        def sub(a):
+            if isinstance(a, ClientObjectRef):
+                return {_REF_MARK: a._rid}
+            if isinstance(a, ClientActorHandle):
+                return {_ACTOR_MARK: a._actor_id}
+            return a
+
+        return cloudpickle.dumps(
+            ([sub(a) for a in args], {k: sub(v) for k, v in kwargs.items()}))
+
+    def _release(self, rid: str) -> None:
+        with self._release_lock:
+            self._release_buf.append(rid)
+
+    # --- public API mirror ---
+
+    def remote(self, target, **opts):
+        if isinstance(target, type):
+            return ClientActorClass(self, target, opts)
+        return ClientRemoteFunction(self, target, opts)
+
+    def put(self, value) -> ClientObjectRef:
+        reply = self._call("client_put", {"data": cloudpickle.dumps(value)})
+        return ClientObjectRef(self, reply["ref"])
+
+    def get(self, refs, timeout: Optional[float] = 60.0):
+        single = isinstance(refs, ClientObjectRef)
+        ref_list = [refs] if single else list(refs)
+        reply = self._call("client_get", {
+            "refs": [r._rid for r in ref_list], "timeout": timeout})
+        values = cloudpickle.loads(reply["data"])
+        return values[0] if single else values
+
+    def kill(self, actor: ClientActorHandle) -> None:
+        self._call("client_kill", {"actor": actor._actor_id})
+
+    def disconnect(self) -> None:
+        if self._closed:
+            return
+        self._flush_releases()
+        self._closed = True
+        try:
+            self._io.run(self._rpc.close())
+        except Exception:
+            pass
+        self._io.stop()
+
+
+def connect(address: str) -> ClientContext:
+    return ClientContext(address)

@@ -174,25 +174,35 @@ def test_trainer_consumes_streaming_split(ray_cluster, tmp_path):
     ds = rdata.range(64, parallelism=8)
     iterators = ds.streaming_split(2, equal=True)
 
+    shard_dir = tmp_path / "shards"
+    shard_dir.mkdir()
+
     def train_fn(config):
+        import json as _json
+
         ctx = train.get_context()
         it = config["iterators"][ctx.rank]
         seen = []
         for batch in it.iter_batches(batch_size=8):
             seen.extend(int(x) for x in batch["id"])
+        # every rank records its shard (same-host gang: shared fs)
+        with open(f"{config['shard_dir']}/rank{ctx.rank}.json", "w") as f:
+            _json.dump(seen, f)
         train.report({"seen": seen, "rank": ctx.rank})
 
     result = Trainer(
         train_fn,
-        train_loop_config={"iterators": iterators},
+        train_loop_config={"iterators": iterators,
+                           "shard_dir": str(shard_dir)},
         scaling_config=ScalingConfig(num_workers=2),
         run_config=RunConfig(name="data_gang", storage_path=str(tmp_path)),
     ).fit()
     assert result.error is None
-    # rank 0's report reaches the controller; collect rank 1's rows via
-    # a second run artifact isn't available, so assert rank 0 saw a
-    # proper non-overlapping shard and the split group closed cleanly
-    seen0 = result.metrics["seen"]
-    # equal split of 64 rows over 2 ranks: exactly half, no duplicates
-    assert len(seen0) == 32 and len(set(seen0)) == 32
-    assert set(seen0) <= set(range(64))
+    import json
+
+    shards = [json.load(open(shard_dir / f"rank{r}.json"))
+              for r in range(2)]
+    # equal split: exactly half each, no duplicates, union covers all
+    assert len(shards[0]) == 32 and len(shards[1]) == 32
+    assert set(shards[0]) | set(shards[1]) == set(range(64))
+    assert not set(shards[0]) & set(shards[1])
