@@ -14,10 +14,11 @@ from .api import (
     start,
     status,
 )
+from .batching import batch
 from .handle import DeploymentHandle
 
 __all__ = [
     "Application", "Deployment", "DeploymentHandle",
     "deployment", "run", "start", "status", "delete", "shutdown",
-    "get_deployment_handle",
+    "get_deployment_handle", "batch",
 ]

@@ -1,0 +1,133 @@
+"""Dynamic request batching (ref: python/ray/serve/batching.py —
+@serve.batch collects concurrent calls into one list-in/list-out
+invocation; the standard trick for keeping model replicas fed with
+full batches).
+
+    class Model:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.01)
+        async def __call__(self, payloads: list):
+            return [self.model(p) for p in payloads]
+
+Each caller awaits its own single result; the wrapped function sees the
+coalesced batch. Works on instance methods (per-instance queues) and
+free async functions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = batch_wait_timeout_s
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self._runner: Optional[asyncio.Task] = None
+
+    def _ensure_runner(self) -> None:
+        if self._runner is None or self._runner.done():
+            self._runner = asyncio.get_event_loop().create_task(
+                self._run_loop())
+
+    async def submit(self, item: Any) -> Any:
+        fut = asyncio.get_event_loop().create_future()
+        self.queue.put_nowait((item, fut))
+        self._ensure_runner()
+        return await fut
+
+    async def _collect(self) -> List:
+        """One batch: the first item blocks indefinitely, then more are
+        taken until the wait window closes or the batch fills."""
+        first = await self.queue.get()
+        batch = [first]
+        if self.timeout_s > 0:
+            deadline = asyncio.get_event_loop().time() + self.timeout_s
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(
+                        self.queue.get(), remaining))
+                except asyncio.TimeoutError:
+                    break
+        else:
+            while (len(batch) < self.max_batch_size
+                   and not self.queue.empty()):
+                batch.append(self.queue.get_nowait())
+        return batch
+
+    async def _run_loop(self) -> None:
+        while True:
+            batch = await self._collect()
+            items = [b[0] for b in batch]
+            futs = [b[1] for b in batch]
+            try:
+                results = await self.fn(items)
+            except asyncio.CancelledError:
+                # loop teardown: fail pending callers and honor the cancel
+                for fut in futs:
+                    if not fut.done():
+                        fut.cancel()
+                raise
+                if (not isinstance(results, list)
+                        or len(results) != len(items)):
+                    raise TypeError(
+                        f"@serve.batch function must return a list of "
+                        f"length {len(items)}, got {type(results).__name__}"
+                        f"{'' if not isinstance(results, list) else f' of length {len(results)}'}")
+            except BaseException as e:  # noqa: BLE001 — fan the error out
+                for fut in futs:
+                    if not fut.done():
+                        fut.set_exception(
+                            e if isinstance(e, Exception)
+                            else RuntimeError(repr(e)))
+                continue
+            for fut, result in zip(futs, results):
+                if not fut.done():
+                    fut.set_result(result)
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator (ref: serve/batching.py:batch). The wrapped async
+    function must accept a list and return an equal-length list."""
+
+    def _decorate(fn: Callable):
+        if not asyncio.iscoroutinefunction(fn):
+            raise TypeError("@serve.batch requires an async function")
+        attr = f"__rtpu_batch_queue_{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, payload)
+                self_obj, item = args
+                queue = getattr(self_obj, attr, None)
+                if queue is None:
+                    bound = functools.partial(fn, self_obj)
+                    queue = _BatchQueue(bound, max_batch_size,
+                                        batch_wait_timeout_s)
+                    setattr(self_obj, attr, queue)
+            elif len(args) == 1:  # free function: (payload,)
+                item = args[0]
+                queue = getattr(wrapper, "_queue", None)
+                if queue is None:
+                    queue = _BatchQueue(fn, max_batch_size,
+                                        batch_wait_timeout_s)
+                    wrapper._queue = queue
+            else:
+                raise TypeError(
+                    "@serve.batch functions take exactly one payload "
+                    "argument (plus self for methods)")
+            return await queue.submit(item)
+
+        return wrapper
+
+    if _fn is not None:
+        return _decorate(_fn)
+    return _decorate

@@ -39,15 +39,35 @@ _ACTOR_MARK = "__rtpu_client_actor__"
 
 class _ClientServer:
     def __init__(self):
-        self._refs: Dict[str, Any] = {}        # ref id -> ObjectRef
-        self._actors: Dict[str, Any] = {}      # actor id -> ActorHandle
+        # ref id -> (owner conn id, ObjectRef); entries die with their
+        # connection so crashed thin clients can't pin objects forever
+        self._refs: Dict[str, Tuple[int, Any]] = {}
+        self._actors: Dict[str, Tuple[int, Any]] = {}
         self._lock = threading.Lock()
 
-    def _track(self, ref) -> str:
+    def _track(self, ref, conn) -> str:
         rid = uuid.uuid4().hex
         with self._lock:
-            self._refs[rid] = ref
+            self._refs[rid] = (id(conn), ref)
         return rid
+
+    async def on_disconnect(self, conn) -> None:
+        """Sweep a gone client's refs and actors (the reference client
+        server's per-connection cleanup)."""
+        import ray_tpu
+
+        key = id(conn)
+        with self._lock:
+            self._refs = {r: v for r, v in self._refs.items()
+                          if v[0] != key}
+            dead = [v[1] for v in self._actors.values() if v[0] == key]
+            self._actors = {a: v for a, v in self._actors.items()
+                            if v[0] != key}
+        for handle in dead:
+            try:
+                await self._offload(ray_tpu.kill, handle)
+            except Exception:
+                pass
 
     def _resolve_args(self, blob: bytes) -> Tuple[list, dict]:
         args, kwargs = cloudpickle.loads(blob)
@@ -55,10 +75,10 @@ class _ClientServer:
         def sub(a):
             if isinstance(a, dict) and _REF_MARK in a:
                 with self._lock:
-                    return self._refs[a[_REF_MARK]]
+                    return self._refs[a[_REF_MARK]][1]
             if isinstance(a, dict) and _ACTOR_MARK in a:
                 with self._lock:
-                    return self._actors[a[_ACTOR_MARK]]
+                    return self._actors[a[_ACTOR_MARK]][1]
             return a
 
         return [sub(a) for a in args], {k: sub(v) for k, v in kwargs.items()}
@@ -75,13 +95,13 @@ class _ClientServer:
 
         value = cloudpickle.loads(payload["data"])
         ref = await self._offload(ray_tpu.put, value)
-        return {"ref": self._track(ref)}
+        return {"ref": self._track(ref, conn)}
 
     async def handle_client_get(self, payload, conn):
         import ray_tpu
 
         with self._lock:
-            refs = [self._refs[r] for r in payload["refs"]]
+            refs = [self._refs[r][1] for r in payload["refs"]]
 
         def _get():
             return ray_tpu.get(refs, timeout=payload.get("timeout"))
@@ -102,7 +122,7 @@ class _ClientServer:
 
         refs = await self._offload(_submit)
         refs = refs if isinstance(refs, list) else [refs]
-        return {"refs": [self._track(r) for r in refs]}
+        return {"refs": [self._track(r, conn) for r in refs]}
 
     async def handle_client_actor_new(self, payload, conn):
         import ray_tpu
@@ -119,12 +139,12 @@ class _ClientServer:
         handle = await self._offload(_create)
         aid = uuid.uuid4().hex
         with self._lock:
-            self._actors[aid] = handle
+            self._actors[aid] = (id(conn), handle)
         return {"actor": aid}
 
     async def handle_client_actor_call(self, payload, conn):
         with self._lock:
-            handle = self._actors[payload["actor"]]
+            handle = self._actors[payload["actor"]][1]
         args, kwargs = self._resolve_args(payload["args"])
         method = getattr(handle, payload["method"])
 
@@ -132,15 +152,15 @@ class _ClientServer:
             return method.remote(*args, **kwargs)
 
         ref = await self._offload(_call)
-        return {"refs": [self._track(ref)]}
+        return {"refs": [self._track(ref, conn)]}
 
     async def handle_client_kill(self, payload, conn):
         import ray_tpu
 
         with self._lock:
-            handle = self._actors.pop(payload["actor"], None)
-        if handle is not None:
-            await self._offload(ray_tpu.kill, handle)
+            entry = self._actors.pop(payload["actor"], None)
+        if entry is not None:
+            await self._offload(ray_tpu.kill, entry[1])
         return True
 
     async def handle_client_release(self, payload, conn):
@@ -152,25 +172,32 @@ class _ClientServer:
 
 _server = None
 _server_rpc = None
+_server_core = None
 
 
 def enable_client_server(port: int = 0, host: str = "0.0.0.0") -> int:
     """Start the client proxy inside the CURRENT driver; returns the
     bound TCP port (ref: ray client server on the head node)."""
-    global _server, _server_rpc
+    global _server, _server_rpc, _server_core
     import ray_tpu
     from .. import _worker_api
     from .._private.rpc import RpcServer
 
     if not ray_tpu.is_initialized():
         raise RuntimeError("enable_client_server requires ray_tpu.init()")
-    if _server_rpc is not None:
-        return int(_server_rpc.address.rsplit(":", 1)[1])
     core = _worker_api.core()
+    if _server_rpc is not None:
+        if _server_core is core:
+            return int(_server_rpc.address.rsplit(":", 1)[1])
+        # the cluster this server belonged to shut down; its RpcServer
+        # died with the old core's io loop — start fresh
+        _server = _server_rpc = _server_core = None
     _server = _ClientServer()
     _server_rpc = RpcServer(f"{host}:{port}", name="client_server")
     _server_rpc.register_all(_server)
+    _server_rpc.on_disconnect = _server.on_disconnect
     core.io.run(_server_rpc.start())
+    _server_core = core
     return int(_server_rpc.address.rsplit(":", 1)[1])
 
 
@@ -203,6 +230,10 @@ class ClientRemoteFunction:
         return out
 
     def remote(self, *args, **kwargs):
+        if self._opts.get("num_returns") == "streaming":
+            raise ValueError(
+                "streaming generators are not supported over the thin "
+                "client (run as a full driver for ObjectRefGenerator)")
         reply = self._ctx._call("client_task", {
             "fn": self._fn_blob,
             "args": self._ctx._pack_args(args, kwargs),
@@ -313,7 +344,8 @@ class ClientContext:
         reply = self._call("client_put", {"data": cloudpickle.dumps(value)})
         return ClientObjectRef(self, reply["ref"])
 
-    def get(self, refs, timeout: Optional[float] = 60.0):
+    def get(self, refs, timeout: Optional[float] = None):
+        """Mirror of ray_tpu.get — same wait-forever default."""
         single = isinstance(refs, ClientObjectRef)
         ref_list = [refs] if single else list(refs)
         reply = self._call("client_get", {

@@ -16,9 +16,8 @@ def client_server():
     port = client_mod.enable_client_server()
     yield port
     ray_tpu.shutdown()
-    # module-level server state dies with the cluster
-    client_mod._server = None
-    client_mod._server_rpc = None
+    # enable_client_server detects the dead core and restarts itself
+    # on the next cluster — no manual reset needed
 
 
 def test_client_tasks_put_get(client_server):
@@ -64,6 +63,29 @@ def test_client_actors(client_server):
         client.kill(c)
     finally:
         client.disconnect()
+
+
+def test_client_disconnect_sweeps_refs_and_actors(client_server):
+    """A disconnecting (or crashed) thin client must not pin objects or
+    leak actors on the server."""
+    import time
+
+    class Holder:
+        def ping(self):
+            return 1
+
+    client = client_mod.connect(f"127.0.0.1:{client_server}")
+    ref = client.put({"big": 1})
+    h = client.remote(Holder).remote()
+    assert client.get(h.ping.remote()) == 1
+    server = client_mod._server
+    assert server._refs and server._actors
+    client.disconnect()
+    deadline = time.time() + 15
+    while time.time() < deadline and (server._refs or server._actors):
+        time.sleep(0.2)
+    assert not server._refs and not server._actors
+    del ref, h
 
 
 def test_client_from_separate_process(client_server):

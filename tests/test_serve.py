@@ -220,3 +220,35 @@ def test_autoscaling_scales_with_load(serve_cluster):
             break
         time.sleep(1.0)
     assert shrunk == 1
+
+
+def test_serve_batch_coalesces_requests(serve_cluster):
+    """@serve.batch: concurrent singleton calls reach the function as
+    one list; callers get their own results (ref: serve/batching.py)."""
+    @serve.deployment
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        # generous wait window: the coalescing assertion below must not
+        # hinge on sub-100ms scheduling under CI load
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.5)
+        async def handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 10 for x in items]
+
+        async def __call__(self, payload):
+            return await self.handle(payload["x"])
+
+        async def sizes(self, _=None):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind())
+    refs = [handle.remote({"x": i}) for i in range(8)]
+    out = ray_tpu.get(refs, timeout=60)
+    assert sorted(out) == [i * 10 for i in range(8)]
+    sizes = ray_tpu.get(
+        handle.options(method_name="sizes").remote(), timeout=60)
+    # coalescing happened: fewer invocations than requests, none over max
+    assert sum(sizes) == 8 and len(sizes) < 8
+    assert max(sizes) <= 4 and max(sizes) >= 2
