@@ -15,10 +15,12 @@ from .api import (
     status,
 )
 from .batching import batch
+from .multiplex import get_multiplexed_model_id, multiplexed
 from .handle import DeploymentHandle
 
 __all__ = [
     "Application", "Deployment", "DeploymentHandle",
     "deployment", "run", "start", "status", "delete", "shutdown",
-    "get_deployment_handle", "batch",
+    "get_deployment_handle", "batch", "multiplexed",
+    "get_multiplexed_model_id",
 ]

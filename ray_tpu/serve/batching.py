@@ -69,18 +69,20 @@ class _BatchQueue:
             futs = [b[1] for b in batch]
             try:
                 results = await self.fn(items)
+                if not isinstance(results, list):
+                    raise TypeError(
+                        f"@serve.batch function must return a list, got "
+                        f"{type(results).__name__}")
+                if len(results) != len(items):
+                    raise TypeError(
+                        f"@serve.batch function must return one result "
+                        f"per item: got {len(results)} for {len(items)}")
             except asyncio.CancelledError:
                 # loop teardown: fail pending callers and honor the cancel
                 for fut in futs:
                     if not fut.done():
                         fut.cancel()
                 raise
-                if (not isinstance(results, list)
-                        or len(results) != len(items)):
-                    raise TypeError(
-                        f"@serve.batch function must return a list of "
-                        f"length {len(items)}, got {type(results).__name__}"
-                        f"{'' if not isinstance(results, list) else f' of length {len(results)}'}")
             except BaseException as e:  # noqa: BLE001 — fan the error out
                 for fut in futs:
                     if not fut.done():
@@ -99,13 +101,27 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
     function must accept a list and return an equal-length list."""
 
     def _decorate(fn: Callable):
+        import inspect
+
         if not asyncio.iscoroutinefunction(fn):
             raise TypeError("@serve.batch requires an async function")
         attr = f"__rtpu_batch_queue_{fn.__name__}"
+        # bound-method detection from the SIGNATURE, not call-site arg
+        # count (a free function with two positional args must not have
+        # its payload mistaken for self)
+        params = list(inspect.signature(fn).parameters)
+        is_method = bool(params) and params[0] == "self"
+        expected = 2 if is_method else 1
 
         @functools.wraps(fn)
         async def wrapper(*args):
-            if len(args) == 2:  # bound method: (self, payload)
+            if len(args) != expected:
+                raise TypeError(
+                    f"@serve.batch function {fn.__name__} takes exactly "
+                    f"one positional payload argument"
+                    f"{' after self' if is_method else ''}; got "
+                    f"{len(args)} args")
+            if is_method:
                 self_obj, item = args
                 queue = getattr(self_obj, attr, None)
                 if queue is None:
@@ -113,17 +129,13 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
                     queue = _BatchQueue(bound, max_batch_size,
                                         batch_wait_timeout_s)
                     setattr(self_obj, attr, queue)
-            elif len(args) == 1:  # free function: (payload,)
+            else:
                 item = args[0]
                 queue = getattr(wrapper, "_queue", None)
                 if queue is None:
                     queue = _BatchQueue(fn, max_batch_size,
                                         batch_wait_timeout_s)
                     wrapper._queue = queue
-            else:
-                raise TypeError(
-                    "@serve.batch functions take exactly one payload "
-                    "argument (plus self for methods)")
             return await queue.submit(item)
 
         return wrapper

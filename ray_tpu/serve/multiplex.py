@@ -1,0 +1,122 @@
+"""Model multiplexing (ref: python/ray/serve/multiplex.py —
+@serve.multiplexed caches per-model-id loads on each replica with LRU
+eviction; serve.get_multiplexed_model_id() reads the request's target
+model; many fine-tuned variants share one replica pool).
+
+    class Multi:
+        @serve.multiplexed(max_num_models_per_replica=3)
+        async def get_model(self, model_id: str):
+            return load_checkpoint(model_id)   # arbitrary (LoRA, etc.)
+
+        async def __call__(self, payload):
+            model = await self.get_model(
+                serve.get_multiplexed_model_id(payload))
+            return model(payload["x"])
+
+The model id rides the request payload under "model_id" (the
+reference's header-based routing collapses to this field on our
+payload-dict proxy contract).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+_MODEL_ID_KEY = "model_id"
+
+
+def get_multiplexed_model_id(payload: Any = None) -> str:
+    """The target model id of the current request (ref:
+    serve.get_multiplexed_model_id). On this proxy contract the id rides
+    the payload dict's "model_id" field."""
+    if isinstance(payload, dict):
+        return str(payload.get(_MODEL_ID_KEY, ""))
+    return ""
+
+
+class _ModelCache:
+    """Per-replica LRU of loaded models; loads are deduplicated so
+    concurrent requests for one model trigger a single load, and
+    evicted models get their ``__del__``/``close`` a chance to free
+    device memory."""
+
+    def __init__(self, loader: Callable, capacity: int):
+        self.loader = loader
+        self.capacity = capacity
+        self.models: "OrderedDict[str, Any]" = OrderedDict()
+        self.loading: Dict[str, asyncio.Future] = {}
+
+    async def get(self, model_id: str) -> Any:
+        while True:
+            if model_id in self.models:
+                self.models.move_to_end(model_id)
+                return self.models[model_id]
+            pending = self.loading.get(model_id)
+            if pending is None:
+                break
+            try:
+                # shield: our caller being cancelled must not cancel the
+                # shared load other waiters are parked on
+                return await asyncio.shield(pending)
+            except asyncio.CancelledError:
+                if pending.cancelled():
+                    continue  # the LOADER was cancelled: retry ourselves
+                raise         # our own request was cancelled
+        fut = asyncio.get_event_loop().create_future()
+        self.loading[model_id] = fut
+        try:
+            model = await self.loader(model_id)
+        except asyncio.CancelledError:
+            # the winning request died mid-load; waiters retry the load
+            # instead of inheriting an unrelated cancellation
+            self.loading.pop(model_id, None)
+            fut.cancel()
+            raise
+        except BaseException as e:
+            self.loading.pop(model_id, None)
+            if not fut.done():
+                fut.set_exception(e)
+            raise
+        self.models[model_id] = model
+        while len(self.models) > self.capacity:
+            _, evicted = self.models.popitem(last=False)  # LRU out
+            close = getattr(evicted, "close", None)
+            if callable(close):
+                try:
+                    close()
+                except Exception:
+                    pass
+        self.loading.pop(model_id, None)
+        if not fut.done():
+            fut.set_result(model)
+        return model
+
+
+def multiplexed(_fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for an async per-model loader method
+    (ref: serve/multiplex.py:multiplexed)."""
+
+    def _decorate(fn: Callable):
+        if not asyncio.iscoroutinefunction(fn):
+            raise TypeError("@serve.multiplexed requires an async loader")
+        attr = f"__rtpu_model_cache_{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def wrapper(self_obj, model_id: str):
+            cache = getattr(self_obj, attr, None)
+            if cache is None:
+                cache = _ModelCache(functools.partial(fn, self_obj),
+                                    max_num_models_per_replica)
+                setattr(self_obj, attr, cache)
+            return await cache.get(str(model_id))
+
+        wrapper.__rtpu_multiplexed__ = True
+        return wrapper
+
+    if _fn is not None:
+        return _decorate(_fn)
+    return _decorate

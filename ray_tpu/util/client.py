@@ -43,11 +43,16 @@ class _ClientServer:
         # connection so crashed thin clients can't pin objects forever
         self._refs: Dict[str, Tuple[int, Any]] = {}
         self._actors: Dict[str, Tuple[int, Any]] = {}
+        # conn ids already swept: an in-flight handler finishing AFTER
+        # its connection dropped must not register an unsweepable entry
+        self._dead_conns: "set[int]" = set()
         self._lock = threading.Lock()
 
     def _track(self, ref, conn) -> str:
         rid = uuid.uuid4().hex
         with self._lock:
+            if id(conn) in self._dead_conns:
+                return rid  # owner gone: drop the ref immediately
             self._refs[rid] = (id(conn), ref)
         return rid
 
@@ -58,6 +63,10 @@ class _ClientServer:
 
         key = id(conn)
         with self._lock:
+            self._dead_conns.add(key)
+            if len(self._dead_conns) > 4096:  # id() values recycle; a
+                # bounded set is only a best-effort in-flight guard
+                self._dead_conns.pop()
             self._refs = {r: v for r, v in self._refs.items()
                           if v[0] != key}
             dead = [v[1] for v in self._actors.values() if v[0] == key]
@@ -139,7 +148,13 @@ class _ClientServer:
         handle = await self._offload(_create)
         aid = uuid.uuid4().hex
         with self._lock:
-            self._actors[aid] = (id(conn), handle)
+            if id(conn) in self._dead_conns:
+                orphaned = True
+            else:
+                orphaned = False
+                self._actors[aid] = (id(conn), handle)
+        if orphaned:  # owner disconnected while the actor was starting
+            await self._offload(ray_tpu.kill, handle)
         return {"actor": aid}
 
     async def handle_client_actor_call(self, payload, conn):

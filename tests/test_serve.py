@@ -252,3 +252,41 @@ def test_serve_batch_coalesces_requests(serve_cluster):
     # coalescing happened: fewer invocations than requests, none over max
     assert sum(sizes) == 8 and len(sizes) < 8
     assert max(sizes) <= 4 and max(sizes) >= 2
+
+
+def test_serve_multiplexed_model_loading(serve_cluster):
+    """@serve.multiplexed: per-replica model cache with LRU eviction and
+    deduplicated loads (ref: serve/multiplex.py)."""
+    @serve.deployment
+    class Multi:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": int(model_id) * 10}
+
+        async def __call__(self, payload):
+            model = await self.get_model(
+                serve.get_multiplexed_model_id(payload))
+            return model["scale"] + payload["x"]
+
+        async def load_log(self, _=None):
+            return self.loads
+
+    handle = serve.run(Multi.bind())
+    # model 1 twice (one load), model 2 once, then model 3 evicts 1 (LRU)
+    assert ray_tpu.get(handle.remote({"model_id": "1", "x": 5}),
+                       timeout=60) == 15
+    assert ray_tpu.get(handle.remote({"model_id": "1", "x": 6}),
+                       timeout=60) == 16
+    assert ray_tpu.get(handle.remote({"model_id": "2", "x": 0}),
+                       timeout=60) == 20
+    assert ray_tpu.get(handle.remote({"model_id": "3", "x": 0}),
+                       timeout=60) == 30
+    assert ray_tpu.get(handle.remote({"model_id": "1", "x": 0}),
+                       timeout=60) == 10  # reload after eviction
+    loads = ray_tpu.get(
+        handle.options(method_name="load_log").remote(), timeout=60)
+    assert loads == ["1", "2", "3", "1"]
