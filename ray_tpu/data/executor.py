@@ -390,11 +390,36 @@ class StreamingExecutor:
         return [s.stats for s in self.stages]
 
 
+def _fuse_map_ops(plan):
+    """Operator fusion (ref: _internal/logical/optimizers — MapFusion):
+    consecutive map_block ops with identical remote args collapse into
+    one stage, so a map->filter->map chain costs one task per block
+    instead of three hops through the object store."""
+    from .dataset import _LogicalOp
+
+    fused = [plan[0]]
+    for op in plan[1:]:
+        prev = fused[-1]
+        if (op.kind == "map_block" and prev.kind == "map_block"
+                and op.remote_args == prev.remote_args):
+            first_fn = prev.args["block_fn"]
+            second_fn = op.args["block_fn"]
+
+            def chained(block, _f=first_fn, _s=second_fn):
+                return _s(_f(block))
+
+            fused[-1] = _LogicalOp(
+                "map_block", f"{prev.name}->{op.name}",
+                {"block_fn": chained}, prev.remote_args)
+        else:
+            fused.append(op)
+    return fused
+
+
 def build_executor(plan, parallelism: int) -> StreamingExecutor:
     """Logical plan → stage chain (the planner role, ref:
     _internal/planner/)."""
-    from .dataset import _LogicalOp  # noqa: F401 — typing only
-
+    plan = _fuse_map_ops(plan)
     stages: List[_Stage] = []
     q: "queue.Queue" = queue.Queue(maxsize=STAGE_QUEUE_CAP)
     first = plan[0]

@@ -106,12 +106,16 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
         if not asyncio.iscoroutinefunction(fn):
             raise TypeError("@serve.batch requires an async function")
         attr = f"__rtpu_batch_queue_{fn.__name__}"
-        # bound-method detection from the SIGNATURE, not call-site arg
-        # count (a free function with two positional args must not have
-        # its payload mistaken for self)
+        # bound-method detection from the SIGNATURE's parameter count:
+        # a batch function takes exactly one payload, so two parameters
+        # means (self-like, payload) regardless of the first one's name
         params = list(inspect.signature(fn).parameters)
-        is_method = bool(params) and params[0] == "self"
-        expected = 2 if is_method else 1
+        if len(params) not in (1, 2):
+            raise TypeError(
+                "@serve.batch functions take exactly one payload "
+                "parameter (plus self for methods)")
+        is_method = len(params) == 2
+        expected = len(params)
 
         @functools.wraps(fn)
         async def wrapper(*args):

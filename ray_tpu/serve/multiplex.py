@@ -67,6 +67,18 @@ class _ModelCache:
                 raise         # our own request was cancelled
         fut = asyncio.get_event_loop().create_future()
         self.loading[model_id] = fut
+        # make room BEFORE loading: capacity bounds device memory, so
+        # concurrent loads must count against it too (best effort —
+        # only resident models are evictable)
+        while (len(self.models) + len(self.loading) > self.capacity
+               and self.models):
+            _, evicted = self.models.popitem(last=False)
+            close = getattr(evicted, "close", None)
+            if callable(close):
+                try:
+                    close()
+                except Exception:
+                    pass
         try:
             model = await self.loader(model_id)
         except asyncio.CancelledError:

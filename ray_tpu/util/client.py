@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import threading
 import uuid
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -43,9 +44,12 @@ class _ClientServer:
         # connection so crashed thin clients can't pin objects forever
         self._refs: Dict[str, Tuple[int, Any]] = {}
         self._actors: Dict[str, Tuple[int, Any]] = {}
-        # conn ids already swept: an in-flight handler finishing AFTER
-        # its connection dropped must not register an unsweepable entry
-        self._dead_conns: "set[int]" = set()
+        # connections already swept: an in-flight handler finishing
+        # AFTER its connection dropped must not register an unsweepable
+        # entry. Holds STRONG refs to the dead conn objects (bounded,
+        # oldest-out) so their id()s cannot be recycled onto live
+        # connections while the guard still matters.
+        self._dead_conns: "OrderedDict[int, Any]" = OrderedDict()
         self._lock = threading.Lock()
 
     def _track(self, ref, conn) -> str:
@@ -63,10 +67,9 @@ class _ClientServer:
 
         key = id(conn)
         with self._lock:
-            self._dead_conns.add(key)
-            if len(self._dead_conns) > 4096:  # id() values recycle; a
-                # bounded set is only a best-effort in-flight guard
-                self._dead_conns.pop()
+            self._dead_conns[key] = conn
+            while len(self._dead_conns) > 4096:
+                self._dead_conns.popitem(last=False)  # oldest out
             self._refs = {r: v for r, v in self._refs.items()
                           if v[0] != key}
             dead = [v[1] for v in self._actors.values() if v[0] == key]

@@ -134,3 +134,21 @@ def test_iter_torch_batches(ray_cluster):
     batches = list(ds.iter_torch_batches(batch_size=8))
     assert len(batches) == 4
     assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+
+
+def test_map_fusion_collapses_stages(ray_cluster):
+    """Consecutive map/filter ops fuse into one physical stage
+    (ref: _internal/logical MapFusion): same results, fewer hops."""
+    from ray_tpu.data.executor import build_executor
+
+    ds = (rdata.range(32, parallelism=4)
+          .map_batches(lambda b: {"id": b["id"], "y": b["id"] * 2})
+          .filter(lambda r: r["y"] % 4 == 0)
+          .map(lambda r: {"z": int(r["y"]) + 1}))
+    # build without starting: stage threads only run on start()
+    executor = build_executor(ds._plan, 4)
+    names = [s.stats.name for s in executor.stages]
+    # read + ONE fused map stage (3 logical map ops collapsed)
+    assert len(names) == 2, names
+    rows = sorted(r["z"] for r in ds.iter_rows())
+    assert rows == [i * 2 + 1 for i in range(32) if (i * 2) % 4 == 0]
