@@ -1,0 +1,176 @@
+"""Core-runtime microbenchmarks (ref: python/ray/_private/ray_perf.py:120-288).
+
+Measures the framework's control-plane throughput — NOT the model. Families
+mirror the reference microbenchmark suite:
+
+  * trivial task throughput (single client, batched submission)
+  * 1:1 sync actor calls/s
+  * 1:1 async actor calls/s (batch of concurrent calls)
+  * n:n actor calls/s (n clients -> n actors, n = min(4, cpus))
+  * put/get small-object round-trips/s
+  * put throughput GB/s (10 MB objects via shared store)
+  * wait on 1k refs
+
+Prints one JSON line per family plus a summary line. Run:
+    python bench_core.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import ray_tpu as ray
+
+
+QUICK = "--quick" in sys.argv
+
+
+def timeit(name, fn, multiplier=1, unit="per_s"):
+    # warmup
+    fn()
+    best = 0.0
+    reps = 1 if QUICK else 2
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        best = max(best, multiplier / dt)
+    rec = {"bench": name, "value": round(best, 1), "unit": unit}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+@ray.remote
+def _nullary():
+    return None
+
+
+@ray.remote
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+
+@ray.remote
+class _AsyncCounter:
+    def __init__(self):
+        self.n = 0
+
+    async def inc(self):
+        self.n += 1
+        return self.n
+
+
+def bench_tasks(results, n=1000):
+    n = 200 if QUICK else n
+
+    def run():
+        ray.get([_nullary.remote() for _ in range(n)])
+
+    results.append(timeit("tasks_per_s", run, multiplier=n))
+
+
+def bench_actor_sync(results, n=1000):
+    n = 200 if QUICK else n
+    actor = _Counter.remote()
+    ray.get(actor.inc.remote())
+
+    def run():
+        ray.get([actor.inc.remote() for _ in range(n)])
+
+    results.append(timeit("actor_calls_1_1_per_s", run, multiplier=n))
+
+
+def bench_actor_async(results, n=1000):
+    n = 200 if QUICK else n
+    actor = _AsyncCounter.remote()
+    ray.get(actor.inc.remote())
+
+    def run():
+        ray.get([actor.inc.remote() for _ in range(n)])
+
+    results.append(timeit("async_actor_calls_per_s", run, multiplier=n))
+
+
+def bench_actor_nn(results, n=1000, width=4):
+    n = 200 if QUICK else n
+    actors = [_Counter.remote() for _ in range(width)]
+    ray.get([a.inc.remote() for a in actors])
+
+    def run():
+        refs = []
+        for i in range(n):
+            refs.append(actors[i % width].inc.remote())
+        ray.get(refs)
+
+    results.append(timeit(f"actor_calls_n_n_per_s", run, multiplier=n))
+
+
+def bench_put_get_small(results, n=1000):
+    n = 200 if QUICK else n
+    payload = b"x" * 100
+
+    def run():
+        refs = [ray.put(payload) for _ in range(n)]
+        for r in refs:
+            ray.get(r)
+
+    results.append(timeit("put_get_small_per_s", run, multiplier=n))
+
+
+def bench_put_gbps(results, n=20):
+    n = 5 if QUICK else n
+    import numpy as np
+
+    data = np.random.randint(0, 255, size=10 * 1024 * 1024, dtype=np.uint8)
+
+    def run():
+        refs = [ray.put(data) for _ in range(n)]
+        del refs
+
+    results.append(
+        timeit("put_throughput_GB_s", run,
+               multiplier=n * data.nbytes / 1e9, unit="GB/s"))
+
+
+def bench_wait_1k(results):
+    k = 200 if QUICK else 1000
+    refs = [ray.put(i) for i in range(k)]
+
+    def run():
+        ready, _ = ray.wait(refs, num_returns=len(refs), timeout=30)
+        assert len(ready) == len(refs)
+
+    results.append(timeit("wait_1k_refs_per_s", run, multiplier=k))
+
+
+def main():
+    t0 = time.time()
+    ray.init(num_cpus=8, object_store_memory=1 << 30)
+    results = []
+    try:
+        bench_tasks(results)
+        bench_actor_sync(results)
+        bench_actor_async(results)
+        bench_actor_nn(results)
+        bench_put_get_small(results)
+        bench_put_gbps(results)
+        bench_wait_1k(results)
+    finally:
+        ray.shutdown()
+    by = {r["bench"]: r["value"] for r in results}
+    print(json.dumps({
+        "suite": "core_microbench",
+        "elapsed_s": round(time.time() - t0, 1),
+        "results": by,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

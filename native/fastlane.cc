@@ -1,0 +1,296 @@
+// Fast lane: shared-memory task-submission rings (the native task plane).
+//
+// TPU-native substitution for the reference's per-task gRPC hop
+// (ref: src/ray/core_worker/transport/normal_task_submitter.h:227
+// PushNormalTask, src/ray/rpc/grpc_server.h): once a worker lease is
+// held, task frames stream driver->worker through a shared-memory byte
+// ring with futex wakeups — no sockets, no event loop, no syscalls on
+// the fast path beyond the futex when a side would block. The asyncio
+// control plane still owns placement, failures and everything cold;
+// this file is only the steady-state submission/reply data path (the
+// same split plasma makes for objects: ref object_manager/plasma/).
+//
+// Layout of a ring file (mmap'd, lives in the session's store dir):
+//   [Header][data bytes ...]
+// Records are [u32 len][payload], wrapping byte-wise around the data
+// area. head/tail are free-running u64 byte cursors (never wrapped);
+// (head - tail) <= capacity is the invariant. Push/pop each take an
+// in-header robust-ish spinlock only against their own side (multiple
+// producers / multiple consumers each serialize; the two sides never
+// share a lock). Cross-side visibility is seq-cst atomics + futex.
+//
+// Build: part of libray_tpu_core.so (see ray_tpu/_native/__init__.py).
+
+#include <atomic>
+#include <cerrno>
+#include <new>
+#include <sched.h>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x464C4E31;  // "FLN1"
+
+struct Header {
+  uint32_t magic;
+  uint32_t capacity;                 // data area bytes
+  std::atomic<uint64_t> head;        // bytes ever written
+  std::atomic<uint64_t> tail;        // bytes ever consumed
+  std::atomic<uint32_t> data_seq;    // bumped on push (futex word)
+  std::atomic<uint32_t> space_seq;   // bumped on pop (futex word)
+  std::atomic<uint32_t> closed;
+  std::atomic<uint32_t> push_lock;   // producer-side mutex (spin+yield)
+  std::atomic<uint32_t> pop_lock;    // consumer-side mutex
+  uint32_t _pad[7];
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  size_t map_len;
+  int fd;
+};
+
+int futex_wait(std::atomic<uint32_t>* addr, uint32_t expect, int timeout_ms) {
+  timespec ts, *tsp = nullptr;
+  if (timeout_ms >= 0) {
+    ts.tv_sec = timeout_ms / 1000;
+    ts.tv_nsec = (timeout_ms % 1000) * 1000000L;
+    tsp = &ts;
+  }
+  return syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAIT,
+                 expect, tsp, nullptr, 0);
+}
+
+void futex_wake(std::atomic<uint32_t>* addr) {
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAKE, INT32_MAX,
+          nullptr, nullptr, 0);
+}
+
+void side_lock(std::atomic<uint32_t>& l) {
+  // Same-side producers (or consumers) are nearly always uncontended;
+  // spin briefly then yield. Not robust across holder death — a dying
+  // holder means the owning process died and the lane is torn down.
+  int spins = 0;
+  uint32_t zero = 0;
+  while (!l.compare_exchange_weak(zero, 1, std::memory_order_acquire)) {
+    zero = 0;
+    if (++spins > 256) {
+      sched_yield();
+      spins = 0;
+    }
+  }
+}
+
+void side_unlock(std::atomic<uint32_t>& l) {
+  l.store(0, std::memory_order_release);
+}
+
+void copy_in(Ring* r, uint64_t at, const void* src, uint32_t n) {
+  uint32_t cap = r->hdr->capacity;
+  uint32_t off = static_cast<uint32_t>(at % cap);
+  uint32_t first = n < cap - off ? n : cap - off;
+  memcpy(r->data + off, src, first);
+  if (n > first) memcpy(r->data, static_cast<const uint8_t*>(src) + first, n - first);
+}
+
+void copy_out(Ring* r, uint64_t at, void* dst, uint32_t n) {
+  uint32_t cap = r->hdr->capacity;
+  uint32_t off = static_cast<uint32_t>(at % cap);
+  uint32_t first = n < cap - off ? n : cap - off;
+  memcpy(dst, r->data + off, first);
+  if (n > first) memcpy(static_cast<uint8_t*>(dst) + first, r->data, n - first);
+}
+
+int64_t now_ms() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000LL + ts.tv_nsec / 1000000LL;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (truncate) a ring file with the given data capacity.
+void* rtpu_ring_create(const char* path, uint32_t capacity) {
+  size_t len = sizeof(Header) + capacity;
+  int fd = open(path, O_CREAT | O_RDWR | O_TRUNC, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, len) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = new (mem) Header();
+  h->capacity = capacity;
+  h->head.store(0);
+  h->tail.store(0);
+  h->data_seq.store(0);
+  h->space_seq.store(0);
+  h->closed.store(0);
+  h->push_lock.store(0);
+  h->pop_lock.store(0);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  h->magic = kMagic;  // published last: rtpu_ring_open spins on it
+  Ring* r = new Ring{h, static_cast<uint8_t*>(mem) + sizeof(Header), len, fd};
+  return r;
+}
+
+// Open an existing ring; waits briefly for the creator to finish init.
+void* rtpu_ring_open(const char* path) {
+  int fd = -1;
+  for (int i = 0; i < 200; i++) {  // creator may still be at ftruncate
+    fd = open(path, O_RDWR);
+    if (fd >= 0) {
+      struct stat st;
+      if (fstat(fd, &st) == 0 &&
+          st.st_size >= static_cast<long>(sizeof(Header)))
+        break;
+      close(fd);
+      fd = -1;
+    }
+    usleep(2000);
+  }
+  if (fd < 0) return nullptr;
+  struct stat st;
+  fstat(fd, &st);
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = static_cast<Header*>(mem);
+  for (int i = 0; i < 500 && h->magic != kMagic; i++) usleep(1000);
+  if (h->magic != kMagic) {
+    munmap(mem, st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Ring* r = new Ring{h, static_cast<uint8_t*>(mem) + sizeof(Header),
+                     static_cast<size_t>(st.st_size), fd};
+  return r;
+}
+
+// Push one record. 0 ok; -1 closed; -2 timeout; -3 record larger than ring.
+int rtpu_ring_push(void* rp, const void* buf, uint32_t len, int timeout_ms) {
+  Ring* r = static_cast<Ring*>(rp);
+  Header* h = r->hdr;
+  uint32_t need = len + 4;
+  if (need > h->capacity) return -3;
+  int64_t deadline = timeout_ms >= 0 ? now_ms() + timeout_ms : -1;
+  side_lock(h->push_lock);
+  for (;;) {
+    if (h->closed.load()) {
+      side_unlock(h->push_lock);
+      return -1;
+    }
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    if (head + need - tail <= h->capacity) {
+      copy_in(r, head, &len, 4);
+      copy_in(r, head + 4, buf, len);
+      h->head.store(head + need, std::memory_order_release);
+      h->data_seq.fetch_add(1, std::memory_order_seq_cst);
+      futex_wake(&h->data_seq);
+      side_unlock(h->push_lock);
+      return 0;
+    }
+    uint32_t seq = h->space_seq.load(std::memory_order_seq_cst);
+    // re-check after loading the wait ticket (lost-wake race)
+    tail = h->tail.load(std::memory_order_acquire);
+    if (head + need - tail <= h->capacity) continue;
+    int wait_ms = 50;
+    if (deadline >= 0) {
+      int64_t left = deadline - now_ms();
+      if (left <= 0) {
+        side_unlock(h->push_lock);
+        return -2;
+      }
+      wait_ms = left < 50 ? static_cast<int>(left) : 50;
+    }
+    futex_wait(&h->space_seq, seq, wait_ms);
+  }
+}
+
+// Pop one record into out (cap bytes). Returns payload length >= 0;
+// -1 closed-and-drained; -2 timeout; -3 too small (*need_out set).
+int64_t rtpu_ring_pop(void* rp, void* out, uint32_t cap, uint32_t* need_out,
+                      int timeout_ms) {
+  Ring* r = static_cast<Ring*>(rp);
+  Header* h = r->hdr;
+  int64_t deadline = timeout_ms >= 0 ? now_ms() + timeout_ms : -1;
+  side_lock(h->pop_lock);
+  for (;;) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    if (head != tail) {
+      uint32_t len;
+      copy_out(r, tail, &len, 4);
+      if (len > cap) {
+        if (need_out) *need_out = len;
+        side_unlock(h->pop_lock);
+        return -3;
+      }
+      copy_out(r, tail + 4, out, len);
+      h->tail.store(tail + 4 + len, std::memory_order_release);
+      h->space_seq.fetch_add(1, std::memory_order_seq_cst);
+      futex_wake(&h->space_seq);
+      side_unlock(h->pop_lock);
+      return len;
+    }
+    if (h->closed.load()) {
+      side_unlock(h->pop_lock);
+      return -1;
+    }
+    uint32_t seq = h->data_seq.load(std::memory_order_seq_cst);
+    head = h->head.load(std::memory_order_acquire);
+    if (head != tail) continue;  // raced with a push
+    int wait_ms = 50;
+    if (deadline >= 0) {
+      int64_t left = deadline - now_ms();
+      if (left <= 0) {
+        side_unlock(h->pop_lock);
+        return -2;
+      }
+      wait_ms = left < 50 ? static_cast<int>(left) : 50;
+    }
+    futex_wait(&h->data_seq, seq, wait_ms);
+  }
+}
+
+void rtpu_ring_close(void* rp) {
+  Ring* r = static_cast<Ring*>(rp);
+  r->hdr->closed.store(1);
+  r->hdr->data_seq.fetch_add(1);
+  r->hdr->space_seq.fetch_add(1);
+  futex_wake(&r->hdr->data_seq);
+  futex_wake(&r->hdr->space_seq);
+}
+
+int rtpu_ring_closed(void* rp) {
+  return static_cast<Ring*>(rp)->hdr->closed.load() ? 1 : 0;
+}
+
+void rtpu_ring_free(void* rp) {
+  Ring* r = static_cast<Ring*>(rp);
+  munmap(r->hdr, r->map_len);
+  close(r->fd);
+  delete r;
+}
+
+}  // extern "C"

@@ -81,15 +81,40 @@ struct Index {
   std::string data_dir;  // per-object data files live here (hex names);
                          // victims are unlinked UNDER the index mutex so
                          // an eviction cannot race a re-create's seal
+  std::string spill_dir; // when set, sealed eviction victims are MOVED
+                         // here instead of destroyed (ref: raylet/
+                         // local_object_manager.h:45 spill-on-pressure;
+                         // restore happens lazily on next access)
 };
 
-void unlink_data(const Index* ix, const uint8_t* id) {
-  if (ix->data_dir.empty()) return;
+std::string hex_name(const uint8_t* id) {
   char name[kIdLen * 2 + 1];
   for (uint32_t i = 0; i < kIdLen; ++i)
     snprintf(name + 2 * i, 3, "%02x", id[i]);
-  std::string path = ix->data_dir + "/" + name;
+  return std::string(name);
+}
+
+void unlink_data(const Index* ix, const uint8_t* id) {
+  if (ix->data_dir.empty()) return;
+  std::string path = ix->data_dir + "/" + hex_name(id);
   unlink(path.c_str());
+}
+
+// Move a victim's data file OUT OF THE STORE under the mutex — but
+// never copy bytes while holding it: the file is renamed to a
+// same-filesystem ".spilling" staging name (atomic, O(1)); the caller
+// of rtpu_idx_reserve moves staged victims to the real (cross-fs) spill
+// directory AFTER the lock is released. A 1 GB eviction must not stall
+// every store operation on the node for the copy's duration.
+void spill_data(const Index* ix, const uint8_t* id) {
+  if (ix->data_dir.empty() || ix->spill_dir.empty()) {
+    unlink_data(ix, id);
+    return;
+  }
+  std::string name = hex_name(id);
+  std::string src = ix->data_dir + "/" + name;
+  std::string staged = ix->data_dir + "/" + name + ".spilling";
+  if (rename(src.c_str(), staged.c_str()) != 0) unlink(src.c_str());
 }
 
 uint64_t hash_id(const uint8_t* id) {
@@ -222,6 +247,12 @@ void* rtpu_idx_open(const char* path, uint64_t capacity, uint64_t nslots,
   return ix;
 }
 
+// Enable spill-on-eviction: sealed victims move here instead of dying.
+void rtpu_idx_set_spill_dir(void* h, const char* dir) {
+  Index* ix = (Index*)h;
+  ix->spill_dir = dir ? std::string(dir) : std::string();
+}
+
 void rtpu_idx_close(void* h) {
   Index* ix = (Index*)h;
   munmap((void*)ix->hdr, ix->map_len);
@@ -290,7 +321,10 @@ int rtpu_idx_reserve(void* h, const uint8_t* id, uint64_t size,
       (*n_victims)++;
       hd->used -= cands[j]->size;
       hd->live--;
-      unlink_data(ix, cands[j]->id);  // under the mutex: no seal race
+      if (cands[j]->state == kSealed)
+        spill_data(ix, cands[j]->id);   // under the mutex: no seal race
+      else
+        unlink_data(ix, cands[j]->id);  // stale creation: garbage
       erase(ix, cands[j]);
     }
   }
@@ -379,6 +413,12 @@ int rtpu_idx_delete(void* h, const uint8_t* id) {
   unlock(ix);
   return s ? 0 : -1;
 }
+
+// Full memory fence for lock-free mmap protocols (channels publish a
+// payload then a seq counter; weakly-ordered CPUs need a real barrier
+// between the two stores, and between the reader's counter load and
+// payload load).
+void rtpu_fence(void) { __atomic_thread_fence(__ATOMIC_SEQ_CST); }
 
 uint64_t rtpu_idx_used(void* h) { return ((Index*)h)->hdr->used; }
 uint64_t rtpu_idx_live(void* h) { return ((Index*)h)->hdr->live; }

@@ -1,15 +1,20 @@
-"""Native (C++) substrate loader: builds and binds libray_tpu_store.so
+"""Native (C++) substrate loader: builds and binds libray_tpu_core.so
 (ref: SURVEY §2.1 — native components get C++ equivalents, not Python
 stand-ins; this module is the N17 Python⇄native bridge for them).
 
-The library is compiled on demand with g++ into ray_tpu/_native/build/
-(cached by source mtime); loading failures degrade gracefully — callers
-fall back to pure-Python implementations.
+Sources under native/ (store_index.cc: shared store index; fastlane.cc:
+shm task-submission rings; core_tables.cc: refcount table + lease
+scheduler) compile on demand with g++ into ray_tpu/_native/build/. The
+cache key is a CONTENT HASH of all sources baked into the output
+filename — a stale binary can never shadow edited sources, and builds
+race safely via atomic rename. Loading failures degrade gracefully —
+callers fall back to pure-Python implementations.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -24,20 +29,33 @@ _LIB_ERR: Optional[str] = None
 
 ID_LEN = 28
 
+_SOURCES = ("store_index.cc", "fastlane.cc", "core_tables.cc")
+
 
 def _build_lib() -> str:
-    src = os.path.join(_SRC, "store_index.cc")
-    out = os.path.join(_BUILD, "libray_tpu_store.so")
-    if (os.path.exists(out)
-            and os.path.getmtime(out) >= os.path.getmtime(src)):
+    srcs = [os.path.join(_SRC, s) for s in _SOURCES]
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    out = os.path.join(_BUILD, f"libray_tpu_core_{h.hexdigest()[:16]}.so")
+    if os.path.exists(out):
         return out
     os.makedirs(_BUILD, exist_ok=True)
     tmp = out + f".tmp.{os.getpid()}"
     subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src,
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, *srcs,
          "-lpthread"],
-        check=True, capture_output=True, timeout=120)
+        check=True, capture_output=True, timeout=180)
     os.replace(tmp, out)  # atomic: concurrent builders race safely
+    # sweep superseded builds (best effort)
+    for f in os.listdir(_BUILD):
+        if f.startswith("libray_tpu_") and f.endswith(".so") \
+                and os.path.join(_BUILD, f) != out:
+            try:
+                os.unlink(os.path.join(_BUILD, f))
+            except OSError:
+                pass
     return out
 
 
@@ -55,6 +73,7 @@ def get_lib():
         except Exception as e:  # no g++ / bad toolchain: pure-Python path
             _LIB_ERR = repr(e)
             return None
+        # ---- store index ----
         lib.rtpu_idx_open.restype = ctypes.c_void_p
         lib.rtpu_idx_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                       ctypes.c_uint64, ctypes.c_char_p]
@@ -71,9 +90,75 @@ def get_lib():
         lib.rtpu_idx_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_int]
         lib.rtpu_idx_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_idx_set_spill_dir.restype = None
+        lib.rtpu_idx_set_spill_dir.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p]
         for fn in ("rtpu_idx_used", "rtpu_idx_live", "rtpu_idx_capacity"):
             getattr(lib, fn).restype = ctypes.c_uint64
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.rtpu_fence.restype = None
+        lib.rtpu_fence.argtypes = []
+        # ---- fastlane rings ----
+        lib.rtpu_ring_create.restype = ctypes.c_void_p
+        lib.rtpu_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.rtpu_ring_open.restype = ctypes.c_void_p
+        lib.rtpu_ring_open.argtypes = [ctypes.c_char_p]
+        lib.rtpu_ring_push.restype = ctypes.c_int
+        lib.rtpu_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint32, ctypes.c_int]
+        lib.rtpu_ring_pop.restype = ctypes.c_int64
+        lib.rtpu_ring_pop.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int]
+        lib.rtpu_ring_close.argtypes = [ctypes.c_void_p]
+        lib.rtpu_ring_closed.restype = ctypes.c_int
+        lib.rtpu_ring_closed.argtypes = [ctypes.c_void_p]
+        lib.rtpu_ring_free.argtypes = [ctypes.c_void_p]
+        # ---- refcount table ----
+        lib.rtpu_rc_open.restype = ctypes.c_void_p
+        lib.rtpu_rc_open.argtypes = []
+        lib.rtpu_rc_close.argtypes = [ctypes.c_void_p]
+        for fn in ("rtpu_rc_add_local", "rtpu_rc_pin_dep",
+                   "rtpu_rc_set_borrowed"):
+            getattr(lib, fn).restype = None
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        for fn in ("rtpu_rc_remove_local", "rtpu_rc_unpin_dep",
+                   "rtpu_rc_contains", "rtpu_rc_local_count"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_rc_size.restype = ctypes.c_uint64
+        lib.rtpu_rc_size.argtypes = [ctypes.c_void_p]
+        # ---- lease scheduler ----
+        U32P = ctypes.POINTER(ctypes.c_uint32)
+        F64P = ctypes.POINTER(ctypes.c_double)
+        U64P = ctypes.POINTER(ctypes.c_uint64)
+        lib.rtpu_sched_open.restype = ctypes.c_void_p
+        lib.rtpu_sched_open.argtypes = [ctypes.c_uint64]
+        lib.rtpu_sched_close.argtypes = [ctypes.c_void_p]
+        lib.rtpu_sched_node_upsert.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, U32P, F64P, F64P,
+            ctypes.c_uint32]
+        lib.rtpu_sched_node_remove.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_uint64]
+        lib.rtpu_sched_try_allocate.restype = ctypes.c_int
+        lib.rtpu_sched_try_allocate.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, U32P, F64P, ctypes.c_uint32]
+        lib.rtpu_sched_release.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, U32P, F64P, ctypes.c_uint32]
+        lib.rtpu_sched_queue_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, U32P, F64P, ctypes.c_uint32,
+            ctypes.c_int32, ctypes.c_uint64]
+        lib.rtpu_sched_queue_remove.restype = ctypes.c_int
+        lib.rtpu_sched_queue_remove.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_uint64]
+        lib.rtpu_sched_pending.restype = ctypes.c_uint64
+        lib.rtpu_sched_pending.argtypes = [ctypes.c_void_p]
+        lib.rtpu_sched_pump.restype = ctypes.c_uint64
+        lib.rtpu_sched_pump.argtypes = [ctypes.c_void_p, U64P, U64P,
+                                        ctypes.c_uint64]
+        lib.rtpu_sched_avail.restype = ctypes.c_double
+        lib.rtpu_sched_avail.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                         ctypes.c_uint32]
         _LIB = lib
         return _LIB
 
@@ -117,6 +202,9 @@ class NativeIndex:
                    for i in range(n.value)]
         return rc, victims
 
+    def set_spill_dir(self, path: str) -> None:
+        self._lib.rtpu_idx_set_spill_dir(self._h, path.encode())
+
     def seal(self, oid: bytes) -> int:
         return self._lib.rtpu_idx_seal(self._h, oid)
 
@@ -152,4 +240,203 @@ class NativeIndex:
     def close(self) -> None:
         if self._h:
             self._lib.rtpu_idx_close(self._h)
+            self._h = None
+
+
+class Ring:
+    """SPSC-ish shm byte ring with futex wakeups (native/fastlane.cc).
+
+    ``push``/``pop`` release the GIL (ctypes) — safe to block on from
+    dedicated threads. Records are bytes; framing is the caller's."""
+
+    def __init__(self, path: str, capacity: int = 1 << 20, *,
+                 create: bool = False):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native lib unavailable: {_LIB_ERR}")
+        self._lib = lib
+        self.path = path
+        if create:
+            self._h = lib.rtpu_ring_create(path.encode(), capacity)
+        else:
+            self._h = lib.rtpu_ring_open(path.encode())
+        if not self._h:
+            raise RuntimeError(f"cannot open ring at {path}")
+        self._buf = ctypes.create_string_buffer(1 << 16)
+
+    def push(self, data: bytes, timeout_ms: int = -1) -> bool:
+        """False on timeout; raises when the ring is closed."""
+        rc = self._lib.rtpu_ring_push(self._h, data, len(data), timeout_ms)
+        if rc == 0:
+            return True
+        if rc == -2:
+            return False
+        if rc == -1:
+            raise BrokenPipeError(f"ring closed: {self.path}")
+        raise ValueError(f"ring push rc={rc} (len={len(data)})")
+
+    def pop(self, timeout_ms: int = -1) -> Optional[bytes]:
+        """None on timeout; raises BrokenPipeError when closed+drained."""
+        need = ctypes.c_uint32(0)
+        while True:
+            n = self._lib.rtpu_ring_pop(
+                self._h, self._buf, len(self._buf), ctypes.byref(need),
+                timeout_ms)
+            if n >= 0:
+                return self._buf.raw[:n]
+            if n == -2:
+                return None
+            if n == -1:
+                raise BrokenPipeError(f"ring closed: {self.path}")
+            if n == -3:  # grow and retry
+                self._buf = ctypes.create_string_buffer(
+                    max(need.value, len(self._buf) * 2))
+                continue
+            raise ValueError(f"ring pop rc={n}")
+
+    def close_write(self) -> None:
+        if self._h:
+            self._lib.rtpu_ring_close(self._h)
+
+    @property
+    def closed(self) -> bool:
+        return bool(self._lib.rtpu_ring_closed(self._h)) if self._h else True
+
+    def free(self) -> None:
+        if self._h:
+            self._lib.rtpu_ring_free(self._h)
+            self._h = None
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+class RefTable:
+    """Native distributed-refcount table (core_tables.cc; ref:
+    reference_count.h:66). Free decisions: 0 keep, 1 free (owned),
+    2 drop local state only (borrowed)."""
+
+    def __init__(self):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native lib unavailable: {_LIB_ERR}")
+        self._lib = lib
+        self._h = lib.rtpu_rc_open()
+
+    def add_local(self, oid: bytes) -> None:
+        self._lib.rtpu_rc_add_local(self._h, oid)
+
+    def remove_local(self, oid: bytes) -> int:
+        return self._lib.rtpu_rc_remove_local(self._h, oid)
+
+    def pin_dep(self, oid: bytes) -> None:
+        self._lib.rtpu_rc_pin_dep(self._h, oid)
+
+    def unpin_dep(self, oid: bytes) -> int:
+        return self._lib.rtpu_rc_unpin_dep(self._h, oid)
+
+    def set_borrowed(self, oid: bytes) -> None:
+        self._lib.rtpu_rc_set_borrowed(self._h, oid)
+
+    def contains(self, oid: bytes) -> bool:
+        return bool(self._lib.rtpu_rc_contains(self._h, oid))
+
+    def local_count(self, oid: bytes) -> int:
+        return self._lib.rtpu_rc_local_count(self._h, oid)
+
+    def __len__(self) -> int:
+        return self._lib.rtpu_rc_size(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rtpu_rc_close(self._h)
+            self._h = None
+
+
+class LeaseScheduler:
+    """Native lease queue + dispatch engine (core_tables.cc; ref:
+    cluster_task_manager.h + hybrid_scheduling_policy.h:50).
+
+    Resource names are interned to u32 ids per instance; node ids are
+    u64 handles chosen by the caller. ``pump`` sweeps the whole backlog
+    natively and returns [(req_id, node_handle)] grants."""
+
+    SPREAD = 1
+    NO_SPILL = 2
+
+    def __init__(self, local_node: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native lib unavailable: {_LIB_ERR}")
+        self._lib = lib
+        self._h = lib.rtpu_sched_open(local_node)
+        self._intern: dict = {}
+        self._out_req = (ctypes.c_uint64 * 4096)()
+        self._out_node = (ctypes.c_uint64 * 4096)()
+
+    def _vec(self, resources: dict):
+        n = len(resources)
+        ids = (ctypes.c_uint32 * n)()
+        vals = (ctypes.c_double * n)()
+        for i, (k, v) in enumerate(resources.items()):
+            rid = self._intern.get(k)
+            if rid is None:
+                rid = self._intern[k] = len(self._intern) + 1
+            ids[i] = rid
+            vals[i] = float(v)
+        return ids, vals, n
+
+    def node_upsert(self, node: int, total: dict, available: dict) -> None:
+        keys = sorted(set(total) | set(available))
+        merged_tot = {k: total.get(k, 0.0) for k in keys}
+        ids, tot, n = self._vec(merged_tot)
+        av = (ctypes.c_double * n)()
+        for i, k in enumerate(merged_tot):
+            av[i] = float(available.get(k, 0.0))
+        self._lib.rtpu_sched_node_upsert(self._h, node, ids, tot, av, n)
+
+    def node_remove(self, node: int) -> None:
+        self._lib.rtpu_sched_node_remove(self._h, node)
+
+    def try_allocate(self, node: int, resources: dict) -> bool:
+        ids, vals, n = self._vec(resources)
+        return bool(self._lib.rtpu_sched_try_allocate(
+            self._h, node, ids, vals, n))
+
+    def release(self, node: int, resources: dict) -> None:
+        ids, vals, n = self._vec(resources)
+        self._lib.rtpu_sched_release(self._h, node, ids, vals, n)
+
+    def queue_push(self, req_id: int, resources: dict, *,
+                   spread: bool = False, no_spill: bool = False,
+                   affinity_node: int = 0) -> None:
+        ids, vals, n = self._vec(resources)
+        flags = (self.SPREAD if spread else 0) | \
+            (self.NO_SPILL if no_spill else 0)
+        self._lib.rtpu_sched_queue_push(self._h, req_id, ids, vals, n,
+                                        flags, affinity_node)
+
+    def queue_remove(self, req_id: int) -> bool:
+        return bool(self._lib.rtpu_sched_queue_remove(self._h, req_id))
+
+    def pending(self) -> int:
+        return self._lib.rtpu_sched_pending(self._h)
+
+    def pump(self) -> List[Tuple[int, int]]:
+        n = self._lib.rtpu_sched_pump(self._h, self._out_req,
+                                      self._out_node, 4096)
+        return [(self._out_req[i], self._out_node[i]) for i in range(n)]
+
+    def avail(self, node: int, resource: str) -> float:
+        rid = self._intern.get(resource)
+        if rid is None:
+            return 0.0
+        return self._lib.rtpu_sched_avail(self._h, node, rid)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rtpu_sched_close(self._h)
             self._h = None

@@ -46,10 +46,19 @@ class Config:
     # --- object store ---
     object_store_memory_bytes: int = 2 * 1024**3
     object_store_small_object_threshold: int = 100 * 1024  # inline below this
+    object_spilling_enabled: bool = True      # evictees spill to disk
+    object_spilling_dir: str = ""             # "" = TEMP_ROOT/spill/<store>
     object_spilling_threshold: float = 0.8
     object_store_eviction_fraction: float = 0.1
+    # --- memory pressure (ref: memory_monitor.h:52 + killing policies) ---
+    memory_monitor_refresh_ms: int = 500      # 0 disables the monitor
+    memory_usage_threshold: float = 0.95      # host RSS fraction to act at
+    memory_monitor_test_file: str = ""        # tests: file with a fraction
     max_grpc_message_bytes: int = 512 * 1024**2
     object_transfer_chunk_bytes: int = 8 * 1024**2
+    # --- fast lane (native shm task plane; ray_tpu/_private/fastlane.py) ---
+    fastlane_width: int = 4                   # max lanes (leased workers)
+    fastlane_window: int = 32                 # in-flight tasks per lane
     # --- workers ---
     num_workers_soft_limit: int = -1          # -1: num_cpus
     worker_startup_timeout_s: float = 60.0

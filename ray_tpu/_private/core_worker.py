@@ -138,7 +138,17 @@ class CoreWorker:
         self._put_index = 0
         self._put_lock = threading.Lock()
 
-        # reference counting
+        # reference counting — native C++ table by default (ref:
+        # reference_count.h:66; native/core_tables.cc), Python dicts as
+        # the fallback when the toolchain can't build the lib
+        self._rc = None
+        try:
+            from .._native import RefTable, native_unavailable_reason
+
+            if native_unavailable_reason() is None:
+                self._rc = RefTable()
+        except Exception:
+            self._rc = None
         self._local_refs: Dict[ObjectID, int] = {}
         self._borrowed: Dict[ObjectID, str] = {}
         self._task_deps: Dict[ObjectID, int] = {}
@@ -150,6 +160,9 @@ class CoreWorker:
         self._actors: Dict[ActorID, _ActorState] = {}
         self._function_cache: Dict[str, Any] = {}
         self._exported_blobs: set = set()
+        # id(func) -> (func, FunctionDescriptor); func kept so the id
+        # cannot be recycled by a different object
+        self._descriptor_cache: Dict[int, tuple] = {}
         # lineage: resubmittable specs for owned objects (recorded, replayed by
         # the recovery manager milestone)
         self._lineage: Dict[TaskID, TaskSpec] = {}
@@ -166,9 +179,41 @@ class CoreWorker:
         # task events buffered toward the GCS (ref: task_event_buffer.h)
         self._task_events: List[dict] = []
         self._task_events_lock = threading.Lock()
+        self._task_event_flusher_armed = False
         self.address = ""  # worker-mode processes set their push address
+        self._owner_server = None  # drivers: serves owned small objects
+
+        # fast-lane submission plane (ray_tpu/_private/fastlane.py):
+        # shm-ring task streaming to leased workers, asyncio as fallback
+        from .fastlane import LanePool, lanes_enabled
+
+        self._lane_events: Dict[ObjectID, threading.Event] = {}
+        self._actor_lanes: Dict[ActorID, Any] = {}
+        self._actor_lane_blocked: set = set()
+        if lanes_enabled():
+            # more lanes than cores just adds context-switch thrash: each
+            # lane is a busy worker process (plus its reply thread here)
+            width = max(1, min(self.cfg.fastlane_width,
+                               os.cpu_count() or 1))
+            self._lane_pool = LanePool(
+                self, width=width, window=self.cfg.fastlane_window)
+            self.io.spawn(self._lane_maintenance_loop())
+        else:
+            self._lane_pool = None
 
         _set_ref_registry(self)
+
+    def _on_reclaim_lease(self, payload):
+        """Raylet push under pending demand: give back the named lane's
+        lease if it has nothing in flight."""
+        if self._lane_pool is not None:
+            self._lane_pool.reclaim(payload.get("lease_id"))
+
+    async def _lane_maintenance_loop(self):
+        while True:
+            await asyncio.sleep(2.0)
+            if self._lane_pool is not None:
+                self._lane_pool.maintain()
 
     # ------------------------------------------------------- task context
     @property
@@ -204,15 +249,65 @@ class CoreWorker:
         await self.gcs.connect()
         await self.raylet.connect()
         self.gcs.on_push("pubsub:actor", self._on_actor_update)
+        self.raylet.on_push("reclaim_lease", self._on_reclaim_lease)
         await self.gcs.call("subscribe", {"channels": ["actor"]})
+        if self.mode == "driver" and not self.address:
+            await self._start_owner_server()
+
+    async def _start_owner_server(self):
+        """Drivers serve their owned in-memory objects to borrowers
+        (ref: core_worker.proto GetObject — the owner is the source of
+        truth for small objects, which never touch plasma). Workers
+        register the same handler on their existing task server."""
+        from .rpc import RpcServer, parse_address
+
+        kind = parse_address(self.raylet.address)
+        if kind[0] == "unix":
+            base = os.path.dirname(kind[1])
+            addr = os.path.join(
+                base, f"driver_{self.worker_id.hex()[:12]}.sock")
+        else:
+            addr = "127.0.0.1:0"
+        self._owner_server = RpcServer(
+            addr, name=f"owner-{self.worker_id.hex()[:8]}")
+        self._owner_server.register("fetch_object", self._handle_fetch_object)
+        await self._owner_server.start()
+        self.address = self._owner_server.address
+
+    async def _handle_fetch_object(self, payload, conn):
+        """Serve one owned object: {"status": ok|pending|gone, "data"}.
+        pending = the creating task is still in flight here, the
+        borrower should retry."""
+        oid = payload["object_id"]
+        data = self.memory_store.get(oid)
+        if data is None:
+            view = self.store.get(oid)
+            if view is not None:
+                data = bytes(view)
+        if data is not None:
+            return {"status": "ok", "data": data}
+        if (oid in self._lane_events or oid.task_id() in self._inflight
+                or oid.task_id() in self._streams):
+            return {"status": "pending", "data": None}
+        return {"status": "gone", "data": None}
 
     def shutdown(self):
+        if self._lane_pool is not None:
+            self._lane_pool.close()
+        for lane in list(self._actor_lanes.values()):
+            lane.close()
+        self._actor_lanes.clear()
         try:
             self.io.run(self._shutdown(), timeout=5)
         except Exception:
             pass
         self.io.stop()
         _set_ref_registry(None)
+        # The native RefTable is deliberately NOT closed: ObjectRef
+        # finalizers and lane reply threads may still race a call into
+        # it during interpreter teardown, and close() would free the C++
+        # table under them (use-after-free). It is in-process memory —
+        # process exit reclaims it.
 
     async def _shutdown(self):
         if self.mode == "driver" and not self.gcs.closed:
@@ -230,15 +325,29 @@ class CoreWorker:
                 await client.close()
             except Exception:
                 pass
+        if self._owner_server is not None:
+            try:
+                await self._owner_server.stop()
+            except Exception:
+                pass
         await self.gcs.close()
         await self.raylet.close()
 
     # -------------------------------------------------------- ref counting
+    # Native C++ table when available (self._rc, native/core_tables.cc);
+    # the table returns the free decision: 0 keep, 1 free (owned),
+    # 2 drop local state only (borrowed).
     def add_local_ref(self, oid: ObjectID):
+        if self._rc is not None:
+            self._rc.add_local(oid.binary())
+            return
         with self._ref_lock:
             self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
 
     def remove_local_ref(self, oid: ObjectID):
+        if self._rc is not None:
+            self._apply_free_decision(oid, self._rc.remove_local(oid.binary()))
+            return
         with self._ref_lock:
             count = self._local_refs.get(oid, 0) - 1
             if count <= 0:
@@ -249,15 +358,24 @@ class CoreWorker:
                 self._local_refs[oid] = count
 
     def add_borrowed_ref(self, oid: ObjectID, owner_address: str):
+        self._borrowed[oid] = owner_address
+        if self._rc is not None:
+            self._rc.set_borrowed(oid.binary())
+            return
         with self._ref_lock:
-            self._borrowed[oid] = owner_address
             self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
 
     def _pin_task_dep(self, oid: ObjectID):
+        if self._rc is not None:
+            self._rc.pin_dep(oid.binary())
+            return
         with self._ref_lock:
             self._task_deps[oid] = self._task_deps.get(oid, 0) + 1
 
     def _unpin_task_dep(self, oid: ObjectID):
+        if self._rc is not None:
+            self._apply_free_decision(oid, self._rc.unpin_dep(oid.binary()))
+            return
         with self._ref_lock:
             count = self._task_deps.get(oid, 0) - 1
             if count <= 0:
@@ -267,11 +385,22 @@ class CoreWorker:
             else:
                 self._task_deps[oid] = count
 
+    def _apply_free_decision(self, oid: ObjectID, decision: int):
+        if decision == 0:
+            return
+        if decision == 2:  # borrowed: drop local state, owner frees
+            self._borrowed.pop(oid, None)
+            return
+        self._free_owned(oid)
+
     def _maybe_free(self, oid: ObjectID):
         # only the owner frees plasma copies; borrowers just drop local state
         if oid in self._borrowed:
             self._borrowed.pop(oid, None)
             return
+        self._free_owned(oid)
+
+    def _free_owned(self, oid: ObjectID):
         self.memory_store.delete(oid)
         if oid in self._owned_in_plasma:
             self._owned_in_plasma.discard(oid)
@@ -288,32 +417,42 @@ class CoreWorker:
 
     # ----------------------------------------------------------- task events
     def _record_task_event(self, task_id: TaskID, **fields) -> None:
-        """Buffer a task state transition; flushed to the GCS in batches
-        (ref: task_event_buffer.h → gcs_task_manager.h). Fire-and-forget:
-        observability must never block or fail the submission path."""
+        """Buffer a task state transition; a standing periodic flusher
+        ships batches to the GCS (ref: task_event_buffer.h →
+        gcs_task_manager.h). Nothing is spawned on the submit path —
+        at 10k tasks/s even one run_coroutine_threadsafe per event
+        would dominate."""
         event = {"task_id": task_id}
         event.update(fields)
-        flush = None
-        arm_timer = False
         with self._task_events_lock:
             self._task_events.append(event)
-            if len(self._task_events) >= 20:
-                flush, self._task_events = self._task_events, []
-            else:
-                # one timer per buffer fill, not per event — high submit
-                # rates must not stack thousands of sleeper coroutines
-                arm_timer = len(self._task_events) == 1
-        if flush is not None:
-            self.io.spawn(self._send_task_events(flush))
-        elif arm_timer:
-            self.io.spawn(self._flush_task_events_soon())
+            arm = not self._task_event_flusher_armed
+            if arm:
+                self._task_event_flusher_armed = True
+        if arm:
+            self.io.spawn(self._task_event_flusher())
 
-    async def _flush_task_events_soon(self):
-        await asyncio.sleep(0.5)
+    async def _task_event_flusher(self):
+        """Standing flusher; exits after an idle period so short-lived
+        cores don't keep a wakeup loop alive."""
+        idle = 0
+        while idle < 20:
+            await asyncio.sleep(0.25)
+            with self._task_events_lock:
+                flush, self._task_events = self._task_events, []
+            if flush:
+                idle = 0
+                await self._send_task_events(flush)
+            else:
+                idle += 1
         with self._task_events_lock:
-            flush, self._task_events = self._task_events, []
-        if flush:
-            await self._send_task_events(flush)
+            if self._task_events:
+                # an event landed between the last empty swap and now;
+                # disarming here would strand it — let a fresh flusher
+                # take over
+                self.io.spawn(self._task_event_flusher())
+            else:
+                self._task_event_flusher_armed = False
 
     async def _send_task_events(self, events: List[dict]):
         try:
@@ -357,29 +496,155 @@ class CoreWorker:
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         oids = [r.id() for r in refs]
-        return self.io.run(self._get(oids, timeout),
+        # Fast path: every object is already local, or is the pending
+        # return of a fast-lane task (completed by the lane reply thread
+        # setting a threading.Event) — no event-loop hop, no raylet RPC.
+        fast = []
+        for oid in oids:
+            ev = self._lane_events.get(oid)
+            if ev is not None:
+                fast.append((oid, ev))
+            elif self.memory_store.contains(oid) or self.store.contains(oid):
+                fast.append((oid, None))
+            else:
+                fast = None
+                break
+        if fast is not None:
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            out = []
+            for oid, ev in fast:
+                if ev is not None and not (self.memory_store.contains(oid)
+                                           or self.store.contains(oid)):
+                    left = (None if deadline is None
+                            else max(0.0, deadline - time.monotonic()))
+                    if not ev.wait(left):
+                        raise exc.GetTimeoutError(
+                            "Get timed out: fast-lane task not finished")
+                out.append(self._load_object(oid))
+            return out
+        owners = {r.id(): r.owner_address for r in refs if r.owner_address}
+        return self.io.run(self._get(oids, timeout, owners),
                            timeout=None if timeout is None else timeout + 30)
 
-    async def _get(self, oids: List[ObjectID], timeout: Optional[float]) -> List[Any]:
-        missing = [oid for oid in oids if not self.memory_store.contains(oid)
-                   and not self.store.contains(oid)]
-        if missing:
-            reply = await self.raylet.call("wait_objects", {
-                "object_ids": missing, "num_returns": len(missing), "timeout": timeout,
-            })
-            lost = reply.get("lost", [])
-            if lost:
-                recovered = await self._try_recover(lost)
-                if not recovered:
-                    raise exc.ObjectLostError(lost[0])
-                return await self._get(oids, timeout)
-            if len(reply["ready"]) < len(missing):
-                raise exc.GetTimeoutError(
-                    f"Get timed out: {len(missing) - len(reply['ready'])} object(s) not ready")
-        out = []
-        for oid in oids:
-            out.append(self._load_object(oid))
-        return out
+    async def _fetch_from_owner(self, owner: str, oid: ObjectID,
+                                deadline: Optional[float]) -> str:
+        """Pull one object from its owner into the local memory store
+        (small objects never seal into plasma — the owner serves them).
+        Retries while the owner reports the creating task pending.
+        Returns "ok" | "gone" | "unreachable" | "timeout"."""
+        delay = 0.005
+        while True:
+            try:
+                client = await self._client_for(owner)
+                reply = await client.call("fetch_object",
+                                          {"object_id": oid}, timeout=10)
+            except Exception:
+                return "unreachable"  # owner dead or not serving
+            if reply is None or reply.get("status") == "gone":
+                return "gone"
+            if reply["status"] == "ok":
+                self.memory_store.put(oid, reply["data"])
+                return "ok"
+            if (deadline is not None
+                    and asyncio.get_event_loop().time() > deadline):
+                return "timeout"
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 0.1)
+
+    async def _get(self, oids: List[ObjectID], timeout: Optional[float],
+                   owners: Optional[Dict[ObjectID, str]] = None) -> List[Any]:
+        """Resolution order per object: local stores → (owned, task in
+        flight here) poll local completion → (borrowed, owner known)
+        fetch from owner → raylet directory wait + lineage recovery.
+        Small objects never seal into plasma, so the directory only
+        covers large/sealed ones."""
+        loop = asyncio.get_event_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+        owners = owners or {}
+        delay = 0.002
+        gone_strikes: Dict[ObjectID, int] = {}
+        while True:
+            missing = [oid for oid in oids
+                       if not self.memory_store.contains(oid)
+                       and not self.store.contains(oid)]
+            if not missing:
+                return [self._load_object(oid) for oid in oids]
+            pending_here = {oid for oid in missing
+                            if oid in self._lane_events
+                            or oid.task_id() in self._inflight
+                            or oid.task_id() in self._streams}
+            foreign = [oid for oid in missing if oid not in pending_here]
+            progressed = False
+            plasma_wait = []
+            for oid in foreign:
+                owner = owners.get(oid)
+                if owner and owner != self.address:
+                    status = await self._fetch_from_owner(owner, oid,
+                                                          deadline)
+                    if status == "ok":
+                        progressed = True
+                        continue
+                    if status in ("gone", "unreachable"):
+                        # The owner has nothing IN MEMORY — but a large
+                        # result seals into plasma on the EXECUTING node,
+                        # so consult the directory (with a grace window
+                        # for the batched seal report) before declaring
+                        # loss. Repeated strikes with an empty directory
+                        # → lineage recovery or ObjectLostError.
+                        strikes = gone_strikes.get(oid, 0) + 1
+                        gone_strikes[oid] = strikes
+                        if strikes >= 4:
+                            if await self._try_recover([oid]):
+                                gone_strikes.pop(oid, None)
+                                continue
+                            raise exc.ObjectLostError(oid)
+                        plasma_wait.append(oid)
+                        continue
+                    raise exc.GetTimeoutError(
+                        f"Get timed out waiting on owner {owner}")
+                plasma_wait.append(oid)
+            if plasma_wait:
+                left = (None if deadline is None
+                        else max(0.0, deadline - loop.time()))
+                # bounded slices when owned work is also pending here or
+                # an owner said gone (the directory may never learn of a
+                # small object), so local completions / strikes progress
+                slice_t = left
+                if pending_here or gone_strikes:
+                    slice_t = 0.2 if left is None else min(0.2, left)
+                reply = await self.raylet.call("wait_objects", {
+                    "object_ids": plasma_wait,
+                    "num_returns": len(plasma_wait),
+                    "timeout": slice_t,
+                })
+                lost = reply.get("lost", [])
+                if lost:
+                    recovered = await self._try_recover(lost)
+                    if not recovered:
+                        raise exc.ObjectLostError(lost[0])
+                    continue
+                if len(reply["ready"]) >= len(plasma_wait):
+                    progressed = True
+                elif not pending_here and timeout is not None and (
+                        deadline is None or loop.time() >= deadline):
+                    raise exc.GetTimeoutError(
+                        f"Get timed out: "
+                        f"{len(plasma_wait) - len(reply['ready'])} "
+                        f"object(s) not ready")
+            if deadline is not None and loop.time() >= deadline:
+                still = [oid for oid in oids
+                         if not self.memory_store.contains(oid)
+                         and not self.store.contains(oid)]
+                if still:
+                    raise exc.GetTimeoutError(
+                        f"Get timed out: {len(still)} object(s) not ready")
+                continue
+            if not progressed:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 0.05)
+            else:
+                delay = 0.002
 
     async def _try_recover(self, oids: List[ObjectID]) -> bool:
         """Lineage reconstruction (ref: object_recovery_manager.h,
@@ -453,19 +718,50 @@ class CoreWorker:
         return ready, not_ready
 
     async def _wait(self, oids, num_returns, timeout):
-        local_ready = [oid for oid in oids if self.memory_store.contains(oid)]
-        if len(local_ready) >= num_returns:
-            return local_ready
-        remaining = [oid for oid in oids if oid not in set(local_ready)]
-        reply = await self.raylet.call("wait_objects", {
-            "object_ids": remaining,
-            "num_returns": num_returns - len(local_ready),
-            "timeout": timeout,
-        })
-        # lost objects count as ready: their get() surfaces ObjectLostError
-        # (matches the reference, where a failed reconstruction stores an
-        # error object) — and keeps wait-loops from spinning hot on them
-        return local_ready + reply["ready"] + reply.get("lost", [])
+        """Readiness: local stores first; owned in-flight tasks (fast
+        lane / asyncio) complete into the memory store, so they are
+        polled locally — small returns never reach the plasma
+        directory; everything else blocks on the raylet wait manager.
+        Lost objects count as ready: their get() surfaces
+        ObjectLostError (matches the reference, where a failed
+        reconstruction stores an error object)."""
+        loop = asyncio.get_event_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+        delay = 0.002
+        while True:
+            ready = [oid for oid in oids
+                     if self.memory_store.contains(oid)
+                     or self.store.contains(oid)]
+            if len(ready) >= num_returns:
+                return ready
+            ready_set = set(ready)
+            pending_here = {oid for oid in oids
+                            if oid not in ready_set
+                            and (oid in self._lane_events
+                                 or oid.task_id() in self._inflight
+                                 or oid.task_id() in self._streams)}
+            remote = [oid for oid in oids
+                      if oid not in ready_set and oid not in pending_here]
+            if remote and not pending_here:
+                left = (None if deadline is None
+                        else max(0.0, deadline - loop.time()))
+                reply = await self.raylet.call("wait_objects", {
+                    "object_ids": remote,
+                    "num_returns": num_returns - len(ready),
+                    "timeout": left if timeout is not None else None,
+                })
+                return ready + reply["ready"] + reply.get("lost", [])
+            if remote:
+                reply = await self.raylet.call("wait_objects", {
+                    "object_ids": remote, "num_returns": len(remote),
+                    "timeout": 0})
+                combined = ready + reply["ready"] + reply.get("lost", [])
+                if len(combined) >= num_returns:
+                    return combined
+            if deadline is not None and loop.time() >= deadline:
+                return ready
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 0.05)
 
     def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
@@ -482,6 +778,21 @@ class CoreWorker:
 
     # ------------------------------------------------------ function export
     def export_function(self, func_or_class: Any) -> FunctionDescriptor:
+        # Descriptor memoized per function OBJECT: cloudpickling the
+        # function on every submit would dominate the trivial-task path
+        # (~130us each). Keyed by identity in a WeakKeyDictionary-like
+        # id map so redefinition (new object) re-exports; the closure
+        # caveat (mutated captured state is not re-shipped) matches the
+        # reference's once-per-function export via function_manager.py.
+        key = id(func_or_class)
+        cached = self._descriptor_cache.get(key)
+        if cached is not None and cached[0] is func_or_class:
+            return cached[1]
+        if len(self._descriptor_cache) >= 4096:
+            # bound the cache: drivers minting closures in a loop must
+            # not pin every one (plus its captures) forever
+            for old in list(self._descriptor_cache)[:2048]:
+                self._descriptor_cache.pop(old, None)
         pickled = cloudpickle.dumps(func_or_class)
         blob_id = FunctionDescriptor.blob_id_for(pickled)
         if blob_id not in self._exported_blobs:
@@ -490,7 +801,9 @@ class CoreWorker:
             }))
             self._exported_blobs.add(blob_id)
         name = getattr(func_or_class, "__qualname__", repr(func_or_class))
-        return FunctionDescriptor(blob_id=blob_id, repr_name=name)
+        descriptor = FunctionDescriptor(blob_id=blob_id, repr_name=name)
+        self._descriptor_cache[key] = (func_or_class, descriptor)
+        return descriptor
 
     def load_function(self, blob_id: str) -> Any:
         cached = self._function_cache.get(blob_id)
@@ -512,14 +825,30 @@ class CoreWorker:
             actual = item[2] if isinstance(item, tuple) and len(item) == 3 and item[0] == "__kw__" else item
             kw = item[1] if actual is not item else None
             if isinstance(actual, ObjectRef):
-                packed.append(TaskArg(ArgKind.OBJECT_REF, value=kw, object_id=actual.id()))
+                # Inline small owned values the owner already holds
+                # (ref: transport/dependency_resolver.h inlines small
+                # in-memory objects): the consuming worker skips the
+                # whole dependency wait. Error payloads stay by-ref so
+                # the dependency failure surfaces as a task error, not
+                # as a (err, tb) tuple argument.
+                inline = self.memory_store.get(actual.id())
+                if (inline is not None and len(inline) <= _SMALL
+                        and ser.get_metadata(inline) == ser.META_PLAIN):
+                    packed.append(TaskArg(ArgKind.VALUE,
+                                          value=(kw, inline)))
+                    continue
+                packed.append(TaskArg(
+                    ArgKind.OBJECT_REF, value=kw, object_id=actual.id(),
+                    owner=actual.owner_address or self.address))
                 dep_ids.append(actual.id())
                 self._pin_task_dep(actual.id())
             else:
                 data = ser.serialize(actual)
                 if len(data) > _SMALL:
                     ref = self.put(actual)
-                    packed.append(TaskArg(ArgKind.OBJECT_REF, value=kw, object_id=ref.id()))
+                    packed.append(TaskArg(
+                        ArgKind.OBJECT_REF, value=kw, object_id=ref.id(),
+                        owner=self.address))
                     dep_ids.append(ref.id())
                     self._pin_task_dep(ref.id())
                 else:
@@ -559,23 +888,43 @@ class CoreWorker:
     # ------------------------------------------------------ normal tasks
     def _prepare_runtime_env(self, opts: dict) -> Optional[dict]:
         """Pack a runtime_env option for the wire (ref: runtime envs,
-        SURVEY §2.2). Cached per (env-spec, dir mtimes): re-tarring a
-        working_dir on every one of thousands of submissions would
-        dominate the submit path. The mtime key means edits *inside* an
-        already-uploaded directory tree are only picked up when a
-        top-level entry changes — the reference's URI-cache has the same
-        refresh granularity."""
+        SURVEY §2.2). Cached per (env-spec, content fingerprint):
+        re-tarring a working_dir on every one of thousands of
+        submissions would dominate the submit path. The fingerprint is
+        a shallow walk of every file's (relpath, size, mtime) — editing
+        a file's CONTENTS bumps its mtime, so re-submitting from the
+        same driver ships fresh code (the reference re-hashes directory
+        contents per upload; a directory-level mtime would miss edits
+        inside existing files)."""
         env = opts.get("runtime_env")
         if not env:
             return None
         import json
         import os as _os
 
+        def _dir_fingerprint(d: str):
+            if not d:
+                return 0.0
+            sig = []
+            try:
+                for root, subdirs, files in _os.walk(d):
+                    subdirs.sort()
+                    for f in sorted(files):
+                        p = _os.path.join(root, f)
+                        try:
+                            st = _os.stat(p)
+                        except OSError:
+                            continue
+                        sig.append((_os.path.relpath(p, d),
+                                    st.st_size, st.st_mtime))
+            except OSError:
+                return 0.0
+            return tuple(sig)
+
         dirs = [env.get("working_dir") or ""] + list(
             env.get("py_modules") or [])
         try:
-            mtimes = tuple(
-                _os.path.getmtime(d) if d else 0.0 for d in dirs)
+            mtimes = tuple(_dir_fingerprint(d) for d in dirs)
         except OSError:
             mtimes = ()
         try:
@@ -631,8 +980,30 @@ class CoreWorker:
             self.io.spawn(self._submit_normal(spec, deps))
             return ObjectRefGenerator(spec.task_id, self)
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        if self._lane_eligible(spec, deps) and self._lane_submit(spec):
+            return refs
         self.io.spawn(self._submit_normal(spec, deps))
         return refs
+
+    def _lane_eligible(self, spec: TaskSpec, deps: List[ObjectID]) -> bool:
+        """Fast-lane tasks: default-shaped, dependency-free, one return.
+        Everything else takes the asyncio control plane."""
+        return (self._lane_pool is not None
+                and not deps
+                and spec.num_returns == 1
+                and spec.runtime_env is None
+                and isinstance(spec.scheduling_strategy,
+                               DefaultSchedulingStrategy)
+                and spec.resources.key() == (("CPU", 1.0),))
+
+    def _lane_submit(self, spec: TaskSpec) -> bool:
+        event = threading.Event()
+        oid = ObjectID.for_return(spec.task_id, 1)
+        self._lane_events[oid] = event
+        if self._lane_pool.try_submit(spec, event):
+            return True
+        self._lane_events.pop(oid, None)
+        return False
 
     async def _submit_normal(self, spec: TaskSpec, deps: List[ObjectID]):
         info = self._inflight.setdefault(spec.task_id, {
@@ -742,6 +1113,8 @@ class CoreWorker:
             "owner_address": self.address,
             "actor_id": spec.actor_id if spec.actor_creation else None,
             "task_id": spec.task_id,
+            # lane leases are preemptible-when-idle (reclaim_lease push)
+            "lane": spec.function.repr_name == "__lane__",
             # stable across retries: the raylet dedups grants by this id, so
             # a lost reply cannot leak a second worker lease
             "request_id": uuid.uuid4().hex,
@@ -1095,6 +1468,12 @@ class CoreWorker:
         state.state = info.state
         state.address = info.address
         state.death_cause = info.death_cause
+        if info.state in ("DEAD", "RESTARTING"):
+            # tear down the fast lane: buffered calls flush through the
+            # asyncio path, which owns death/restart semantics
+            lane = self._actor_lanes.pop(info.actor_id, None)
+            if lane is not None:
+                lane.close()
         if info.state in ("ALIVE", "DEAD"):
             state.restart_in_flight = False
             for fut in state.waiters:
@@ -1143,8 +1522,46 @@ class CoreWorker:
             owner_address=self.address,
         )
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        # registered so borrower fetch_object sees in-flight returns as
+        # pending rather than gone
+        self._inflight.setdefault(spec.task_id,
+                                  {"canceled": False, "worker_address": None})
+        if self._actor_lane_submit(spec, deps):
+            return refs
+        self._actor_lane_blocked.add(actor_id)
         self.io.spawn(self._submit_actor_task(spec, deps))
         return refs
+
+    def _actor_lane_submit(self, spec: TaskSpec, deps: List[ObjectID]) -> bool:
+        """Route the call through the actor's fast lane. Once a lane
+        exists ALL calls from this owner must ride it (ring FIFO is the
+        ordering guarantee). A lane may only OPEN on the first-ever call
+        to the actor from this owner — if any call already took the
+        asyncio path, opening a lane later could reorder around the
+        in-flight stream, so the actor is lane-blocked for good."""
+        if self._lane_pool is None:  # native plane disabled
+            return False
+        known = self._actors.get(spec.actor_id)
+        if known is not None and known.state == "DEAD":
+            # the asyncio path raises ActorDiedError with the cause;
+            # the ring would just see a dead socket
+            return False
+        lane = self._actor_lanes.get(spec.actor_id)
+        if lane is None:
+            if deps or spec.actor_id in self._actor_lane_blocked:
+                return False
+            from .fastlane import ActorLane
+
+            lane = self._actor_lanes.setdefault(
+                spec.actor_id, ActorLane(self, spec.actor_id))
+        event = threading.Event()
+        for oid in spec.return_ids():
+            self._lane_events[oid] = event
+        if lane.submit(spec, event):
+            return True
+        for oid in spec.return_ids():
+            self._lane_events.pop(oid, None)
+        return False
 
     async def _submit_actor_task(self, spec: TaskSpec, deps: List[ObjectID]):
         try:
@@ -1179,6 +1596,7 @@ class CoreWorker:
         except BaseException as e:  # noqa: BLE001
             self._store_error(spec, e)
         finally:
+            self._inflight.pop(spec.task_id, None)
             for oid in deps:
                 self._unpin_task_dep(oid)
 

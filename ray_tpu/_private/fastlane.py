@@ -1,0 +1,604 @@
+"""Fast-lane task plane: native shm rings between an owner and its
+leased workers (binding over native/fastlane.cc).
+
+The reference's steady-state submission path is a direct worker->worker
+gRPC PushTask once a lease is held (ref: transport/normal_task_submitter.h:227,
+:58-60 SchedulingKey lease pool). Here the steady state drops sockets
+entirely: task frames ride a shared-memory ring pair per (owner, worker)
+— push from the submitting user thread (no event loop on the hot path),
+pop on a dedicated worker thread, replies matched by sequence number on
+a driver-side reply thread. The asyncio RPC plane still owns leasing,
+placement, failure handling, cancellation, streaming and anything cold;
+eligibility for the lane is checked per task and everything else falls
+back transparently.
+
+Parallelism: a LanePool grows to ``fastlane_width`` lanes (one leased
+worker each) while backlog exists, balances by least-outstanding, and
+releases idle lanes back to the raylet, mirroring the reference's lease
+pool dynamics. Per-lane in-flight is capped (``fastlane_window``) so a
+burst of slow tasks spreads over workers instead of convoying behind
+one.
+
+Actor calls: one lane per actor handle-owner pair. Ordering: once the
+lane attaches, ALL calls from this owner ride it (ring FIFO == submit
+order); during attach, calls buffer locally and flush in order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .ids import ObjectID
+from .task_spec import ArgKind, TaskSpec
+from .. import exceptions as exc
+
+
+def _spec_deps(spec: TaskSpec) -> List[ObjectID]:
+    """The ObjectRef args _pack_args pinned for this spec — lane
+    completion paths must unpin exactly these (inlined VALUE args were
+    never pinned)."""
+    return [a.object_id for a in spec.args if a.kind == ArgKind.OBJECT_REF]
+
+# Ring capacity per direction. Shm files are cheap; generous headroom
+# means even many-arg specs (inline VALUE args are individually capped
+# at the small-object threshold by _pack_args) batch into one frame.
+_RING_CAP = 8 << 20
+
+
+def lanes_enabled() -> bool:
+    if os.environ.get("RAY_TPU_FASTLANE", "1") == "0":
+        return False
+    try:
+        from .._native import get_lib
+
+        return get_lib() is not None
+    except Exception:
+        return False
+
+
+class _Lane:
+    """One attached (owner -> leased worker) ring pair."""
+
+    def __init__(self, core, grant: dict, sub, rep, client):
+        self.core = core
+        self.grant = grant
+        self.worker_address = grant["worker_address"]
+        self.sub = sub          # owner pushes task frames
+        self.rep = rep          # worker pushes reply frames
+        self.client = client    # asyncio client (liveness + cancel path)
+        self.pending: Dict[int, Tuple[TaskSpec, threading.Event]] = {}
+        self.outstanding = 0
+        self.last_used = time.monotonic()
+        self.dead = False
+        self.on_slot: Optional[Callable[[], None]] = None  # pool wakeup
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._reply_thread = threading.Thread(
+            target=self._reply_loop, daemon=True,
+            name=f"lane_reply_{self.worker_address[-8:]}")
+        self._reply_thread.start()
+
+    # ---- submit path (called from user threads / the pool feeder) ----
+    def submit(self, spec: TaskSpec, event: threading.Event) -> bool:
+        return self.submit_many([(spec, event)]) == 1
+
+    def submit_many(self, items: List[Tuple[TaskSpec, threading.Event]]) -> int:
+        """Ship a chunk of tasks as ONE frame (one pickle, one ring
+        push) — burst submission amortizes the per-frame cost. Returns
+        how many were accepted (0 on a dead lane; never partial)."""
+        if not items:
+            return 0
+        with self._lock:
+            if self.dead:
+                return 0
+            batch = []
+            for spec, event in items:
+                self._seq += 1
+                self.pending[self._seq] = (spec, event)
+                batch.append((self._seq, spec))
+            self.outstanding += len(items)
+            self.last_used = time.monotonic()
+        for _, spec in batch:
+            info = self.core._inflight.get(spec.task_id)
+            if info is not None:
+                info["worker_address"] = self.worker_address
+        frame = pickle.dumps(batch, protocol=5)
+        try:
+            if not self.sub.push(frame, timeout_ms=2000):
+                raise BrokenPipeError("ring full")
+        except ValueError:
+            # frame larger than the ring: the lane is perfectly healthy,
+            # this batch just can't ride it — un-register and let the
+            # caller route it elsewhere (killing the lane here would
+            # requeue the same chunk into a grow/kill spin)
+            with self._lock:
+                for seq, _ in batch:
+                    self.pending.pop(seq, None)
+                self.outstanding -= len(batch)
+            return -1
+        except BrokenPipeError:
+            with self._lock:
+                for seq, _ in batch:
+                    self.pending.pop(seq, None)
+                self.outstanding -= len(batch)
+            self._mark_dead()
+            return 0
+        return len(batch)
+
+    # ---- reply path ----
+    def _reply_loop(self):
+        while True:
+            try:
+                frame = self.rep.pop(timeout_ms=200)
+            except (BrokenPipeError, ValueError):
+                break
+            if frame is None:
+                if self.dead or self.client.closed:
+                    break
+                continue
+            try:
+                seq, reply = pickle.loads(frame)
+            except Exception:
+                continue
+            with self._lock:
+                entry = self.pending.pop(seq, None)
+                if entry is not None:
+                    self.outstanding -= 1
+            if entry is None:
+                continue
+            spec, event = entry
+            try:
+                errored = self.core._handle_task_reply(spec, reply)
+                self.core._record_task_event(
+                    spec.task_id, state="FAILED" if errored else "FINISHED",
+                    end_time=time.time(),
+                    error="application error" if errored else None)
+            finally:
+                self.core._inflight.pop(spec.task_id, None)
+                for oid in spec.return_ids():
+                    self.core._lane_events.pop(oid, None)
+                for oid in _spec_deps(spec):
+                    self.core._unpin_task_dep(oid)
+                event.set()
+                if self.on_slot is not None:
+                    self.on_slot()
+        self._mark_dead()
+        self._fail_pending()
+        if self.on_slot is not None:
+            self.on_slot()
+
+    def _mark_dead(self):
+        with self._lock:
+            if self.dead:
+                return
+            self.dead = True
+        try:
+            self.sub.close_write()
+        except Exception:
+            pass
+
+    def _fail_pending(self):
+        """Worker died: resubmit retriable pending tasks through the
+        asyncio path, error the rest (ref: lease failure handling in
+        normal_task_submitter)."""
+        with self._lock:
+            entries = list(self.pending.values())
+            self.pending.clear()
+            self.outstanding = 0
+        for spec, event in entries:
+            if spec.max_retries > 0 and not spec.is_actor_task():
+                spec.max_retries -= 1
+
+                async def _resub(spec=spec, event=event):
+                    try:
+                        # deps transfer to the asyncio path, whose
+                        # finally unpins them
+                        await self.core._submit_normal(spec,
+                                                       _spec_deps(spec))
+                    finally:
+                        for oid in spec.return_ids():
+                            self.core._lane_events.pop(oid, None)
+                        event.set()
+
+                self.core.io.spawn(_resub())
+            elif spec.is_actor_task() and spec.max_retries > 0:
+                # retriable actor call: ride the restart/retry path (may
+                # re-execute — at-least-once, like the reference's
+                # max_task_retries)
+                spec.max_retries -= 1
+
+                async def _resub_actor(spec=spec, event=event):
+                    try:
+                        await self.core._submit_actor_task(
+                            spec, _spec_deps(spec))
+                    finally:
+                        for oid in spec.return_ids():
+                            self.core._lane_events.pop(oid, None)
+                        event.set()
+
+                self.core.io.spawn(_resub_actor())
+            else:
+                err: BaseException
+                info = self.core._inflight.get(spec.task_id)
+                if info is not None and info.get("canceled"):
+                    # a force-cancel killed the worker: surface the
+                    # cancellation, not the crash it caused
+                    err = exc.TaskCancelledError(
+                        f"task {spec.function.repr_name} was cancelled")
+                elif spec.is_actor_task():
+                    err = exc.ActorDiedError(
+                        spec.actor_id,
+                        "the actor died while this call was in flight "
+                        "(set max_task_retries to retry on restart)")
+                else:
+                    err = exc.WorkerCrashedError(
+                        f"fast-lane worker {self.worker_address} died")
+                self.core._store_error(spec, err)
+                self.core._record_task_event(
+                    spec.task_id, state="FAILED", end_time=time.time(),
+                    error=str(err))
+                self.core._inflight.pop(spec.task_id, None)
+                for oid in spec.return_ids():
+                    self.core._lane_events.pop(oid, None)
+                for oid in _spec_deps(spec):
+                    self.core._unpin_task_dep(oid)
+                event.set()
+
+    def close(self, *, release_lease: bool = True):
+        self._mark_dead()
+        try:
+            self.rep.close_write()
+        except Exception:
+            pass
+        if release_lease and not self.client.closed:
+            async def _ret():
+                try:
+                    await self.grant["_raylet"].call("return_worker", {
+                        "lease_id": self.grant["lease_id"],
+                        "disconnect_worker": False,
+                    })
+                except Exception:
+                    pass
+
+            self.core.io.spawn(_ret())
+
+
+class LanePool:
+    """Pool of task lanes with a driver-side feeder queue.
+
+    ``try_submit`` only enqueues (user threads never block); a feeder
+    thread drains the queue onto the least-loaded live lane, growing the
+    pool (one leased worker per lane, up to ``width``) while a backlog
+    exists — the same dynamics as the reference's per-SchedulingKey
+    lease pool, with the ring as the per-worker pipeline. Per-lane
+    in-flight is capped at ``window`` so slow tasks spread across
+    workers instead of convoying."""
+
+    def __init__(self, core, width: int, window: int):
+        self.core = core
+        self.width = width
+        self.window = window
+        self.lanes: List[_Lane] = []
+        self._growing = False
+        self._grow_fail_until = 0.0
+        self._lock = threading.Lock()
+        self.closed = False
+        self._queue: List[Tuple[TaskSpec, threading.Event]] = []
+        self._qlock = threading.Lock()
+        self._qevent = threading.Event()
+        self._slot = threading.Event()
+        self._feeder = threading.Thread(target=self._feed_loop, daemon=True,
+                                        name="lane_feeder")
+        self._feeder.start()
+
+    # -- user-thread side --
+    def try_submit(self, spec: TaskSpec, event: threading.Event) -> bool:
+        if self.closed:
+            return False
+        with self._qlock:
+            self._queue.append((spec, event))
+        self._qevent.set()
+        return True
+
+    def _signal_slot(self):
+        self._slot.set()
+
+    # -- feeder --
+    def _feed_loop(self):
+        while not self.closed:
+            if not self._qevent.wait(timeout=0.2):
+                continue
+            self._pump()
+        # drain on close: surface shutdown errors so getters unblock
+        with self._qlock:
+            rest, self._queue = self._queue, []
+        for spec, event in rest:
+            try:
+                self.core._store_error(spec, exc.WorkerCrashedError(
+                    "shutdown while task queued on fast lane"))
+            except Exception:
+                pass
+            event.set()
+
+    _CHUNK = 16
+
+    def _pump(self) -> None:
+        while not self.closed:
+            with self._qlock:
+                if not self._queue:
+                    self._qevent.clear()
+                    return
+            with self._lock:
+                live = [l for l in self.lanes if not l.dead]
+                self.lanes = live
+                best = min(live, key=lambda l: l.outstanding) if live else None
+                backlogged = best is None or best.outstanding >= 1
+                can_grow = (len(live) < self.width and not self._growing
+                            and time.monotonic() > self._grow_fail_until)
+                if backlogged and can_grow:
+                    self._growing = True
+                    self.core.io.spawn(self._grow())
+            if best is None:
+                if self._growing:
+                    self._slot.wait(timeout=0.05)
+                    self._slot.clear()
+                    continue
+                # cannot attach any lane: asyncio fallback keeps liveness
+                with self._qlock:
+                    if not self._queue:
+                        continue
+                    spec, event = self._queue.pop(0)
+                self._fallback(spec, event)
+                continue
+            room = self.window - best.outstanding
+            if room <= 0:
+                self._slot.wait(timeout=0.05)
+                self._slot.clear()
+                continue
+            with self._qlock:
+                take = min(room, self._CHUNK, len(self._queue))
+                chunk = self._queue[:take]
+                del self._queue[:take]
+            if not chunk:
+                continue
+            rc = best.submit_many(chunk)
+            if rc == 0:  # lane died mid-flight: requeue for another lane
+                with self._qlock:
+                    self._queue[:0] = chunk
+            elif rc == -1:  # chunk too large for the ring: shrink
+                if len(chunk) > 1:
+                    with self._qlock:
+                        self._queue[:0] = chunk[1:]
+                    chunk = chunk[:1]
+                if len(chunk) == 1 and best.submit_many(chunk) < 1:
+                    # a single spec that outsizes the ring: asyncio path
+                    self._fallback(*chunk[0])
+
+    def _fallback(self, spec: TaskSpec, event: threading.Event):
+        async def _run(spec=spec, event=event):
+            try:
+                await self.core._submit_normal(spec, _spec_deps(spec))
+            finally:
+                for oid in spec.return_ids():
+                    self.core._lane_events.pop(oid, None)
+                event.set()
+
+        self.core.io.spawn(_run())
+
+    async def _grow(self):
+        try:
+            lane = await attach_task_lane(self.core)
+            with self._lock:
+                if lane is None:
+                    # back off so a broken environment doesn't lease-storm
+                    self._grow_fail_until = time.monotonic() + 2.0
+                elif self.closed:
+                    lane.close()
+                else:
+                    lane.on_slot = self._signal_slot
+                    self.lanes.append(lane)
+        finally:
+            with self._lock:
+                self._growing = False
+            self._slot.set()
+
+    def maintain(self, idle_timeout: float = 8.0):
+        """Release EVERY lane idle beyond the timeout. No warm lane is
+        kept: a held lease is capacity the rest of the cluster (queued
+        leases, placement-group reservations) cannot see, and
+        re-attaching after an idle gap costs one lease round trip."""
+        now = time.monotonic()
+        with self._lock:
+            keep, drop = [], []
+            for lane in self.lanes:
+                if lane.dead:
+                    drop.append((lane, False))
+                elif (lane.outstanding == 0
+                        and now - lane.last_used > idle_timeout):
+                    drop.append((lane, True))
+                else:
+                    keep.append(lane)
+            self.lanes = keep
+        for lane, release in drop:
+            lane.close(release_lease=release)
+
+    def reclaim(self, lease_id: int) -> bool:
+        """Raylet-driven preemption: release the lane holding this lease
+        if it is idle (pending demand — queued leases or PG bundle
+        reservations — outranks a warm idle lane; ref: the reference's
+        idle-worker return path in worker_pool.h)."""
+        with self._lock:
+            target = None
+            for lane in self.lanes:
+                if lane.grant.get("lease_id") == lease_id:
+                    target = lane
+                    break
+            if target is None or target.outstanding > 0:
+                return False
+            self.lanes.remove(target)
+        target.close(release_lease=True)
+        return True
+
+    def close(self):
+        with self._lock:
+            self.closed = True
+            lanes, self.lanes = self.lanes, []
+        self._qevent.set()  # wake the feeder so it drains and exits
+        for lane in lanes:
+            lane.close(release_lease=False)
+
+
+async def _make_rings(core, tag: str):
+    """Create the ring pair in the node's shm store dir."""
+    from .._native import Ring
+
+    base = os.path.join(core.store.dir, f"lane_{tag}")
+    sub = Ring(base + ".sub", _RING_CAP, create=True)
+    rep = Ring(base + ".rep", _RING_CAP, create=True)
+    return sub, rep, base
+
+
+async def attach_task_lane(core) -> Optional[_Lane]:
+    """Lease a worker and attach a normal-task lane to it."""
+    probe = TaskSpec.lane_probe(core.job_id, core.address)
+    try:
+        grant = await core._request_lease(probe)
+    except Exception:
+        return None
+    try:
+        client = await core._client_for(grant["worker_address"])
+        tag = f"{core.worker_id.hex()[:8]}_{os.getpid()}_{id(grant) & 0xffffff:x}"
+        sub, rep, base = await _make_rings(core, tag)
+        ok = await client.call("fastlane_attach", {
+            "sub": base + ".sub", "rep": base + ".rep", "kind": "task",
+        }, timeout=10)
+        if not ok:
+            raise RuntimeError("attach refused")
+        return _Lane(core, grant, sub, rep, client)
+    except Exception:
+        try:
+            await grant["_raylet"].call("return_worker", {
+                "lease_id": grant["lease_id"], "disconnect_worker": False})
+        except Exception:
+            pass
+        return None
+
+
+class ActorLane:
+    """Per-actor fast lane. All calls from this owner ride it once
+    attached (ordering = ring FIFO = submission order). Calls buffer in
+    a local list and a single flusher thread drains them with
+    ``submit_many`` — burst call patterns coalesce into batched frames
+    (one pickle + one ring push per chunk), and the attach window is
+    just the flusher not having started yet."""
+
+    _CHUNK = 32
+
+    def __init__(self, core, actor_id):
+        self.core = core
+        self.actor_id = actor_id
+        self.lane: Optional[_Lane] = None
+        self.state = "attaching"  # attaching | up | down
+        self._buffer: List[Tuple[TaskSpec, threading.Event]] = []
+        self._lock = threading.Lock()
+        self._flush_event = threading.Event()
+        core.io.spawn(self._attach())
+
+    def submit(self, spec: TaskSpec, event: threading.Event) -> bool:
+        """False → caller must use the asyncio path."""
+        with self._lock:
+            if self.state == "down":
+                return False
+            self._buffer.append((spec, event))
+        self._flush_event.set()
+        return True
+
+    async def _attach(self):
+        try:
+            state = await self.core._wait_actor_alive(self.actor_id)
+            client = await self.core._client_for(state.address)
+            tag = (f"a{self.actor_id.hex()[:8]}_"
+                   f"{self.core.worker_id.hex()[:8]}_{os.getpid()}")
+            sub, rep, base = await _make_rings(self.core, tag)
+            ok = await client.call("fastlane_attach", {
+                "sub": base + ".sub", "rep": base + ".rep", "kind": "actor",
+            }, timeout=10)
+            if not ok:
+                raise RuntimeError("attach refused")
+            grant = {"worker_address": state.address, "lease_id": -1,
+                     "_raylet": self.core.raylet}
+            lane = _Lane(self.core, grant, sub, rep, client)
+        except Exception:
+            lane = None
+        if lane is None:
+            self._drain_down()
+            return
+        with self._lock:
+            self.lane = lane
+            self.state = "up"
+        threading.Thread(target=self._flush_loop, daemon=True,
+                         name=f"actor_lane_{self.actor_id.hex()[:8]}").start()
+
+    def _flush_loop(self):
+        while True:
+            if not self._flush_event.wait(timeout=0.5):
+                with self._lock:
+                    if self.state != "up":
+                        return
+                continue
+            self._flush_event.clear()
+            while True:
+                with self._lock:
+                    if self.state != "up":
+                        return
+                    chunk = self._buffer[:self._CHUNK]
+                    del self._buffer[:len(chunk)]
+                if not chunk:
+                    break
+                lane = self.lane
+                rc = 0 if lane is None else lane.submit_many(chunk)
+                if rc == -1:
+                    # over-ring-size chunk: retry one by one; a single
+                    # call that still doesn't fit takes the asyncio path
+                    # (a >8MB inline spec — refs and big args were
+                    # already externalized by _pack_args)
+                    for item in chunk:
+                        if lane.submit_many([item]) < 1:
+                            self._spawn_asyncio(*item)
+                    continue
+                if rc == 0:
+                    with self._lock:
+                        self._buffer[:0] = chunk
+                    self._drain_down()
+                    return
+
+    def _drain_down(self):
+        """Lane gone: flush everything buffered through the asyncio
+        path, preserving order, and reject future lane submissions."""
+        with self._lock:
+            self.state = "down"
+            buffered, self._buffer = self._buffer, []
+            lane, self.lane = self.lane, None
+        if lane is not None:
+            lane.close(release_lease=False)
+        for spec, event in buffered:
+            self._spawn_asyncio(spec, event)
+
+    def _spawn_asyncio(self, spec: TaskSpec, event: threading.Event):
+        async def _run(spec=spec, event=event):
+            try:
+                await self.core._submit_actor_task(spec, _spec_deps(spec))
+            finally:
+                for oid in spec.return_ids():
+                    self.core._lane_events.pop(oid, None)
+                event.set()
+
+        self.core.io.spawn(_run())
+
+    def close(self):
+        self._drain_down()

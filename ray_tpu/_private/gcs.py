@@ -20,6 +20,8 @@ import asyncio
 import json
 import os
 import pickle
+
+from . import wire
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -89,11 +91,13 @@ class Storage:
             self._journal = open(journal_path, "ab")
 
     def _compact(self, path: str) -> None:
+        # every record is rewritten at the CURRENT wire version here —
+        # this is how a journal written by an older build migrates
         tmp = path + ".compact"
         with open(tmp, "wb") as f:
             for ns, table in self._kv.items():
                 for key, val in table.items():
-                    body = pickle.dumps(("put", ns, key, val))
+                    body = wire.journal_encode("put", ns, key, val)
                     f.write(len(body).to_bytes(4, "little") + body)
             f.flush()
             os.fsync(f.fileno())
@@ -111,7 +115,7 @@ class Storage:
                 body = f.read(length)
                 if len(body) < length:
                     break
-                op, ns, key, val = pickle.loads(body)
+                op, ns, key, val = wire.journal_decode(body)
                 if op == "put":
                     self._kv.setdefault(ns, {})[key] = val
                 elif op == "del":
@@ -119,7 +123,7 @@ class Storage:
 
     def _log(self, op: str, ns: str, key: str, val: Optional[bytes]) -> None:
         if self._journal is not None:
-            body = pickle.dumps((op, ns, key, val))
+            body = wire.journal_encode(op, ns, key, val)
             self._journal.write(len(body).to_bytes(4, "little") + body)
             self._journal.flush()
 
@@ -744,6 +748,14 @@ class GcsServer:
     # ---- object directory ----
     async def handle_add_object_location(self, payload, conn):
         self.object_locations.setdefault(payload["object_id"], set()).add(payload["node_id"])
+        return True
+
+    async def handle_add_object_locations(self, payload, conn):
+        """Batched location adds (raylets coalesce seal reports — the
+        directory write amortizes to one frame per flush window)."""
+        node_id = payload["node_id"]
+        for oid in payload["object_ids"]:
+            self.object_locations.setdefault(oid, set()).add(node_id)
         return True
 
     async def handle_remove_object_location(self, payload, conn):

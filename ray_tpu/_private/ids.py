@@ -13,15 +13,28 @@ import os
 import threading
 
 _rng_lock = threading.Lock()
+# Uniqueness, not cryptography: an os.urandom syscall per ID taxes the
+# trivial-task submit path (two IDs each). A per-process random128 seed
+# + counter stream from Python's Mersenne generator is collision-safe
+# across processes (seed entropy) and within one (counter), and ~10x
+# cheaper. Re-seeded after fork so children diverge.
+_rng_state = {"pid": None, "rng": None}
 
 
 def _random_bytes(n: int) -> bytes:
-    return os.urandom(n)
+    pid = os.getpid()
+    with _rng_lock:
+        if _rng_state["pid"] != pid:
+            import random
+
+            _rng_state["pid"] = pid
+            _rng_state["rng"] = random.Random(os.urandom(16))
+        return _rng_state["rng"].getrandbits(n * 8).to_bytes(n, "little")
 
 
 class BaseID:
     SIZE = 16
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_h")
 
     def __init__(self, id_bytes: bytes):
         if len(id_bytes) != self.SIZE:
@@ -29,6 +42,8 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
             )
         self._bytes = bytes(id_bytes)
+        # ids key every hot-path dict; hash once, not per lookup
+        self._h = hash((type(self).__name__, self._bytes))
 
     @classmethod
     def from_random(cls):
@@ -52,7 +67,7 @@ class BaseID:
         return self._bytes.hex()
 
     def __hash__(self):
-        return hash((type(self).__name__, self._bytes))
+        return self._h
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

@@ -65,9 +65,28 @@ class SharedObjectStore:
         self.capacity = capacity_bytes
         if create_dir:
             os.makedirs(self.dir, exist_ok=True)
+        # Spill-on-pressure (ref: raylet/local_object_manager.h:45,
+        # _private/external_storage.py): sealed LRU victims move to a
+        # disk directory instead of dying; restore is lazy on access.
+        # Shared per store dir so every process on the node can restore.
+        # NOTE: the default lives under TEMP_ROOT (/tmp) — on distros
+        # that mount /tmp as tmpfs that is still RAM; deployments there
+        # must point RAY_TPU_OBJECT_SPILLING_DIR at a real disk (the
+        # reference has the same contract via its spilling config).
+        from .config import TEMP_ROOT, global_config as _gc
+
+        cfg = _gc()
+        if cfg.object_spilling_enabled:
+            self.spill_dir = cfg.object_spilling_dir or os.path.join(
+                TEMP_ROOT, "spill", os.path.basename(self.dir.rstrip("/")))
+            os.makedirs(self.spill_dir, exist_ok=True)
+        else:
+            self.spill_dir = None
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._lock = threading.Lock()
         self._used = 0
+        # fallback-path eviction staging (flushed outside self._lock)
+        self._pending_spill_flush: list = []
         # Native index (C++ shared table, ray_tpu/_native): makes seal
         # state, capacity accounting, pins and LRU order node-global
         # facts across every process sharing this dir. Falls back to
@@ -80,6 +99,8 @@ class SharedObjectStore:
         if native_unavailable_reason() is None:
             self._idx = NativeIndex(os.path.join(self.dir, "index.bin"),
                                     capacity_bytes, data_dir=self.dir)
+            if self.spill_dir:
+                self._idx.set_spill_dir(self.spill_dir)
         else:
             self._idx = None
 
@@ -114,7 +135,33 @@ class SharedObjectStore:
                         entry.mm.close()
                     except BufferError:
                         pass
+            # the index staged spilled victims as <hex>.spilling (same
+            # fs, under its mutex); the cross-fs copy to the spill dir
+            # happens HERE, outside any lock
+            self._flush_staged_spill(voi)
         return True
+
+    def _flush_staged_spill(self, oid: ObjectID) -> None:
+        if not self.spill_dir:
+            return
+        staged = os.path.join(self.dir, oid.hex() + ".spilling")
+        if not os.path.exists(staged):
+            return
+        import shutil
+
+        try:
+            shutil.move(staged, os.path.join(self.spill_dir, oid.hex()))
+        except (FileNotFoundError, OSError):
+            pass
+
+    def _flush_pending_spills(self) -> None:
+        """Fallback-path staging flush, outside self._lock."""
+        while True:
+            with self._lock:
+                if not self._pending_spill_flush:
+                    return
+                oid = self._pending_spill_flush.pop()
+            self._flush_staged_spill(oid)
 
     def create(self, oid: ObjectID, size: int) -> memoryview:
         """Allocate an unsealed buffer; returns a writable view. Caller must
@@ -128,6 +175,7 @@ class SharedObjectStore:
                 # Reserve capacity before dropping the lock so concurrent
                 # creates can't collectively overshoot it.
                 self._used += size
+            self._flush_pending_spills()
         tmp = f"{self._path(oid)}.tmp.{os.getpid()}"
         try:
             fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
@@ -162,7 +210,27 @@ class SharedObjectStore:
             os.rename(entry.tmp_path or entry.path + ".tmp", entry.path)
             entry.sealed = True
         if self._idx is not None:
-            self._idx.seal(oid.binary())
+            rc = self._idx.seal(oid.binary())
+            if rc != 0:
+                # The index reclaimed our reservation (stale-creation
+                # sweep or a racing delete) — the renamed data file has
+                # no index entry, so it would consume tmpfs capacity
+                # that used() never accounts and could never be
+                # evicted. Unlink it and surface the object as lost.
+                with self._lock:
+                    e = self._entries.pop(oid, None)
+                    if e is not None and e.mm is not None:
+                        try:
+                            e.mm.close()
+                        except BufferError:
+                            pass
+                try:
+                    os.unlink(entry.path)
+                except FileNotFoundError:
+                    pass
+                raise ObjectStoreFullError(
+                    f"object {oid.hex()} lost at seal: index reservation "
+                    f"was reclaimed (rc={rc}); re-put the object")
 
     def abort(self, oid: ObjectID) -> None:
         with self._lock:
@@ -188,7 +256,8 @@ class SharedObjectStore:
 
     # ---- read path ----
     def get(self, oid: ObjectID) -> Optional[memoryview]:
-        """Map a sealed object; zero-copy view. None if absent/unsealed."""
+        """Map a sealed object; zero-copy view. None if absent/unsealed.
+        Objects spilled to disk are transparently restored first."""
         if self._idx is not None:
             # index is the authority (and the lookup is the LRU touch):
             # a locally-cached mmap whose entry another process evicted
@@ -206,7 +275,10 @@ class SharedObjectStore:
                                 entry.mm.close()
                             except BufferError:
                                 pass
-                return None
+                if state == 1 and self._restore_from_spill(oid):
+                    pass  # restored: fall through and serve it
+                else:
+                    return None
         with self._lock:
             entry = self._entries.get(oid)
             if entry is not None and entry.sealed and entry.mm is not None:
@@ -218,7 +290,13 @@ class SharedObjectStore:
         try:
             fd = os.open(path, os.O_RDWR)
         except FileNotFoundError:
-            return None
+            if self._idx is None and self._restore_from_spill(oid):
+                try:
+                    fd = os.open(path, os.O_RDWR)
+                except FileNotFoundError:
+                    return None
+            else:
+                return None
         try:
             size = os.fstat(fd).st_size
             mm = mmap.mmap(fd, size)
@@ -248,16 +326,63 @@ class SharedObjectStore:
             self._entries.move_to_end(oid)
             return memoryview(entry.mm)[: entry.size]
 
+    def _spill_path(self, oid: ObjectID) -> Optional[str]:
+        if not self.spill_dir:
+            return None
+        return os.path.join(self.spill_dir, oid.hex())
+
+    def _restore_from_spill(self, oid: ObjectID) -> bool:
+        """Copy a spilled object back into the store (which may cascade
+        further spills) and drop the disk copy. Concurrent restores of
+        one object are benign: create() tolerates an existing
+        reservation and seal renames atomically. Also serves objects
+        still sitting in the same-fs ".spilling" staging name (the
+        evictor flushes those to the spill dir outside the index lock —
+        a reader can land in that window, or after an evictor crash)."""
+        path = self._spill_path(oid)
+        if path is None:
+            return False
+        data = None
+        for candidate in (path, os.path.join(self.dir,
+                                             oid.hex() + ".spilling")):
+            try:
+                with open(candidate, "rb") as f:
+                    data = f.read()
+                path = candidate
+                break
+            except (FileNotFoundError, OSError):
+                continue
+        if data is None:
+            return False
+        try:
+            self.put(oid, data)
+        except (ObjectStoreFullError, OSError):
+            return False
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        return True
+
     def contains(self, oid: ObjectID) -> bool:
         if self._idx is not None:
             # existence probe: no LRU touch (polling must not distort
             # node-global eviction order)
-            return self._idx.lookup(oid.binary(), touch=False)[0] == 0
-        with self._lock:
-            entry = self._entries.get(oid)
-            if entry is not None and entry.sealed:
+            if self._idx.lookup(oid.binary(), touch=False)[0] == 0:
                 return True
-        return os.path.exists(self._path(oid))
+        else:
+            with self._lock:
+                entry = self._entries.get(oid)
+                if entry is not None and entry.sealed:
+                    return True
+            if os.path.exists(self._path(oid)):
+                return True
+        path = self._spill_path(oid)
+        if path is None:
+            return False
+        return (os.path.exists(path)
+                or os.path.exists(os.path.join(self.dir,
+                                               oid.hex() + ".spilling")))
 
     def pin(self, oid: ObjectID) -> None:
         if self._idx is not None:
@@ -293,6 +418,14 @@ class SharedObjectStore:
             os.unlink(self._path(oid))
         except FileNotFoundError:
             pass
+        spath = self._spill_path(oid)
+        if spath is not None:
+            for p in (spath, os.path.join(self.dir,
+                                          oid.hex() + ".spilling")):
+                try:
+                    os.unlink(p)
+                except FileNotFoundError:
+                    pass
 
     # ---- accounting / eviction ----
     def used_bytes(self) -> int:
@@ -329,8 +462,14 @@ class SharedObjectStore:
                 except BufferError:
                     pass
             try:
-                os.unlink(entry.path)
-            except FileNotFoundError:
+                if self.spill_dir:
+                    # stage under the lock (same-fs rename, O(1)); the
+                    # caller flushes to the spill dir after releasing it
+                    os.rename(entry.path, entry.path + ".spilling")
+                    self._pending_spill_flush.append(oid)
+                else:
+                    os.unlink(entry.path)
+            except (FileNotFoundError, OSError):
                 pass
         if self._used + incoming > self.capacity:
             raise ObjectStoreFullError(
@@ -354,6 +493,8 @@ class SharedObjectStore:
         import shutil
 
         shutil.rmtree(self.dir, ignore_errors=True)
+        if self.spill_dir:
+            shutil.rmtree(self.spill_dir, ignore_errors=True)
 
 
 class MemoryStore:

@@ -60,6 +60,12 @@ class Lease:
     resources: ResourceSet
     owner_address: str
     pg_key: Optional[tuple] = None    # (pg_id, bundle_idx) the lease lives in
+    # fast-lane leases are preemptible when idle: under pending demand
+    # the raylet pushes "reclaim_lease" to the owner, who returns the
+    # worker if the lane has nothing in flight
+    lane: bool = False
+    conn: Optional[ServerConnection] = None
+    reclaim_requested_at: float = 0.0
 
 
 @dataclass
@@ -71,29 +77,60 @@ class _PendingLease:
 
 
 class NodeResources:
+    """Per-node resource accounting. Backed by the native lease-scheduler
+    engine when available (native/core_tables.cc — the C++ half of the
+    reference's cluster_resource_scheduler/local_resource_manager pair);
+    the Python ResourceSet arithmetic is the fallback."""
+
+    _NODE = 1  # single-node handle inside the native engine
+
     def __init__(self, total: Dict[str, float]):
         self.total = ResourceSet(total)
-        self.available = self.total.copy()
+        self._native = None
+        try:
+            from .._native import LeaseScheduler, native_unavailable_reason
+
+            if native_unavailable_reason() is None:
+                self._native = LeaseScheduler(local_node=self._NODE)
+                self._native.node_upsert(self._NODE, self.total.to_dict(),
+                                         self.total.to_dict())
+        except Exception:
+            self._native = None
+        self._available = self.total.copy()  # fallback bookkeeping
+
+    @property
+    def available(self) -> ResourceSet:
+        if self._native is not None:
+            return ResourceSet({
+                k: self._native.avail(self._NODE, k)
+                for k in self.total.to_dict()})
+        return self._available
 
     def try_allocate(self, req: ResourceSet) -> bool:
-        if not req.fits(self.available):
+        if self._native is not None:
+            return self._native.try_allocate(self._NODE, req.to_dict())
+        if not req.fits(self._available):
             return False
-        self.available.subtract(req)
+        self._available.subtract(req)
         return True
 
     def release(self, req: ResourceSet) -> None:
-        self.available.add(req)
+        if self._native is not None:
+            self._native.release(self._NODE, req.to_dict())
+            return
+        self._available.add(req)
         # clamp against float drift
-        for k, v in self.available.res.items():
+        for k, v in self._available.res.items():
             cap = self.total.get(k)
             if v > cap:
-                self.available.res[k] = cap
+                self._available.res[k] = cap
 
     def utilization(self) -> float:
+        avail = self.available
         best = 0.0
         for k, cap in self.total.res.items():
             if cap > 0:
-                best = max(best, 1.0 - self.available.get(k, 0.0) / cap)
+                best = max(best, 1.0 - avail.get(k, 0.0) / cap)
         return best
 
 
@@ -131,7 +168,18 @@ class Raylet:
         self._starting: int = 0
         self._register_waiters: List[asyncio.Future] = []
         max_workers = cfg.num_workers_soft_limit
-        self.max_workers = max_workers if max_workers > 0 else max(4, os.cpu_count() or 4)
+        if max_workers > 0:
+            self.max_workers = max_workers
+        else:
+            # The pool must be able to back every leasable CPU slot: the
+            # node's ADVERTISED CPU resource, not the host core count —
+            # a node faking num_cpus=8 on a 1-core box (tests, oversub-
+            # scribed orchestration) would otherwise wedge the 5th
+            # lease forever behind a 4-worker cap (actors hold workers
+            # for life). (ref: worker_pool.h prestart/soft-limit ties
+            # to num_cpus the same way.)
+            ncpu = int(self.resources.total.get("CPU", 0))
+            self.max_workers = max(4, ncpu, os.cpu_count() or 1)
         # leases
         self._leases: Dict[int, Lease] = {}
         self._next_lease_id = 1
@@ -189,6 +237,69 @@ class Raylet:
         if self.cfg.prestart_workers:
             for _ in range(min(2, self.max_workers)):
                 self._spawn_worker()
+        if self.cfg.memory_monitor_refresh_ms > 0:
+            asyncio.ensure_future(self._memory_monitor_loop())
+
+    # ----------------------------------------------------- memory pressure
+    def _memory_fraction(self) -> Optional[float]:
+        """Host memory usage fraction (ref: memory_monitor.h:52). Tests
+        inject a fraction through ``memory_monitor_test_file``."""
+        tf = self.cfg.memory_monitor_test_file
+        if tf:
+            try:
+                with open(tf) as f:
+                    return float(f.read().strip())
+            except (OSError, ValueError):
+                return None
+        try:
+            total = avail = None
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1])
+                    if total is not None and avail is not None:
+                        break
+            if total and avail is not None:
+                return 1.0 - avail / total
+        except OSError:
+            pass
+        return None
+
+    async def _memory_monitor_loop(self):
+        """Kill workers under host memory pressure so retriable work is
+        shed instead of the OS OOM-killer shooting randomly (ref:
+        memory_monitor.h:52 + worker_killing_policy_retriable_fifo.h —
+        newest non-actor lease dies first; its owner retries within the
+        task's max_retries budget)."""
+        period = self.cfg.memory_monitor_refresh_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            frac = self._memory_fraction()
+            if frac is None or frac < self.cfg.memory_usage_threshold:
+                continue
+            leases = [l for l in self._leases.values()
+                      if l.worker.actor_id is None and l.worker.alive]
+            if not leases:
+                continue
+            victim = max(leases, key=lambda l: l.lease_id)
+            worker = victim.worker
+            try:
+                os.kill(worker.pid, 9)
+            except (ProcessLookupError, PermissionError):
+                continue
+            worker.alive = False
+            try:
+                await self.gcs.call("report_task_events", {"events": [{
+                    "task_id": f"oom_kill_{worker.worker_id.hex()[:12]}",
+                    "name": "WORKER_OOM_KILLED",
+                    "state": "WORKER_OOM_KILLED",
+                    "node_id": self.node_id,
+                    "memory_fraction": frac,
+                }]})
+            except Exception:
+                pass
 
     async def stop(self):
         for worker in self._workers.values():
@@ -379,6 +490,7 @@ class Raylet:
         reply:   {granted: bool, worker_address, lease_id, node_id}
                | {retry_at: (node_id, address)}
         """
+        payload["_conn"] = conn  # reclaim push channel for lane leases
         rid = payload.get("request_id")
         if rid is not None:
             cached = self._lease_rid_grants.get(rid)
@@ -471,7 +583,9 @@ class Raylet:
                 self.resources.release(resources)
             return None
         lease = Lease(self._next_lease_id, worker, resources,
-                      payload.get("owner_address", ""), pg_key=alloc_key)
+                      payload.get("owner_address", ""), pg_key=alloc_key,
+                      lane=bool(payload.get("lane")),
+                      conn=payload.get("_conn"))
         self._next_lease_id += 1
         worker.lease = lease
         if payload.get("actor_id") is not None:
@@ -571,6 +685,7 @@ class Raylet:
                         continue
                     grant = await self._try_grant(pending.resources, pending.payload)
                     if grant is None:
+                        await self._request_lane_reclaims()
                         # spillback: a node that joined (autoscaler) or
                         # freed up since this lease queued may fit it
                         # now. Damped: never for no_spill leases (chain
@@ -661,6 +776,23 @@ class Raylet:
             return
         self.resources.release(lease.resources)
 
+    async def _request_lane_reclaims(self) -> None:
+        """Pending demand (queued lease / PG reservation) cannot fit:
+        ask fast-lane owners to hand back idle lanes. Rate-limited per
+        lease; actual release is the owner's call (a busy lane stays)."""
+        now = time.monotonic()
+        for lease in self._leases.values():
+            if not lease.lane or lease.conn is None:
+                continue
+            if now - lease.reclaim_requested_at < 2.0:
+                continue
+            lease.reclaim_requested_at = now
+            try:
+                await lease.conn.push("reclaim_lease",
+                                      {"lease_id": lease.lease_id})
+            except Exception:
+                pass
+
     async def handle_reserve_bundle(self, payload, conn):
         """Two-phase commit, phase 1: reserve resources for a PG bundle
         (ref: placement_group_resource_manager.h)."""
@@ -669,6 +801,9 @@ class Raylet:
         if key in self._pg_bundles:
             return True
         if not self.resources.try_allocate(resources):
+            # idle fast lanes may be squatting on exactly this capacity;
+            # the GCS retries the reservation after the release lands
+            await self._request_lane_reclaims()
             return False
         self._pg_bundles[key] = NodeResources(resources.to_dict())
         await self._report_resources()
@@ -721,6 +856,23 @@ class Raylet:
         self._mark_local_sealed(oid, size)
         asyncio.ensure_future(self._report_location(oid))
         return True
+
+    async def handle_objects_sealed_batch(self, payload, conn):
+        """Coalesced seal notifications (fast-lane executors batch their
+        per-return reports; one frame covers a flush window)."""
+        oids = []
+        for oid, size in payload["objects"]:
+            self._mark_local_sealed(oid, size)
+            oids.append(oid)
+        asyncio.ensure_future(self._report_locations(oids))
+        return True
+
+    async def _report_locations(self, oids: List[ObjectID]):
+        try:
+            await self.gcs.call("add_object_locations", {
+                "object_ids": oids, "node_id": self.node_id})
+        except Exception:
+            pass
 
     async def _report_location(self, oid: ObjectID):
         try:

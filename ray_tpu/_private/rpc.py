@@ -10,7 +10,9 @@ Addresses: a path ("/tmp/.../x.sock") binds a unix-domain socket (intra-host);
 Binding port 0 picks a free port; the server's resolved address is
 ``server.address`` after ``start()``.
 
-Wire format: [u32 frame_len][pickled Frame]. A Frame is
+Wire format: [u32 frame_len][msgpack envelope] — the envelope layout and
+every framework message struct live in ray_tpu/_private/wire.py (the N16
+schema surface; ref: src/ray/protobuf/). A Frame is
 (msg_id, kind, method, payload) with kind in {REQUEST, REPLY, ERROR, PUSH}.
 PUSH frames implement server->client pubsub (ref: src/ray/pubsub) without a
 pending long-poll.
@@ -24,12 +26,12 @@ from __future__ import annotations
 
 import asyncio
 import itertools
-import pickle
 import random
 import struct
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional
 
+from . import wire
 from .config import global_config
 
 _LEN = struct.Struct("<I")
@@ -92,7 +94,7 @@ class _ChaosInjector:
 
 
 def _frame(msg_id: int, kind: int, method: str, payload: Any) -> bytes:
-    body = pickle.dumps((msg_id, kind, method, payload), protocol=5)
+    body = wire.encode_frame(msg_id, kind, method, payload)
     return _LEN.pack(len(body)) + body
 
 
@@ -102,7 +104,7 @@ async def _read_frame(reader: asyncio.StreamReader):
     if length > _MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
     body = await reader.readexactly(length)
-    return pickle.loads(body)
+    return wire.decode_frame(body)
 
 
 Handler = Callable[[Any, "ServerConnection"], Awaitable[Any]]
@@ -297,6 +299,10 @@ class RpcClient:
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionLost(self.socket_path))
+                    # mark retrieved: fire-and-forget callers dropping the
+                    # future at shutdown must not spam "exception was
+                    # never retrieved" (real awaiters still see it raise)
+                    fut.exception()
             self._pending.clear()
 
     async def call(self, method: str, payload: Any = None, timeout: Optional[float] = None):
@@ -336,8 +342,13 @@ class RpcClient:
 
     async def close(self) -> None:
         self.closed = True
-        if self._recv_task is not None:
-            self._recv_task.cancel()
+        task, self._recv_task = self._recv_task, None
+        if task is not None and task is not asyncio.current_task():
+            task.cancel()
+            try:
+                await task
+            except BaseException:
+                pass
         if self._writer is not None:
             try:
                 self._writer.close()
@@ -378,9 +389,39 @@ class EventLoopThread:
     def stop(self):
         self._stopping = True
 
-        def _cancel_all():
-            for task in asyncio.all_tasks(self.loop):
-                task.cancel()
-        self.loop.call_soon_threadsafe(_cancel_all)
-        self.loop.call_soon_threadsafe(self.loop.stop)
+        async def _drain():
+            # Cancel every outstanding task, then AWAIT the cancellations:
+            # stopping the loop in the same tick would strand tasks mid-
+            # cancel ("Task was destroyed but it is pending!" at loop GC)
+            # and leak their sockets/FDs.
+            me = asyncio.current_task()
+            deadline = self.loop.time() + 3
+            for _ in range(10):  # handlers may spawn tasks while draining
+                tasks = [t for t in asyncio.all_tasks(self.loop)
+                         if t is not me]
+                if not tasks:
+                    break
+                for t in tasks:
+                    t.cancel()
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*tasks, return_exceptions=True),
+                        max(0.1, deadline - self.loop.time()))
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    break
+            self.loop.stop()
+
+        def _kick():
+            asyncio.ensure_future(_drain())
+
+        try:
+            self.loop.call_soon_threadsafe(_kick)
+        except RuntimeError:
+            return  # loop already closed
         self.thread.join(timeout=5)
+        if self.thread.is_alive():  # drain wedged: force the loop down
+            try:
+                self.loop.call_soon_threadsafe(self.loop.stop)
+            except RuntimeError:
+                pass
+            self.thread.join(timeout=2)

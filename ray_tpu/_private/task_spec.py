@@ -29,6 +29,10 @@ class TaskArg:
     kind: ArgKind
     value: Any = None          # serialized bytes for VALUE
     object_id: Optional[ObjectID] = None
+    # owner address for OBJECT_REF args: small objects never touch
+    # plasma — the executing worker fetches them from the owner (ref:
+    # core_worker.proto GetObject / ownership model reference_count.h:66)
+    owner: str = ""
 
 
 class ResourceSet:
@@ -175,3 +179,17 @@ class TaskSpec:
     def scheduling_class(self) -> int:
         strat = self.scheduling_strategy
         return scheduling_class_of(self.resources, type(strat).__name__ + repr(strat))
+
+    @classmethod
+    def lane_probe(cls, job_id: JobID, owner_address: str) -> "TaskSpec":
+        """A {CPU:1} default-strategy spec used to lease a worker for a
+        fast lane (ray_tpu/_private/fastlane.py) — the lane then streams
+        many real tasks through the one lease, the way the reference
+        reuses a leased worker per SchedulingKey."""
+        return cls(
+            task_id=TaskID.for_normal_task(job_id),
+            job_id=job_id,
+            function=FunctionDescriptor(blob_id="", repr_name="__lane__"),
+            resources=ResourceSet({"CPU": 1}),
+            owner_address=owner_address,
+        )

@@ -1,0 +1,252 @@
+"""Versioned wire schemas for the control plane (N16).
+
+TPU-native analog of the reference's protobuf surface
+(ref: src/ray/protobuf/*.proto — 22 files define every RPC frame, GCS
+table record and journal entry). This module is the single place the
+framework's on-the-wire layout lives:
+
+  * **RPC frames** (ray_tpu/_private/rpc.py) are a msgpack envelope
+    ``[WIRE_VERSION, msg_id, kind, method, body]`` — no pickle in the
+    frame layer, so a native (C++/other-language) peer can speak the
+    protocol by implementing this file's tables.
+  * **Framework types** cross as msgpack extension records with stable
+    tags (the "message structs"): ids, TaskSpec/TaskArg, ResourceSet,
+    scheduling strategies, GCS info records, known exceptions.
+  * **Application payloads** (user args/returns, arbitrary objects
+    inside handler dicts) fall back to a tagged pickle extension
+    (EXT_PICKLE) — exactly the reference's split, where protobuf
+    envelopes carry pickled app bytes in ``bytes`` fields. Framework
+    control messages never need the fallback.
+  * **GCS journal** records are ``[WIRE_VERSION, op, ns, key, val]``
+    msgpack arrays behind a little-endian u32 length; a journal whose
+    records are legacy pickle (version 0, pre-schema) is still replayed
+    — see ``journal_decode`` — which is the version-migration path.
+
+Version policy: WIRE_VERSION bumps on any breaking layout change; a
+receiver seeing a newer major version rejects the frame loudly instead
+of misparsing it.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import fields, is_dataclass
+from typing import Any, Callable, Dict, Tuple, Type
+
+import msgpack
+
+WIRE_VERSION = 1
+
+# --- msgpack extension tags (stable wire contract; never reuse) ---
+EXT_ID = 1          # framework id: (class_tag:u8)(raw bytes)
+EXT_STRUCT = 2      # registered struct: msgpack([tag, [field values...]])
+EXT_EXC = 3         # known exception: msgpack([tag, [args...]])
+EXT_TUPLE = 4       # python tuple (msgpack arrays decode as lists)
+EXT_SET = 5         # python set
+EXT_PICKLE = 127    # app-payload escape hatch (documented, tagged)
+
+
+class WireError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------- registry
+
+_ID_CLASSES: Dict[int, Type] = {}
+_ID_TAGS: Dict[Type, int] = {}
+_STRUCTS: Dict[int, Tuple[Type, Tuple[str, ...]]] = {}
+_STRUCT_TAGS: Dict[Type, int] = {}
+_EXCEPTIONS: Dict[int, Type] = {}
+_EXC_TAGS: Dict[Type, int] = {}
+
+
+def register_id(tag: int, cls: Type) -> None:
+    _ID_CLASSES[tag] = cls
+    _ID_TAGS[cls] = tag
+
+
+def register_struct(tag: int, cls: Type,
+                    field_names: Tuple[str, ...] = ()) -> None:
+    """Dataclass-like record: encoded as its field values, positionally.
+    APPEND new fields only (decode tolerates short records by letting
+    dataclass defaults fill the tail) — that is the schema-evolution
+    rule, the analog of proto field numbering."""
+    if not field_names and is_dataclass(cls):
+        field_names = tuple(f.name for f in fields(cls))
+    _STRUCTS[tag] = (cls, field_names)
+    _STRUCT_TAGS[cls] = tag
+
+
+def register_exception(tag: int, cls: Type) -> None:
+    _EXCEPTIONS[tag] = cls
+    _EXC_TAGS[cls] = tag
+
+
+def _register_all() -> None:
+    from . import ids as _ids
+    from . import task_spec as _ts
+    from .. import exceptions as _exc
+
+    register_id(1, _ids.JobID)
+    register_id(2, _ids.NodeID)
+    register_id(3, _ids.WorkerID)
+    register_id(4, _ids.ActorID)
+    register_id(5, _ids.TaskID)
+    register_id(6, _ids.ObjectID)
+    register_id(7, _ids.PlacementGroupID)
+
+    register_struct(1, _ts.TaskArg)
+    register_struct(2, _ts.FunctionDescriptor)
+    register_struct(3, _ts.TaskSpec)
+    register_struct(4, _ts.DefaultSchedulingStrategy)
+    register_struct(5, _ts.SpreadSchedulingStrategy)
+    register_struct(6, _ts.NodeAffinitySchedulingStrategy)
+    register_struct(7, _ts.PlacementGroupSchedulingStrategy)
+    register_struct(8, _ts.SliceSchedulingStrategy)
+
+    from . import gcs as _gcs
+
+    register_struct(9, _gcs.NodeInfo)
+    register_struct(10, _gcs.ActorInfo)
+
+    register_exception(1, _exc.RayTpuError)
+    register_exception(2, _exc.TaskError)
+    register_exception(3, _exc.TaskCancelledError)
+    register_exception(4, _exc.WorkerCrashedError)
+    register_exception(5, _exc.ObjectLostError)
+    register_exception(6, _exc.GetTimeoutError)
+    register_exception(7, _exc.ActorDiedError)
+
+
+_registered = False
+
+
+def _ensure_registered() -> None:
+    global _registered
+    if not _registered:
+        _registered = True
+        _register_all()
+
+
+# ---------------------------------------------------------------- encoding
+
+def _default(obj: Any):
+    _ensure_registered()
+    t = type(obj)
+    tag = _ID_TAGS.get(t)
+    if tag is not None:
+        return msgpack.ExtType(EXT_ID, bytes([tag]) + obj.binary())
+    tag = _STRUCT_TAGS.get(t)
+    if tag is not None:
+        names = _STRUCTS[tag][1]
+        vals = [getattr(obj, n) for n in names]
+        return msgpack.ExtType(EXT_STRUCT, _pack([tag, vals]))
+    if t is tuple:
+        return msgpack.ExtType(EXT_TUPLE, _pack(list(obj)))
+    if t is set or t is frozenset:
+        return msgpack.ExtType(EXT_SET, _pack(list(obj)))
+    from .task_spec import ResourceSet
+
+    if t is ResourceSet:
+        return msgpack.ExtType(EXT_STRUCT, _pack([100, [obj.to_dict()]]))
+    if isinstance(obj, BaseException):
+        tag = _EXC_TAGS.get(t)
+        if tag is not None:
+            try:
+                return msgpack.ExtType(EXT_EXC, _pack([tag, list(obj.args)]))
+            except Exception:
+                pass
+        # unknown/unpacked exception (user-defined, chained state):
+        # tagged pickle fallback, same as app payloads
+    return msgpack.ExtType(EXT_PICKLE, pickle.dumps(obj, protocol=5))
+
+
+def _ext_hook(code: int, data: bytes):
+    _ensure_registered()
+    if code == EXT_ID:
+        cls = _ID_CLASSES.get(data[0])
+        if cls is None:
+            raise WireError(f"unknown id tag {data[0]}")
+        return cls(data[1:])
+    if code == EXT_STRUCT:
+        tag, vals = _unpack(data)
+        if tag == 100:
+            from .task_spec import ResourceSet
+
+            return ResourceSet(vals[0])
+        entry = _STRUCTS.get(tag)
+        if entry is None:
+            raise WireError(f"unknown struct tag {tag}")
+        cls, names = entry
+        # forward-compat both ways: extra trailing values (newer peer)
+        # are dropped; missing ones (older peer) take field defaults
+        kwargs = {n: v for n, v in zip(names, vals)}
+        return cls(**kwargs)
+    if code == EXT_EXC:
+        tag, args = _unpack(data)
+        cls = _EXCEPTIONS.get(tag)
+        if cls is None:
+            raise WireError(f"unknown exception tag {tag}")
+        try:
+            return cls(*args)
+        except TypeError:
+            e = Exception(*args)
+            e.__class__ = cls  # arg-shape drift: still the right type
+            return e
+    if code == EXT_TUPLE:
+        return tuple(_unpack(data))
+    if code == EXT_SET:
+        return set(_unpack(data))
+    if code == EXT_PICKLE:
+        return pickle.loads(data)
+    raise WireError(f"unknown extension code {code}")
+
+
+def _pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, default=_default, use_bin_type=True,
+                         strict_types=True)
+
+
+def _unpack(data) -> Any:
+    return msgpack.unpackb(data, ext_hook=_ext_hook, raw=False,
+                           strict_map_key=False)
+
+
+# ---------------------------------------------------------------- frames
+
+def encode_frame(msg_id: int, kind: int, method: str, payload: Any) -> bytes:
+    """One RPC frame body (the [u32 len] prefix is the transport's)."""
+    return _pack([WIRE_VERSION, msg_id, kind, method, payload])
+
+
+def decode_frame(body) -> Tuple[int, int, str, Any]:
+    if body[:1] == b"\x80":  # pickle protocol-2+ magic: a v0 peer
+        msg_id, kind, method, payload = pickle.loads(body)
+        return msg_id, kind, method, payload
+    frame = _unpack(body)
+    version = frame[0]
+    if version > WIRE_VERSION:
+        raise WireError(
+            f"peer speaks wire version {version}, this build supports "
+            f"<= {WIRE_VERSION}")
+    return frame[1], frame[2], frame[3], frame[4]
+
+
+# ---------------------------------------------------------------- journal
+
+def journal_encode(op: str, ns: str, key: str, val) -> bytes:
+    return _pack([WIRE_VERSION, op, ns, key, val])
+
+
+def journal_decode(body) -> Tuple[str, str, str, Any]:
+    """Decode one journal record; legacy (version-0) records are raw
+    pickled (op, ns, key, val) tuples — replaying them transparently is
+    the journal's version-migration path (a restart compacts the
+    journal, rewriting every record at the current version)."""
+    if body[:1] == b"\x80":
+        op, ns, key, val = pickle.loads(body)
+        return op, ns, key, val
+    rec = _unpack(body)
+    if rec[0] > WIRE_VERSION:
+        raise WireError(f"journal record version {rec[0]} unsupported")
+    return rec[1], rec[2], rec[3], rec[4]

@@ -75,10 +75,53 @@ class _GenBudget:
                 self._cond.wait(timeout=1.0)
 
 
+class SealBatcher:
+    """Coalesces seal notifications into one ``objects_sealed_batch``
+    RPC per flush window. Per-return round trips to the raylet dominate
+    trivial-task latency otherwise (ref: task_event_buffer.h applies the
+    same batching idea to task events)."""
+
+    def __init__(self, core: CoreWorker, raylet: RpcClient,
+                 window_s: float = 0.002):
+        self.core = core
+        self.raylet = raylet
+        self.window_s = window_s
+        self._q: List[Tuple[ObjectID, int]] = []
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="seal_batcher")
+        self._thread.start()
+
+    def add(self, oid: ObjectID, size: int) -> None:
+        with self._lock:
+            self._q.append((oid, size))
+        self._event.set()
+
+    def _loop(self) -> None:
+        import time as _time
+
+        while True:
+            self._event.wait()
+            _time.sleep(self.window_s)  # coalesce a burst
+            with self._lock:
+                batch, self._q = self._q, []
+                self._event.clear()
+            if not batch:
+                continue
+            try:
+                self.core.io.run(self.raylet.call_retrying(
+                    "objects_sealed_batch", {"objects": batch},
+                    attempts=5, per_try_timeout=2.0))
+            except Exception:
+                pass
+
+
 class TaskExecutor:
     def __init__(self, core: CoreWorker, raylet: RpcClient):
         self.core = core
         self.raylet = raylet
+        self.seal_batcher: Optional[SealBatcher] = None
         self.pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="task_exec")
         self._applied_env: dict = {}  # runtime-env hash this worker adopted
         # actor runtime
@@ -107,14 +150,28 @@ class TaskExecutor:
     # ---------------------------------------------------------- arg loading
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
         args, kwargs = [], {}
-        # gather plasma deps first so we wait once
-        dep_ids = [a.object_id for a in spec.args if a.kind == ArgKind.OBJECT_REF]
-        if dep_ids:
-            missing = [oid for oid in dep_ids if not self.core.store.contains(oid)]
-            if missing:
-                self.core.io.run(self.core.raylet.call("wait_objects", {
-                    "object_ids": missing, "num_returns": len(missing), "timeout": None,
-                }))
+        # gather deps first so we wait once; small objects come from
+        # their owner (never sealed into plasma), the rest through the
+        # raylet directory/pull path
+        ref_args = [a for a in spec.args if a.kind == ArgKind.OBJECT_REF]
+        missing = [a for a in ref_args
+                   if not self.core.store.contains(a.object_id)
+                   and not self.core.memory_store.contains(a.object_id)]
+        plasma_wait = []
+        for a in missing:
+            if a.owner and a.owner != self.core.address:
+                status = self.core.io.run(self.core._fetch_from_owner(
+                    a.owner, a.object_id, None))
+                if status == "ok":
+                    continue
+                # "gone"/"unreachable": the object may still be sealed
+                # in plasma on a third node — fall to the directory wait
+            plasma_wait.append(a.object_id)
+        if plasma_wait:
+            self.core.io.run(self.core.raylet.call("wait_objects", {
+                "object_ids": plasma_wait, "num_returns": len(plasma_wait),
+                "timeout": None,
+            }))
         for arg in spec.args:
             if arg.kind == ArgKind.VALUE:
                 kw, data = arg.value
@@ -141,14 +198,24 @@ class TaskExecutor:
         for i, value in enumerate(values[: spec.num_returns]):
             oid = ObjectID.for_return(spec.task_id, i + 1)
             data = ser.serialize(value)
-            self.core.store.put(oid, data)
-            self._notify_sealed(oid, len(data))
-            results.append((oid, data if len(data) <= small_limit else None))
+            if len(data) <= small_limit:
+                # small returns ride the reply into the owner's memory
+                # store and are served from there (fetch_object); no
+                # plasma write, no directory entry (ref: the reference's
+                # in-process store for inlined returns)
+                results.append((oid, data))
+            else:
+                self.core.store.put(oid, data)
+                self._notify_sealed(oid, len(data))
+                results.append((oid, None))
         return results
 
     def _notify_sealed(self, oid: ObjectID, size: int) -> None:
         # idempotent + retried: a lost seal notification would strand every
         # consumer waiting on this object in the directory
+        if self.seal_batcher is not None:
+            self.seal_batcher.add(oid, size)
+            return
         self.core.io.run(self.raylet.call_retrying(
             "object_sealed", {"object_id": oid, "size": size},
             attempts=5, per_try_timeout=2.0))
@@ -467,6 +534,81 @@ async def _amain():
         loop.call_later(0.05, lambda: os._exit(0))
         return True
 
+    def _lane_serve(sub, rep, kind: str):
+        """Fast-lane server thread: pop task frames (single or batched)
+        off the shm ring, execute, push replies
+        (ray_tpu/_private/fastlane.py). Normal tasks run inline on this
+        thread (the lane is one serial worker, like a leased worker in
+        the reference); actor tasks route into the actor runtime so
+        ordering and concurrency semantics match the asyncio path
+        exactly."""
+        import pickle as _pickle
+
+        def send(seq: int, reply: dict) -> None:
+            try:
+                rep.push(_pickle.dumps((seq, reply), protocol=5),
+                         timeout_ms=5000)
+            except (BrokenPipeError, ValueError):
+                pass
+
+        def serve_one(seq: int, spec) -> None:
+            if kind == "actor" and spec.is_actor_task():
+                if getattr(executor, "actor_async", False):
+                    afut = asyncio.run_coroutine_threadsafe(
+                        executor.execute_actor_task_async(spec),
+                        executor._actor_loop_obj)
+
+                    def _done(f, seq=seq, spec=spec):
+                        try:
+                            send(seq, f.result())
+                        except BaseException as e:  # noqa: BLE001
+                            send(seq, {"results": [],
+                                       "error": executor._seal_error(
+                                           spec, e)})
+
+                    afut.add_done_callback(_done)
+                else:
+                    executor._actor_queue.put(
+                        (spec, lambda reply, seq=seq: send(seq, reply)))
+            else:
+                core.job_id = spec.job_id
+                send(seq, executor.execute_normal(spec))
+
+        try:
+            while True:
+                try:
+                    frame = sub.pop(timeout_ms=500)
+                except (BrokenPipeError, ValueError):
+                    break
+                if frame is None:
+                    continue
+                try:
+                    batch = _pickle.loads(frame)
+                except Exception:
+                    continue
+                if not isinstance(batch, list):
+                    batch = [batch]
+                for seq, spec in batch:
+                    serve_one(seq, spec)
+        finally:
+            try:
+                rep.close_write()
+            except Exception:
+                pass
+
+    async def handle_fastlane_attach(payload, conn):
+        try:
+            from .._native import Ring
+
+            sub = Ring(payload["sub"])
+            rep = Ring(payload["rep"])
+        except Exception:
+            return False
+        threading.Thread(
+            target=_lane_serve, args=(sub, rep, payload.get("kind", "task")),
+            daemon=True, name="fastlane_serve").start()
+        return True
+
     async def handle_health(payload, conn):
         return {"pid": os.getpid(), "actor": executor.actor_id}
 
@@ -475,6 +617,10 @@ async def _amain():
     server.register("generator_ack", handle_generator_ack)
     server.register("kill_self", handle_kill_self)
     server.register("health", handle_health)
+    server.register("fastlane_attach", handle_fastlane_attach)
+    # owner-serve: this worker's owned small objects (nested submissions)
+    server.register("fetch_object", core._handle_fetch_object)
+    executor.seal_batcher = SealBatcher(core, raylet)
     await server.start()
     my_socket = server.address  # resolved (TCP port 0)
     core.address = my_socket

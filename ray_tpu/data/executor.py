@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -22,6 +23,34 @@ _SENTINEL = "__stream_end__"
 # stage tuning (ref: backpressure_policy/ + resource_manager defaults)
 MAX_INFLIGHT_PER_STAGE = 4
 STAGE_QUEUE_CAP = 8
+
+
+def _store_backpressure_wait(stop_event: "threading.Event",
+                             max_wait_s: float = 5.0) -> None:
+    """Pause dispatch while the local object store sits above the
+    spilling threshold (ref: _internal/execution/resource_manager.py +
+    backpressure_policy/ConcurrencyCapBackpressurePolicy — here the
+    signal is actual store usage, not a static cap). Bounded: with
+    disk spilling behind the store this is congestion control, not a
+    correctness gate, so a store pinned full by foreign objects must
+    not deadlock the pipeline."""
+    from .._worker_api import _core
+    from .._private.config import global_config
+
+    core = _core
+    if core is None:
+        return
+    threshold = global_config().object_spilling_threshold
+    capacity = core.store.capacity or 1
+    waited = 0.0
+    while not stop_event.is_set() and waited < max_wait_s:
+        try:
+            if core.store.used_bytes() / capacity < threshold:
+                return
+        except Exception:
+            return
+        time.sleep(0.05)
+        waited += 0.05
 
 
 @dataclass
@@ -128,6 +157,7 @@ class ReadStage(_Stage):
                 if self.stop_event.is_set():
                     break  # downstream satisfied (limit reached)
                 slots.acquire()
+                _store_backpressure_wait(self.stop_event)
                 buf: "queue.Queue" = queue.Queue(maxsize=STAGE_QUEUE_CAP)
                 buffers.append(buf)
                 gen = _exec_read.remote(cloudpickle.dumps(task))
@@ -211,6 +241,7 @@ class MapStage(_Stage):
                     break
                 if self.stop_event.is_set():
                     continue  # downstream satisfied: drop, don't dispatch
+                _store_backpressure_wait(self.stop_event)
                 inflight.append(map_task.remote(item))
                 self.stats.tasks_submitted += 1
             if not inflight:

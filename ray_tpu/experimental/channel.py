@@ -7,9 +7,13 @@ with single-writer / N-reader semantics: the writer blocks until every
 registered reader consumed the previous value, readers block until the
 next value arrives. No locks — cross-process coordination rides on
 monotonic u64 sequence counters in the mapped header (a store-release /
-load-acquire pattern; CPython's mmap writes are atomic enough for u64
-on x86/ARM given the GIL releases around syscalls, and the counters only
-ever move forward).
+load-acquire pattern). The release/acquire edges are REAL barriers: the
+writer fences (native ``rtpu_fence``, seq-cst) between the payload store
+and the seq publication, and the reader fences between observing the seq
+and loading the payload — without this, a weakly-ordered CPU (ARM) could
+let a reader see the counter advance before the payload bytes and
+unpickle torn data. When the native lib is unavailable we require x86-64
+(whose TSO makes plain stores release-ordered) and refuse elsewhere.
 
 Layout:  [magic u32][num_readers u32][write_seq u64]
          [read_seq u64 x num_readers][payload_len u64][payload ...]
@@ -31,6 +35,39 @@ _U64 = struct.Struct("<Q")
 _STOP_LEN = (1 << 64) - 1            # payload_len sentinel: channel closed
 
 DEFAULT_CAPACITY = 1 << 20
+
+
+_FENCE_STATE: list = []  # lazily resolved: [callable-or-None]
+
+
+def _load_fence():
+    """seq-cst fence for the counter protocol; None → x86-64 TSO only.
+    Resolved on first Channel construction, NOT at import — a host with
+    no toolchain must still be able to import this module (it just
+    can't build channels unless it's x86-64)."""
+    try:
+        from ray_tpu._native import get_lib
+
+        lib = get_lib()
+        if lib is not None and hasattr(lib, "rtpu_fence"):
+            return lib.rtpu_fence
+    except Exception:
+        pass
+    import platform
+
+    if platform.machine() not in ("x86_64", "AMD64"):
+        raise RuntimeError(
+            "mutable channels need the native fence on weakly-ordered "
+            f"CPUs ({platform.machine()}): build ray_tpu/_native or run "
+            "on x86-64")
+    return None
+
+
+def _fence() -> None:
+    if not _FENCE_STATE:
+        _FENCE_STATE.append(_load_fence())
+    if _FENCE_STATE[0] is not None:
+        _FENCE_STATE[0]()
 
 
 class ChannelClosed(Exception):
@@ -81,6 +118,7 @@ class Channel:
         self._r_off = _HDR.size + 8
         self._len_off = self._r_off + 8 * self.num_readers
         self._data_off = self._len_off + 8
+        _fence()  # resolve (and platform-check) before any data crosses
 
     # --- low-level counter access ---
 
@@ -112,8 +150,10 @@ class Channel:
         seq = self._write_seq()
         self._wait(lambda: all(self._read_seq(i) >= seq
                                for i in range(self.num_readers)), timeout)
+        _fence()  # acquire: readers' seq stores observed before overwrite
         self._mm[self._data_off:self._data_off + len(payload)] = payload
         _U64.pack_into(self._mm, self._len_off, len(payload))
+        _fence()  # release: payload+len visible before the seq advance
         _U64.pack_into(self._mm, self._w_off, seq + 1)
 
     def close_write(self) -> None:
@@ -125,6 +165,7 @@ class Channel:
         except ChannelTimeout:
             pass  # force-close: a stuck reader must still see STOP
         _U64.pack_into(self._mm, self._len_off, _STOP_LEN)
+        _fence()
         _U64.pack_into(self._mm, self._w_off, seq + 1)
 
     # --- reader API ---
@@ -132,11 +173,13 @@ class Channel:
     def read(self, slot: int = 0, timeout: Optional[float] = None) -> Any:
         seq = self._read_seq(slot)
         self._wait(lambda: self._write_seq() > seq, timeout)
+        _fence()  # acquire: seq observed before payload/len loads
         length = _U64.unpack_from(self._mm, self._len_off)[0]
         if length == _STOP_LEN:
             raise ChannelClosed(self.path)
         value = pickle.loads(
             self._mm[self._data_off:self._data_off + length])
+        _fence()  # release: payload loads retire before the seq advance
         _U64.pack_into(self._mm, self._r_off + 8 * slot, seq + 1)
         return value
 

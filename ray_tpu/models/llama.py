@@ -219,7 +219,17 @@ def forward(params, tokens, cfg: LlamaConfig, *,
     cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1],
                                 cfg.rope_theta, dtype=jnp.float32)
 
-    x = jnp.take(params["embed"], tokens, axis=0)
+    # Embedding lookup, transpose-stable: the stored table is
+    # (vocab→tp, embed→fsdp)-sharded while activations are batch-sharded
+    # over (dp, fsdp); gathering straight from the stored layout makes
+    # SPMD move data between the fsdp and dp mesh dims — a device-order
+    # transposition it can only do by full rematerialization (replicate
+    # + repartition), in the forward AND its jvp transpose. Dropping the
+    # table's embed-dim sharding first keeps the gather's vocab dim on
+    # tp (masked gather + psum, the efficient partitioned path) and the
+    # output reshard to batch is then a local slice.
+    tbl = csl(params["embed"], ("vocab", None))
+    x = jnp.take(tbl, tokens, axis=0)
     x = csl(x, ("batch", "seq", "embed"))
 
     def layer(x, lp):

@@ -48,19 +48,61 @@ class _ModelCache:
         self.capacity = capacity
         self.models: "OrderedDict[str, Any]" = OrderedDict()
         self.loading: Dict[str, asyncio.Future] = {}
+        # In-flight leases per model object: eviction must not close() a
+        # model other requests are still running inference on — close is
+        # deferred until the last leasing request's task completes.
+        self._refs: Dict[int, int] = {}
+        self._retired: Dict[int, Any] = {}
+
+    def _lease(self, model: Any) -> Any:
+        """Pin ``model`` for the duration of the calling request's task."""
+        task = asyncio.current_task()
+        if task is None:
+            return model
+        key = id(model)
+        self._refs[key] = self._refs.get(key, 0) + 1
+        task.add_done_callback(lambda _t, key=key: self._release(key))
+        return model
+
+    def _release(self, key: int) -> None:
+        n = self._refs.get(key, 0) - 1
+        if n > 0:
+            self._refs[key] = n
+            return
+        self._refs.pop(key, None)
+        model = self._retired.pop(key, None)
+        if model is not None:
+            self._close(model)
+
+    def _retire(self, model: Any) -> None:
+        """Evicted from the LRU: close now if idle, else when released."""
+        key = id(model)
+        if self._refs.get(key, 0) > 0:
+            self._retired[key] = model
+        else:
+            self._close(model)
+
+    @staticmethod
+    def _close(model: Any) -> None:
+        close = getattr(model, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                pass
 
     async def get(self, model_id: str) -> Any:
         while True:
             if model_id in self.models:
                 self.models.move_to_end(model_id)
-                return self.models[model_id]
+                return self._lease(self.models[model_id])
             pending = self.loading.get(model_id)
             if pending is None:
                 break
             try:
                 # shield: our caller being cancelled must not cancel the
                 # shared load other waiters are parked on
-                return await asyncio.shield(pending)
+                return self._lease(await asyncio.shield(pending))
             except asyncio.CancelledError:
                 if pending.cancelled():
                     continue  # the LOADER was cancelled: retry ourselves
@@ -73,12 +115,7 @@ class _ModelCache:
         while (len(self.models) + len(self.loading) > self.capacity
                and self.models):
             _, evicted = self.models.popitem(last=False)
-            close = getattr(evicted, "close", None)
-            if callable(close):
-                try:
-                    close()
-                except Exception:
-                    pass
+            self._retire(evicted)
         try:
             model = await self.loader(model_id)
         except asyncio.CancelledError:
@@ -95,16 +132,11 @@ class _ModelCache:
         self.models[model_id] = model
         while len(self.models) > self.capacity:
             _, evicted = self.models.popitem(last=False)  # LRU out
-            close = getattr(evicted, "close", None)
-            if callable(close):
-                try:
-                    close()
-                except Exception:
-                    pass
+            self._retire(evicted)
         self.loading.pop(model_id, None)
         if not fut.done():
             fut.set_result(model)
-        return model
+        return self._lease(model)
 
 
 def multiplexed(_fn: Optional[Callable] = None, *,

@@ -20,7 +20,46 @@ import jax
 # lands before any backend is initialized, so tests stay CPU-only.
 jax.config.update("jax_platforms", "cpu")
 
+import signal
+import threading
+
 import pytest
+
+# Per-test wall-clock guard (ref: the reference root pytest.ini's 180 s
+# default-timeout): one wedged test must not hang a whole CI round.
+# pytest-timeout isn't vendored in this image, so a SIGALRM in the main
+# thread raises inside whatever the test is blocked on.
+_TEST_TIMEOUT_S = int(os.environ.get("RAY_TPU_TEST_TIMEOUT_S", "180"))
+
+
+import faulthandler
+
+if hasattr(signal, "SIGUSR1"):
+    # `kill -USR1 <pytest pid>` dumps every thread's stack — the hung-
+    # test debugging hook (ref: the reference's py-spy dashboard hook)
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    # wraps setup+call+teardown: a wedged fixture (cluster shutdown,
+    # module-scoped init) is guarded too, not just the test body
+    if (_TEST_TIMEOUT_S > 0 and hasattr(signal, "SIGALRM")
+            and threading.current_thread() is threading.main_thread()):
+        def _on_alarm(signum, frame):
+            faulthandler.dump_traceback(all_threads=True)
+            raise TimeoutError(
+                f"test exceeded {_TEST_TIMEOUT_S}s (RAY_TPU_TEST_TIMEOUT_S)")
+
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(_TEST_TIMEOUT_S)
+        try:
+            yield
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+    else:
+        yield
 
 
 @pytest.fixture

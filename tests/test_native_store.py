@@ -92,23 +92,34 @@ print("CHILD_OK")
     ix.close()
 
 
-def test_store_uses_native_index_for_eviction(tmp_path):
-    store = SharedObjectStore(str(tmp_path / "store"), capacity_bytes=1000)
-    assert store._idx is not None
-    a, b = ObjectID.from_random(), ObjectID.from_random()
-    store.put(a, b"x" * 600)
-    store.put(b, b"y" * 300)
-    assert store.used_bytes() == 900
-    c = ObjectID.from_random()
-    store.put(c, b"z" * 500)        # evicts a (LRU)
-    assert store.get(a) is None
-    assert bytes(store.get(c)) == b"z" * 500
-    # pinned objects survive pressure; unpinnable request raises
-    store.pin(b)
-    store.pin(c)
-    with pytest.raises(ObjectStoreFullError):
-        store.put(ObjectID.from_random(), b"w" * 900)
-    store.destroy()
+def test_store_uses_native_index_for_eviction(tmp_path, monkeypatch):
+    # pure eviction semantics: spilling off, so victims truly die
+    # (spill/restore behavior is covered by tests/test_spilling.py)
+    from ray_tpu._private import config as cfgmod
+
+    monkeypatch.setenv("RAY_TPU_OBJECT_SPILLING_ENABLED", "0")
+    cfgmod.reset_global_config()
+    try:
+        store = SharedObjectStore(str(tmp_path / "store"),
+                                  capacity_bytes=1000)
+        assert store._idx is not None
+        assert store.spill_dir is None
+        a, b = ObjectID.from_random(), ObjectID.from_random()
+        store.put(a, b"x" * 600)
+        store.put(b, b"y" * 300)
+        assert store.used_bytes() == 900
+        c = ObjectID.from_random()
+        store.put(c, b"z" * 500)        # evicts a (LRU)
+        assert store.get(a) is None
+        assert bytes(store.get(c)) == b"z" * 500
+        # pinned objects survive pressure; unpinnable request raises
+        store.pin(b)
+        store.pin(c)
+        with pytest.raises(ObjectStoreFullError):
+            store.put(ObjectID.from_random(), b"w" * 900)
+        store.destroy()
+    finally:
+        cfgmod.reset_global_config()
 
 
 def test_store_cross_handle_accounting(tmp_path):
