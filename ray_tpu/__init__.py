@@ -17,6 +17,7 @@ from . import exceptions
 from ._private.object_ref import ObjectRef, ObjectRefGenerator
 from ._worker_api import (
     available_resources,
+    get_tpu_chip_ids,
     cancel,
     cluster_resources,
     get,
@@ -81,5 +82,6 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method",
     "get", "put", "wait", "kill", "cancel", "get_actor",
     "cluster_resources", "available_resources", "nodes",
+    "get_tpu_chip_ids",
     "util", "exceptions", "__version__",
 ]

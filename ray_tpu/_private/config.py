@@ -84,6 +84,11 @@ class Config:
     event_log_enabled: bool = True
     metrics_report_interval_ms: int = 2000
     # --- device plane ---
+    # Serving decode attention: stream KV pages through the Pallas
+    # paged-attention kernel (ops/paged_attention.py) instead of the
+    # XLA jnp.take gather. Off until the kernel wins on real hardware
+    # for the deployment's shapes (flip with RAY_TPU_LLM_PAGED_KERNEL=1).
+    llm_paged_kernel: bool = False
     mesh_compile_cache_dir: str = ""
     default_device_platform: str = ""         # "" = jax default
     ici_mesh_auto_axis_order: bool = True

@@ -137,6 +137,8 @@ class CoreWorker:
         self._task_local = threading.local()  # per-execution-thread task context
         self._put_index = 0
         self._put_lock = threading.Lock()
+        self._block_depth = 0          # worker dep-block nesting
+        self._block_lock = threading.Lock()
 
         # reference counting — native C++ table by default (ref:
         # reference_count.h:66; native/core_tables.cc), Python dicts as
@@ -333,6 +335,39 @@ class CoreWorker:
         await self.gcs.close()
         await self.raylet.close()
 
+    # ------------------------------------------------- blocked notification
+    def _notify_blocked(self):
+        """Worker mode: tell the raylet this worker's task is blocked on
+        object resolution so the lease's CPU is released back (ref:
+        NotifyDirectCallTaskBlocked — see raylet.handle_worker_blocked).
+        Re-entrant; no-op for drivers."""
+        if self.mode != "worker":
+            return
+        with self._block_lock:
+            self._block_depth += 1
+            first = self._block_depth == 1
+        if first:
+            try:
+                self.io.run(self.raylet.call(
+                    "worker_blocked", {"worker_id": self.worker_id},
+                    timeout=5), timeout=6)
+            except Exception:
+                pass
+
+    def _notify_unblocked(self):
+        if self.mode != "worker":
+            return
+        with self._block_lock:
+            self._block_depth = max(0, self._block_depth - 1)
+            last = self._block_depth == 0
+        if last:
+            try:
+                self.io.run(self.raylet.call(
+                    "worker_unblocked", {"worker_id": self.worker_id},
+                    timeout=5), timeout=6)
+            except Exception:
+                pass
+
     # -------------------------------------------------------- ref counting
     # Native C++ table when available (self._rc, native/core_tables.cc);
     # the table returns the free decision: 0 keep, 1 free (owned),
@@ -512,20 +547,33 @@ class CoreWorker:
         if fast is not None:
             deadline = (None if timeout is None
                         else time.monotonic() + timeout)
-            out = []
-            for oid, ev in fast:
-                if ev is not None and not (self.memory_store.contains(oid)
-                                           or self.store.contains(oid)):
-                    left = (None if deadline is None
-                            else max(0.0, deadline - time.monotonic()))
-                    if not ev.wait(left):
-                        raise exc.GetTimeoutError(
-                            "Get timed out: fast-lane task not finished")
-                out.append(self._load_object(oid))
-            return out
+            waiting = any(ev is not None for _, ev in fast)
+            if waiting:
+                self._notify_blocked()
+            try:
+                out = []
+                for oid, ev in fast:
+                    if ev is not None and not (
+                            self.memory_store.contains(oid)
+                            or self.store.contains(oid)):
+                        left = (None if deadline is None
+                                else max(0.0, deadline - time.monotonic()))
+                        if not ev.wait(left):
+                            raise exc.GetTimeoutError(
+                                "Get timed out: fast-lane task not finished")
+                    out.append(self._load_object(oid))
+                return out
+            finally:
+                if waiting:
+                    self._notify_unblocked()
         owners = {r.id(): r.owner_address for r in refs if r.owner_address}
-        return self.io.run(self._get(oids, timeout, owners),
-                           timeout=None if timeout is None else timeout + 30)
+        self._notify_blocked()  # worker dep-wait: give the CPU back
+        try:
+            return self.io.run(
+                self._get(oids, timeout, owners),
+                timeout=None if timeout is None else timeout + 30)
+        finally:
+            self._notify_unblocked()
 
     async def _fetch_from_owner(self, owner: str, oid: ObjectID,
                                 deadline: Optional[float]) -> str:
@@ -1081,6 +1129,8 @@ class CoreWorker:
                     raise exc.TaskCancelledError(
                         f"task {spec.function.repr_name} was cancelled")
                 info["worker_address"] = grant["worker_address"]
+            if grant.get("chip_ids"):
+                spec.chip_ids = grant["chip_ids"]
             client = await self._client_for(grant["worker_address"])
             reply = await client.call("push_task", cloudpickle.dumps(spec))
             errored = self._handle_task_reply(spec, reply)
@@ -1435,6 +1485,10 @@ class CoreWorker:
             sched_class = spec.scheduling_class()
             pool = self._lease_pools.setdefault(sched_class, _LeasePool())
             grant = await self._acquire_lease(pool, spec)
+            if grant.get("chip_ids"):
+                # the actor owns its lease's chips for life; the worker
+                # exports them before __init__ runs
+                spec.chip_ids = grant["chip_ids"]
             client = await self._client_for(grant["worker_address"])
             reply = await client.call("push_task", cloudpickle.dumps(spec), timeout=None)
             if reply.get("error") is not None:

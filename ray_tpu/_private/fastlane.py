@@ -78,6 +78,10 @@ class _Lane:
         self.on_slot: Optional[Callable[[], None]] = None  # pool wakeup
         self._seq = 0
         self._lock = threading.Lock()
+        # serializes ring pushes against teardown: free() (munmap) must
+        # never run under a concurrent push
+        self._push_lock = threading.Lock()
+        self._sub_freed = False
         self._reply_thread = threading.Thread(
             target=self._reply_loop, daemon=True,
             name=f"lane_reply_{self.worker_address[-8:]}")
@@ -109,8 +113,11 @@ class _Lane:
                 info["worker_address"] = self.worker_address
         frame = pickle.dumps(batch, protocol=5)
         try:
-            if not self.sub.push(frame, timeout_ms=2000):
-                raise BrokenPipeError("ring full")
+            with self._push_lock:
+                if self._sub_freed:
+                    raise BrokenPipeError("lane torn down")
+                if not self.sub.push(frame, timeout_ms=2000):
+                    raise BrokenPipeError("ring full")
         except ValueError:
             # frame larger than the ring: the lane is perfectly healthy,
             # this batch just can't ride it — un-register and let the
@@ -171,6 +178,28 @@ class _Lane:
         self._fail_pending()
         if self.on_slot is not None:
             self.on_slot()
+        self._cleanup_rings()
+
+    def _cleanup_rings(self):
+        """Reply-thread exit owns teardown: unmap both rings and unlink
+        their files (16 MB of tmpfs per lane otherwise leaks on every
+        attach/release cycle). The push lock keeps a racing submitter
+        out of the sub ring's mapping while it dies."""
+        try:
+            self.rep.free()
+        except Exception:
+            pass
+        with self._push_lock:
+            self._sub_freed = True
+            try:
+                self.sub.free()
+            except Exception:
+                pass
+        for ring in (self.sub, self.rep):
+            try:
+                ring.unlink()
+            except Exception:
+                pass
 
     def _mark_dead(self):
         with self._lock:

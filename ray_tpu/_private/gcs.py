@@ -588,6 +588,21 @@ class GcsServer:
                         placed[i] = nid
                     return placed  # type: ignore[return-value]
             return None
+        # TPU slice-aware placement (the TPU-first substitution of
+        # SURVEY §7.1.2): a spread PG whose bundles all request TPU maps
+        # onto ONE ICI slice, bundle k on the slice's k-th host in
+        # host_index order — the gang becomes a physical sub-cube whose
+        # collectives ride ICI, not DCN (ref:
+        # policy/bundle_scheduling_policy.h:82-106 +
+        # accelerators/tpu.py:401-403's slice-head gang resource,
+        # promoted from resource-string convention into the scheduler).
+        if (todo and strategy in ("SPREAD", "STRICT_SPREAD")
+                and all(ResourceSet(pg["bundles"][i]).get("TPU") > 0
+                        for i in todo)):
+            sliced = self._plan_bundles_on_slice(pg, avail, placed, todo)
+            if sliced is not None:
+                return sliced
+            # no slice can host the whole gang: generic placement below
         # place most-constrained bundles first (fewest feasible nodes) so a
         # bundle needing a rare resource isn't starved by flexible ones
         todo.sort(key=lambda i: sum(
@@ -614,6 +629,53 @@ class GcsServer:
             avail[nid].subtract(req)
             used_nodes.add(nid)
         return placed  # type: ignore[return-value]
+
+    def _plan_bundles_on_slice(self, pg: dict, avail: dict,
+                               placed: list, todo: list):
+        """Assign the unplaced bundles of a TPU gang to the hosts of one
+        ICI slice in host_index order. Prefers the smallest slice that
+        fits (tight sub-cubes leave big slices for big gangs). Returns
+        the full placement list or None."""
+        from .task_spec import ResourceSet
+
+        used = {n for n in placed if n is not None}
+        slices: Dict[str, list] = {}
+        for nid, info in self.nodes.items():
+            if info.alive and info.slice_name and nid in avail:
+                slices.setdefault(info.slice_name, []).append(
+                    (info.host_index, nid))
+        if used:
+            # bundles already reserved pin the gang to their slice
+            names = {self.nodes[n].slice_name for n in used
+                     if n in self.nodes}
+            if len(names) != 1 or "" in names:
+                return None
+            slices = {k: v for k, v in slices.items() if k in names}
+        best = None
+        for name in sorted(slices):
+            hosts = sorted(slices[name])
+            free_hosts = [nid for _, nid in hosts if nid not in used]
+            if len(free_hosts) < len(todo):
+                continue
+            trial = {nid: avail[nid].copy() for nid in free_hosts}
+            assign = {}
+            ok = True
+            for k, i in enumerate(sorted(todo)):
+                nid = free_hosts[k]  # bundle k -> k-th host by host_index
+                req = ResourceSet(pg["bundles"][i])
+                if not req.fits(trial[nid]):
+                    ok = False
+                    break
+                trial[nid].subtract(req)
+                assign[i] = nid
+            if ok and (best is None or len(hosts) < best[0]):
+                best = (len(hosts), assign)
+        if best is None:
+            return None
+        out = list(placed)
+        for i, nid in best[1].items():
+            out[i] = nid
+        return out
 
     async def _try_schedule_pg(self, pg: dict) -> bool:
         plan = self._plan_bundles(pg)

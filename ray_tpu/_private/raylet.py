@@ -9,10 +9,15 @@ Design deltas from the reference, driven by the TPU runtime model:
    so the dependency manager's pull path degenerates to a directory lookup on
    one host — multi-host transfer rides the DCN object-transfer service
    (future native component) behind the same `wait_objects` contract;
- * scheduling understands TPU slice resources natively: a worker leased with
-   "TPU" resources gets TPU_VISIBLE_CHIPS-style isolation via env vars
-   (ref: python/ray/_private/accelerators/tpu.py:31), and slice-head resources
-   gang-reserve whole hosts (SURVEY §5.8);
+ * scheduling understands TPU chips natively: every lease carrying "TPU"
+   resources is assigned physical chip ids from a per-chip accounting pool
+   (whole chips exclusive, fractional leases bin-packed onto shared chips —
+   `_allocate_chips`), and the executing worker exports them as
+   TPU_VISIBLE_CHIPS / RAY_TPU_CHIP_IDS before user code runs (ref:
+   python/ray/_private/accelerators/tpu.py:31, promoted from env-var
+   convention into scheduler state; tests/test_topology.py). Slice-spread
+   placement-group gangs map onto one ICI slice in host_index order
+   (gcs._plan_bundles_on_slice; SURVEY §5.8, §7.1.2);
  * hybrid scheduling policy: pack onto the local node below a utilization
    threshold, spread above it, spill to the best remote node otherwise
    (ref: policy/hybrid_scheduling_policy.h:50).
@@ -66,6 +71,15 @@ class Lease:
     lane: bool = False
     conn: Optional[ServerConnection] = None
     reclaim_requested_at: float = 0.0
+    # TPU chips granted to this lease as [(chip_id, fraction)] — the
+    # worker sees them as TPU_VISIBLE_CHIPS (ref:
+    # python/ray/_private/accelerators/tpu.py:31, promoted from env-var
+    # convention to first-class per-lease accounting)
+    chips: List[tuple] = field(default_factory=list)
+    # CPU share temporarily given back while the worker blocks on object
+    # resolution (ref: NotifyDirectCallTaskBlocked in node_manager.cc —
+    # without this, a gang of dep-waiting workers deadlocks the node)
+    blocked_cpu: Optional[ResourceSet] = None
 
 
 @dataclass
@@ -113,6 +127,17 @@ class NodeResources:
             return False
         self._available.subtract(req)
         return True
+
+    def force_allocate(self, req: ResourceSet) -> None:
+        """Unconditional subtraction — availability may go transiently
+        negative (a dep-blocked worker resuming re-takes its CPU even if
+        the node is momentarily oversubscribed, matching the reference's
+        unblock semantics)."""
+        if self._native is not None:
+            self._native.release(self._NODE,
+                                 {k: -v for k, v in req.to_dict().items()})
+            return
+        self._available.subtract(req)
 
     def release(self, req: ResourceSet) -> None:
         if self._native is not None:
@@ -210,6 +235,12 @@ class Raylet:
         # total + what's still leasable within it (ref:
         # placement_group_resource_manager.h bundle resource bookkeeping)
         self._pg_bundles: Dict[tuple, NodeResources] = {}
+        # per-chip TPU accounting: chip i carries a used fraction in
+        # [0, 1]; whole-chip leases take exclusive chips, fractional
+        # leases bin-pack onto shared ones (ref: accelerators/tpu.py
+        # TPU_VISIBLE_CHIPS isolation + GPU fractional semantics)
+        self._chip_used: List[float] = \
+            [0.0] * int(self.resources.total.get("TPU", 0))
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -444,6 +475,57 @@ class Raylet:
         await self._pump_pending()
         return {"node_id": self.node_id, "session": self.session_name}
 
+    async def handle_worker_blocked(self, payload, conn):
+        """The worker's current task is blocked resolving objects: hand
+        its CPU share back so other work can run — withholding it
+        deadlocks dependency chains once every worker waits (ref:
+        node_manager.cc HandleNotifyDirectCallTaskBlocked →
+        ReleaseCpuResourcesFromBlockedWorker)."""
+        worker = self._workers.get(payload["worker_id"])
+        if worker is None or worker.lease is None:
+            return False
+        lease = worker.lease
+        if lease.blocked_cpu is not None:
+            return True  # already released (re-entrant block)
+        cpu = lease.resources.get("CPU", 0.0)
+        if cpu <= 0:
+            return True
+        part = ResourceSet({"CPU": cpu})
+        lease.blocked_cpu = part
+        lease.resources = ResourceSet(
+            {k: v for k, v in lease.resources.to_dict().items()
+             if k != "CPU"})
+        if lease.pg_key is not None:
+            bundle = self._pg_bundles.get(lease.pg_key)
+            if bundle is not None:
+                bundle.release(part)
+        else:
+            self.resources.release(part)
+        await self._report_resources()
+        await self._pump_pending()
+        return True
+
+    async def handle_worker_unblocked(self, payload, conn):
+        """Blocked worker resumed: re-take its CPU (forced — transient
+        oversubscription beats starving the resumed task, matching the
+        reference's ReturnCpuResourcesToUnblockedWorker)."""
+        worker = self._workers.get(payload["worker_id"])
+        if worker is None or worker.lease is None:
+            return False
+        lease = worker.lease
+        part, lease.blocked_cpu = lease.blocked_cpu, None
+        if part is None:
+            return True
+        if lease.pg_key is not None:
+            bundle = self._pg_bundles.get(lease.pg_key)
+            if bundle is not None:
+                bundle.force_allocate(part)
+        else:
+            self.resources.force_allocate(part)
+        lease.resources.add(part)
+        await self._report_resources()
+        return True
+
     async def _on_disconnect(self, conn):
         # reap exited worker subprocesses and drop them from tracking (dead
         # workers would otherwise linger as zombies until node stop)
@@ -478,7 +560,13 @@ class Raylet:
             worker = self._idle.pop()
             if worker.alive:
                 return worker
-        if len(self._workers) + self._starting < self.max_workers:
+        # dep-blocked workers released their CPU but still sit in the
+        # pool: they must not count against the cap, or the freed CPU is
+        # ungrantable (no worker to run on) and dependency chains starve
+        # (ref: worker_pool.h soft-limit exempting blocked workers)
+        blocked = sum(1 for l in self._leases.values()
+                      if l.blocked_cpu is not None)
+        if len(self._workers) + self._starting - blocked < self.max_workers:
             self._spawn_worker()
         return None
 
@@ -582,10 +670,20 @@ class Raylet:
             else:
                 self.resources.release(resources)
             return None
+        chips = self._allocate_chips(resources.get("TPU", 0.0))
+        if chips is None:
+            # resource math admitted the lease but chips are exhausted
+            # (should not diverge; defensive): give everything back
+            if alloc_key is not None:
+                self._pg_bundles[alloc_key].release(resources)
+            else:
+                self.resources.release(resources)
+            self._return_worker_to_pool(worker)
+            return None
         lease = Lease(self._next_lease_id, worker, resources,
                       payload.get("owner_address", ""), pg_key=alloc_key,
                       lane=bool(payload.get("lane")),
-                      conn=payload.get("_conn"))
+                      conn=payload.get("_conn"), chips=chips)
         self._next_lease_id += 1
         worker.lease = lease
         if payload.get("actor_id") is not None:
@@ -598,6 +696,8 @@ class Raylet:
             "worker_id": worker.worker_id,
             "lease_id": lease.lease_id,
             "node_id": self.node_id,
+            # the leased worker's chip visibility set (TPU leases only)
+            "chip_ids": sorted(i for i, _ in lease.chips),
         }
 
     async def handle_cancel_lease_request(self, payload, conn):
@@ -769,12 +869,62 @@ class Raylet:
         """Return a finished lease's resources to the bundle it drew from, or
         to the node pool. A canceled bundle already released its whole
         reservation, so its leases return nothing."""
+        self._release_chips(lease.chips)
+        lease.chips = []
         if lease.pg_key is not None:
             bundle = self._pg_bundles.get(lease.pg_key)
             if bundle is not None:
                 bundle.release(lease.resources)
             return
         self.resources.release(lease.resources)
+
+    # -------------------------------------------------- per-lease TPU chips
+    def _allocate_chips(self, amount: float) -> Optional[List[tuple]]:
+        """Assign physical chips to a TPU lease: whole units take
+        exclusive free chips; a fractional tail bin-packs onto the most-
+        loaded chip it still fits (so shards share one chip, not many).
+        Returns [(chip_id, fraction)], [] for non-TPU leases, None when
+        chip accounting can't satisfy the amount."""
+        if amount <= 0 or not self._chip_used:
+            return []
+        eps = 1e-9
+        whole = int(amount + eps)
+        frac = amount - whole
+        alloc: List[tuple] = []
+        free = [i for i, u in enumerate(self._chip_used) if u <= eps]
+        if len(free) < whole:
+            return None
+        for i in free[:whole]:
+            alloc.append((i, 1.0))
+        if frac > eps:
+            taken = {i for i, _ in alloc}
+            best = None
+            for i, used in enumerate(self._chip_used):
+                if i in taken or used + frac > 1.0 + eps:
+                    continue
+                if used > eps and (best is None
+                                   or used > self._chip_used[best]):
+                    best = i  # most-loaded shared chip that still fits
+            if best is None:  # no partially-used chip fits: take a free one
+                rest = free[whole:]
+                if not rest:
+                    return None  # nothing reserved yet: clean failure
+                best = rest[0]
+            alloc.append((best, frac))
+        for i, f in alloc:
+            self._chip_used[i] += f
+        return alloc
+
+    def _release_chips(self, chips: List[tuple]) -> None:
+        for i, f in chips:
+            if 0 <= i < len(self._chip_used):
+                self._chip_used[i] = max(0.0, self._chip_used[i] - f)
+
+    def _return_worker_to_pool(self, worker: WorkerHandle) -> None:
+        worker.lease = None
+        if worker.alive and worker.actor_id is None:
+            worker.idle_since = time.monotonic()
+            self._idle.append(worker)
 
     async def _request_lane_reclaims(self) -> None:
         """Pending demand (queued lease / PG reservation) cannot fit:
@@ -824,6 +974,10 @@ class Raylet:
             if lease.pg_key == key:
                 self._leases.pop(lease.lease_id, None)
                 self._forget_rid(lease.lease_id)
+                # bundle resources die with the reservation below, but
+                # chip accounting is node-global and must be returned
+                self._release_chips(lease.chips)
+                lease.chips = []
                 worker = lease.worker
                 worker.lease = None
                 worker.alive = False

@@ -169,6 +169,10 @@ class TaskSpec:
     seq_no: int = 0                             # per-caller actor task ordering
     owner_address: str = ""                     # socket of the owning core worker
     runtime_env: Optional[dict] = None
+    # physical TPU chips granted to the executing lease — the worker
+    # exports them as TPU_VISIBLE_CHIPS before running user code (ref:
+    # accelerators/tpu.py:31 promoted to per-lease scheduler state)
+    chip_ids: Optional[List[int]] = None
 
     def is_actor_task(self) -> bool:
         return self.actor_id is not None and not self.actor_creation

@@ -114,7 +114,14 @@ class SealBatcher:
                     "objects_sealed_batch", {"objects": batch},
                     attempts=5, per_try_timeout=2.0))
             except Exception:
-                pass
+                # a lost seal notification would strand every consumer
+                # of these objects in the directory: REQUEUE and keep
+                # trying (the raylet being down this long usually means
+                # the node is dying anyway — but never silently drop)
+                with self._lock:
+                    self._q = batch + self._q
+                self._event.set()
+                _time.sleep(1.0)
 
 
 class TaskExecutor:
@@ -157,21 +164,31 @@ class TaskExecutor:
         missing = [a for a in ref_args
                    if not self.core.store.contains(a.object_id)
                    and not self.core.memory_store.contains(a.object_id)]
-        plasma_wait = []
-        for a in missing:
-            if a.owner and a.owner != self.core.address:
-                status = self.core.io.run(self.core._fetch_from_owner(
-                    a.owner, a.object_id, None))
-                if status == "ok":
-                    continue
-                # "gone"/"unreachable": the object may still be sealed
-                # in plasma on a third node — fall to the directory wait
-            plasma_wait.append(a.object_id)
-        if plasma_wait:
-            self.core.io.run(self.core.raylet.call("wait_objects", {
-                "object_ids": plasma_wait, "num_returns": len(plasma_wait),
-                "timeout": None,
-            }))
+        if missing:
+            # dep wait: release the lease's CPU for the duration, or a
+            # gang of dep-blocked workers deadlocks the node (ref:
+            # NotifyDirectCallTaskBlocked)
+            self.core._notify_blocked()
+        try:
+            plasma_wait = []
+            for a in missing:
+                if a.owner and a.owner != self.core.address:
+                    status = self.core.io.run(self.core._fetch_from_owner(
+                        a.owner, a.object_id, None))
+                    if status == "ok":
+                        continue
+                    # "gone"/"unreachable": the object may still be
+                    # sealed in plasma on a third node — directory wait
+                plasma_wait.append(a.object_id)
+            if plasma_wait:
+                self.core.io.run(self.core.raylet.call("wait_objects", {
+                    "object_ids": plasma_wait,
+                    "num_returns": len(plasma_wait),
+                    "timeout": None,
+                }))
+        finally:
+            if missing:
+                self.core._notify_unblocked()
         for arg in spec.args:
             if arg.kind == ArgKind.VALUE:
                 kw, data = arg.value
@@ -231,7 +248,26 @@ class TaskExecutor:
     def _ensure_runtime_env(self, spec: TaskSpec) -> None:
         from .runtime_env import apply_runtime_env
 
+        self._apply_chip_visibility(spec)
         apply_runtime_env(self.core, spec.runtime_env, self._applied_env)
+
+    def _apply_chip_visibility(self, spec: TaskSpec) -> None:
+        """Export the lease's physical chip set before user code runs
+        (ref: accelerators/tpu.py:31 TPU_VISIBLE_CHIPS — here the ids
+        come from the raylet's per-lease chip accounting, so two
+        fractional-host leases on one machine see disjoint chips).
+        Effective for code that initializes jax after this point; the
+        pool worker itself stays CPU-pinned for the control plane."""
+        if spec.chip_ids is None:
+            # chipless task on a reused pool worker: stale visibility
+            # from a PREVIOUS lease must not leak (the chips may belong
+            # to someone else now)
+            os.environ.pop("TPU_VISIBLE_CHIPS", None)
+            os.environ.pop("RAY_TPU_CHIP_IDS", None)
+            return
+        ids = ",".join(str(i) for i in spec.chip_ids)
+        os.environ["TPU_VISIBLE_CHIPS"] = ids
+        os.environ["RAY_TPU_CHIP_IDS"] = ids
 
     def execute_normal(self, spec: TaskSpec) -> dict:
         try:
@@ -595,6 +631,16 @@ async def _amain():
                 rep.close_write()
             except Exception:
                 pass
+            if kind == "task":
+                # only this thread ever touched the rings: drop the
+                # mappings (the owner unlinks the files). Actor lanes
+                # skip this — in-flight calls may still push replies
+                # from actor threads; the mappings die with the process.
+                for ring in (sub, rep):
+                    try:
+                        ring.free()
+                    except Exception:
+                        pass
 
     async def handle_fastlane_attach(payload, conn):
         try:

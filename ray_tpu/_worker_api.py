@@ -232,6 +232,16 @@ def cancel(ref, *, force: bool = False) -> None:
     core().cancel(ref, force)
 
 
+def get_tpu_chip_ids() -> list:
+    """Physical TPU chips assigned to the current worker's lease (ref:
+    accelerators/tpu.py TPU_VISIBLE_CHIPS, promoted to first-class
+    per-lease scheduler state). Empty outside a TPU lease."""
+    import os
+
+    raw = os.environ.get("RAY_TPU_CHIP_IDS", "")
+    return [int(x) for x in raw.split(",") if x]
+
+
 def cluster_resources() -> Dict[str, float]:
     c = core()
     nodes = c.io.run(c.gcs.call("get_all_nodes", {}))

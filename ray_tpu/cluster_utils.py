@@ -35,6 +35,13 @@ class Cluster:
         self._connected = False
         if initialize_head:
             args = dict(head_node_args or {})
+            res = dict(args.pop("resources", None) or {})
+            if "num_cpus" in args:
+                res["CPU"] = float(args.pop("num_cpus"))
+            if "num_tpus" in args:
+                res["TPU"] = float(args.pop("num_tpus"))
+            if res:
+                args["resources"] = res
             if tcp:
                 args.setdefault("port", 0)
             self.head_node = Node(head=True, **args)

@@ -136,11 +136,12 @@ def prefill_sample(params, cache_k, cache_v, tokens, prompt_lens,
     return toks, cache_k, cache_v
 
 
-@partial(jax.jit, static_argnames=("cfg", "n_steps"),
+@partial(jax.jit, static_argnames=("cfg", "n_steps", "paged_kernel"),
          donate_argnames=("cache_k", "cache_v"))
 def decode_burst(params, cache_k, cache_v, tokens, positions,
                  block_tables, active, cos, sin, seed, temperature,
-                 top_k, top_p, *, cfg: LlamaConfig, n_steps: int):
+                 top_k, top_p, *, cfg: LlamaConfig, n_steps: int,
+                 paged_kernel: bool = None):
     """n_steps fused decode+sample steps, sampled tokens fed back
     ON-DEVICE (multi-step scheduling, vLLM's --num-scheduler-steps
     analog). One host round trip yields n_steps tokens per slot — the
@@ -161,6 +162,11 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
     """
     from .sampling import sample_from_logits
 
+    from .._private.config import global_config
+
+    # static jit arg (None -> config default) so flag flips retrace
+    use_paged_kernel = (global_config().llm_paged_kernel
+                        if paged_kernel is None else paged_kernel)
     B = tokens.shape[0]
     K = n_steps
     L = cfg.n_layers
@@ -168,11 +174,16 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
     rep = cfg.n_heads // cfg.n_kv_heads
     page_size = cache_k.shape[2]
     Sold = block_tables.shape[1] * page_size
-    # old context gathered ONCE per burst (read-only during the burst)
-    old_k = jnp.take(cache_k, block_tables, axis=1).reshape(
-        L, B, Sold, kvh, hd)
-    old_v = jnp.take(cache_v, block_tables, axis=1).reshape(
-        L, B, Sold, kvh, hd)
+    if use_paged_kernel:
+        # pages stream straight through the Pallas kernel per layer —
+        # no materialized [L, B, Sold] gather copy in HBM
+        old_k = old_v = jnp.zeros((L, 0), cache_k.dtype)
+    else:
+        # old context gathered ONCE per burst (read-only during burst)
+        old_k = jnp.take(cache_k, block_tables, axis=1).reshape(
+            L, B, Sold, kvh, hd)
+        old_v = jnp.take(cache_v, block_tables, axis=1).reshape(
+            L, B, Sold, kvh, hd)
     scratch_k = jnp.zeros((L, B, K, kvh, hd), cache_k.dtype)
     scratch_v = jnp.zeros((L, B, K, kvh, hd), cache_v.dtype)
     old_mask = jnp.arange(Sold)[None, :] < positions[:, None]  # [B, Sold]
@@ -182,6 +193,32 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
         pos_i = positions + i
         x = jnp.take(params["embed"], toks, axis=0)[:, None, :]
         new_mask = jnp.arange(K)[None, :] <= i                 # [1, K]
+
+        def attend_gathered(qg, ok, ov, nk, nv):
+            # bf16 operands straight onto the MXU, f32 accumulation
+            s_old = jnp.einsum("bgrd,bsgd->bgrs", qg, ok,
+                               preferred_element_type=jnp.float32)
+            s_new = jnp.einsum("bgrd,bkgd->bgrk", qg, nk,
+                               preferred_element_type=jnp.float32)
+            scale = hd ** -0.5
+            s_old = jnp.where(old_mask[:, None, None, :], s_old * scale,
+                              -jnp.inf)
+            s_new = jnp.where(new_mask[None, None, :, :], s_new * scale,
+                              -jnp.inf)
+            s_all = jnp.concatenate([s_old, s_new], axis=-1)
+            p_all = jax.nn.softmax(s_all, axis=-1).astype(ok.dtype)
+            return (jnp.einsum("bgrs,bsgd->bgrd", p_all[..., :Sold], ov,
+                               preferred_element_type=jnp.float32)
+                    + jnp.einsum("bgrk,bkgd->bgrd", p_all[..., Sold:], nv,
+                                 preferred_element_type=jnp.float32))
+
+        def attend_paged(qg, ck_l, cv_l, nk, nv):
+            from ..ops.paged_attention import paged_decode_attention
+
+            return paged_decode_attention(
+                qg, ck_l, cv_l, nk, nv, block_tables, positions,
+                jnp.full((B,), i + 1, jnp.int32),
+                page_size=page_size).astype(jnp.float32)
 
         def layer(x, inputs):
             lp, ok, ov, nk, nv = inputs
@@ -196,30 +233,19 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
             nv = jax.lax.dynamic_update_index_in_dim(
                 nv, v[:, 0].astype(nv.dtype), i, 1)
             qg = q.reshape(B, kvh, rep, hd)
-            # bf16 operands straight onto the MXU, f32 accumulation
-            s_old = jnp.einsum("bgrd,bsgd->bgrs", qg, ok,
-                               preferred_element_type=jnp.float32)
-            s_new = jnp.einsum("bgrd,bkgd->bgrk", qg, nk,
-                               preferred_element_type=jnp.float32)
-            scale = hd ** -0.5
-            s_old = jnp.where(old_mask[:, None, None, :], s_old * scale,
-                              -jnp.inf)
-            s_new = jnp.where(new_mask[None, None, :, :], s_new * scale,
-                              -jnp.inf)
-            s_all = jnp.concatenate([s_old, s_new], axis=-1)
-            p_all = jax.nn.softmax(s_all, axis=-1).astype(ok.dtype)
-            o = (jnp.einsum("bgrs,bsgd->bgrd", p_all[..., :Sold], ov,
-                            preferred_element_type=jnp.float32)
-                 + jnp.einsum("bgrk,bkgd->bgrd", p_all[..., Sold:], nv,
-                              preferred_element_type=jnp.float32))
+            if use_paged_kernel:
+                o = attend_paged(qg, ok, ov, nk, nv)
+            else:
+                o = attend_gathered(qg, ok, ov, nk, nv)
             o = o.reshape(B, 1, cfg.n_heads, hd).astype(x.dtype)
             x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
             h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
             x = x + _mlp(h, lp, cfg)
             return x, (nk, nv)
 
+        xs_kv = (cache_k, cache_v) if use_paged_kernel else (old_k, old_v)
         x, (sk, sv) = jax.lax.scan(
-            layer, x, (params["layers"], old_k, old_v, sk, sv))
+            layer, x, (params["layers"], *xs_kv, sk, sv))
         h = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
         logits = jnp.einsum("bd,dv->bv", h.astype(cfg.dtype),
                             params["lm_head"].astype(cfg.dtype),

@@ -1,0 +1,95 @@
+"""TPU-topology-first scheduling tests.
+
+Covers per-lease chip accounting/visibility (ref:
+python/ray/_private/accelerators/tpu.py:31 TPU_VISIBLE_CHIPS, promoted
+into the raylet scheduler as first-class per-lease state) and the
+slice-aware bundle policy (ref:
+raylet/scheduling/policy/bundle_scheduling_policy.h:82-106 +
+tpu.py:401-403 — spread TPU gangs map onto one ICI slice in host_index
+order)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_fractional_host_chip_isolation():
+    """Two {TPU:2} actors on a 4-chip host see disjoint chip pairs."""
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4})
+    try:
+        @ray_tpu.remote
+        class Holder:
+            def chips(self):
+                return ray_tpu.get_tpu_chip_ids()
+
+        a = Holder.options(num_tpus=2).remote()
+        b = Holder.options(num_tpus=2).remote()
+        chips_a = ray_tpu.get(a.chips.remote(), timeout=60)
+        chips_b = ray_tpu.get(b.chips.remote(), timeout=60)
+        assert len(chips_a) == 2 and len(chips_b) == 2
+        assert set(chips_a).isdisjoint(chips_b), (chips_a, chips_b)
+        assert set(chips_a) | set(chips_b) == {0, 1, 2, 3}
+        # releasing one lease frees its chips for a new lease
+        ray_tpu.kill(a)
+        time.sleep(0.5)
+        c = Holder.options(num_tpus=2).remote()
+        chips_c = ray_tpu.get(c.chips.remote(), timeout=60)
+        assert set(chips_c) == set(chips_a)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_fractional_chip_sharing():
+    """Two {TPU:0.5} leases share ONE chip (bin-packed), not two."""
+    ray_tpu.init(num_cpus=4, resources={"TPU": 2})
+    try:
+        @ray_tpu.remote
+        class Shard:
+            def chips(self):
+                return ray_tpu.get_tpu_chip_ids()
+
+        s1 = Shard.options(num_tpus=0.5).remote()
+        s2 = Shard.options(num_tpus=0.5).remote()
+        c1 = ray_tpu.get(s1.chips.remote(), timeout=60)
+        c2 = ray_tpu.get(s2.chips.remote(), timeout=60)
+        assert len(c1) == 1 and c1 == c2, (c1, c2)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_strict_spread_pg_maps_to_slice_host_order():
+    """A STRICT_SPREAD TPU gang lands on one slice, bundle k on the
+    slice's k-th host by host_index — regardless of node join order."""
+    from ray_tpu.util.placement_group import (placement_group,
+                                              placement_group_table)
+
+    cluster = Cluster(head_node_args={"num_cpus": 1}, connect=True)
+    try:
+        # join out of order: host 1 first, then host 0, plus a non-slice
+        # distractor node with plenty of TPU
+        n1 = cluster.add_node(num_cpus=2, num_tpus=4,
+                              labels={"slice_name": "v5p-16-a",
+                                      "host_index": "1"})
+        n0 = cluster.add_node(num_cpus=2, num_tpus=4,
+                              labels={"slice_name": "v5p-16-a",
+                                      "host_index": "0"})
+        loose = cluster.add_node(num_cpus=2, num_tpus=8)
+        deadline = time.time() + 30
+        while len(ray_tpu.nodes()) < 4 and time.time() < deadline:
+            time.sleep(0.1)
+        assert len(ray_tpu.nodes()) >= 4
+
+        pg = placement_group(
+            [{"TPU": 2, "CPU": 1}, {"TPU": 2, "CPU": 1}],
+            strategy="STRICT_SPREAD")
+        assert pg.wait(timeout_seconds=60)
+        placements = placement_group_table(pg)["bundle_nodes"]
+        assert placements[0] == n0.node_id.hex(), \
+            f"bundle 0 must land on host_index 0: {placements}"
+        assert placements[1] == n1.node_id.hex()
+        assert loose.node_id.hex() not in placements
+    finally:
+        cluster.shutdown()
