@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# CI entry point (ref: the reference's ci/ + root pytest.ini contract):
+#   1. native build must succeed from scratch (content-hash cache bypassed)
+#   2. full test suite on the virtual 8-device CPU mesh, per-test timeout
+#   3. multichip dry-run (the driver's own validation, run here too)
+# One wedged test cannot hang the round: tests/conftest.py arms a
+# per-test SIGALRM (RAY_TPU_TEST_TIMEOUT_S, default 180 s) and this
+# script bounds each phase with a hard wall clock.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== [1/3] native build =="
+rm -rf ray_tpu/_native/build
+python - <<'PY'
+from ray_tpu._native import get_lib, native_unavailable_reason
+assert get_lib() is not None, native_unavailable_reason()
+print("native lib built + loaded")
+PY
+
+echo "== [2/3] test suite =="
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+RAY_TPU_TEST_TIMEOUT_S="${RAY_TPU_TEST_TIMEOUT_S:-180}" \
+timeout "${CI_SUITE_TIMEOUT_S:-3000}" \
+    python -m pytest tests/ -q
+
+echo "== [3/3] multichip dry-run =="
+timeout "${CI_DRYRUN_TIMEOUT_S:-1200}" \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+echo "CI PASSED"

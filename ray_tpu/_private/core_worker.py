@@ -335,6 +335,19 @@ class CoreWorker:
         await self.gcs.close()
         await self.raylet.close()
 
+    # --------------------------------------------------- app-level pubsub
+    def subscribe_channel(self, channel: str, callback) -> None:
+        """Receive pushes on an application pubsub channel (the long-poll
+        replacement surface — ref: serve/_private/long_poll.py:66; here
+        pushes ride the standing GCS connection)."""
+        self.gcs.on_push("pubsub:" + channel, callback)
+        self.io.run(self.gcs.call("subscribe", {"channels": [channel]}),
+                    timeout=10)
+
+    def publish_channel(self, channel: str, message) -> None:
+        self.io.run(self.gcs.call("publish", {
+            "channel": channel, "message": message}), timeout=10)
+
     # ------------------------------------------------- blocked notification
     def _notify_blocked(self):
         """Worker mode: tell the raylet this worker's task is blocked on
@@ -547,7 +560,13 @@ class CoreWorker:
         if fast is not None:
             deadline = (None if timeout is None
                         else time.monotonic() + timeout)
-            waiting = any(ev is not None for _, ev in fast)
+            # notify only for a REAL wait: pre-set events / already-
+            # completed results must not cost two raylet RPCs
+            waiting = any(
+                ev is not None and not ev.is_set()
+                and not (self.memory_store.contains(oid)
+                         or self.store.contains(oid))
+                for oid, ev in fast)
             if waiting:
                 self._notify_blocked()
             try:
@@ -567,7 +586,9 @@ class CoreWorker:
                 if waiting:
                     self._notify_unblocked()
         owners = {r.id(): r.owner_address for r in refs if r.owner_address}
-        self._notify_blocked()  # worker dep-wait: give the CPU back
+        # fast==None means at least one object is neither local nor an
+        # in-flight lane return: a real wait — give the CPU back
+        self._notify_blocked()
         try:
             return self.io.run(
                 self._get(oids, timeout, owners),

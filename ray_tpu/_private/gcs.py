@@ -238,6 +238,15 @@ class GcsServer:
             self._subs.setdefault(channel, set()).add(conn)
         return True
 
+    async def handle_publish(self, payload, conn):
+        """Application-level pubsub fan-out (the reference's long-poll
+        broadcast role, ref: python/ray/serve/_private/long_poll.py:66
+        LongPollClient — here a plain push to every subscriber of the
+        channel; Serve uses it to push config versions to routers and
+        handles instead of having them poll)."""
+        await self._publish(payload["channel"], payload["message"])
+        return True
+
     async def _on_disconnect(self, conn):
         for subs in self._subs.values():
             subs.discard(conn)

@@ -27,7 +27,7 @@ import tarfile
 from typing import Any, Dict, List, Optional
 
 _KV_NS = "runtime_envs"
-_ALLOWED = {"env_vars", "working_dir", "py_modules", "config"}
+_ALLOWED = {"env_vars", "working_dir", "py_modules", "config", "pip", "uv"}
 
 
 def _pack_dir(path: str) -> bytes:
@@ -80,10 +80,75 @@ def prepare_runtime_env(core, runtime_env: Optional[dict]) -> Optional[dict]:
     for path in runtime_env.get("py_modules") or []:
         hasher.update(b"py_module:")
         wire.setdefault("py_module_keys", []).append(upload(path))
+    for installer in ("pip", "uv"):
+        reqs = runtime_env.get(installer)
+        if not reqs:
+            continue
+        if isinstance(reqs, dict):  # {"packages": [...]} long form
+            reqs = reqs.get("packages") or []
+        if not isinstance(reqs, (list, tuple)) or not all(
+                isinstance(r, str) for r in reqs):
+            raise TypeError(f"{installer} must be a list of requirement "
+                            "strings")
+        wire[installer] = sorted(reqs)
+        hasher.update(f"{installer}:{wire[installer]!r}".encode())
     if not wire:
         return None
     wire["hash"] = hasher.hexdigest()[:16]
     return wire
+
+
+def _materialize_venv(requirements: List[str], installer: str) -> str:
+    """Build (or reuse) a virtualenv holding the requirements; returns
+    its site-packages path (ref: _private/runtime_env/{pip,uv}.py — the
+    per-env venv with a URI cache keyed on the requirement set). The
+    worker adopts it by sys.path prepend: pure-python deps resolve from
+    the venv, everything else falls through to the base environment
+    (``--system-site-packages``)."""
+    import subprocess
+
+    key = hashlib.sha256(
+        f"{installer}:{requirements!r}:{sys.version_info[:2]}".encode()
+    ).hexdigest()[:16]
+    root = os.path.join("/tmp/ray_tpu_runtime_envs", f"venv_{key}")
+    marker = os.path.join(root, ".ready")
+    site = os.path.join(
+        root, "lib", f"python{sys.version_info[0]}.{sys.version_info[1]}",
+        "site-packages")
+    if os.path.exists(marker):
+        return site
+    tmp = root + f".tmp.{os.getpid()}"
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    uv = shutil.which("uv") if installer == "uv" else None
+    if uv:
+        subprocess.run([uv, "venv", "--system-site-packages", tmp],
+                       check=True, capture_output=True, timeout=300)
+        install = [uv, "pip", "install", "--python",
+                   os.path.join(tmp, "bin", "python")] + list(requirements)
+    else:
+        subprocess.run([sys.executable, "-m", "venv",
+                        "--system-site-packages", tmp],
+                       check=True, capture_output=True, timeout=300)
+        # --no-build-isolation: sdists build against the venv's visible
+        # setuptools (system-site) instead of pip fetching a build env
+        # from an index — keeps air-gapped clusters working
+        install = [os.path.join(tmp, "bin", "python"), "-m", "pip",
+                   "install", "--no-input", "--no-build-isolation"] \
+            + list(requirements)
+    proc = subprocess.run(install, capture_output=True, timeout=1800)
+    if proc.returncode != 0:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeError(
+            f"runtime_env {installer} install failed: "
+            f"{proc.stderr.decode(errors='replace')[-2000:]}")
+    open(os.path.join(tmp, ".ready"), "w").close()
+    try:
+        os.rename(tmp, root)  # atomic; concurrent builder loses cleanly
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return site
 
 
 def apply_runtime_env(core, wire: Optional[dict],
@@ -119,6 +184,12 @@ def apply_runtime_env(core, wire: Optional[dict],
 
     for key, value in (wire.get("env_vars") or {}).items():
         os.environ[key] = value
+    for installer in ("pip", "uv"):
+        reqs = wire.get(installer)
+        if reqs:
+            site = _materialize_venv(reqs, installer)
+            if site not in sys.path:
+                sys.path.insert(0, site)
     for key in wire.get("py_module_keys") or []:
         path = materialize(key)
         if path not in sys.path:

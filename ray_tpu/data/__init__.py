@@ -43,9 +43,13 @@ def from_items(items: List[Any], *,
 
 
 def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 output_format: str = "numpy",
                  parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
-    return read_datasource(ParquetDatasource(paths, columns),
-                           parallelism=parallelism)
+    """``output_format="arrow"`` keeps blocks as pyarrow Tables end to
+    end (zero-copy slicing/batching; ref: _internal/arrow_block.py)."""
+    return read_datasource(
+        ParquetDatasource(paths, columns, output_format=output_format),
+        parallelism=parallelism)
 
 
 def read_json(paths, *, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
@@ -61,9 +65,30 @@ def read_csv(paths, *, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
     return read_datasource(CSVDatasource(paths), parallelism=parallelism)
 
 
+def read_tfrecords(paths, *, raw: bool = False,
+                   parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """TFRecord files; tf.train.Example records parse natively (no
+    tensorflow import — see TFRecordsDatasource). ``raw=True`` yields
+    undecoded record bytes."""
+    from .datasource import TFRecordsDatasource
+
+    return read_datasource(TFRecordsDatasource(paths, raw=raw),
+                           parallelism=parallelism)
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """Image files as {"image": HWC uint8, "path"} blocks; ``size``
+    resizes at read time (ref: _internal/datasource/image_datasource.py)."""
+    from .datasource import ImageDatasource
+
+    return read_datasource(ImageDatasource(paths, size=size, mode=mode),
+                           parallelism=parallelism)
+
+
 __all__ = [
     "Block", "Dataset", "DataIterator", "Datasource", "ReadTask",
     "GroupedData",
     "read_datasource", "range", "from_items", "read_parquet", "read_json",
-    "read_numpy", "read_csv",
+    "read_numpy", "read_csv", "read_tfrecords", "read_images",
 ]

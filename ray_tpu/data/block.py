@@ -13,14 +13,54 @@ from typing import Any, Dict, Iterator, List, Optional, Union
 
 import numpy as np
 
-Block = Union[Dict[str, np.ndarray], List[Any]]
+# a block is a dict of numpy columns (canonical), a pyarrow.Table
+# (Arrow-backed columnar — zero-copy from parquet/ipc; ref:
+# _internal/arrow_block.py), or a plain list of rows
+Block = Union[Dict[str, np.ndarray], "pa.Table", List[Any]]
+
+
+def is_arrow(block: Block) -> bool:
+    try:
+        import pyarrow as pa
+    except ImportError:  # pragma: no cover
+        return False
+    return isinstance(block, pa.Table)
 
 
 def is_columnar(block: Block) -> bool:
-    return isinstance(block, dict)
+    return isinstance(block, dict) or is_arrow(block)
+
+
+def arrow_to_numpy(block: Block) -> Dict[str, np.ndarray]:
+    """Arrow table -> dict-of-numpy (copy only when the layout demands,
+    e.g. strings/nested; numeric columns convert zero-copy when
+    contiguous)."""
+    if not is_arrow(block):
+        return block
+    out = {}
+    for name in block.schema.names:
+        col = block.column(name)
+        try:
+            out[name] = col.to_numpy(zero_copy_only=False)
+        except Exception:
+            out[name] = np.asarray(col.to_pylist(), dtype=object)
+    return out
+
+
+def numpy_to_arrow(block: Block):
+    """Dict-of-numpy -> Arrow table (for batch_format="pyarrow")."""
+    import pyarrow as pa
+
+    if is_arrow(block):
+        return block
+    if not isinstance(block, dict):
+        raise ValueError("arrow conversion requires a columnar block")
+    return pa.table({k: pa.array(np.asarray(v)) for k, v in block.items()})
 
 
 def block_num_rows(block: Block) -> int:
+    if is_arrow(block):
+        return block.num_rows
     if is_columnar(block):
         if not block:
             return 0
@@ -29,12 +69,16 @@ def block_num_rows(block: Block) -> int:
 
 
 def block_size_bytes(block: Block) -> int:
+    if is_arrow(block):
+        return int(block.nbytes)
     if is_columnar(block):
         return int(sum(np.asarray(v).nbytes for v in block.values()))
     return int(sum(getattr(x, "nbytes", 64) for x in block))
 
 
 def slice_block(block: Block, start: int, end: int) -> Block:
+    if is_arrow(block):
+        return block.slice(start, end - start)  # zero-copy view
     if is_columnar(block):
         return {k: v[start:end] for k, v in block.items()}
     return block[start:end]
@@ -44,6 +88,14 @@ def concat_blocks(blocks: List[Block]) -> Block:
     blocks = [b for b in blocks if block_num_rows(b) > 0]
     if not blocks:
         return []
+    if is_arrow(blocks[0]):
+        import pyarrow as pa
+
+        if all(is_arrow(b) for b in blocks):
+            return pa.concat_tables(blocks)  # zero-copy chunked concat
+        blocks = [arrow_to_numpy(b) for b in blocks]
+    elif any(is_arrow(b) for b in blocks):
+        blocks = [arrow_to_numpy(b) for b in blocks]
     if is_columnar(blocks[0]):
         keys = blocks[0].keys()
         out = {}
@@ -108,6 +160,9 @@ def iter_batches(blocks: Iterator[Block], batch_size: Optional[int],
 
 
 def block_schema(block: Block) -> Optional[dict]:
+    if is_arrow(block):
+        return {name: str(block.schema.field(name).type)
+                for name in block.schema.names}
     if is_columnar(block):
         return {k: str(np.asarray(v).dtype) for k, v in block.items()}
     if block:
@@ -116,6 +171,9 @@ def block_schema(block: Block) -> Optional[dict]:
 
 
 def rows_of(block: Block) -> Iterator[Any]:
+    if is_arrow(block):
+        yield from block.to_pylist()
+        return
     if is_columnar(block):
         keys = list(block.keys())
         for i in range(block_num_rows(block)):
@@ -138,6 +196,8 @@ def _np_column(values: List[Any]) -> np.ndarray:
 
 def to_columnar(block: Block) -> Dict[str, np.ndarray]:
     """Best-effort conversion of a simple block to columnar form."""
+    if is_arrow(block):
+        return arrow_to_numpy(block)
     if is_columnar(block):
         return block
     if block and isinstance(block[0], dict):

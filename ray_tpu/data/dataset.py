@@ -70,6 +70,11 @@ class Dataset:
             for batch in _rebatch(iter([block]), batch_size):
                 if batch_format == "numpy":
                     batch = to_columnar(batch)
+                elif batch_format == "pyarrow":
+                    from .block import is_arrow, numpy_to_arrow
+
+                    if not is_arrow(batch):  # arrow in: zero-copy pass
+                        batch = numpy_to_arrow(to_columnar(batch))
                 out = fn(batch)
                 outs.append(out)
             return concat_blocks(outs)
@@ -106,8 +111,10 @@ class Dataset:
         def block_fn(block):
             import numpy as np
 
-            from .block import is_columnar
+            from .block import is_arrow, is_columnar
 
+            if is_arrow(block):
+                block = to_columnar(block)
             if is_columnar(block):
                 # boolean-mask the columns: schema and dtypes survive even
                 # when no rows do
@@ -118,6 +125,30 @@ class Dataset:
 
         return self._append(_LogicalOp(
             "map_block", "filter", {"block_fn": block_fn}, remote_args))
+
+    def select_columns(self, cols) -> "Dataset":
+        """Keep only the named columns (ref: dataset.py select_columns).
+        Recorded as its own logical op so the planner can push the
+        projection into column-aware reads (parquet never materializes
+        dropped columns — see executor._pushdown_projection)."""
+        cols = list(cols)
+
+        def block_fn(block):
+            from .block import is_arrow, is_columnar
+
+            if is_arrow(block):
+                return block.select(cols)  # zero-copy projection
+            if not is_columnar(block):
+                raise ValueError("select_columns requires columnar blocks")
+            missing = [c for c in cols if c not in block]
+            if missing:
+                raise KeyError(f"columns not in block: {missing}")
+            return {c: block[c] for c in cols}
+
+        return self._append(_LogicalOp(
+            "map_block", f"select_columns[{','.join(cols)}]",
+            {"block_fn": block_fn, "columns": cols},
+            {"num_cpus": 1}))
 
     def limit(self, n: int) -> "Dataset":
         return self._append(_LogicalOp("limit", f"limit({n})", {"n": n},

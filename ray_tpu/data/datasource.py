@@ -106,18 +106,26 @@ class ParquetDatasource(Datasource):
     splitting)."""
 
     def __init__(self, paths, columns: Optional[List[str]] = None,
-                 batch_rows: int = 32768):
+                 batch_rows: int = 32768, output_format: str = "numpy"):
         self.files = _expand_paths(paths, (".parquet",))
         self.columns = columns
         self.batch_rows = batch_rows
+        self.output_format = output_format  # "numpy" | "arrow"
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         tasks = []
         for path in self.files:
-            def _read(path=path, columns=self.columns, rows=self.batch_rows):
+            def _read(path=path, columns=self.columns, rows=self.batch_rows,
+                      fmt=self.output_format):
                 import pyarrow.parquet as pq
 
                 table = pq.read_table(path, columns=columns)
+                if fmt == "arrow":
+                    # Arrow-backed blocks end to end: slicing/batching
+                    # stays zero-copy (ref: _internal/arrow_block.py)
+                    for i in range(0, max(table.num_rows, 1), rows):
+                        yield table.slice(i, rows)
+                    return
                 for batch in table.to_batches(max_chunksize=rows):
                     yield {name: batch.column(i).to_numpy(zero_copy_only=False)
                            for i, name in enumerate(batch.schema.names)}
@@ -201,6 +209,191 @@ class NumpyDatasource(Datasource):
         for path in self.files:
             def _read(path=path):
                 yield {"data": np.load(path)}
+
+            tasks.append(ReadTask(_read))
+        return tasks
+
+
+class TFRecordsDatasource(Datasource):
+    """read_tfrecords: TFRecord container framing + a native
+    tf.train.Example wire-format parser — no tensorflow dependency
+    (ref: _internal/datasource/tfrecords_datasource.py, which needs
+    tf; the proto wire format is stable and tiny, so we parse it
+    directly). Emits one columnar block per file: bytes features ->
+    object arrays, int64/float lists -> numpy columns (scalar lists
+    are flattened)."""
+
+    def __init__(self, paths, raw: bool = False):
+        self.files = _expand_paths(paths, (".tfrecord", ".tfrecords"))
+        self.raw = raw  # True: yield {"data": [record bytes...]} only
+
+    @staticmethod
+    def _iter_records(path):
+        import struct as _struct
+
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if len(header) < 8:
+                    return
+                (length,) = _struct.unpack("<Q", header)
+                f.read(4)  # length crc (unchecked, like most readers)
+                data = f.read(length)
+                if len(data) < length:
+                    return
+                f.read(4)  # data crc
+                yield data
+
+    @staticmethod
+    def _parse_example(buf: bytes):
+        """Minimal protobuf wire parser for tf.train.Example:
+        Example{1: Features{1: map<string, Feature>}},
+        Feature{1: BytesList, 2: FloatList, 3: Int64List}."""
+        import struct as _struct
+
+        def varint(b, i):
+            out = shift = 0
+            while True:
+                x = b[i]
+                i += 1
+                out |= (x & 0x7F) << shift
+                if not x & 0x80:
+                    return out, i
+                shift += 7
+
+        def fields(b):
+            i = 0
+            while i < len(b):
+                key, i = varint(b, i)
+                fno, wt = key >> 3, key & 7
+                if wt == 2:
+                    ln, i = varint(b, i)
+                    yield fno, b[i:i + ln]
+                    i += ln
+                elif wt == 0:
+                    v, i = varint(b, i)
+                    yield fno, v
+                elif wt == 5:
+                    yield fno, b[i:i + 4]
+                    i += 4
+                elif wt == 1:
+                    yield fno, b[i:i + 8]
+                    i += 8
+                else:
+                    raise ValueError(f"unsupported wire type {wt}")
+
+        out = {}
+        for fno, features in fields(buf):          # Example.features
+            if fno != 1:
+                continue
+            for fno2, entry in fields(features):   # Features.feature map
+                if fno2 != 1:
+                    continue
+                name, feature = None, None
+                for k, v in fields(entry):         # map entry {1:key 2:val}
+                    if k == 1:
+                        name = v.decode()
+                    elif k == 2:
+                        feature = v
+                if name is None or feature is None:
+                    continue
+                for k, payload in fields(feature):  # Feature oneof
+                    vals: List[Any]
+                    if k == 1:      # BytesList{1: repeated bytes}
+                        vals = [v for f2, v in fields(payload) if f2 == 1]
+                    elif k == 2:    # FloatList{1: repeated float}
+                        # packed (one wt-2 blob) and unpacked (wt-5
+                        # 4-byte chunks) both surface as bytes: concat
+                        blob = b"".join(
+                            v for f2, v in fields(payload)
+                            if f2 == 1 and isinstance(v, bytes))
+                        vals = [float(x) for x in
+                                np.frombuffer(blob, dtype="<f4")]
+                    elif k == 3:    # Int64List{1: repeated int64 (packed)}
+                        packed = [v for f2, v in fields(payload) if f2 == 1]
+                        if packed and isinstance(packed[0], bytes):
+                            ints = []
+                            for blob in packed:
+                                j = 0
+                                while j < len(blob):
+                                    val, j = varint(blob, j)
+                                    ints.append(val)
+                        else:
+                            ints = packed
+                        # two's-complement: proto int64 varints are the
+                        # unsigned 64-bit image of the signed value
+                        vals = [v - (1 << 64) if v >= 1 << 63 else v
+                                for v in ints]
+                    else:
+                        continue
+                    out[name] = vals
+        return out
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.files:
+            def _read(path=path, raw=self.raw):
+                records = list(TFRecordsDatasource._iter_records(path))
+                if raw:
+                    yield {"data": np.asarray(records, dtype=object)}
+                    return
+                parsed_rows = [TFRecordsDatasource._parse_example(rec)
+                               for rec in records]
+                keys = []
+                for row in parsed_rows:
+                    for k in row:
+                        if k not in keys:
+                            keys.append(k)
+                # columns stay ROW-ALIGNED: a record missing a feature
+                # contributes None at its row index (never a silent
+                # shift pairing values with the wrong record)
+                cols: Dict[str, list] = {k: [] for k in keys}
+                for row in parsed_rows:
+                    for k in keys:
+                        vals = row.get(k)
+                        if vals is None:
+                            cols[k].append(None)
+                        else:
+                            cols[k].append(
+                                vals[0] if len(vals) == 1 else vals)
+                out = {}
+                for k, v in cols.items():
+                    try:
+                        out[k] = np.asarray(v)
+                    except Exception:
+                        out[k] = np.asarray(v, dtype=object)
+                yield out
+
+            tasks.append(ReadTask(_read))
+        return tasks
+
+
+class ImageDatasource(Datasource):
+    """read_images: one task per file; blocks carry {"image": HWC uint8,
+    "path": str} (ref: _internal/datasource/image_datasource.py, PIL-
+    backed). ``size=(H, W)`` resizes at read time so downstream blocks
+    are uniform and stackable."""
+
+    EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def __init__(self, paths, size: Optional[tuple] = None,
+                 mode: str = "RGB"):
+        self.files = _expand_paths(paths, self.EXTS)
+        self.size = size
+        self.mode = mode
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.files:
+            def _read(path=path, size=self.size, mode=self.mode):
+                from PIL import Image
+
+                img = Image.open(path).convert(mode)
+                if size is not None:
+                    img = img.resize((size[1], size[0]))
+                arr = np.asarray(img)
+                yield {"image": arr[None, ...],
+                       "path": np.asarray([path])}
 
             tasks.append(ReadTask(_read))
         return tasks

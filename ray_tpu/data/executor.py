@@ -447,10 +447,43 @@ def _fuse_map_ops(plan):
     return fused
 
 
+def _pushdown_projection(plan):
+    """Logical optimization: a select_columns immediately after a
+    column-aware read moves INTO the read (ref: _internal/logical/
+    optimizers.py projection pushdown) — parquet then never
+    materializes the dropped columns at all. The plan visibly loses the
+    select op (asserted by tests/test_data_optimizer.py)."""
+    if len(plan) < 2 or plan[0].kind != "read":
+        return plan
+    op = plan[1]
+    cols = op.args.get("columns") if op.kind == "map_block" else None
+    src = plan[0].args.get("datasource")
+    if cols is None or not hasattr(src, "columns"):
+        return plan
+    import copy
+
+    new_src = copy.copy(src)
+    new_src.columns = (list(cols) if new_src.columns is None
+                      else [c for c in new_src.columns if c in cols])
+    read = type(plan[0])(plan[0].kind,
+                         plan[0].name + f"[cols={','.join(cols)}]",
+                         dict(plan[0].args, datasource=new_src),
+                         plan[0].remote_args)
+    return [read] + plan[2:]
+
+
+def optimize_plan(plan):
+    """All logical-plan rewrites, in order (the reference's logical
+    optimizer chain, ref: _internal/logical/optimizers.py): projection
+    pushdown into reads, then adjacent-map fusion."""
+    plan = _pushdown_projection(plan)
+    return _fuse_map_ops(plan)
+
+
 def build_executor(plan, parallelism: int) -> StreamingExecutor:
     """Logical plan → stage chain (the planner role, ref:
     _internal/planner/)."""
-    plan = _fuse_map_ops(plan)
+    plan = optimize_plan(plan)
     stages: List[_Stage] = []
     q: "queue.Queue" = queue.Queue(maxsize=STAGE_QUEUE_CAP)
     first = plan[0]

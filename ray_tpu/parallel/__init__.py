@@ -6,6 +6,9 @@ Replaces the reference's NCCL/GLOO collective stack
 meshes + named shardings + shard_map, compiled by XLA.
 """
 
+from .multislice import (DCN_AXIS, MULTISLICE_RULES, build_multislice_mesh,
+                         group_devices_by_slice, multislice_rules,
+                         two_level_pmean, two_level_psum)
 from .mesh import (
     MeshSpec,
     build_mesh,
@@ -33,6 +36,9 @@ from .collectives import (
 )
 
 __all__ = [
+    "DCN_AXIS", "MULTISLICE_RULES", "build_multislice_mesh",
+    "group_devices_by_slice", "multislice_rules", "two_level_pmean",
+    "two_level_psum",
     "pipeline_apply", "split_stages",
     "MeshSpec", "build_mesh", "local_mesh", "slice_topology",
     "LogicalAxisRules", "DEFAULT_RULES", "logical_sharding", "shard_pytree",

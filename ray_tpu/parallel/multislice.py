@@ -1,0 +1,123 @@
+"""Multi-slice execution: two-level collectives (ICI within a slice,
+DCN across slices) and slice-per-stage pipelining.
+
+A TPU pod slice is an ICI domain; multiple slices connect only over the
+data-center network. The reference has no notion of this (its collectives
+are NCCL within one job — SURVEY §5.8 calls the two-level mapping out as
+a required TPU-native capability). Here the cross-slice boundary is a
+first-class mesh axis named ``dcn``:
+
+  * ``build_multislice_mesh`` builds a mesh whose OUTERMOST axis spans
+    slices — so any sharding that keeps ``dcn`` coarse (data-parallel
+    replicas, pipeline stages) sends only small/infrequent traffic over
+    DCN while tp/fsdp/sp collectives stay inside a slice's ICI.
+  * ``MULTISLICE_RULES`` extends the logical-axis table: "batch" shards
+    over ("dcn", "dp", "fsdp") — each slice computes its local grads
+    entirely over ICI and only the cross-slice grad mean crosses DCN
+    (GSPMD emits exactly that hierarchical reduction for this layout).
+  * ``two_level_psum`` is the explicit shard_map form: reduce inside
+    the slice first, then reduce the per-slice partials across ``dcn``
+    — the pre-reduction is what keeps DCN traffic at 1/devices-per-
+    slice of the naive all-reduce.
+  * slice-per-stage pipelining = ``pipeline_apply`` over a mesh whose
+    ``pp`` axis is the slice axis: each stage's weights and compute
+    live inside one slice; only microbatch activations hop DCN
+    (ref: SURVEY §7.4 "multi-slice / multi-pod: slice = stage").
+
+On real hardware, slice membership comes from ``jax.devices()``'s
+``slice_index``; tests and the driver's dry-run emulate S slices by
+chunking the virtual CPU device list (the collective structure — which
+axis a reduction runs over — is identical; only link speeds differ).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .sharding import DEFAULT_RULES, LogicalAxisRules
+
+DCN_AXIS = "dcn"
+
+
+def group_devices_by_slice(devices: Optional[Sequence] = None
+                           ) -> List[List]:
+    """Devices grouped by their physical slice (ICI domain).
+
+    Real TPU backends expose ``device.slice_index``; hosts without it
+    (CPU emulation, single slice) collapse to one group. Order is by
+    slice index, devices in id order within a slice."""
+    devices = list(devices if devices is not None else jax.devices())
+    groups: Dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    return [sorted(g, key=lambda d: d.id)
+            for _, g in sorted(groups.items())]
+
+
+def build_multislice_mesh(axes: Dict[str, int],
+                          n_slices: Optional[int] = None,
+                          devices: Optional[Sequence] = None,
+                          dcn_axis_name: str = DCN_AXIS) -> Mesh:
+    """Mesh with a leading cross-slice axis (named ``dcn`` by default).
+
+    ``axes``: intra-slice axis sizes (e.g. {"dp": 2, "tp": 2}); their
+    product must equal the per-slice device count. ``n_slices`` forces
+    emulated slicing by chunking the device list (tests / dry-run);
+    by default physical slice grouping is used. ``dcn_axis_name="pp"``
+    builds the slice-per-stage pipeline layout: each pipeline stage's
+    weights and compute live inside one slice, and only microbatch
+    activations hop the DCN (SURVEY §7.4)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_slices is None:
+        groups = group_devices_by_slice(devices)
+    else:
+        per = len(devices) // n_slices
+        assert per * n_slices == len(devices), (
+            f"{len(devices)} devices do not split into {n_slices} slices")
+        groups = [devices[i * per:(i + 1) * per] for i in range(n_slices)]
+    per_slice = len(groups[0])
+    sizes = [max(1, int(v)) for v in axes.values()]
+    assert int(np.prod(sizes)) == per_slice, (
+        f"intra-slice axes {axes} do not fill a {per_slice}-device slice")
+    arr = np.array([d for g in groups for d in g], dtype=object).reshape(
+        len(groups), *sizes)
+    return Mesh(arr, (dcn_axis_name, *axes.keys()))
+
+
+def multislice_rules(base: LogicalAxisRules = DEFAULT_RULES
+                     ) -> LogicalAxisRules:
+    """Logical-axis rules for a dcn-leading mesh: the batch dim gains
+    the cross-slice axis (each slice is a data-parallel super-replica);
+    parameter/sequence/expert axes stay intra-slice so their collectives
+    never touch DCN."""
+    out = []
+    for name, axes in base:
+        if name == "batch":
+            flat = (axes,) if isinstance(axes, str) else tuple(axes or ())
+            out.append((name, (DCN_AXIS, *flat)))
+        else:
+            out.append((name, axes))
+    return tuple(out)
+
+
+MULTISLICE_RULES = multislice_rules()
+
+
+def two_level_psum(x, intra_axis, dcn_axis: str = DCN_AXIS):
+    """Hierarchical all-reduce for explicit shard_map code: reduce over
+    the slice's ICI axis first, then reduce the per-slice partials over
+    DCN. Semantically ``psum(x, (intra, dcn))``; structurally the DCN
+    phase sees already-reduced values — its traffic is divided by the
+    slice size (the "How to Scale Your Model" two-level recipe)."""
+    partial = jax.lax.psum(x, intra_axis)
+    return jax.lax.psum(partial, dcn_axis)
+
+
+def two_level_pmean(x, intra_axis, dcn_axis: str = DCN_AXIS):
+    intra = jax.lax.pmean(x, intra_axis)
+    return jax.lax.pmean(intra, dcn_axis)

@@ -84,9 +84,18 @@ def _get_or_create_controller():
         ).remote()
 
 
-def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
+def run(app: Application, *, name: Optional[str] = None,
+        local_testing_mode: bool = False) -> DeploymentHandle:
     """Deploy (or update) an application; returns its handle
-    (ref: serve.run → controller.deploy_applications)."""
+    (ref: serve.run → controller.deploy_applications).
+
+    ``local_testing_mode=True`` runs the whole application in-process —
+    no cluster, no actors (ref: serve/_private/local_testing_mode.py);
+    see ray_tpu/serve/local_testing.py."""
+    if local_testing_mode:
+        from .local_testing import run_local
+
+        return run_local(app)  # type: ignore[return-value]
     import ray_tpu
 
     dep = app.deployment

@@ -69,6 +69,7 @@ class ServeController:
             dep["config"] = config
             self._version += 1
             await self._reconcile_deployment(dep)
+            self._publish_version()
         self._ensure_reconcile_loop()
         return self._version
 
@@ -80,6 +81,7 @@ class ServeController:
             for replica, _ in dep["replicas"]:
                 await self._stop_replica(replica)
             self._version += 1
+            self._publish_version()
             return True
 
     async def _make_replica(self, dep: dict):
@@ -205,6 +207,19 @@ class ServeController:
             changed = True
         if changed:
             self._version += 1
+            self._publish_version()
+
+    def _publish_version(self) -> None:
+        """Push the new config version to every router/handle over GCS
+        pubsub (the long-poll push, ref: serve/_private/long_poll.py:66
+        LongPollHost) — subscribed handles skip their poll entirely and
+        re-pull the replica set only when this lands."""
+        try:
+            from .._worker_api import core
+
+            core().publish_channel("serve", {"version": self._version})
+        except Exception:
+            pass  # pushes are an optimization; handles still fall back
 
     def _ensure_reconcile_loop(self) -> None:
         if self._reconcile_task is None or self._reconcile_task.done():

@@ -14,6 +14,39 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+# Config-push state (ref: serve/_private/long_poll.py:66 LongPollClient):
+# the controller publishes its version on the "serve" GCS pubsub channel;
+# every handle in this process shares one subscription. While the pushed
+# version equals a handle's snapshot, the poll is skipped entirely —
+# config changes propagate push-driven, not poll-driven.
+_push_lock = threading.Lock()
+_push_state: Dict[str, Any] = {"core": None, "version": None}
+
+
+def _pushed_version():
+    return _push_state["version"]
+
+
+def _ensure_push_subscription() -> bool:
+    from .._worker_api import _core
+
+    core = _core
+    if core is None:
+        return False
+    with _push_lock:
+        if _push_state["core"] is core:
+            return True
+        try:
+            def _on_serve_push(msg, _state=_push_state):
+                _state["version"] = msg.get("version")
+
+            core.subscribe_channel("serve", _on_serve_push)
+            _push_state["core"] = core
+            _push_state["version"] = None
+            return True
+        except Exception:
+            return False
+
 
 class DeploymentHandle:
     """Callable handle to a deployment; picklable (it re-resolves the
@@ -46,9 +79,22 @@ class DeploymentHandle:
         from .. import get
 
         now = time.monotonic()
+        pushed = _pushed_version() if _ensure_push_subscription() else None
         with self._lock:
-            if not force and self._replicas and now - self._last_refresh < 2.0:
-                return
+            if not force and self._replicas:
+                if pushed is not None:
+                    # monotonic versions: an OLD push (raced behind our
+                    # fetch) must not force an RPC per request
+                    if (pushed <= self._version
+                            and now - self._last_refresh < 30.0):
+                        # push says current: zero steady-state polling.
+                        # The 30 s staleness bound is the liveness net
+                        # for a silently dead subscription (e.g. a GCS
+                        # reconnect dropped it server-side).
+                        return
+                    # version moved: re-pull immediately (no 2 s wait)
+                elif now - self._last_refresh < 2.0:
+                    return
         version, replicas = get(
             self._controller().get_replicas.remote(self._name), timeout=30)
         if replicas is None:
@@ -59,6 +105,13 @@ class DeploymentHandle:
             self._last_refresh = now
             self._ongoing = {r._actor_id: self._ongoing.get(r._actor_id, 0)
                              for r in replicas}
+        # prime the push state from this fetch: we subscribed BEFORE the
+        # RPC, so any later change still lands as a push — from here the
+        # handle routes with zero polling until the version moves
+        with _push_lock:
+            if (_push_state["core"] is not None
+                    and _push_state["version"] is None):
+                _push_state["version"] = version
 
     def _pick(self):
         """Power-of-two-choices on local in-flight counts."""

@@ -1,0 +1,221 @@
+"""Arrow blocks, the logical-plan optimizer (projection pushdown +
+fusion), and the tfrecords/images datasources (VERDICT next #9; ref:
+_internal/arrow_block.py, _internal/logical/, _internal/datasource/)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ arrow blocks
+
+def _write_parquet(tmp_path, n=100):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "x": np.arange(n, dtype=np.int64),
+        "y": np.arange(n, dtype=np.float64) * 0.5,
+        "tag": [f"r{i}" for i in range(n)],
+    }), path)
+    return path
+
+
+def test_arrow_block_helpers():
+    import pyarrow as pa
+
+    from ray_tpu.data.block import (arrow_to_numpy, block_num_rows,
+                                    block_schema, concat_blocks, is_arrow,
+                                    is_columnar, numpy_to_arrow,
+                                    slice_block)
+
+    t = pa.table({"a": np.arange(10), "b": np.arange(10) * 2.0})
+    assert is_arrow(t) and is_columnar(t)
+    assert block_num_rows(t) == 10
+    part = slice_block(t, 2, 5)
+    assert is_arrow(part) and block_num_rows(part) == 3
+    both = concat_blocks([part, slice_block(t, 5, 7)])
+    assert is_arrow(both) and block_num_rows(both) == 5
+    nd = arrow_to_numpy(both)
+    np.testing.assert_array_equal(nd["a"], [2, 3, 4, 5, 6])
+    back = numpy_to_arrow(nd)
+    assert is_arrow(back)
+    assert "a" in block_schema(t)
+
+
+def test_read_parquet_arrow_end_to_end(cluster, tmp_path):
+    import pyarrow as pa
+
+    path = _write_parquet(tmp_path)
+    ds = rd.read_parquet(path, output_format="arrow")
+
+    def double_x(batch):  # arrives as a pyarrow Table
+        assert isinstance(batch, pa.Table)
+        return {"x2": batch.column("x").to_numpy() * 2}
+
+    out = ds.map_batches(double_x, batch_format="pyarrow",
+                         batch_size=32).take_all()
+    xs = sorted(int(r["x2"]) for r in out)
+    assert xs == [2 * i for i in range(100)]
+
+
+def test_parquet_roundtrip_preserved(cluster, tmp_path):
+    path = _write_parquet(tmp_path, n=50)
+    rows = rd.read_parquet(path).take_all()
+    assert len(rows) == 50
+    assert sorted(int(r["x"]) for r in rows) == list(range(50))
+
+
+# --------------------------------------------------------------- optimizer
+
+def test_projection_pushdown_into_parquet(tmp_path):
+    from ray_tpu.data.executor import optimize_plan
+
+    path = _write_parquet(tmp_path)
+    ds = rd.read_parquet(path).select_columns(["x"])
+    plan = optimize_plan(ds._plan)
+    # the select op disappeared INTO the read
+    assert len(plan) == 1 and plan[0].kind == "read"
+    assert "cols=x" in plan[0].name
+    assert plan[0].args["datasource"].columns == ["x"]
+    # and the original dataset's plan is untouched (pure rewrite)
+    assert ds._plan[0].args["datasource"].columns is None
+
+
+def test_map_fusion_visible_in_plan():
+    from ray_tpu.data.executor import optimize_plan
+
+    ds = rd.range(10).map_batches(lambda b: b).map_batches(lambda b: b)
+    plan = optimize_plan(ds._plan)
+    assert len(plan) == 2  # read + ONE fused map stage
+
+
+def test_pushdown_executes_correctly(cluster, tmp_path):
+    path = _write_parquet(tmp_path)
+    rows = rd.read_parquet(path).select_columns(["x"]).take_all()
+    assert set(rows[0].keys()) == {"x"}
+    assert sorted(int(r["x"]) for r in rows) == list(range(100))
+
+
+# -------------------------------------------------------------- tfrecords
+
+def _masked_crc(_data):  # readers ignore the crc; zeros are fine
+    return 0
+
+
+def _write_tfrecord(path, examples):
+    """Serialize tf.train.Example records with a hand-rolled proto writer
+    (mirror of the reader; no tensorflow in the image)."""
+    def varint(n):
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def ld(fno, payload):  # length-delimited field
+        return varint((fno << 3) | 2) + varint(len(payload)) + payload
+
+    with open(path, "wb") as f:
+        for ex in examples:
+            feats = b""
+            for name, val in ex.items():
+                if isinstance(val, bytes):
+                    feature = ld(1, ld(1, val))          # BytesList
+                elif isinstance(val, float):
+                    feature = ld(2, ld(1, struct.pack("<f", val)))
+                else:
+                    feature = ld(3, ld(1, varint(int(val))))  # Int64List
+                entry = ld(1, name.encode()) + ld(2, feature)
+                feats += ld(1, entry)
+            rec = ld(1, feats)  # Example.features
+            f.write(struct.pack("<Q", len(rec)))
+            f.write(struct.pack("<I", _masked_crc(rec)))
+            f.write(rec)
+            f.write(struct.pack("<I", _masked_crc(rec)))
+
+
+def test_read_tfrecords_examples(cluster, tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    _write_tfrecord(path, [
+        {"label": 3, "score": 0.5, "name": b"ab"},
+        {"label": 7, "score": 1.5, "name": b"cd"},
+    ])
+    rows = rd.read_tfrecords(path).take_all()
+    assert sorted(int(r["label"]) for r in rows) == [3, 7]
+    assert sorted(float(r["score"]) for r in rows) == [0.5, 1.5]
+    assert sorted(r["name"] for r in rows) == [b"ab", b"cd"]
+
+
+def test_read_tfrecords_negative_and_missing_features(cluster, tmp_path):
+    """Negative int64s sign-extend; a record missing a feature pads None
+    at ITS row (columns stay row-aligned, never silently shifted)."""
+    def varint(n):
+        # proto encodes negative int64 as the 64-bit two's complement
+        if n < 0:
+            n += 1 << 64
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def ld(fno, payload):
+        return varint((fno << 3) | 2) + varint(len(payload)) + payload
+
+    path = str(tmp_path / "neg.tfrecord")
+    with open(path, "wb") as f:
+        for ex in [{"label": -1, "img": b"A"}, {"img": b"B"},
+                   {"label": 7, "img": b"C"}]:
+            feats = b""
+            for name, val in ex.items():
+                if isinstance(val, bytes):
+                    feature = ld(1, ld(1, val))
+                else:
+                    feature = ld(3, ld(1, varint(int(val))))
+                feats += ld(1, ld(1, name.encode()) + ld(2, feature))
+            rec = ld(1, feats)
+            f.write(struct.pack("<Q", len(rec)) + struct.pack("<I", 0)
+                    + rec + struct.pack("<I", 0))
+    rows = rd.read_tfrecords(path).take_all()
+    by_img = {r["img"]: r for r in rows}
+    assert int(by_img[b"A"]["label"]) == -1        # sign-extended
+    assert by_img[b"B"]["label"] is None           # missing -> None
+    assert int(by_img[b"C"]["label"]) == 7         # row-aligned
+
+
+def test_read_tfrecords_raw(cluster, tmp_path):
+    path = str(tmp_path / "b.tfrecord")
+    _write_tfrecord(path, [{"label": 1}])
+    rows = rd.read_tfrecords(path, raw=True).take_all()
+    assert len(rows) == 1 and isinstance(rows[0]["data"], bytes)
+
+
+# ----------------------------------------------------------------- images
+
+def test_read_images(cluster, tmp_path):
+    from PIL import Image
+
+    for i in range(3):
+        Image.new("RGB", (10 + i, 8), color=(i, 0, 0)).save(
+            str(tmp_path / f"img{i}.png"))
+    rows = rd.read_images(str(tmp_path), size=(8, 8)).take_all()
+    assert len(rows) == 3
+    assert all(r["image"].shape == (8, 8, 3) for r in rows)
+    assert all(r["image"].dtype == np.uint8 for r in rows)
