@@ -109,6 +109,23 @@ def _bench_serving(name: str):
     while engine.has_unfinished():
         engine.step()
 
+    # host<->device link RTT: a trivial dispatch + value fetch. Over
+    # the axon relay this is ~40-110 ms of pure transport; a locally
+    # attached chip measures ~1 ms. Reported separately so TTFT
+    # decomposes into link vs compute (VERDICT r2: the tunnel share
+    # must not masquerade as model latency).
+    import jax as _jax
+    import numpy as _np
+
+    one = _jax.jit(lambda x: x + 1)
+    float(one(_jax.numpy.float32(0)))  # compile
+    rtts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(one(_jax.numpy.float32(0)))
+        rtts.append(time.perf_counter() - t0)
+    rtt_ms = 1e3 * min(rtts)
+
     # TTFT: time from arrival to first sampled token (prefill only —
     # step(skip_decode=True) stops once the first token is out)
     t0 = time.perf_counter()
@@ -133,8 +150,49 @@ def _bench_serving(name: str):
     return {
         "serve_decode_tokens_per_sec": round(n_tokens / dt, 1),
         "serve_ttft_ms": round(ttft_ms, 2),
+        "serve_link_rtt_ms": round(rtt_ms, 2),
+        "serve_ttft_compute_ms": round(max(0.0, ttft_ms - rtt_ms), 2),
         "serve_batch": B,
         "serve_decode_burst": engine.ecfg.decode_burst,
+    }
+
+
+def _bench_core_summary():
+    """Control-plane microbenchmarks (tasks/s, actor calls/s) folded
+    into the bench line — the framework's own speed, not the model's
+    (ref: python/ray/_private/ray_perf.py families; full suite in
+    bench_core.py)."""
+    import ray_tpu as ray
+
+    @ray.remote
+    def _nop():
+        return None
+
+    @ray.remote
+    class _Ctr:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    ray.init(num_cpus=8, object_store_memory=1 << 29)
+    try:
+        ray.get(_nop.remote(), timeout=60)
+        t0 = time.perf_counter()
+        ray.get([_nop.remote() for _ in range(2000)], timeout=120)
+        tasks_per_s = 2000 / (time.perf_counter() - t0)
+        a = _Ctr.remote()
+        ray.get(a.inc.remote(), timeout=60)
+        t0 = time.perf_counter()
+        ray.get([a.inc.remote() for _ in range(2000)], timeout=120)
+        actor_per_s = 2000 / (time.perf_counter() - t0)
+    finally:
+        ray.shutdown()
+    return {
+        "core_tasks_per_sec": round(tasks_per_s, 1),
+        "core_actor_calls_per_sec": round(actor_per_s, 1),
     }
 
 
@@ -198,6 +256,12 @@ def main():
     except Exception as e:  # serving bench must not sink the train number
         serve_metrics = {"serve_error": repr(e)[:200]}
 
+    core_metrics = {}
+    try:
+        core_metrics = _bench_core_summary()
+    except Exception as e:  # control-plane bench must not sink the number
+        core_metrics = {"core_bench_error": repr(e)[:200]}
+
     print(json.dumps({
         "metric": f"llama_{name}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -210,8 +274,13 @@ def main():
         "batch": batch,
         "seq": seq,
         "pallas_parity": pallas_ok,
+        # vs_baseline is a PROXY: the reference publishes no tokens/s
+        # for its training path (BASELINE.md), so this is achieved MFU
+        # over the 40%-MFU public yardstick — see module docstring
+        "vs_baseline_kind": "proxy_mfu_over_0.40",
         "loss": round(float(metrics["loss"]), 4),
         **serve_metrics,
+        **core_metrics,
     }))
 
 

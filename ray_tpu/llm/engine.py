@@ -81,6 +81,11 @@ class LLMEngine:
     def __init__(self, params, cfg: LlamaConfig,
                  engine_config: Optional[EngineConfig] = None):
         self.cfg = cfg
+        from .._private.config import global_config
+
+        # resolved once per engine: a static jit arg, so the flag is
+        # part of every decode executable's cache key
+        self._paged_kernel = bool(global_config().llm_paged_kernel)
         self.ecfg = engine_config or EngineConfig()
         if self.ecfg.max_seq_len > cfg.max_seq:
             raise ValueError("engine max_seq_len exceeds model max_seq")
@@ -333,7 +338,8 @@ class LLMEngine:
             jnp.asarray(tokens), jnp.asarray(positions),
             self._bt(self._active_span()),
             jnp.asarray(active), self.cos, self.sin,
-            seed, temp, top_k, top_p, cfg=self.cfg, n_steps=K)
+            seed, temp, top_k, top_p, cfg=self.cfg, n_steps=K,
+            paged_kernel=self._paged_kernel)
         self.cache = KVCache(ck, cv)
         sampled = np.asarray(toks)  # [K, B]
         outs = []

@@ -48,9 +48,15 @@ def _kernel(ctx_len_ref, new_len_ref, bt_ref,  # scalar prefetch
             q_ref, k_page_ref, v_page_ref, new_k_ref, new_v_ref,
             o_ref,
             m_ref, l_ref, acc_ref,
-            *, page_size: int, n_pages: int, scale: float):
+            *, page_size: int, n_pages: int, scale: float, kvh: int):
+    """Grid (B, n_pages + 1); blocks carry whole pages [page, kvh, hd]
+    (TPU tiling: a block's trailing dims must equal the array's or tile
+    by (8, 128) — the head dim therefore stays INSIDE the block and the
+    kernel unrolls over the static kvh). Scratch rows are the kvh*rep
+    flattened query heads."""
     b = pl.program_id(0)
-    p = pl.program_id(2)
+    p = pl.program_id(1)
+    rep = q_ref.shape[2]
 
     @pl.when(p == 0)
     def _init():
@@ -58,15 +64,15 @@ def _kernel(ctx_len_ref, new_len_ref, bt_ref,  # scalar prefetch
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale       # [rep, hd]
-
-    def online_update(k, v, pos_mask):
-        """One flash block: k/v [S, hd] f32, pos_mask [S] bool."""
+    def online_update(g, k, v, pos_mask):
+        """One flash block for kv head g: k/v [S, hd] f32, mask [S]."""
+        rows = slice(g * rep, (g + 1) * rep)
+        q = q_ref[0, g].astype(jnp.float32) * scale   # [rep, hd]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [rep, S]
         s = jnp.where(pos_mask[None, :], s, _NEG_INF)
-        m_prev = m_ref[...]                           # [rep, 1]
+        m_prev = m_ref[rows]                          # [rep, 1]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         # masked entries must contribute EXACTLY zero: when a whole
@@ -74,29 +80,35 @@ def _kernel(ctx_len_ref, new_len_ref, bt_ref,  # scalar prefetch
         # exp(0) = 1 per masked entry, poisoning l and acc
         p_blk = jnp.where(pos_mask[None, :],
                           jnp.exp(s - m_new), 0.0)    # [rep, S]
-        l_ref[...] = l_ref[...] * alpha + p_blk.sum(-1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        l_ref[rows] = l_ref[rows] * alpha + p_blk.sum(-1, keepdims=True)
+        acc_ref[rows] = acc_ref[rows] * alpha + jax.lax.dot_general(
             p_blk, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [rep, hd]
-        m_ref[...] = m_new
+        m_ref[rows] = m_new
 
     @pl.when(p < n_pages)
     def _page_step():
-        k = k_page_ref[0, :, 0].astype(jnp.float32)   # [page, hd]
-        v = v_page_ref[0, :, 0].astype(jnp.float32)
         base = p * page_size
-        pos = base + jax.lax.broadcasted_iota(jnp.int32, (page_size,), 0)
-        online_update(k, v, pos < ctx_len_ref[b])
+        pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)[:, 0]
+        mask = pos < ctx_len_ref[b]
+        for g in range(kvh):  # static unroll over kv heads
+            k = k_page_ref[0, :, g].astype(jnp.float32)   # [page, hd]
+            v = v_page_ref[0, :, g].astype(jnp.float32)
+            online_update(g, k, v, mask)
 
     @pl.when(p == n_pages)
     def _tail_and_write():
-        k = new_k_ref[0, :, 0].astype(jnp.float32)    # [K, hd]
-        v = new_v_ref[0, :, 0].astype(jnp.float32)
-        kk = k.shape[0]
-        pos = jax.lax.broadcasted_iota(jnp.int32, (kk,), 0)
-        online_update(k, v, pos < new_len_ref[b])
+        kk = new_k_ref.shape[1]
+        pos = jax.lax.broadcasted_iota(jnp.int32, (kk, 1), 0)[:, 0]
+        mask = pos < new_len_ref[b]
+        for g in range(kvh):
+            k = new_k_ref[0, :, g].astype(jnp.float32)    # [K, hd]
+            v = new_v_ref[0, :, g].astype(jnp.float32)
+            online_update(g, k, v, mask)
         l = jnp.maximum(l_ref[...], 1e-20)
-        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        out = (acc_ref[...] / l)                      # [kvh*rep, hd]
+        o_ref[0] = out.reshape(kvh, rep, out.shape[-1]).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
@@ -116,47 +128,47 @@ def paged_decode_attention(q, cache_k, cache_v, new_k, new_v,
     B, kvh, rep, hd = q.shape
     n_pages = block_tables.shape[1]
     K = new_k.shape[1]
-    grid = (B, kvh, n_pages + 1)
+    grid = (B, n_pages + 1)
 
-    def q_map(b, g, p, ctx, nl, bt):
-        return (b, g, 0, 0)
+    def q_map(b, p, ctx, nl, bt):
+        return (b, 0, 0, 0)
 
-    def page_map(b, g, p, ctx, nl, bt):
+    def page_map(b, p, ctx, nl, bt):
         # last (tail) step re-reads an arbitrary valid page; masked out
-        return (bt[b, jnp.minimum(p, n_pages - 1)], 0, g, 0)
+        return (bt[b, jnp.minimum(p, n_pages - 1)], 0, 0, 0)
 
-    def new_map(b, g, p, ctx, nl, bt):
-        return (b, 0, g, 0)
+    def new_map(b, p, ctx, nl, bt):
+        return (b, 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, rep, hd), q_map),
-            pl.BlockSpec((1, page_size, 1, hd), page_map),
-            pl.BlockSpec((1, page_size, 1, hd), page_map),
-            pl.BlockSpec((1, K, 1, hd), new_map),
-            pl.BlockSpec((1, K, 1, hd), new_map),
+            pl.BlockSpec((1, kvh, rep, hd), q_map),
+            pl.BlockSpec((1, page_size, kvh, hd), page_map),
+            pl.BlockSpec((1, page_size, kvh, hd), page_map),
+            pl.BlockSpec((1, K, kvh, hd), new_map),
+            pl.BlockSpec((1, K, kvh, hd), new_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, rep, hd), q_map),
+        out_specs=pl.BlockSpec((1, kvh, rep, hd), q_map),
         scratch_shapes=[
-            pltpu.VMEM((rep, 1), jnp.float32),   # m
-            pltpu.VMEM((rep, 1), jnp.float32),   # l
-            pltpu.VMEM((rep, hd), jnp.float32),  # acc
+            pltpu.VMEM((kvh * rep, 1), jnp.float32),   # m
+            pltpu.VMEM((kvh * rep, 1), jnp.float32),   # l
+            pltpu.VMEM((kvh * rep, hd), jnp.float32),  # acc
         ],
     )
     kernel = functools.partial(
         _kernel, page_size=page_size, n_pages=n_pages,
-        scale=float(hd) ** -0.5)
+        scale=float(hd) ** -0.5, kvh=kvh)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, kvh, rep, hd), q.dtype),
         interpret=interpret,
-        # grid dims b/g are parallel; the page dim carries the softmax
+        # the batch dim is parallel; the page dim carries the softmax
         # state and must run sequentially
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary")),
     )(ctx_len, new_len, block_tables, q, cache_k, cache_v, new_k, new_v)
 
 
