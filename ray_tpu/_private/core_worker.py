@@ -137,6 +137,7 @@ class CoreWorker:
         self._task_local = threading.local()  # per-execution-thread task context
         self._put_index = 0
         self._put_lock = threading.Lock()
+        self._subscribed_channels: set = set()
         self._block_depth = 0          # worker dep-block nesting
         self._block_lock = threading.Lock()
 
@@ -252,7 +253,9 @@ class CoreWorker:
         await self.raylet.connect()
         self.gcs.on_push("pubsub:actor", self._on_actor_update)
         self.raylet.on_push("reclaim_lease", self._on_reclaim_lease)
+        self._subscribed_channels = {"actor"}
         await self.gcs.call("subscribe", {"channels": ["actor"]})
+        self.gcs.on_reconnect.append(self._resubscribe_gcs)
         if self.mode == "driver" and not self.address:
             await self._start_owner_server()
 
@@ -335,12 +338,22 @@ class CoreWorker:
         await self.gcs.close()
         await self.raylet.close()
 
+    async def _resubscribe_gcs(self):
+        """A restarted GCS dropped this connection's subscriptions;
+        re-establish every channel this core ever subscribed."""
+        try:
+            await self.gcs.call("subscribe", {
+                "channels": sorted(self._subscribed_channels)})
+        except Exception:
+            pass
+
     # --------------------------------------------------- app-level pubsub
     def subscribe_channel(self, channel: str, callback) -> None:
         """Receive pushes on an application pubsub channel (the long-poll
         replacement surface — ref: serve/_private/long_poll.py:66; here
         pushes ride the standing GCS connection)."""
         self.gcs.on_push("pubsub:" + channel, callback)
+        self._subscribed_channels.add(channel)
         self.io.run(self.gcs.call("subscribe", {"channels": [channel]}),
                     timeout=10)
 

@@ -40,9 +40,11 @@ from .rpc import RpcClient, RpcServer, ServerConnection
 from .task_spec import (
     DefaultSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     ResourceSet,
     SpreadSchedulingStrategy,
+    label_expr_matches,
 )
 
 
@@ -227,6 +229,8 @@ class Raylet:
         self._peer_clients: Dict[str, RpcClient] = {}
         # cluster view (for spillback) — node_id -> (address, available)
         self._remote_nodes: Dict[NodeID, Tuple[str, ResourceSet]] = {}
+        # node_id -> labels (incl. this node), for label-match scheduling
+        self._node_labels: Dict[NodeID, Dict[str, str]] = {}
         self._worker_conns: Dict[ServerConnection, WorkerID] = {}
         self._spill_rr = 0
         self._resource_seq = 0
@@ -261,15 +265,42 @@ class Raylet:
             "host_index": int(self.labels.get("host_index", 0)),
             "store_dir": self.store.dir,
         })
+        self._node_labels[self.node_id] = dict(self.labels)
         for info in reply["nodes"]:
             if info.node_id != self.node_id and info.alive:
                 self._remote_nodes[info.node_id] = (info.address, ResourceSet(info.resources_available))
+                self._node_labels[info.node_id] = dict(info.labels or {})
         await self.gcs.call("subscribe", {"channels": ["resources", "node", "object"]})
+        self.gcs.on_reconnect.append(self._on_gcs_reconnect)
         if self.cfg.prestart_workers:
             for _ in range(min(2, self.max_workers)):
                 self._spawn_worker()
         if self.cfg.memory_monitor_refresh_ms > 0:
             asyncio.ensure_future(self._memory_monitor_loop())
+
+    async def _on_gcs_reconnect(self):
+        """A restarted GCS lost every per-connection subscription (and,
+        if its journal was cold, this node's registration): re-register
+        idempotently, re-subscribe, and push a fresh resource report so
+        the cluster view heals without operator action (ref:
+        gcs_redis_failure_detector.h restart path)."""
+        try:
+            await self.gcs.call("register_node", {
+                "node_id": self.node_id,
+                "address": self.server.address,
+                "resources_total": self.resources.total.to_dict(),
+                "resources_available": self.resources.available.to_dict(),
+                "labels": self.labels,
+                "slice_name": self.labels.get("slice_name", ""),
+                "host_index": int(self.labels.get("host_index", 0)),
+                "store_dir": self.store.dir,
+            })
+            await self.gcs.call(
+                "subscribe",
+                {"channels": ["resources", "node", "object"]})
+            await self._report_resources()
+        except Exception:
+            pass  # next retrying call reconnects and refires this hook
 
     # ----------------------------------------------------- memory pressure
     def _memory_fraction(self) -> Optional[float]:
@@ -386,6 +417,7 @@ class Raylet:
             info = payload["node"]
             if info.node_id != self.node_id:
                 self._remote_nodes[info.node_id] = (info.address, ResourceSet(info.resources_available))
+                self._node_labels[info.node_id] = dict(info.labels or {})
                 if self._pending_leases:  # a new node may fit queued work
                     asyncio.ensure_future(self._pump_pending())
         elif payload["event"] == "removed":
@@ -652,7 +684,18 @@ class Raylet:
                 return k
         return None
 
+    def _strategy_allows_local(self, strategy) -> bool:
+        """Hard label expressions must hold for THIS node before a local
+        grant; otherwise the lease stays queued for spillback/arrival."""
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            return label_expr_matches(
+                self._node_labels.get(self.node_id, dict(self.labels)),
+                strategy.hard)
+        return True
+
     async def _try_grant(self, resources: ResourceSet, payload):
+        if not self._strategy_allows_local(payload.get("strategy")):
+            return None
         pg_key = self._pg_key(payload.get("strategy"))
         alloc_key = None
         if pg_key is not None:
@@ -838,6 +881,28 @@ class Raylet:
         if self._pg_key(strategy) is not None:
             return self.node_id  # caller already directed to the bundle's node
         local_fits = resources.fits(self.resources.available)
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            # hard expressions gate feasibility; soft ones rank the
+            # feasible set (ref: node_label_scheduling_policy.h + A.2)
+            def _labels(nid):
+                return self._node_labels.get(nid, {})
+
+            candidates = [(self.node_id, self.resources.available)] + [
+                (nid, avail) for nid, (_, avail) in self._remote_nodes.items()
+            ]
+            feasible = [
+                (nid, a) for nid, a in candidates
+                if resources.fits(a)
+                and label_expr_matches(_labels(nid), strategy.hard)]
+            if not feasible:
+                return None  # queue: a matching node may join/free up
+            soft_ok = [(nid, a) for nid, a in feasible
+                       if label_expr_matches(_labels(nid), strategy.soft)]
+            pool = soft_ok or feasible
+            for nid, _ in pool:
+                if nid == self.node_id:
+                    return nid  # local preferred within the match set
+            return pool[0][0]
         if isinstance(strategy, SpreadSchedulingStrategy):
             candidates = [(self.node_id, self.resources.available)] + [
                 (nid, avail) for nid, (_, avail) in self._remote_nodes.items()

@@ -248,6 +248,13 @@ class RpcClient:
         self._connect_lock = asyncio.Lock()
         self._recv_task: Optional[asyncio.Task] = None
         self.closed = False
+        self._ever_connected = False
+        # async callbacks fired after a RE-connect (transport came back,
+        # e.g. a restarted GCS): server-side per-connection state —
+        # pubsub subscriptions above all — must be re-established by the
+        # client (ref: gcs_redis_failure_detector.h + the reference's
+        # client-side resubscribe on GCS restart)
+        self.on_reconnect: list = []
 
     def on_push(self, method: str, handler: Callable[[Any], Any]) -> None:
         self._push_handlers[method] = handler
@@ -272,6 +279,10 @@ class RpcClient:
         if self._recv_task is not None and not self._recv_task.done():
             self._recv_task.cancel()
         self._recv_task = asyncio.ensure_future(self._recv_loop())
+        if self._ever_connected:
+            for cb in list(self.on_reconnect):
+                asyncio.ensure_future(cb())
+        self._ever_connected = True
 
     async def _recv_loop(self):
         try:
@@ -311,9 +322,16 @@ class RpcClient:
         msg_id = next(self._msg_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
-        async with self._write_lock:
-            self._writer.write(_frame(msg_id, REQUEST, method, payload))
-            await self._writer.drain()
+        try:
+            async with self._write_lock:
+                self._writer.write(_frame(msg_id, REQUEST, method, payload))
+                await self._writer.drain()
+        except (ConnectionError, RuntimeError, OSError) as e:
+            # a dead transport surfaces as ConnectionLost so retrying
+            # callers reconnect instead of crashing on the raw OS error
+            self._pending.pop(msg_id, None)
+            self.closed = True
+            raise ConnectionLost(f"{self.socket_path}: {e}") from e
         if timeout is None:
             return await fut
         return await asyncio.wait_for(fut, timeout)

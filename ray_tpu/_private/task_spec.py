@@ -111,6 +111,74 @@ class SliceSchedulingStrategy:
     host_index: int = -1
 
 
+# Label match expressions (ref: python/ray/util/scheduling_strategies.py
+# In:94 / NotIn / Exists / DoesNotExist + the node-label policy in
+# raylet/scheduling/policy/node_label_scheduling_policy.h)
+@dataclass
+class In:
+    values: List[str] = field(default_factory=list)
+
+    def __init__(self, *args, values=None):
+        # accept In("a", "b"), In(["a", "b"]) and In(values=[...])
+        if values is None:
+            values = args[0] if (len(args) == 1 and isinstance(
+                args[0], (list, tuple))) else args
+        self.values = list(values)
+
+
+@dataclass
+class NotIn:
+    values: List[str] = field(default_factory=list)
+
+    def __init__(self, *args, values=None):
+        if values is None:
+            values = args[0] if (len(args) == 1 and isinstance(
+                args[0], (list, tuple))) else args
+        self.values = list(values)
+
+
+@dataclass
+class Exists:
+    pass
+
+
+@dataclass
+class DoesNotExist:
+    pass
+
+
+def label_expr_matches(labels: Dict[str, str], exprs: Dict[str, Any]) -> bool:
+    """Does a node's label set satisfy every (key -> expression)?"""
+    for key, expr in (exprs or {}).items():
+        present = key in labels
+        value = labels.get(key)
+        if isinstance(expr, In):
+            if not present or value not in expr.values:
+                return False
+        elif isinstance(expr, NotIn):
+            if present and value in expr.values:
+                return False
+        elif isinstance(expr, Exists):
+            if not present:
+                return False
+        elif isinstance(expr, DoesNotExist):
+            if present:
+                return False
+        else:
+            raise TypeError(f"unknown label expression {expr!r}")
+    return True
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Match nodes by label expressions: ``hard`` must hold, ``soft``
+    breaks ties among hard-feasible nodes (ref: scheduling_strategies.py
+    NodeLabelSchedulingStrategy:135)."""
+
+    hard: Dict[str, Any] = field(default_factory=dict)
+    soft: Dict[str, Any] = field(default_factory=dict)
+
+
 SchedulingStrategy = Any  # union of the above
 
 

@@ -103,6 +103,11 @@ def _register_all() -> None:
     register_struct(6, _ts.NodeAffinitySchedulingStrategy)
     register_struct(7, _ts.PlacementGroupSchedulingStrategy)
     register_struct(8, _ts.SliceSchedulingStrategy)
+    register_struct(11, _ts.In, ("values",))
+    register_struct(12, _ts.NotIn, ("values",))
+    register_struct(13, _ts.Exists)
+    register_struct(14, _ts.DoesNotExist)
+    register_struct(15, _ts.NodeLabelSchedulingStrategy)
 
     from . import gcs as _gcs
 

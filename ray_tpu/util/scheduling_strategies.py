@@ -13,7 +13,12 @@ from typing import Optional, Union
 from .._private.ids import PlacementGroupID
 from .._private.task_spec import (
     DefaultSchedulingStrategy,
+    DoesNotExist,
+    Exists,
+    In,
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    NotIn,
     PlacementGroupSchedulingStrategy as _PgStrategy,
     SpreadSchedulingStrategy,
 )
@@ -45,5 +50,7 @@ __all__ = [
     "DefaultSchedulingStrategy",
     "SpreadSchedulingStrategy",
     "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
+    "In", "NotIn", "Exists", "DoesNotExist",
 ]

@@ -93,3 +93,46 @@ def test_strict_spread_pg_maps_to_slice_host_order():
         assert loose.node_id.hex() not in placements
     finally:
         cluster.shutdown()
+
+
+def test_node_label_scheduling_strategy():
+    """NodeLabelSchedulingStrategy with In/NotIn/Exists/DoesNotExist
+    (ref: scheduling_strategies.py:135 + A.2): hard expressions pin the
+    task to matching nodes; unsatisfiable ones queue until a match."""
+    import os as _os
+
+    from ray_tpu.util.scheduling_strategies import (
+        DoesNotExist, Exists, In, NodeLabelSchedulingStrategy)
+
+    cluster = Cluster(head_node_args={"num_cpus": 1}, connect=True)
+    try:
+        east = cluster.add_node(num_cpus=2,
+                                labels={"zone": "east", "disk": "ssd"})
+        west = cluster.add_node(num_cpus=2, labels={"zone": "west"})
+        deadline = time.time() + 30
+        while len(ray_tpu.nodes()) < 3 and time.time() < deadline:
+            time.sleep(0.1)
+
+        @ray_tpu.remote
+        def where():
+            return _os.environ["RAY_TPU_NODE_ID"]
+
+        strat = NodeLabelSchedulingStrategy(hard={"zone": In("east")})
+        got = ray_tpu.get(
+            where.options(scheduling_strategy=strat).remote(), timeout=60)
+        assert got == east.node_id.hex()
+
+        strat = NodeLabelSchedulingStrategy(
+            hard={"zone": Exists(), "disk": DoesNotExist()})
+        got = ray_tpu.get(
+            where.options(scheduling_strategy=strat).remote(), timeout=60)
+        assert got == west.node_id.hex()
+
+        # soft preference ranks within the hard-feasible set
+        strat = NodeLabelSchedulingStrategy(
+            hard={"zone": Exists()}, soft={"disk": In("ssd")})
+        got = ray_tpu.get(
+            where.options(scheduling_strategy=strat).remote(), timeout=60)
+        assert got == east.node_id.hex()
+    finally:
+        cluster.shutdown()
