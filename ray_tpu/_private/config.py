@@ -87,11 +87,13 @@ class Config:
     # Serving decode attention: stream KV pages through the Pallas
     # paged-attention kernel (ops/paged_attention.py) instead of the
     # XLA jnp.take gather. Measured r3 on 1x v5e (llama-400m, B=16,
-    # burst=32): kernel 430 tok/s vs gather 1136 tok/s — the layer scan
-    # dynamic-slices the [L, P, ...] page pool per (step, layer), and
-    # that copy dwarfs the gather the kernel avoids. Winning needs the
-    # cache split into per-layer arrays (no L dim to slice); until that
-    # lands the XLA gather stays the default.
+    # burst=32, ~300-token contexts): kernel ~400 tok/s vs gather
+    # ~1050-1130 tok/s, with both a scanned and an UNROLLED layer loop —
+    # at short contexts (~5 pages/seq) the kernel's per-page sequential
+    # DMAs and skinny [rep, page] matmuls lose to one big fused gather
+    # einsum. The kernel's regime is long contexts (100+ pages, where
+    # the gather's HBM copy dominates); flip per deployment after
+    # measuring, this default serves the short-context bench shape.
     llm_paged_kernel: bool = False
     mesh_compile_cache_dir: str = ""
     default_device_platform: str = ""         # "" = jax default

@@ -243,9 +243,23 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
             x = x + _mlp(h, lp, cfg)
             return x, (nk, nv)
 
-        xs_kv = (cache_k, cache_v) if use_paged_kernel else (old_k, old_v)
-        x, (sk, sv) = jax.lax.scan(
-            layer, x, (params["layers"], *xs_kv, sk, sv))
+        if use_paged_kernel:
+            # UNROLLED layers: a lax.scan over the cache would dynamic-
+            # slice the whole [L, P, ...] page pool per (step, layer) —
+            # measured 2.6x slower than the gather path. Static slices
+            # in an unrolled loop let XLA alias into the donated pool.
+            sks, svs = [], []
+            for li in range(L):
+                lp_l = jax.tree.map(lambda a: a[li], params["layers"])
+                x, (nk_l, nv_l) = layer(
+                    x, (lp_l, cache_k[li], cache_v[li], sk[li], sv[li]))
+                sks.append(nk_l)
+                svs.append(nv_l)
+            sk = jnp.stack(sks)
+            sv = jnp.stack(svs)
+        else:
+            x, (sk, sv) = jax.lax.scan(
+                layer, x, (params["layers"], old_k, old_v, sk, sv))
         h = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
         logits = jnp.einsum("bd,dv->bv", h.astype(cfg.dtype),
                             params["lm_head"].astype(cfg.dtype),
