@@ -76,6 +76,10 @@ class Config:
     max_lineage_bytes: int = 1024**3
     # --- chaos / testing (mirrors rpc_chaos.h fault injection) ---
     testing_rpc_failure: str = ""             # "method=prob_req:prob_resp,..."
+    # locality-aware leasing: lease at the node holding a task's argument
+    # bytes when the known dependency mass there reaches this many bytes
+    # (ref: lease_policy.h LocalityAwareLeasePolicy). 0 disables.
+    scheduler_locality_min_bytes: int = 64 * 1024
     # per-try timeout for lease RPCs; 0 = wait forever (reliable transport).
     # Chaos/unreliable setups set this so dropped frames trigger a retry,
     # which the raylet dedups by request id.
@@ -95,6 +99,12 @@ class Config:
     # the gather's HBM copy dominates); flip per deployment after
     # measuring, this default serves the short-context bench shape.
     llm_paged_kernel: bool = False
+    # Auto-select: when llm_paged_kernel is off, a decode round whose
+    # bucketed block-table span is >= this many pages uses the Pallas
+    # kernel anyway (0 disables auto-select). The span is a static shape
+    # (engine buckets it), so each (span, path) pair is its own compiled
+    # executable — flipping per round costs nothing at steady state.
+    llm_paged_kernel_min_ctx_pages: int = 0
     mesh_compile_cache_dir: str = ""
     default_device_platform: str = ""         # "" = jax default
     ici_mesh_auto_axis_order: bool = True

@@ -26,6 +26,7 @@ manager in a later milestone.
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
 import os
 import threading
@@ -177,6 +178,16 @@ class CoreWorker:
         self._reconstructions: Dict[TaskID, int] = {}
         # cancellation: in-flight normal tasks (ref: core_worker.cc Cancel)
         self._inflight: Dict[TaskID, dict] = {}
+        # object-locality hints: oid -> (node_hex, bytes) for sealed
+        # plasma objects this owner knows about (its puts + its tasks'
+        # large returns). Feeds locality-aware leasing (ref:
+        # core_worker/lease_policy.h LocalityAwareLeasePolicy +
+        # scheduling/policy/scorer.h): lease where the argument bytes
+        # already live. Bounded FIFO — a hint, not a directory.
+        self._obj_locality: "collections.OrderedDict" = (
+            collections.OrderedDict())
+        self._node_addr_cache: Dict[str, str] = {}
+        self._node_addr_ts = 0.0
         # streaming generators (ref: task_manager.h ObjectRefStream)
         self._streams: Dict[TaskID, _StreamState] = {}
         # task events buffered toward the GCS (ref: task_event_buffer.h)
@@ -543,6 +554,7 @@ class CoreWorker:
         else:
             self.store.put(oid, data)
             self._owned_in_plasma.add(oid)
+            self._note_locality(oid, self.node_id.hex(), len(data))
             self.io.spawn(self._notify_sealed(oid, len(data)))
 
     async def _notify_sealed(self, oid: ObjectID, size: int):
@@ -1167,7 +1179,9 @@ class CoreWorker:
                 spec.chip_ids = grant["chip_ids"]
             client = await self._client_for(grant["worker_address"])
             reply = await client.call("push_task", cloudpickle.dumps(spec))
-            errored = self._handle_task_reply(spec, reply)
+            gnode = grant.get("node_id")
+            errored = self._handle_task_reply(
+                spec, reply, node_id=gnode.hex() if gnode else "")
             keep = True
             return errored
         finally:
@@ -1207,8 +1221,25 @@ class CoreWorker:
         strategy = spec.scheduling_strategy
         pg_strategy = (isinstance(strategy, PlacementGroupSchedulingStrategy)
                        and strategy.placement_group_id is not None)
+        # locality-aware leasing (DEFAULT strategy only — explicit
+        # strategies encode the user's placement intent): start the lease
+        # chain at the node holding the task's argument bytes; its raylet
+        # still applies the hybrid policy and may spill back out
+        locality_raylet = None
+        from .task_spec import DefaultSchedulingStrategy
+
+        if (strategy is None
+                or isinstance(strategy, DefaultSchedulingStrategy)) and spec.args:
+            target = self._locality_node(spec)
+            if target is not None and target != self.node_id.hex():
+                addr = await self._node_raylet_address(target)
+                if addr:
+                    try:
+                        locality_raylet = await self._raylet_client_for(addr)
+                    except Exception:
+                        locality_raylet = None
         for pg_attempt in range(8):
-            raylet = self.raylet
+            raylet = locality_raylet or self.raylet
             if pg_strategy:
                 address = await self._pg_bundle_address(strategy)
                 raylet = await self._raylet_client_for(address)
@@ -1239,6 +1270,11 @@ class CoreWorker:
                 # the bundle moved (node died, PG rescheduling) between the
                 # directory lookup and the lease request — re-resolve
                 if not pg_strategy:
+                    if locality_raylet is not None:
+                        # the locality hint pointed at a dead/stale node:
+                        # degrade to the local raylet, don't fail the task
+                        locality_raylet = None
+                        continue
                     raise
                 self._pg_cache.pop(strategy.placement_group_id, None)
                 await asyncio.sleep(0.05 * (pg_attempt + 1))
@@ -1358,9 +1394,11 @@ class CoreWorker:
                 self._worker_clients.pop(address, None)
             raise
 
-    def _handle_task_reply(self, spec: TaskSpec, reply: dict) -> bool:
-        """reply: {results: [(oid, data|None)], error: bytes|None}.
-        Returns True when the task raised (its returns hold the error)."""
+    def _handle_task_reply(self, spec: TaskSpec, reply: dict,
+                           node_id: str = "") -> bool:
+        """reply: {results: [(oid, data|None)], error: bytes|None,
+        sealed?: [(oid, size)]}. Returns True when the task raised (its
+        returns hold the error)."""
         if reply.get("error") is not None:
             for oid in spec.return_ids():
                 self.memory_store.put(oid, reply["error"])
@@ -1369,7 +1407,57 @@ class CoreWorker:
             if data is not None:
                 self.memory_store.put(oid, data)
             # else: large result sealed in plasma by the executor
+        if node_id:
+            for oid, size in reply.get("sealed", ()):
+                self._note_locality(oid, node_id, size)
         return False
+
+    # ------------------------------------------------ locality-aware leasing
+    _LOCALITY_CAP = 65536  # hint entries kept (FIFO)
+
+    def _note_locality(self, oid: ObjectID, node_hex: str, size: int) -> None:
+        loc = self._obj_locality
+        loc[oid] = (node_hex, size)
+        loc.move_to_end(oid)
+        while len(loc) > self._LOCALITY_CAP:
+            loc.popitem(last=False)
+
+    def _locality_node(self, spec: TaskSpec) -> Optional[str]:
+        """Node holding the most known dependency bytes, when that beats
+        the threshold (ref: LocalityAwareLeasePolicy::GetBestNodeForTask)."""
+        if self.cfg.scheduler_locality_min_bytes <= 0:
+            return None
+        by_node: Dict[str, int] = {}
+        for arg in spec.args:
+            if arg.object_id is None:
+                continue
+            hint = self._obj_locality.get(arg.object_id)
+            if hint is not None:
+                by_node[hint[0]] = by_node.get(hint[0], 0) + hint[1]
+        if not by_node:
+            return None
+        best = max(by_node, key=by_node.get)
+        if by_node[best] < self.cfg.scheduler_locality_min_bytes:
+            return None
+        return best
+
+    async def _node_raylet_address(self, node_hex: str) -> Optional[str]:
+        """node_id -> raylet address, via a TTL-cached GCS node listing
+        (locality leases are for big-data tasks; one listing per 10 s is
+        noise next to the transfers it avoids)."""
+        now = time.monotonic()
+        # staleness alone gates the refresh: a hint pointing at a dead
+        # node must NOT turn every submission into a GCS listing — a
+        # fresh-cache miss just skips the locality lease this time
+        if now - self._node_addr_ts > 10.0:
+            try:
+                infos = await self.gcs.call("get_all_nodes", {})
+            except Exception:
+                return None
+            self._node_addr_cache = {
+                i.node_id.hex(): i.address for i in infos if i.alive}
+            self._node_addr_ts = now
+        return self._node_addr_cache.get(node_hex)
 
     # ------------------------------------------------- streaming generators
     def _on_generator_item(self, payload):

@@ -1,0 +1,140 @@
+"""Prometheus text-format exporter over the GCS metrics table.
+
+Reference analog: python/ray/_private/metrics_agent.py +
+prometheus_exporter.py — there, each node's metrics agent exposes an
+OpenCensus registry as a Prometheus scrape endpoint and the dashboard
+proxies them. Here the GCS is already the aggregation point
+(gcs.py handle_report_metrics / handle_get_metrics), so one scrape
+endpoint on the dashboard (`GET /metrics`) renders the whole cluster:
+no per-node agent fleet is needed for a TPU-pod-sized cluster, and the
+scrape is consistent because it reads one table.
+
+Layout produced (text exposition format 0.0.4):
+  counters   -> `# TYPE name counter`  + `name{tags} value`
+  gauges     -> `# TYPE name gauge`    + `name{tags} value`
+  histograms -> `# TYPE name histogram` + `name_bucket{tags,le=...}`,
+                `name_sum{tags}`, `name_count{tags}` (cumulative
+                buckets, as Prometheus requires — the internal registry
+                stores per-bucket counts non-cumulatively bounded by
+                each `le`, which IS already cumulative: observe() adds
+                to every bucket the value fits in; see
+                util/metrics.py Histogram.observe).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, List, Tuple
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_OK = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _sanitize_name(name: str) -> str:
+    if _NAME_OK.match(name):
+        return name
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not re.match(r"[a-zA-Z_:]", out[0]):
+        out = "_" + out
+    return out
+
+
+def _sanitize_label(name: str) -> str:
+    if _LABEL_OK.match(name):
+        return name
+    out = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if not out or not re.match(r"[a-zA-Z_]", out[0]):
+        out = "_" + out
+    return out
+
+
+def _escape_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _fmt_labels(tags: Dict[str, str]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(
+        f'{_sanitize_label(k)}="{_escape_value(str(v))}"'
+        for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render(entries: Iterable[Dict[str, Any]]) -> str:
+    """Render GCS metric entries (handle_get_metrics layout: name, kind,
+    tags, value, description) as Prometheus exposition text."""
+    # group by (name, kind) so TYPE/HELP headers appear once
+    groups: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    for e in entries:
+        groups.setdefault((e["name"], e.get("kind", "gauge")), []).append(e)
+    lines: List[str] = []
+    for (name, kind), items in sorted(groups.items()):
+        pname = _sanitize_name(name)
+        desc = next((i.get("description") for i in items
+                     if i.get("description")), "")
+        if desc:
+            lines.append(f"# HELP {pname} {_escape_value(desc)}")
+        ptype = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "histogram"}.get(kind, "untyped")
+        lines.append(f"# TYPE {pname} {ptype}")
+        for e in items:
+            tags = dict(e.get("tags") or {})
+            if kind == "histogram":
+                stat = tags.pop("__stat__", None)
+                if stat == "sum":
+                    lines.append(f"{pname}_sum{_fmt_labels(tags)} "
+                                 f"{_fmt_value(e['value'])}")
+                elif stat == "count":
+                    lines.append(f"{pname}_count{_fmt_labels(tags)} "
+                                 f"{_fmt_value(e['value'])}")
+                elif "le" in tags:
+                    lines.append(f"{pname}_bucket{_fmt_labels(tags)} "
+                                 f"{_fmt_value(e['value'])}")
+                else:  # stray histogram row: emit as untyped sample
+                    lines.append(f"{pname}{_fmt_labels(tags)} "
+                                 f"{_fmt_value(e['value'])}")
+            else:
+                lines.append(f"{pname}{_fmt_labels(tags)} "
+                             f"{_fmt_value(e['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_cluster() -> str:
+    """Scrape payload for the connected cluster: application metrics from
+    the GCS table plus built-in cluster gauges (nodes/actors/tasks by
+    state — the reference's metric_defs.h families)."""
+    from ..util import state as state_api
+    from .. import nodes as _nodes
+
+    entries: List[Dict[str, Any]] = list(state_api.get_metrics())
+    try:
+        alive = sum(1 for n in _nodes() if n.get("Alive"))
+        entries.append({"name": "ray_tpu_cluster_nodes", "kind": "gauge",
+                        "tags": {}, "value": float(alive),
+                        "description": "Alive nodes in the cluster"})
+        for st, n in state_api.summarize_tasks().items():
+            entries.append({
+                "name": "ray_tpu_tasks", "kind": "gauge",
+                "tags": {"state": st}, "value": float(n),
+                "description": "Tasks by state (ref metric_defs.h tasks)"})
+        actors = state_api.list_actors()
+        by_state: Dict[str, int] = {}
+        for a in actors:
+            by_state[a.get("state", "UNKNOWN")] = (
+                by_state.get(a.get("state", "UNKNOWN"), 0) + 1)
+        for st, n in by_state.items():
+            entries.append({
+                "name": "ray_tpu_actors", "kind": "gauge",
+                "tags": {"state": st}, "value": float(n),
+                "description": "Actors by state"})
+    except Exception:
+        pass  # partial scrape beats a failed scrape
+    return render(entries)

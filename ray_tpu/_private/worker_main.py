@@ -203,15 +203,20 @@ class TaskExecutor:
         return args, kwargs
 
     # -------------------------------------------------------- result sealing
-    def _seal_results(self, spec: TaskSpec, values: Any) -> list:
+    def _ok_reply(self, spec: TaskSpec, values: Any) -> dict:
+        results, sealed = self._seal_results(spec, values)
+        return {"results": results, "sealed": sealed, "error": None}
+
+    def _seal_results(self, spec: TaskSpec, values: Any) -> tuple:
         small_limit = global_config().object_store_small_object_threshold
         if spec.num_returns == 0:
-            return []
+            return [], []
         if spec.num_returns == 1:
             values = (values,)
         elif not isinstance(values, tuple):
             values = tuple(values)
         results = []
+        sealed = []
         for i, value in enumerate(values[: spec.num_returns]):
             oid = ObjectID.for_return(spec.task_id, i + 1)
             data = ser.serialize(value)
@@ -225,7 +230,10 @@ class TaskExecutor:
                 self.core.store.put(oid, data)
                 self._notify_sealed(oid, len(data))
                 results.append((oid, None))
-        return results
+                # rides the reply so the owner learns where (and how big)
+                # its large returns are — locality-aware leasing input
+                sealed.append((oid, len(data)))
+        return results, sealed
 
     def _notify_sealed(self, oid: ObjectID, size: int) -> None:
         # idempotent + retried: a lost seal notification would strand every
@@ -281,7 +289,7 @@ class TaskExecutor:
             finally:
                 self._running.pop(spec.task_id, None)
                 self.core.clear_task_context()
-            return {"results": self._seal_results(spec, values), "error": None}
+            return self._ok_reply(spec, values)
         except BaseException as e:  # noqa: BLE001
             return {"results": [], "error": self._seal_error(spec, e)}
 
@@ -415,9 +423,7 @@ class TaskExecutor:
                 if asyncio.iscoroutine(values):
                     values = await values
                 return await loop.run_in_executor(
-                    self.pool, lambda: {
-                        "results": self._seal_results(spec, values),
-                        "error": None})
+                    self.pool, lambda: self._ok_reply(spec, values))
             except BaseException as e:  # noqa: BLE001
                 return {"results": [],
                         "error": await loop.run_in_executor(
@@ -444,7 +450,7 @@ class TaskExecutor:
                 self.core.clear_task_context()
             if asyncio.iscoroutine(values):
                 values = asyncio.get_event_loop_policy().new_event_loop().run_until_complete(values)
-            return {"results": self._seal_results(spec, values), "error": None}
+            return self._ok_reply(spec, values)
         except BaseException as e:  # noqa: BLE001
             return {"results": [], "error": self._seal_error(spec, e)}
 

@@ -1,11 +1,14 @@
-"""Dashboard head: a JSON API over cluster state (ref:
-python/ray/dashboard/head.py:65 + modules/* REST routes; the aiohttp app
-serves the same state the reference UI reads — nodes, actors, tasks,
-objects, jobs, metrics — without shipping a frontend bundle).
+"""Dashboard head: a JSON API + minimal UI over cluster state (ref:
+python/ray/dashboard/head.py:65 + modules/* REST routes; the reference
+ships a React bundle — here a single self-contained HTML page renders
+the same tables from the JSON API, no build step, no assets).
 
     port = ray_tpu.dashboard.start_dashboard()
+    GET /                  — HTML UI (auto-refreshing tables)
     GET /api/nodes /api/actors /api/tasks /api/objects /api/jobs
         /api/cluster_status /api/metrics
+    GET /metrics           — Prometheus text scrape endpoint
+                             (ref: _private/prometheus_exporter.py)
 """
 
 from __future__ import annotations
@@ -17,6 +20,79 @@ from typing import Any, Dict, Optional
 _runner = None
 _loop: Optional[asyncio.AbstractEventLoop] = None
 _port: Optional[int] = None
+
+_UI_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font:13px/1.5 system-ui,sans-serif;margin:0;background:#fafafa;color:#222}
+ header{background:#1a237e;color:#fff;padding:10px 20px;display:flex;
+        align-items:baseline;gap:16px}
+ header h1{font-size:16px;margin:0}
+ header span{opacity:.8;font-size:12px}
+ main{padding:16px 20px;max-width:1200px}
+ section{background:#fff;border:1px solid #e0e0e0;border-radius:6px;
+         margin-bottom:16px;padding:12px 16px}
+ h2{font-size:13px;text-transform:uppercase;letter-spacing:.05em;
+    color:#555;margin:0 0 8px}
+ table{border-collapse:collapse;width:100%;font-size:12px}
+ th{text-align:left;color:#777;font-weight:600;border-bottom:1px solid #eee;
+    padding:3px 10px 3px 0}
+ td{border-bottom:1px solid #f3f3f3;padding:3px 10px 3px 0;
+    font-family:ui-monospace,monospace;white-space:nowrap;overflow:hidden;
+    max-width:260px;text-overflow:ellipsis}
+ .pill{display:inline-block;border-radius:9px;padding:0 8px;font-size:11px}
+ .ok{background:#e8f5e9;color:#1b5e20}.bad{background:#ffebee;color:#b71c1c}
+</style></head><body>
+<header><h1>ray_tpu</h1><span id="status"></span>
+<span style="margin-left:auto"><a style="color:#c5cae9"
+ href="/metrics">/metrics</a></span></header>
+<main>
+ <section><h2>Cluster</h2><div id="cluster"></div></section>
+ <section><h2>Nodes</h2><div id="nodes"></div></section>
+ <section><h2>Actors</h2><div id="actors"></div></section>
+ <section><h2>Jobs</h2><div id="jobs"></div></section>
+ <section><h2>Task summary</h2><div id="tasks"></div></section>
+</main>
+<script>
+const esc=s=>String(s).replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;',
+ '>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+// all API data is HTML-escaped; only values wrapped as {__html} (the
+// alive/dead pills built below) render raw
+const fmt=v=>v&&v.__html?v.__html:
+ esc(typeof v==='object'&&v!==null?JSON.stringify(v):String(v));
+function table(rows,cols){if(!rows||!rows.length)return'<i>none</i>';
+ cols=cols||Object.keys(rows[0]);
+ let h='<table><tr>'+cols.map(c=>'<th>'+c+'</th>').join('')+'</tr>';
+ for(const r of rows.slice(0,200))
+  h+='<tr>'+cols.map(c=>'<td>'+fmt(r[c]??'')+'</td>').join('')+'</tr>';
+ return h+'</table>';}
+async function j(u){const r=await fetch(u);return r.json();}
+async function refresh(){try{
+ const cs=await j('/api/cluster_status');
+ document.getElementById('cluster').innerHTML=table([{
+  nodes:cs.nodes,total:fmt(cs.resources_total),
+  available:fmt(cs.resources_available)}]);
+ document.getElementById('tasks').innerHTML=
+  table(Object.entries(cs.task_summary||{}).map(([k,v])=>({state:k,count:v})));
+ const nodes=await j('/api/nodes');
+ document.getElementById('nodes').innerHTML=table(nodes.map(n=>({
+  id:(n.NodeID||'').slice(0,12),address:n.NodeManagerAddress||n.Address||'',
+  alive:{__html:n.Alive?'<span class="pill ok">alive</span>'
+                       :'<span class="pill bad">dead</span>'},
+  resources:fmt(n.Resources||{}),labels:fmt(n.Labels||{})})),
+  ['id','address','alive','resources','labels']);
+ const actors=await j('/api/actors');
+ document.getElementById('actors').innerHTML=table(actors.map(a=>({
+  id:(a.actor_id||'').slice(0,12),class:a.class_name,state:a.state,
+  name:a.name||'',node:(a.node_id||'').slice(0,12)})));
+ const jobs=await j('/api/jobs');
+ document.getElementById('jobs').innerHTML=table(jobs);
+ document.getElementById('status').textContent=
+  'updated '+new Date().toLocaleTimeString();
+}catch(e){document.getElementById('status').textContent='error: '+e;}}
+refresh();setInterval(refresh,5000);
+</script></body></html>
+"""
 
 
 def _routes():
@@ -62,7 +138,18 @@ def _routes():
             "task_summary": state_api.summarize_tasks(),
         })
 
+    async def prometheus_metrics(_req):
+        from ._private.prometheus import render_cluster
+
+        return web.Response(text=render_cluster(),
+                            content_type="text/plain", charset="utf-8")
+
+    async def index(_req):
+        return web.Response(text=_UI_HTML, content_type="text/html")
+
     app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_get("/metrics", prometheus_metrics)
     app.router.add_get("/api/nodes", api_nodes)
     app.router.add_get("/api/actors", api_actors)
     app.router.add_get("/api/tasks", api_tasks)

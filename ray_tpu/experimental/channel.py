@@ -17,6 +17,19 @@ unpickle torn data. When the native lib is unavailable we require x86-64
 
 Layout:  [magic u32][num_readers u32][write_seq u64]
          [read_seq u64 x num_readers][payload_len u64][payload ...]
+
+Tensor fast path (the reference's device-tensor channels,
+python/ray/experimental/channel/torch_tensor_nccl_channel.py +
+auto_transport_type.py, rebuilt TPU-first): array payloads skip pickle.
+A numpy or jax array is written as a raw header + its bytes — for a jax
+array that is ONE device→host DMA into the mapped buffer's copy, and the
+reader rebuilds it with ONE host→device ``device_put`` (type preserved:
+device arrays arrive as device arrays, numpy stays numpy). Transport
+selection is automatic by value type, per the reference's
+AutoTransportType — no type-hint plumbing needed. On a TPU pod the
+intra-jit path for tensors is XLA collectives over ICI
+(parallel/collectives.py); these channels are the actor⇄actor hop for
+tensors that must cross process boundaries outside a jit program.
 """
 
 from __future__ import annotations
@@ -34,7 +47,50 @@ _HDR = struct.Struct("<II")          # magic, num_readers
 _U64 = struct.Struct("<Q")
 _STOP_LEN = (1 << 64) - 1            # payload_len sentinel: channel closed
 
+# tensor-payload prefix: cannot collide with pickle (protocol>=2 starts
+# with b"\x80"), so readers dispatch on the first bytes
+_TNSR = b"\x93RTT"
+_TNSR_HDR = struct.Struct("<4sBB")   # magic, flags, ndim
+_TNSR_DEV = 1                        # flags bit: jax device array
+
 DEFAULT_CAPACITY = 1 << 20
+
+
+def _as_tensor(value):
+    """(flags, np_array) when value takes the raw-tensor fast path, else
+    None. jax is detected via sys.modules — if the process never
+    imported jax, the value cannot be a jax array."""
+    import sys
+
+    np = sys.modules.get("numpy")
+    if np is None:
+        return None
+    flags = 0
+    jx = sys.modules.get("jax")
+    if jx is not None and isinstance(value, jx.Array):
+        # one D2H transfer; multi-device arrays gather (document: shard
+        # cross-process tensors explicitly if that matters)
+        value = np.asarray(value)
+        flags |= _TNSR_DEV
+    # exact type only: ndarray subclasses (MaskedArray, matrix) carry
+    # state the raw lane would drop — they stay on pickle
+    if type(value) is not np.ndarray:
+        return None
+    if value.dtype.hasobject or value.dtype.names is not None:
+        return None
+    # the header stores dtype.name; names that don't round-trip through
+    # np.dtype (str/bytes dtypes: 'str160' etc.) stay on pickle
+    try:
+        if np.dtype(value.dtype.name) != value.dtype:
+            return None
+    except TypeError:
+        return None
+    return flags, np.ascontiguousarray(value)
+
+
+def _tensor_payload_len(arr) -> int:
+    name = arr.dtype.name.encode()
+    return (_TNSR_HDR.size + 1 + len(name) + 8 * arr.ndim + arr.nbytes)
 
 
 _FENCE_STATE: list = []  # lazily resolved: [callable-or-None]
@@ -142,19 +198,48 @@ class Channel:
     # --- writer API ---
 
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
-        payload = pickle.dumps(value, protocol=5)
-        if len(payload) > self.capacity:
+        tens = _as_tensor(value)
+        if tens is not None:
+            payload = None
+            flags, arr = tens
+            length = _tensor_payload_len(arr)
+        else:
+            payload = pickle.dumps(value, protocol=5)
+            length = len(payload)
+        if length > self.capacity:
             raise ValueError(
-                f"channel payload {len(payload)}B exceeds capacity "
+                f"channel payload {length}B exceeds capacity "
                 f"{self.capacity}B (recompile with a larger buffer)")
         seq = self._write_seq()
         self._wait(lambda: all(self._read_seq(i) >= seq
                                for i in range(self.num_readers)), timeout)
         _fence()  # acquire: readers' seq stores observed before overwrite
-        self._mm[self._data_off:self._data_off + len(payload)] = payload
-        _U64.pack_into(self._mm, self._len_off, len(payload))
+        if payload is not None:
+            self._mm[self._data_off:self._data_off + length] = payload
+        else:
+            self._write_tensor(flags, arr)
+        _U64.pack_into(self._mm, self._len_off, length)
         _fence()  # release: payload+len visible before the seq advance
         _U64.pack_into(self._mm, self._w_off, seq + 1)
+
+    def _write_tensor(self, flags: int, arr) -> None:
+        import numpy as np
+
+        name = arr.dtype.name.encode()
+        off = self._data_off
+        _TNSR_HDR.pack_into(self._mm, off, _TNSR, flags, arr.ndim)
+        off += _TNSR_HDR.size
+        self._mm[off] = len(name)
+        off += 1
+        self._mm[off:off + len(name)] = name
+        off += len(name)
+        for dim in arr.shape:
+            _U64.pack_into(self._mm, off, dim)
+            off += 8
+        # raw bytes straight into the mapped buffer (no pickle copy)
+        view = np.frombuffer(self._mm, dtype=np.uint8, count=arr.nbytes,
+                             offset=off)
+        view[:] = arr.reshape(-1).view(np.uint8)
 
     def close_write(self) -> None:
         """Publish the STOP sentinel; readers raise ChannelClosed."""
@@ -177,11 +262,50 @@ class Channel:
         length = _U64.unpack_from(self._mm, self._len_off)[0]
         if length == _STOP_LEN:
             raise ChannelClosed(self.path)
-        value = pickle.loads(
-            self._mm[self._data_off:self._data_off + length])
+        if (length >= _TNSR_HDR.size
+                and self._mm[self._data_off:self._data_off + 4] == _TNSR):
+            value = self._read_tensor()
+        else:
+            value = pickle.loads(
+                self._mm[self._data_off:self._data_off + length])
         _fence()  # release: payload loads retire before the seq advance
         _U64.pack_into(self._mm, self._r_off + 8 * slot, seq + 1)
         return value
+
+    def _read_tensor(self):
+        import numpy as np
+
+        off = self._data_off
+        _, flags, ndim = _TNSR_HDR.unpack_from(self._mm, off)
+        off += _TNSR_HDR.size
+        nlen = self._mm[off]
+        off += 1
+        name = bytes(self._mm[off:off + nlen]).decode()
+        off += nlen
+        shape = []
+        for _ in range(ndim):
+            shape.append(_U64.unpack_from(self._mm, off)[0])
+            off += 8
+        try:
+            dtype = np.dtype(name)
+        except TypeError:
+            import ml_dtypes  # bfloat16 and friends register on import
+
+            dtype = np.dtype(getattr(ml_dtypes, name))
+        count = dtype.itemsize
+        for dim in shape:
+            count *= dim
+        # private copy BEFORE releasing the slot: the next write may
+        # overwrite the buffer the moment our read seq advances, and a
+        # device_put's H2D copy must not race it
+        data = (np.frombuffer(self._mm, dtype=np.uint8, count=count,
+                              offset=off)
+                .copy().view(dtype).reshape(shape))
+        if flags & _TNSR_DEV:
+            import jax
+
+            return jax.device_put(data)
+        return data
 
     # --- lifecycle ---
 

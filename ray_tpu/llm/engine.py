@@ -86,6 +86,10 @@ class LLMEngine:
         # resolved once per engine: a static jit arg, so the flag is
         # part of every decode executable's cache key
         self._paged_kernel = bool(global_config().llm_paged_kernel)
+        # auto-select threshold (pages): long-context rounds stream
+        # pages through the Pallas kernel even when the flag is off
+        self._paged_min_pages = int(
+            getattr(global_config(), "llm_paged_kernel_min_ctx_pages", 0))
         self.ecfg = engine_config or EngineConfig()
         if self.ecfg.max_seq_len > cfg.max_seq:
             raise ValueError("engine max_seq_len exceeds model max_seq")
@@ -333,13 +337,16 @@ class LLMEngine:
             active[s.slot] = True
         seed, temp, top_k, top_p = self._sampling_arrays(self.slots,
                                                          advance=K)
+        span = self._active_span()
+        use_paged = self._paged_kernel or (
+            self._paged_min_pages > 0 and span >= self._paged_min_pages)
         toks, ck, cv = decode_burst(
             self.params, self.cache.k, self.cache.v,
             jnp.asarray(tokens), jnp.asarray(positions),
-            self._bt(self._active_span()),
+            self._bt(span),
             jnp.asarray(active), self.cos, self.sin,
             seed, temp, top_k, top_p, cfg=self.cfg, n_steps=K,
-            paged_kernel=self._paged_kernel)
+            paged_kernel=use_paged)
         self.cache = KVCache(ck, cv)
         sampled = np.asarray(toks)  # [K, B]
         outs = []

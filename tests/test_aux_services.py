@@ -64,6 +64,48 @@ def test_dashboard_api(ray_cluster):
         dashboard.stop_dashboard()
 
 
+def test_dashboard_ui_and_prometheus(ray_cluster):
+    """The UI page serves, and /metrics renders Prometheus text with
+    application metrics flushed through the GCS (ref:
+    _private/prometheus_exporter.py scrape endpoint)."""
+    from ray_tpu import dashboard
+    from ray_tpu.util import metrics as metrics_api
+
+    c = metrics_api.Counter("prom_test_total", description="scrape test",
+                            tag_keys=("kind",))
+    c.inc(3, tags={"kind": "a"})
+    h = metrics_api.Histogram("prom_test_latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(5.0)
+    metrics_api._flush_once()
+    deadline = time.time() + 30
+    port = dashboard.start_dashboard()
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+                return resp.read().decode()
+
+        html = fetch("/")
+        assert "<html" in html and "/api/cluster_status" in html
+        while True:
+            text = fetch("/metrics")
+            if "prom_test_total" in text or time.time() > deadline:
+                break
+            metrics_api._flush_once()
+            time.sleep(0.2)
+        assert "# TYPE prom_test_total counter" in text
+        assert 'prom_test_total{kind="a"} 3' in text
+        assert "# TYPE prom_test_latency histogram" in text
+        assert 'prom_test_latency_bucket{le="0.1"} 1' in text
+        assert "prom_test_latency_count 2" in text
+        assert "prom_test_latency_sum" in text
+        assert "# TYPE ray_tpu_cluster_nodes gauge" in text
+        assert "ray_tpu_cluster_nodes 1" in text
+    finally:
+        dashboard.stop_dashboard()
+
+
 def test_multiprocessing_pool(ray_cluster):
     from ray_tpu.util.multiprocessing import Pool
 

@@ -65,6 +65,31 @@ def test_node_affinity_strategy(cluster2):
     assert ray_tpu.get(ref, timeout=60) == node2.node_id.hex()
 
 
+def test_locality_aware_leasing(cluster2):
+    """A DEFAULT-strategy task whose big argument was produced on node2
+    leases at node2 (ref: lease_policy.h LocalityAwareLeasePolicy) —
+    even though the head raylet has CPU available."""
+    cluster, node2 = cluster2
+
+    @ray_tpu.remote(num_cpus=2)
+    def make_big():
+        return np.zeros(500_000, dtype=np.float32)  # ~2 MB, sealed on node2
+
+    # the 2-CPU request only fits node2 → result lives there
+    big = make_big.remote()
+    ray_tpu.wait([big], timeout=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(a):
+        return os.environ["RAY_TPU_NODE_ID"], float(a[0])
+
+    # head has a free CPU, but the argument bytes are on node2: the
+    # locality-aware lease must start (and grant) there
+    node, val = ray_tpu.get(consume.remote(big), timeout=60)
+    assert node == node2.node_id.hex()
+    assert val == 0.0
+
+
 def test_node_death_loses_objects(cluster2):
     cluster, node2 = cluster2
 
