@@ -95,9 +95,15 @@ class Config:
     # ~1050-1130 tok/s, with both a scanned and an UNROLLED layer loop —
     # at short contexts (~5 pages/seq) the kernel's per-page sequential
     # DMAs and skinny [rep, page] matmuls lose to one big fused gather
-    # einsum. The kernel's regime is long contexts (100+ pages, where
-    # the gather's HBM copy dominates); flip per deployment after
-    # measuring, this default serves the short-context bench shape.
+    # einsum. Re-measured r3 on 1x v5e across ctx 512..8192 (B=4,
+    # burst=32): the gather path wins at EVERY length — our kernel is
+    # 0.69x..0.18x of gather, and even jax's production
+    # pallas.ops.tpu.paged_attention (multi-page compute blocks,
+    # pipelined DMA) is 0.8x of gather at ctx=8192 (5.6 vs 6.9 ms per
+    # 24-layer step). The burst design gathers ONCE per 32-step burst,
+    # so per-step attention reads a contiguous layout at streaming
+    # bandwidth; paged kernels only pay off when the gather copy itself
+    # is unaffordable (HBM headroom), not for speed at these shapes.
     llm_paged_kernel: bool = False
     # Auto-select: when llm_paged_kernel is off, a decode round whose
     # bucketed block-table span is >= this many pages uses the Pallas

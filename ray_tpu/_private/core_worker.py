@@ -1062,6 +1062,9 @@ class CoreWorker:
             owner_address=self.address,
             runtime_env=self._prepare_runtime_env(opts),
         )
+        from ..util.tracing import inject_trace_ctx
+
+        inject_trace_ctx(spec)
         # registered before the submit coroutine runs, so an immediate
         # cancel() cannot race past the bookkeeping
         self._inflight[spec.task_id] = {"canceled": False, "worker_address": None}
@@ -1697,6 +1700,9 @@ class CoreWorker:
             max_retries=opts.get("max_task_retries", 0),
             owner_address=self.address,
         )
+        from ..util.tracing import inject_trace_ctx
+
+        inject_trace_ctx(spec)
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
         # registered so borrower fetch_object sees in-flight returns as
         # pending rather than gone

@@ -241,6 +241,9 @@ class TaskSpec:
     # exports them as TPU_VISIBLE_CHIPS before running user code (ref:
     # accelerators/tpu.py:31 promoted to per-lease scheduler state)
     chip_ids: Optional[List[int]] = None
+    # span context (trace_id, parent_span_id) when tracing is enabled
+    # (ref: tracing_helper.py — span context rides the task options)
+    trace_ctx: Optional[tuple] = None
 
     def is_actor_task(self) -> bool:
         return self.actor_id is not None and not self.actor_creation

@@ -38,6 +38,19 @@ from .task_spec import ArgKind, TaskSpec
 from .. import exceptions as exc
 
 
+def _maybe_span(spec: TaskSpec):
+    """Execution span when the spec carries a trace context (tracing
+    enabled at the driver); a no-op context otherwise."""
+    import contextlib
+
+    ctx = getattr(spec, "trace_ctx", None)
+    if ctx is None:
+        return contextlib.nullcontext()
+    from ..util.tracing import task_span
+
+    return task_span(ctx, spec.function.repr_name)
+
+
 def _resolve_actor_method(instance, name: str):
     """Bound method lookup with a fallback for the injected dynamic-call
     entry point: classes pickled BY REFERENCE re-import without the
@@ -285,7 +298,8 @@ class TaskExecutor:
             self.core.set_task_context(spec.task_id)
             self._register_running(spec.task_id)
             try:
-                values = func(*args, **kwargs)
+                with _maybe_span(spec):
+                    values = func(*args, **kwargs)
             finally:
                 self._running.pop(spec.task_id, None)
                 self.core.clear_task_context()
@@ -419,9 +433,10 @@ class TaskExecutor:
                     self.actor_instance, spec.function.method_name)
                 args, kwargs = await loop.run_in_executor(
                     self.pool, self._resolve_args, spec)
-                values = method(*args, **kwargs)
-                if asyncio.iscoroutine(values):
-                    values = await values
+                with _maybe_span(spec):
+                    values = method(*args, **kwargs)
+                    if asyncio.iscoroutine(values):
+                        values = await values
                 return await loop.run_in_executor(
                     self.pool, lambda: self._ok_reply(spec, values))
             except BaseException as e:  # noqa: BLE001
@@ -445,7 +460,8 @@ class TaskExecutor:
             args, kwargs = self._resolve_args(spec)
             self.core.set_task_context(spec.task_id)
             try:
-                values = method(*args, **kwargs)
+                with _maybe_span(spec):
+                    values = method(*args, **kwargs)
             finally:
                 self.core.clear_task_context()
             if asyncio.iscoroutine(values):

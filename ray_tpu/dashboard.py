@@ -70,8 +70,8 @@ async function j(u){const r=await fetch(u);return r.json();}
 async function refresh(){try{
  const cs=await j('/api/cluster_status');
  document.getElementById('cluster').innerHTML=table([{
-  nodes:cs.nodes,total:fmt(cs.resources_total),
-  available:fmt(cs.resources_available)}]);
+  nodes:cs.nodes,total:cs.resources_total,
+  available:cs.resources_available}]);
  document.getElementById('tasks').innerHTML=
   table(Object.entries(cs.task_summary||{}).map(([k,v])=>({state:k,count:v})));
  const nodes=await j('/api/nodes');
@@ -79,7 +79,7 @@ async function refresh(){try{
   id:(n.NodeID||'').slice(0,12),address:n.NodeManagerAddress||n.Address||'',
   alive:{__html:n.Alive?'<span class="pill ok">alive</span>'
                        :'<span class="pill bad">dead</span>'},
-  resources:fmt(n.Resources||{}),labels:fmt(n.Labels||{})})),
+  resources:n.Resources||{},labels:n.Labels||{}})),
   ['id','address','alive','resources','labels']);
  const actors=await j('/api/actors');
  document.getElementById('actors').innerHTML=table(actors.map(a=>({

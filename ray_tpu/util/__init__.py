@@ -1,5 +1,7 @@
-"""Public utilities: placement groups, scheduling strategies."""
+"""Public utilities: placement groups, scheduling strategies, actor
+pool, distributed queue (ref: python/ray/util/ public surface)."""
 
+from .actor_pool import ActorPool
 from .placement_group import (
     PlacementGroup,
     placement_group,
@@ -13,6 +15,7 @@ from .scheduling_strategies import (
 from . import metrics, state
 
 __all__ = [
+    "ActorPool",
     "PlacementGroup",
     "placement_group",
     "placement_group_table",

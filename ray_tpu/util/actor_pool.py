@@ -1,0 +1,111 @@
+"""Utility pool over pre-created actor handles.
+
+Reference analog: python/ray/util/actor_pool.py:13 ActorPool — submit
+tasks to whichever actor is free, stream results back in submission or
+completion order. The pattern behind Data's actor-pool operator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, TypeVar
+
+import ray_tpu
+
+V = TypeVar("V")
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        if not self._idle:
+            raise ValueError("ActorPool needs at least one actor")
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._pending_submits: List[tuple] = []
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    # --- submission ---
+
+    def submit(self, fn: Callable[[Any, V], Any], value: V) -> None:
+        """Schedule fn(actor, value) on an idle actor; with none free the
+        submit queues and dispatches when a result is retrieved (the
+        reference's _pending_submits behavior — submit never blocks)."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    # --- retrieval ---
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in SUBMISSION order. On timeout the pool state is
+        untouched (the same call can be retried); the actor is released
+        BEFORE the value is fetched, so a task that raised still returns
+        its actor to the pool and pending submits keep flowing."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        ref = self._index_to_future[self._next_return_index]
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out")
+        self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        self._release(ref)
+        return ray_tpu.get(ref)  # ready: raises only the task's error
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next result in COMPLETION order (same release-before-fetch
+        discipline as get_next)."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1,
+                                timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        index, _ = self._future_to_actor[ref]
+        self._index_to_future.pop(index, None)
+        self._release(ref)
+        return ray_tpu.get(ref)
+
+    def _release(self, ref) -> None:
+        index, actor = self._future_to_actor.pop(ref)
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    # --- bulk helpers ---
+
+    def map(self, fn: Callable[[Any, V], Any],
+            values: Iterable[V]) -> Iterable[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, V], Any],
+                      values: Iterable[V]) -> Iterable[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # --- membership ---
+
+    def push(self, actor: Any) -> None:
+        self._idle.append(actor)
+
+    def pop_idle(self) -> Any:
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,146 @@
+"""Distributed FIFO queue backed by a detached-capable actor.
+
+Reference analog: python/ray/util/queue.py — a Queue actor wrapping an
+asyncio.Queue, with sync proxy methods on the handle (put/get with
+block/timeout semantics matching queue.Queue, plus batch variants).
+The actor's asyncio runtime gives blocking put/get without holding a
+worker thread: callers await on the actor method, the actor parks the
+request on its internal asyncio.Queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    def qsize(self) -> int:
+        return self.q.qsize()
+
+    def empty(self) -> bool:
+        return self.q.empty()
+
+    def full(self) -> bool:
+        return self.q.full()
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        try:
+            await asyncio.wait_for(self.q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def put_nowait(self, item) -> bool:
+        try:
+            self.q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def put_nowait_batch(self, items: List[Any]) -> bool:
+        # all-or-nothing, like the reference
+        if self.q.maxsize and self.q.qsize() + len(items) > self.q.maxsize:
+            return False
+        for item in items:
+            self.q.put_nowait(item)
+        return True
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            return True, await asyncio.wait_for(self.q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    def get_nowait(self):
+        try:
+            return True, self.q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    def get_nowait_batch(self, n: int):
+        if self.q.qsize() < n:
+            return False, None
+        return True, [self.q.get_nowait() for _ in range(n)]
+
+
+class Queue:
+    """Sync facade over the queue actor (usable from any driver/worker)."""
+
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        self.maxsize = maxsize
+        options = dict(actor_options or {})
+        self.actor = ray_tpu.remote(_QueueActor).options(**options).remote(
+            maxsize)
+
+    def __reduce__(self):
+        # handles pickle cleanly: workers get the same actor handle
+        return (_rebuild_queue, (self.actor, self.maxsize))
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            if not ray_tpu.get(self.actor.put_nowait.remote(item)):
+                raise Full()
+            return
+        if not ray_tpu.get(self.actor.put.remote(item, timeout)):
+            raise Full()
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        if not ray_tpu.get(self.actor.put_nowait_batch.remote(list(items))):
+            raise Full()
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+            if not ok:
+                raise Empty()
+            return item
+        ok, item = ray_tpu.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty()
+        return item
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        ok, items = ray_tpu.get(self.actor.get_nowait_batch.remote(n))
+        if not ok:
+            raise Empty()
+        return items
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self.actor)
+
+
+def _rebuild_queue(actor, maxsize):
+    q = object.__new__(Queue)
+    q.actor = actor
+    q.maxsize = maxsize
+    return q

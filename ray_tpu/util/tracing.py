@@ -9,9 +9,131 @@ to jax.profiler, whose traces open in Perfetto/XProf).
 
 from __future__ import annotations
 
+import contextvars
 import json
+import os
+import threading
+import time
+import uuid
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
+
+# ---------------------------------------------------------------- spans
+# Distributed span propagation (ref: util/tracing/tracing_helper.py —
+# _inject_tracing_into_function:326 wraps .remote() in a span and
+# serializes the span context into the task spec; the executing worker
+# re-hydrates it as the parent). The reference emits through
+# opentelemetry; this environment has no otel SDK, so spans are recorded
+# self-contained: one JSONL file per process in the session log dir,
+# aggregated by collect_spans(). Each record:
+#   {trace_id, span_id, parent_id, name, kind, start, end, pid}
+
+_TRACE_ENV = "RAY_TPU_TRACING"
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None)   # (trace_id, span_id) | None
+_sink_lock = threading.Lock()
+_sink = None  # opened spans-<pid>.jsonl file
+
+
+def setup_tracing() -> None:
+    """Enable span tracing for this driver and every worker spawned
+    after this call (propagates via the environment, the reference's
+    --tracing-startup-hook analog). Call before ray_tpu.init()."""
+    os.environ[_TRACE_ENV] = "1"
+
+
+def tracing_enabled() -> bool:
+    return os.environ.get(_TRACE_ENV, "") == "1"
+
+
+def _span_dir() -> Optional[str]:
+    from .._private.config import session_log_dir
+    from .. import _worker_api
+
+    session = os.environ.get("RAY_TPU_SESSION", "")
+    if not session and _worker_api._core is not None:
+        session = _worker_api._core.session_name
+    if not session:
+        return None
+    return session_log_dir(session)
+
+
+def _emit_span(rec: Dict[str, Any]) -> None:
+    global _sink
+    with _sink_lock:
+        if _sink is None:
+            d = _span_dir()
+            if d is None:
+                return
+            os.makedirs(d, exist_ok=True)
+            _sink = open(os.path.join(d, f"spans-{os.getpid()}.jsonl"),
+                         "a", buffering=1)
+        _sink.write(json.dumps(rec) + "\n")
+
+
+def current_trace_ctx(name: str) -> Optional[tuple]:
+    """Submission hook: start a `submit` span under the current context
+    and return (trace_id, span_id) to ride the task spec. None when
+    tracing is off (zero overhead on the hot path)."""
+    if not tracing_enabled():
+        return None
+    parent = _ctx.get()
+    trace_id = parent[0] if parent else uuid.uuid4().hex
+    span_id = uuid.uuid4().hex[:16]
+    _emit_span({"trace_id": trace_id, "span_id": span_id,
+                "parent_id": parent[1] if parent else None,
+                "name": f"{name}.remote()", "kind": "submit",
+                "start": time.time(), "end": time.time(),
+                "pid": os.getpid()})
+    return (trace_id, span_id)
+
+
+def inject_trace_ctx(spec) -> None:
+    """Attach a span context to an outgoing TaskSpec (no-op when
+    tracing is off) — the single gate both submit paths share."""
+    if tracing_enabled():
+        spec.trace_ctx = current_trace_ctx(spec.function.repr_name)
+
+
+@contextmanager
+def task_span(trace_ctx: Optional[tuple], name: str):
+    """Execution hook: run the task under a span parented to the
+    submission span; nested .remote() calls inherit the context."""
+    if trace_ctx is None:
+        yield
+        return
+    trace_id, parent_id = trace_ctx
+    span_id = uuid.uuid4().hex[:16]
+    token = _ctx.set((trace_id, span_id))
+    start = time.time()
+    try:
+        yield
+    finally:
+        _ctx.reset(token)
+        _emit_span({"trace_id": trace_id, "span_id": span_id,
+                    "parent_id": parent_id, "name": name,
+                    "kind": "execute", "start": start,
+                    "end": time.time(), "pid": os.getpid()})
+
+
+def collect_spans() -> List[Dict[str, Any]]:
+    """Aggregate span records from every process of the session."""
+    d = _span_dir()
+    if d is None or not os.path.isdir(d):
+        return []
+    out: List[Dict[str, Any]] = []
+    for fname in sorted(os.listdir(d)):
+        if not (fname.startswith("spans-") and fname.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(d, fname)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        except OSError:
+            continue
+    return out
 
 
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:

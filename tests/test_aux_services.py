@@ -36,6 +36,50 @@ def test_timeline_exports_chrome_trace(ray_cluster, tmp_path):
     assert all(e["ph"] == "X" and e["dur"] > 0 for e in named)
 
 
+def test_tracing_span_propagation(tmp_path, monkeypatch):
+    """Span context rides .remote() across processes (ref:
+    tracing_helper.py _inject_tracing_into_function): a task submitted
+    from inside another task shares its trace_id, and the execute span
+    parents to the submit span."""
+    from ray_tpu.util import tracing
+
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote
+        def inner():
+            return 1
+
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get(inner.remote(), timeout=60)
+
+        assert ray_tpu.get(outer.remote(), timeout=60) == 1
+        def find(spans, kind, name):
+            return [s for s in spans
+                    if s["kind"] == kind and name in s["name"]]
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            spans = tracing.collect_spans()
+            if find(spans, "execute", "outer") and \
+                    find(spans, "execute", "inner"):
+                break
+            time.sleep(0.2)
+        outer_exec = find(spans, "execute", "outer")[0]
+        inner_exec = find(spans, "execute", "inner")[0]
+        # one distributed trace end to end
+        assert inner_exec["trace_id"] == outer_exec["trace_id"]
+        # inner's submit span was emitted INSIDE outer's execution, in a
+        # different process than the driver
+        inner_submit = find(spans, "submit", "inner")[0]
+        assert inner_submit["pid"] == outer_exec["pid"]
+        assert inner_submit["parent_id"] == outer_exec["span_id"]
+        assert inner_exec["parent_id"] == inner_submit["span_id"]
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_dashboard_api(ray_cluster):
     from ray_tpu import dashboard
 
@@ -104,6 +148,85 @@ def test_dashboard_ui_and_prometheus(ray_cluster):
         assert "ray_tpu_cluster_nodes 1" in text
     finally:
         dashboard.stop_dashboard()
+
+
+def test_util_queue(ray_cluster):
+    """Distributed Queue (ref: python/ray/util/queue.py): FIFO order,
+    nowait + batch semantics, cross-task handle sharing."""
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=3)
+    q.put(1)
+    q.put_nowait_batch([2, 3])
+    with pytest.raises(Full):
+        q.put_nowait(4)
+    assert q.full() and q.qsize() == 3
+    assert q.get() == 1
+    assert q.get_nowait_batch(2) == [2, 3]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+
+    # handle travels into tasks: producer task feeds a driver consumer
+    @ray_tpu.remote
+    def produce(queue, n):
+        for i in range(n):
+            queue.put(i)
+        return n
+
+    ref = produce.remote(q, 5)
+    got = [q.get(timeout=30) for _ in range(5)]
+    assert got == list(range(5))
+    assert ray_tpu.get(ref, timeout=60) == 5
+    q.shutdown()
+
+
+def test_util_actor_pool(ray_cluster):
+    """ActorPool (ref: python/ray/util/actor_pool.py): ordered map,
+    unordered drain, pending-submit overflow beyond pool width."""
+    from ray_tpu.util import ActorPool
+
+    @ray_tpu.remote
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(2)])
+    assert list(pool.map(lambda a, v: a.sq.remote(v), range(8))) == \
+        [v * v for v in range(8)]
+    # more submits than actors: the overflow queues and still completes
+    for v in range(6):
+        pool.submit(lambda a, v: a.sq.remote(v), v)
+    out = set()
+    while pool.has_next():
+        out.add(pool.get_next_unordered(timeout=30))
+    assert out == {v * v for v in range(6)}
+    assert pool.has_free()
+    a = pool.pop_idle()
+    assert a is not None
+    pool.push(a)
+
+    # failure path: a raising task must still release its actor so
+    # queued pending submits keep flowing (no deadlock)
+    @ray_tpu.remote
+    class Flaky:
+        def run(self, x):
+            if x < 0:
+                raise ValueError("bad")
+            return x
+
+    fpool = ActorPool([Flaky.remote()])
+    for v in (-1, -2, 5):          # 2 raising + 1 good, 1 actor
+        fpool.submit(lambda a, v: a.run.remote(v), v)
+    results, errors = [], 0
+    while fpool.has_next():
+        try:
+            results.append(fpool.get_next(timeout=30))
+        except Exception:
+            errors += 1
+    assert errors == 2 and results == [5]
 
 
 def test_multiprocessing_pool(ray_cluster):
