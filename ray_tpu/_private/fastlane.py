@@ -78,10 +78,14 @@ class _Lane:
         self.on_slot: Optional[Callable[[], None]] = None  # pool wakeup
         self._seq = 0
         self._lock = threading.Lock()
-        # serializes ring pushes against teardown: free() (munmap) must
-        # never run under a concurrent push
+        # serializes EVERY cross-thread ring touch against teardown:
+        # free() (munmap) must never run under a concurrent push OR
+        # close_write — rtpu_ring_close on a freed mapping segfaults
+        # (observed: reclaim-path close() racing the reply thread's
+        # _cleanup_rings)
         self._push_lock = threading.Lock()
         self._sub_freed = False
+        self._rep_freed = False
         self._reply_thread = threading.Thread(
             target=self._reply_loop, daemon=True,
             name=f"lane_reply_{self.worker_address[-8:]}")
@@ -183,13 +187,15 @@ class _Lane:
     def _cleanup_rings(self):
         """Reply-thread exit owns teardown: unmap both rings and unlink
         their files (16 MB of tmpfs per lane otherwise leaks on every
-        attach/release cycle). The push lock keeps a racing submitter
-        out of the sub ring's mapping while it dies."""
-        try:
-            self.rep.free()
-        except Exception:
-            pass
+        attach/release cycle). The push lock keeps every other thread
+        (submitters pushing, close()/`_mark_dead` writing close flags)
+        out of both mappings while they die."""
         with self._push_lock:
+            self._rep_freed = True
+            try:
+                self.rep.free()
+            except Exception:
+                pass
             self._sub_freed = True
             try:
                 self.sub.free()
@@ -206,10 +212,12 @@ class _Lane:
             if self.dead:
                 return
             self.dead = True
-        try:
-            self.sub.close_write()
-        except Exception:
-            pass
+        with self._push_lock:
+            if not self._sub_freed:
+                try:
+                    self.sub.close_write()
+                except Exception:
+                    pass
 
     def _fail_pending(self):
         """Worker died: resubmit retriable pending tasks through the
@@ -280,10 +288,12 @@ class _Lane:
 
     def close(self, *, release_lease: bool = True):
         self._mark_dead()
-        try:
-            self.rep.close_write()
-        except Exception:
-            pass
+        with self._push_lock:
+            if not self._rep_freed:
+                try:
+                    self.rep.close_write()
+                except Exception:
+                    pass
         if release_lease and not self.client.closed:
             async def _ret():
                 try:

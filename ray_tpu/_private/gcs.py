@@ -179,6 +179,12 @@ class GcsServer:
         self.task_events: Dict[Any, dict] = {}
         self.MAX_TASK_EVENTS = 10_000
         self.MAX_METRICS = 10_000
+        # structured cluster events (ref: src/ray/util/event.h +
+        # _private/event/export_event_logger.py — severity-tagged
+        # lifecycle records the dashboard event module surfaces)
+        import collections as _collections
+
+        self.events: "_collections.deque" = _collections.deque(maxlen=5000)
         self._next_job = 1
         self._restore_tables()
 
@@ -227,6 +233,32 @@ class GcsServer:
                 pass
         await self.server.stop()
         self.storage.close()
+
+    # ---- structured events (ref: util/event.h EventManager) ----
+    def _event(self, source: str, severity: str, message: str,
+               **fields) -> None:
+        rec = {"timestamp": time.time(), "source": source,
+               "severity": severity, "message": message, **fields}
+        self.events.append(rec)
+        # streamed to subscribers too (dashboard live tail)
+        asyncio.ensure_future(self._publish("events", rec))
+
+    async def handle_list_events(self, payload, conn):
+        source = payload.get("source")
+        severity = payload.get("severity")
+        limit = int(payload.get("limit", 1000))
+        out = [e for e in self.events
+               if (not source or e["source"] == source)
+               and (not severity or e["severity"] == severity)]
+        return out[-limit:]
+
+    async def handle_report_event(self, payload, conn):
+        """Application/library events enter the same stream."""
+        self._event(payload.get("source", "APP"),
+                    payload.get("severity", "INFO"),
+                    payload.get("message", ""),
+                    **payload.get("fields", {}))
+        return True
 
     # ---- pubsub ----
     async def _publish(self, channel: str, payload: Any):
@@ -324,6 +356,8 @@ class GcsServer:
         self.nodes[info.node_id] = info
         self._node_conns[conn] = info.node_id
         await self._publish("node", {"event": "added", "node": info})
+        self._event("NODE", "INFO", "node registered",
+                    node_id=info.node_id.hex(), address=info.address)
         return {"nodes": list(self.nodes.values())}
 
     async def handle_get_all_nodes(self, payload, conn):
@@ -354,6 +388,9 @@ class GcsServer:
             return
         info.alive = False
         await self._publish("node", {"event": "removed", "node_id": node_id, "reason": reason})
+        self._event("NODE", "ERROR" if "died" in reason or "lost" in reason
+                    else "INFO", f"node dead: {reason}",
+                    node_id=node_id.hex())
         # Fail actors on the dead node (ref: gcs_actor_manager OnNodeDead)
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION):
@@ -389,6 +426,7 @@ class GcsServer:
         self.jobs[job_id] = {"config": payload.get("config", {}), "start_time": time.time(),
                              "driver_address": payload.get("driver_address", "")}
         self._persist("jobs", str(job_num), (job_id, self.jobs[job_id]))
+        self._event("JOB", "INFO", "job registered", job_id=job_id.hex())
         return job_id
 
     async def handle_get_all_jobs(self, payload, conn):
@@ -430,6 +468,9 @@ class GcsServer:
         self.actors[info.actor_id] = info
         self._persist("actors", info.actor_id.hex(), info)
         await self._publish("actor", {"actor": info})
+        self._event("ACTOR", "INFO", "actor registered",
+                    actor_id=info.actor_id.hex(),
+                    class_name=info.class_name, name=info.name)
         return True
 
     async def handle_actor_alive(self, payload, conn):
@@ -473,6 +514,11 @@ class GcsServer:
             actor.address = ""
             self._persist("actors", actor.actor_id.hex(), actor)
             await self._publish("actor", {"actor": actor})
+            self._event("ACTOR", "WARNING",
+                        f"actor restarting ({actor.num_restarts}/"
+                        f"{actor.max_restarts}): {cause}",
+                        actor_id=actor.actor_id.hex(),
+                        class_name=actor.class_name)
             # restart is driven by the owning core worker, which subscribes
             # to RESTARTING transitions and resubmits the creation task
         else:
@@ -481,6 +527,9 @@ class GcsServer:
             actor.address = ""
             self._persist("actors", actor.actor_id.hex(), actor)
             await self._publish("actor", {"actor": actor})
+            self._event("ACTOR", "ERROR", f"actor died: {cause}",
+                        actor_id=actor.actor_id.hex(),
+                        class_name=actor.class_name)
 
     async def handle_kill_actor(self, payload, conn):
         actor = self.actors.get(payload["actor_id"])

@@ -585,6 +585,30 @@ class Raylet:
                 })
             except Exception:
                 pass
+        # leases the dead process OWNED (fast lanes it opened for its own
+        # subtasks) must be reaped too, or their resources leak forever —
+        # observed: a killed SplitCoordinator's 1-CPU lane lease wedging
+        # every later data pipeline on the node (ref: the reference's
+        # per-owner lease cleanup on worker death,
+        # node_manager.cc HandleUnexpectedWorkerFailure)
+        orphaned = [l for l in self._leases.values()
+                    if l.owner_address == worker.address]
+        for lease in orphaned:
+            self._leases.pop(lease.lease_id, None)
+            self._forget_rid(lease.lease_id)
+            self._release_lease_resources(lease)
+            held = lease.worker
+            held.lease = None
+            # disconnect rather than reuse: the orphaned worker may have
+            # a lane-serve thread still polling the dead owner's ring
+            held.alive = False
+            if held.conn is not None:
+                try:
+                    await held.conn.push("shutdown", {})
+                except Exception:
+                    pass
+        if orphaned:
+            await self._report_resources()
         await self._pump_pending()
 
     async def _pop_worker(self) -> Optional[WorkerHandle]:
@@ -1305,4 +1329,14 @@ class Raylet:
             "num_pending_leases": len(self._pending_leases),
             "num_objects": len(self._sealed),
             "store_used_bytes": self.store.used_bytes(),
+            # per-lease detail: who holds this node's resources (the
+            # `ray memory`-style leak-hunting view)
+            "leases": [{
+                "lease_id": lease.lease_id,
+                "resources": lease.resources.to_dict(),
+                "owner": lease.owner_address,
+                "lane": lease.lane,
+                "actor_id": (lease.worker.actor_id.hex()
+                             if lease.worker.actor_id else None),
+            } for lease in self._leases.values()],
         }

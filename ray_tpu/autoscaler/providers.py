@@ -1,0 +1,156 @@
+"""Node providers beyond the in-process one (ref:
+python/ray/autoscaler/node_provider.py implementations —
+autoscaler/{local,gcp,kuberay}/).
+
+* SubprocessNodeProvider — real worker-NODE processes on this host,
+  launched through the CLI (`python -m ray_tpu.scripts.cli start
+  --address ...`). The process-level analog of LocalNodeProvider: nodes
+  survive the autoscaler, die with terminate_node, and register through
+  the same GCS path a remote host would.
+* TpuQueuedResourceProvider — GCP TPU slices via `gcloud compute tpus
+  queued-resources` (ref: the TPU pod scheduling the reference models
+  with TPU-<type>-head resources + the GKE/kuberay providers). The
+  command layer is injectable, so control logic is unit-testable in a
+  zero-egress environment; with the real default runner it shells out
+  to gcloud.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from . import NodeProvider
+
+
+class SubprocessNodeProvider(NodeProvider):
+    """Worker nodes as real subprocesses joined to a live cluster."""
+
+    def __init__(self, address: str, *,
+                 startup_timeout_s: float = 60.0):
+        self.address = address
+        self.startup_timeout_s = startup_timeout_s
+        self._procs: List[subprocess.Popen] = []
+
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        import tempfile
+
+        cmd = [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+               "--address", self.address, "--block"]
+        if "CPU" in resources:
+            cmd += ["--num-cpus", str(resources["CPU"])]
+        env = dict(os.environ)
+        env["PYTHONUNBUFFERED"] = "1"
+        # logs go to a FILE, never a pipe: nobody drains a pipe after
+        # startup, and a full pipe buffer would wedge the node mid-run
+        log = tempfile.NamedTemporaryFile(
+            mode="w+b", prefix="ray_tpu_node_", suffix=".log",
+            delete=False)
+        proc = subprocess.Popen(cmd, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+        proc._rtpu_log_path = log.name  # type: ignore[attr-defined]
+        log.close()
+        # poll the log for the node-up line (a blocking readline would
+        # defeat the deadline when the child hangs silently)
+        deadline = time.monotonic() + self.startup_timeout_s
+        while time.monotonic() < deadline:
+            with open(log.name, "rb") as f:
+                content = f.read().decode(errors="replace")
+            if "node up:" in content:
+                break
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker node exited at startup: {content[-500:]}")
+            time.sleep(0.2)
+        else:
+            proc.kill()
+            raise TimeoutError("worker node startup timed out")
+        self._procs.append(proc)
+        return proc
+
+    def terminate_node(self, handle: Any) -> None:
+        if handle in self._procs:
+            self._procs.remove(handle)
+        handle.terminate()
+        try:
+            handle.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            handle.kill()
+
+    def non_terminated_nodes(self) -> List[Any]:
+        self._procs = [p for p in self._procs if p.poll() is None]
+        return list(self._procs)
+
+
+def _default_gcloud_runner(cmd: List[str]) -> str:
+    return subprocess.check_output(cmd, text=True,
+                                   stderr=subprocess.STDOUT)
+
+
+class TpuQueuedResourceProvider(NodeProvider):
+    """TPU slices through the queued-resources API.
+
+    create_node provisions one slice (`accelerator_type` e.g.
+    "v5litepod-8", `runtime_version` the TPU VM image) whose startup
+    script joins this cluster; terminate_node deletes the queued
+    resource; non_terminated_nodes lists live ones. ``runner`` executes
+    the gcloud command line and returns stdout — inject a fake to test
+    control logic without GCP access.
+    """
+
+    def __init__(self, *, project: str, zone: str, accelerator_type: str,
+                 runtime_version: str, cluster_address: str,
+                 runner: Callable[[List[str]], str] = _default_gcloud_runner,
+                 name_prefix: str = "ray-tpu"):
+        self.project = project
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.cluster_address = cluster_address
+        self.runner = runner
+        self.name_prefix = name_prefix
+        self._nodes: Dict[str, dict] = {}
+
+    def _base(self, *verb: str) -> List[str]:
+        return ["gcloud", "compute", "tpus", "queued-resources", *verb,
+                "--project", self.project, "--zone", self.zone,
+                "--quiet"]
+
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        name = f"{self.name_prefix}-{uuid.uuid4().hex[:8]}"
+        startup = (f"python -m ray_tpu.scripts.cli start "
+                   f"--address {shlex.quote(self.cluster_address)} --block")
+        cmd = self._base("create", name) + [
+            "--node-id", name,
+            "--accelerator-type", self.accelerator_type,
+            "--runtime-version", self.runtime_version,
+            "--metadata", f"startup-script={startup}",
+        ]
+        self.runner(cmd)
+        self._nodes[name] = {"name": name, "resources": dict(resources)}
+        return name
+
+    def terminate_node(self, handle: Any) -> None:
+        self.runner(self._base("delete", str(handle)) + ["--force"])
+        self._nodes.pop(str(handle), None)
+
+    def non_terminated_nodes(self) -> List[Any]:
+        out = self.runner(self._base("list") + ["--format", "json"])
+        live = []
+        try:
+            for entry in json.loads(out or "[]"):
+                name = entry.get("name", "").rsplit("/", 1)[-1]
+                state = (entry.get("state", {}) or {}).get("state", "")
+                if (name.startswith(self.name_prefix)
+                        and state not in ("SUSPENDED", "FAILED",
+                                          "DELETING")):
+                    live.append(name)
+        except json.JSONDecodeError:
+            live = list(self._nodes)
+        return live

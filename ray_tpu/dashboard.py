@@ -52,6 +52,7 @@ _UI_HTML = """<!doctype html>
  <section><h2>Actors</h2><div id="actors"></div></section>
  <section><h2>Jobs</h2><div id="jobs"></div></section>
  <section><h2>Task summary</h2><div id="tasks"></div></section>
+ <section><h2>Events</h2><div id="events"></div></section>
 </main>
 <script>
 const esc=s=>String(s).replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;',
@@ -87,6 +88,12 @@ async function refresh(){try{
   name:a.name||'',node:(a.node_id||'').slice(0,12)})));
  const jobs=await j('/api/jobs');
  document.getElementById('jobs').innerHTML=table(jobs);
+ const ev=await j('/api/events?limit=30');
+ document.getElementById('events').innerHTML=table(
+  ev.reverse().map(e=>({
+   time:new Date(e.timestamp*1000).toLocaleTimeString(),
+   source:e.source,severity:e.severity,message:e.message})),
+  ['time','source','severity','message']);
  document.getElementById('status').textContent=
   'updated '+new Date().toLocaleTimeString();
 }catch(e){document.getElementById('status').textContent='error: '+e;}}
@@ -124,6 +131,12 @@ def _routes():
     async def api_metrics(_req):
         return _json(state_api.get_metrics())
 
+    async def api_events(req):
+        return _json(state_api.list_cluster_events(
+            source=req.query.get("source"),
+            severity=req.query.get("severity"),
+            limit=int(req.query.get("limit", 100))))
+
     async def api_jobs(_req):
         from .job_submission import JobSubmissionClient
 
@@ -156,6 +169,7 @@ def _routes():
     app.router.add_get("/api/objects", api_objects)
     app.router.add_get("/api/jobs", api_jobs)
     app.router.add_get("/api/metrics", api_metrics)
+    app.router.add_get("/api/events", api_events)
     app.router.add_get("/api/cluster_status", api_cluster_status)
     return app
 

@@ -7,6 +7,7 @@ training gangs with per-worker iterators that prefetch to device (HBM).
 
 from __future__ import annotations
 
+from builtins import range as _builtin_range
 from typing import Any, List, Optional
 
 from .block import Block
@@ -86,9 +87,128 @@ def read_images(paths, *, size=None, mode: str = "RGB",
                            parallelism=parallelism)
 
 
+def read_text(paths, *, drop_empty_lines: bool = True,
+              parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """Line-per-row text files as a 'text' column."""
+    from .datasource import TextDatasource
+
+    return read_datasource(
+        TextDatasource(paths, drop_empty_lines=drop_empty_lines),
+        parallelism=parallelism)
+
+
+def read_binary_files(paths, *,
+                      parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """Whole files as {'bytes', 'path'} rows."""
+    from .datasource import BinaryDatasource
+
+    return read_datasource(BinaryDatasource(paths),
+                           parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *,
+             shard_key: Optional[str] = None, shards: int = 1,
+             parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """Query any DB-API 2.0 database (ref: _internal/datasource/
+    sql_datasource.py). ``connection_factory`` is a zero-arg callable
+    run inside each read task; ``shard_key``/``shards`` split the query
+    by ``key % shards`` for parallel reads."""
+    from .datasource import SQLDatasource
+
+    return read_datasource(
+        SQLDatasource(sql, connection_factory, shard_key=shard_key,
+                      shards=shards),
+        parallelism=parallelism)
+
+
+def read_webdataset(paths, *,
+                    parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """Tar shards of key-grouped samples (webdataset layout)."""
+    from .datasource import WebDatasetDatasource
+
+    return read_datasource(WebDatasetDatasource(paths),
+                           parallelism=parallelism)
+
+
+def from_pandas(df, *, parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """One or more pandas DataFrames as columnar blocks."""
+    dfs = df if isinstance(df, (list, tuple)) else [df]
+    import numpy as np
+
+    blocks = [{str(c): np.asarray(d[c]) for c in d.columns} for d in dfs]
+
+    class _Blocks(Datasource):
+        def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+            return [ReadTask(lambda b=b: iter([b])) for b in blocks]
+
+    return read_datasource(_Blocks(), parallelism=parallelism)
+
+
+def from_huggingface(dataset, *, batch_rows: int = 4096,
+                     parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """A `datasets.Dataset` (huggingface) as columnar blocks (ref:
+    _internal/datasource/huggingface_datasource.py). The dataset is
+    sliced into row ranges; each read task materializes its own range,
+    so blocks load in parallel workers."""
+    n = len(dataset)
+    shard = max(1, -(-n // max(1, parallelism)))
+
+    class _HF(Datasource):
+        def get_read_tasks(self, par: int) -> List[ReadTask]:
+            tasks = []
+            for start in _builtin_range(0, n, shard):
+                def _read(start=start):
+                    import numpy as np
+
+                    end = min(start + shard, n)
+                    sl = dataset[start:end]  # dict of lists
+                    out = {}
+                    for k, v in sl.items():
+                        try:
+                            out[k] = np.asarray(v)
+                        except Exception:
+                            out[k] = np.asarray(v, dtype=object)
+                    return iter([out])
+                tasks.append(ReadTask(_read, num_rows=min(
+                    shard, n - start)))
+            return tasks
+
+        def estimated_rows(self):
+            return n
+
+    return read_datasource(_HF(), parallelism=parallelism)
+
+
+def from_torch(torch_dataset, *,
+               parallelism: int = _DEFAULT_PARALLELISM) -> Dataset:
+    """A map-style torch Dataset as {'item': ...} rows (ref:
+    _internal/datasource/torch_datasource.py)."""
+    n = len(torch_dataset)
+    shard = max(1, -(-n // max(1, parallelism)))
+
+    class _Torch(Datasource):
+        def get_read_tasks(self, par: int) -> List[ReadTask]:
+            tasks = []
+            for start in _builtin_range(0, n, shard):
+                def _read(start=start):
+                    end = min(start + shard, n)
+                    rows = [{"item": torch_dataset[i]}
+                            for i in _builtin_range(start, end)]
+                    return iter([rows])
+                tasks.append(ReadTask(_read, num_rows=min(shard, n - start)))
+            return tasks
+
+        def estimated_rows(self):
+            return n
+
+    return read_datasource(_Torch(), parallelism=parallelism)
+
+
 __all__ = [
     "Block", "Dataset", "DataIterator", "Datasource", "ReadTask",
     "GroupedData",
     "read_datasource", "range", "from_items", "read_parquet", "read_json",
     "read_numpy", "read_csv", "read_tfrecords", "read_images",
+    "read_text", "read_binary_files", "read_sql", "read_webdataset",
+    "from_pandas", "from_huggingface", "from_torch",
 ]

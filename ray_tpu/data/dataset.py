@@ -413,6 +413,15 @@ class Dataset:
             return block_schema(block)
         return None
 
+    def to_pandas(self, limit: Optional[int] = None):
+        """Materialize as one pandas DataFrame (ref: dataset.py
+        to_pandas — same caveat: the whole dataset lands on the
+        driver)."""
+        import pandas as pd
+
+        rows = list(self.iter_rows()) if limit is None else self.take(limit)
+        return pd.DataFrame(rows)
+
     def materialize(self) -> "Dataset":
         """Execute now; the result holds block refs and re-iterates without
         recomputation (ref: dataset.py materialize → MaterializedDataset)."""

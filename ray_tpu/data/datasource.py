@@ -368,6 +368,150 @@ class TFRecordsDatasource(Datasource):
         return tasks
 
 
+class TextDatasource(Datasource):
+    """read_text: one row per line, column 'text' (ref:
+    _internal/datasource/text_datasource.py)."""
+
+    def __init__(self, paths, *, drop_empty_lines: bool = True):
+        self.files = _expand_paths(paths, (".txt", ".text", ".log"))
+        self.drop_empty = drop_empty_lines
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.files:
+            def _read(path=path, drop=self.drop_empty):
+                with open(path, errors="replace") as f:
+                    lines = [ln.rstrip("\n") for ln in f]
+                if drop:
+                    lines = [ln for ln in lines if ln]
+                yield {"text": np.asarray(lines, dtype=object)}
+
+            tasks.append(ReadTask(_read))
+        return tasks
+
+
+class BinaryDatasource(Datasource):
+    """read_binary_files: whole files as rows {'bytes', 'path'} (ref:
+    _internal/datasource/binary_datasource.py)."""
+
+    def __init__(self, paths):
+        self.files = _expand_paths(paths, ())
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.files:
+            def _read(path=path):
+                with open(path, "rb") as f:
+                    data = f.read()
+                yield {"bytes": np.asarray([data], dtype=object),
+                       "path": np.asarray([path])}
+
+            tasks.append(ReadTask(_read, num_rows=1))
+        return tasks
+
+
+class SQLDatasource(Datasource):
+    """read_sql: any DB-API 2.0 connection (ref:
+    _internal/datasource/sql_datasource.py — same contract: a
+    zero-argument ``connection_factory`` so each read task opens its own
+    connection in its worker process; sqlite3/psycopg/mysql all fit).
+    Parallelism is 1 unless ``shard_keys`` splits the query with
+    ``WHERE <key> % N = i`` (the reference's sharding option)."""
+
+    def __init__(self, sql: str, connection_factory: Callable[[], Any],
+                 *, shard_key: Optional[str] = None, shards: int = 1):
+        self.sql = sql
+        self.connection_factory = connection_factory
+        self.shard_key = shard_key
+        self.shards = max(1, shards)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        def make(query):
+            def _read(query=query):
+                conn = self.connection_factory()
+                try:
+                    cur = conn.cursor()
+                    cur.execute(query)
+                    names = [d[0] for d in cur.description]
+                    rows = cur.fetchall()
+                finally:
+                    conn.close()
+                cols: Dict[str, list] = {n: [] for n in names}
+                for row in rows:
+                    for name, val in zip(names, row):
+                        cols[name].append(val)
+                out = {}
+                for name, col in cols.items():
+                    try:
+                        out[name] = np.asarray(col)
+                    except Exception:
+                        out[name] = np.asarray(col, dtype=object)
+                yield out
+
+            return _read
+
+        if self.shard_key and self.shards > 1:
+            # subquery wrap keeps the outer WHERE valid whatever the
+            # user query contains; the double-mod normalizes negative
+            # keys (SQL % takes the dividend's sign — plain `k % N = i`
+            # would silently drop every negative-key row)
+            n = self.shards
+            return [ReadTask(make(
+                f"SELECT * FROM ({self.sql}) __q WHERE "
+                f"((__q.{self.shard_key} % {n}) + {n}) % {n} = {i}"))
+                for i in range(n)]
+        return [ReadTask(make(self.sql))]
+
+
+class WebDatasetDatasource(Datasource):
+    """read_webdataset: tar shards of samples grouped by key — members
+    ``<key>.<ext>`` form one row with one column per extension (ref:
+    _internal/datasource/webdataset_datasource.py, tarfile-native here).
+    Text-ish extensions decode to str, ``.json`` parses, the rest stay
+    bytes."""
+
+    TEXT_EXTS = ("txt", "text", "cls", "caption")
+
+    def __init__(self, paths):
+        self.files = _expand_paths(paths, (".tar",))
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self.files:
+            def _read(path=path):
+                import json
+                import tarfile
+
+                samples: Dict[str, Dict[str, Any]] = {}
+                order: List[str] = []
+                with tarfile.open(path) as tf:
+                    for member in tf:
+                        if not member.isfile():
+                            continue
+                        # webdataset convention: the key is the full
+                        # path up to the basename's first dot — samples
+                        # in different subdirs must not merge
+                        dirn = os.path.dirname(member.name)
+                        stem, _, ext = os.path.basename(
+                            member.name).partition(".")
+                        key = f"{dirn}/{stem}" if dirn else stem
+                        data = tf.extractfile(member).read()
+                        if ext in self.TEXT_EXTS:
+                            value: Any = data.decode(errors="replace")
+                        elif ext == "json":
+                            value = json.loads(data)
+                        else:
+                            value = data
+                        if key not in samples:
+                            samples[key] = {"__key__": key}
+                            order.append(key)
+                        samples[key][ext] = value
+                yield [samples[k] for k in order]
+
+            tasks.append(ReadTask(_read))
+        return tasks
+
+
 class ImageDatasource(Datasource):
     """read_images: one task per file; blocks carry {"image": HWC uint8,
     "path": str} (ref: _internal/datasource/image_datasource.py, PIL-

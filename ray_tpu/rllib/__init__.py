@@ -2,6 +2,8 @@
 EnvRunner sampling actors + a jitted jax Learner; SURVEY §2.4)."""
 
 from .env import CartPole, make_env
+from .dqn import DQN, DQNConfig
 from .ppo import PPO, PPOConfig, EnvRunner
 
-__all__ = ["PPO", "PPOConfig", "EnvRunner", "CartPole", "make_env"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "EnvRunner",
+           "CartPole", "make_env"]

@@ -1,0 +1,310 @@
+"""DQN on jax (ref: rllib/algorithms/dqn/ — new-API-stack shape like
+ppo.py here): epsilon-greedy env-runner actors feed a replay buffer; the
+learner update (double-DQN TD loss + adam + periodic target sync) is one
+jitted function, so the math compiles onto the device while sampling
+stays on CPU actors.
+
+    algo = (DQNConfig().environment("CartPole-v1")
+            .env_runners(num_env_runners=2)
+            .training(lr=1e-3, train_batch_size=64)).build()
+    for _ in range(20):
+        metrics = algo.train()
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .env import make_env
+from .ppo import init_policy  # same MLP trunk; the pi head doubles as Q
+
+
+def q_forward(params, obs):
+    """Q-values per action: the MLP's 'pi' head read as Q(s, ·)."""
+    import jax.numpy as jnp
+
+    x = obs
+    for layer in params["layers"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x @ params["pi"]["w"] + params["pi"]["b"]
+
+
+class ReplayBuffer:
+    """Uniform ring replay (ref: rllib/utils/replay_buffers/)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.size = 0
+        self._next = 0
+
+    def add_batch(self, frag: Dict[str, np.ndarray]) -> None:
+        n = len(frag["actions"])
+        for i in range(n):
+            j = self._next
+            self.obs[j] = frag["obs"][i]
+            self.next_obs[j] = frag["next_obs"][i]
+            self.actions[j] = frag["actions"][i]
+            self.rewards[j] = frag["rewards"][i]
+            self.dones[j] = frag["dones"][i]
+            self._next = (self._next + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, rng: np.random.Generator, batch: int):
+        idx = rng.integers(0, self.size, batch)
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx], "rewards": self.rewards[idx],
+                "dones": self.dones[idx]}
+
+
+class DQNEnvRunner:
+    """Epsilon-greedy sampling actor (ref: single_agent_env_runner.py)."""
+
+    def __init__(self, env_spec, hidden: Tuple[int, ...], seed: int):
+        self.env = make_env(env_spec, seed=seed)
+        self.rng = np.random.default_rng(seed)
+        self._params = None
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._completed: List[float] = []
+
+    def set_params(self, params) -> bool:
+        self._params = params
+        return True
+
+    def sample(self, num_steps: int,
+               epsilon: float) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        obs_dim = len(self._obs)
+        out = {k: np.zeros((num_steps, obs_dim), np.float32)
+               for k in ("obs", "next_obs")}
+        out["actions"] = np.zeros(num_steps, np.int32)
+        out["rewards"] = np.zeros(num_steps, np.float32)
+        out["dones"] = np.zeros(num_steps, np.float32)
+        for t in range(num_steps):
+            if self.rng.random() < epsilon:
+                action = int(self.rng.integers(self.env.action_dim))
+            else:
+                q = np.asarray(q_forward(self._params,
+                                         jnp.asarray(self._obs[None, :])))
+                action = int(q[0].argmax())
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            done = terminated or truncated
+            out["obs"][t] = self._obs
+            out["next_obs"][t] = nxt
+            out["actions"][t] = action
+            out["rewards"][t] = reward
+            out["dones"][t] = float(terminated)  # truncation bootstraps
+            self._episode_return += reward
+            if done:
+                self._completed.append(self._episode_return)
+                self._episode_return = 0.0
+                nxt, _ = self.env.reset()
+            self._obs = nxt
+        completed, self._completed = self._completed, []
+        out["episode_returns"] = np.asarray(completed, np.float32)
+        return out
+
+
+_DQN_UPDATE_JIT = None
+
+
+def dqn_update(params, target_params, opt_state, batch, lr, *,
+               gamma: float, n_updates: int):
+    """``n_updates`` double-DQN steps in one compiled program."""
+    global _DQN_UPDATE_JIT
+    if _DQN_UPDATE_JIT is None:
+        import jax
+
+        _DQN_UPDATE_JIT = jax.jit(
+            _dqn_update_impl, static_argnames=("gamma", "n_updates"))
+    return _DQN_UPDATE_JIT(params, target_params, opt_state, batch, lr,
+                           gamma=gamma, n_updates=n_updates)
+
+
+def _dqn_update_impl(params, target_params, opt_state, batch, lr, *,
+                     gamma: float, n_updates: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.adam(lr)
+    N = batch["obs"].shape[0]
+    mb = N // n_updates
+
+    def loss_fn(p, sl):
+        q = q_forward(p, batch["obs"][sl])
+        q_sel = jnp.take_along_axis(
+            q, batch["actions"][sl][:, None], axis=1)[:, 0]
+        # double DQN: online net picks the argmax, target net scores it
+        q_next_online = q_forward(p, batch["next_obs"][sl])
+        best = jnp.argmax(q_next_online, axis=1)
+        q_next_target = q_forward(target_params, batch["next_obs"][sl])
+        q_best = jnp.take_along_axis(q_next_target, best[:, None],
+                                     axis=1)[:, 0]
+        target = (batch["rewards"][sl]
+                  + gamma * (1.0 - batch["dones"][sl])
+                  * jax.lax.stop_gradient(q_best))
+        td = q_sel - target
+        return jnp.square(td).mean(), jnp.abs(td).mean()
+
+    def step(carry, i):
+        p, opt = carry
+        sl = jax.lax.dynamic_slice_in_dim(jnp.arange(N), i * mb, mb)
+        (loss, td_abs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, sl)
+        updates, opt = optimizer.update(grads, opt, p)
+        p = optax.apply_updates(p, updates)
+        return (p, opt), (loss, td_abs)
+
+    (params, opt_state), (losses, tds) = jax.lax.scan(
+        step, (params, opt_state), jnp.arange(n_updates))
+    return params, opt_state, {"td_loss": losses.mean(),
+                               "td_abs": tds.mean()}
+
+
+@dataclass
+class DQNConfig:
+    env: Any = "CartPole-v1"
+    num_env_runners: int = 1
+    rollout_fragment_length: int = 128
+    train_batch_size: int = 64
+    lr: float = 1e-3
+    gamma: float = 0.99
+    hidden: Tuple[int, ...] = (64, 64)
+    buffer_capacity: int = 50_000
+    learning_starts: int = 500
+    updates_per_iter: int = 8
+    target_update_interval: int = 4      # iterations between target syncs
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_iters: int = 30
+    seed: int = 0
+
+    def environment(self, env) -> "DQNConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "DQNConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "DQNConfig":
+        for key, val in kwargs.items():
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training option {key!r}")
+            setattr(self, key, val)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """Algorithm driver (ref: algorithms/dqn/dqn.py training_step):
+    sample in parallel -> replay add -> minibatch updates -> periodic
+    target sync -> broadcast."""
+
+    def __init__(self, config: DQNConfig):
+        import jax
+        import optax
+
+        self.config = config
+        probe = make_env(config.env, seed=0)
+        self.obs_dim = probe.observation_dim
+        self.act_dim = probe.action_dim
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_policy(key, self.obs_dim, self.act_dim,
+                                  config.hidden)
+        self.target_params = jax.tree.map(lambda a: a, self.params)
+        self.opt_state = optax.adam(config.lr).init(self.params)
+        self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim)
+        self.np_rng = np.random.default_rng(config.seed)
+        self.iteration = 0
+
+        import ray_tpu
+
+        runner_cls = ray_tpu.remote(DQNEnvRunner)
+        self.runners = [
+            runner_cls.remote(config.env, config.hidden,
+                              config.seed + 200 + i)
+            for i in range(config.num_env_runners)
+        ]
+        self._broadcast()
+
+    def _broadcast(self) -> None:
+        import jax
+        import ray_tpu
+
+        host = jax.tree.map(np.asarray, self.params)
+        ray_tpu.get([r.set_params.remote(host) for r in self.runners],
+                    timeout=120)
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_iters))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+        import ray_tpu
+
+        cfg = self.config
+        eps = self._epsilon()
+        frags = ray_tpu.get(
+            [r.sample.remote(cfg.rollout_fragment_length, eps)
+             for r in self.runners], timeout=300)
+        ep_returns: List[float] = []
+        for frag in frags:
+            ep_returns.extend(frag.pop("episode_returns").tolist())
+            self.buffer.add_batch(frag)
+
+        losses = {"td_loss": float("nan"), "td_abs": float("nan")}
+        if self.buffer.size >= max(cfg.learning_starts,
+                                   cfg.train_batch_size):
+            batch_np = self.buffer.sample(
+                self.np_rng, cfg.train_batch_size * cfg.updates_per_iter)
+            batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+            self.params, self.opt_state, metrics = dqn_update(
+                self.params, self.target_params, self.opt_state, batch,
+                cfg.lr, gamma=cfg.gamma, n_updates=cfg.updates_per_iter)
+            losses = {k: float(v) for k, v in metrics.items()}
+            if self.iteration % cfg.target_update_interval == 0:
+                self.target_params = jax.tree.map(lambda a: a, self.params)
+            self._broadcast()
+
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "epsilon": eps,
+            "buffer_size": self.buffer.size,
+            "episode_reward_mean": (float(np.mean(ep_returns))
+                                    if ep_returns else float("nan")),
+            "episodes_this_iter": len(ep_returns),
+            **losses,
+        }
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for runner in self.runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:
+                pass
+        self.runners = []

@@ -12,15 +12,18 @@ from .api import (
     run,
     shutdown,
     start,
+    start_grpc,
     status,
 )
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
+from .grpc_proxy import grpc_call
 from .handle import DeploymentHandle
 
 __all__ = [
     "Application", "Deployment", "DeploymentHandle",
-    "deployment", "run", "start", "status", "delete", "shutdown",
+    "deployment", "run", "start", "start_grpc", "status",
+    "delete", "shutdown", "grpc_call",
     "get_deployment_handle", "batch", "multiplexed",
     "get_multiplexed_model_id",
 ]

@@ -122,6 +122,17 @@ def start(http_port: int = 0) -> int:
     return ray_tpu.get(controller.ensure_proxy.remote(http_port), timeout=120)
 
 
+def start_grpc(grpc_port: int = 0) -> int:
+    """Ensure the gRPC ingress is up; returns the bound port
+    (ref: the reference proxy's gRPC listener; see serve/grpc_proxy.py
+    for the generic-ingress design)."""
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.ensure_grpc_proxy.remote(grpc_port),
+                       timeout=120)
+
+
 def status() -> list:
     import ray_tpu
 

@@ -31,6 +31,9 @@ class ServeController:
         self._proxy = None
         self._proxy_port: Optional[int] = None
         self._proxy_lock: Optional[asyncio.Lock] = None
+        self._grpc_proxy = None
+        self._grpc_proxy_port: Optional[int] = None
+        self._grpc_proxy_lock: Optional[asyncio.Lock] = None
         # serializes deploy/delete/reconcile: the reconcile gather suspends
         # for seconds, and a concurrent mutation of dep["replicas"] would
         # pair stale health verdicts with fresh replicas (killing them) or
@@ -264,37 +267,55 @@ class ServeController:
         ]
 
     # -------------------------------------------------------------- proxy
-    async def ensure_proxy(self, port: int) -> int:
+    async def _ensure_ingress(self, slot: str, actor_cls, name: str,
+                              port: int) -> int:
+        """Single-instance ingress actor with ping recovery, shared by
+        the HTTP and gRPC listeners. ``slot`` names the state attributes
+        (self.<slot>, <slot>_port, <slot>_lock). No max_restarts: a bare
+        actor restart would re-run __init__ but not start(), leaving no
+        listener — recreation through this path (ping fails -> new actor
+        + start) is the recovery."""
         from .. import remote
-        from .proxy import ProxyActor
 
-        if self._proxy_lock is None:
-            self._proxy_lock = asyncio.Lock()
-        async with self._proxy_lock:  # concurrent starts interleave on the
-            # actor loop; without the lock both would create 'SERVE::proxy'
-            if self._proxy_port is not None:
+        if getattr(self, slot + "_lock") is None:
+            setattr(self, slot + "_lock", asyncio.Lock())
+        async with getattr(self, slot + "_lock"):
+            # concurrent starts interleave on the actor loop; without
+            # the lock both would create the named actor
+            if getattr(self, slot + "_port") is not None:
                 try:  # the cached proxy may have died since
                     await asyncio.wait_for(
-                        _await_ref(self._proxy.ping.remote()), 10)
-                    return self._proxy_port  # one proxy; later ports ignored
+                        _await_ref(getattr(self, slot).ping.remote()), 10)
+                    return getattr(self, slot + "_port")  # one instance
                 except Exception:
                     from .. import kill
 
                     try:
-                        kill(self._proxy)
+                        kill(getattr(self, slot))
                     except Exception:
                         pass
-                    self._proxy = None
-                    self._proxy_port = None
-            # no max_restarts: a bare actor restart would re-run __init__
-            # but not start(), leaving no listener — recreation through
-            # this path (ping fails -> new actor + start) is the recovery
-            self._proxy = remote(ProxyActor).options(
-                name="SERVE::proxy", lifetime="detached", num_cpus=0.5,
+                    setattr(self, slot, None)
+                    setattr(self, slot + "_port", None)
+            actor = remote(actor_cls).options(
+                name=name, lifetime="detached", num_cpus=0.5,
             ).remote()
-            self._proxy_port = await asyncio.wait_for(
-                _await_ref(self._proxy.start.remote(port)), 60)
-            return self._proxy_port
+            setattr(self, slot, actor)
+            bound = await asyncio.wait_for(
+                _await_ref(actor.start.remote(port)), 60)
+            setattr(self, slot + "_port", bound)
+            return bound
+
+    async def ensure_proxy(self, port: int) -> int:
+        from .proxy import ProxyActor
+
+        return await self._ensure_ingress(
+            "_proxy", ProxyActor, "SERVE::proxy", port)
+
+    async def ensure_grpc_proxy(self, port: int) -> int:
+        from .grpc_proxy import GrpcProxyActor
+
+        return await self._ensure_ingress(
+            "_grpc_proxy", GrpcProxyActor, "SERVE::grpc_proxy", port)
 
     async def shutdown(self) -> bool:
         from .. import kill
@@ -304,6 +325,11 @@ class ServeController:
         if self._proxy is not None:
             try:
                 kill(self._proxy)
+            except Exception:
+                pass
+        if self._grpc_proxy is not None:
+            try:
+                kill(self._grpc_proxy)
             except Exception:
                 pass
         return True

@@ -14,6 +14,8 @@ from .schedulers import (
     TrialScheduler,
 )
 from .search import (
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -32,6 +34,6 @@ __all__ = [
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
     "uniform", "quniform", "loguniform", "qloguniform", "randint",
-    "choice", "grid_search", "sample_from",
+    "choice", "grid_search", "sample_from", "Searcher", "TPESearcher",
     "report", "get_context", "get_checkpoint", "get_trial_id",
 ]

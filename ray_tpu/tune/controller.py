@@ -44,15 +44,30 @@ class TuneController:
         base = run_config.storage_path or "/tmp/ray_tpu_results"
         self.experiment_dir = os.path.join(base, name)
         os.makedirs(self.experiment_dir, exist_ok=True)
-        generator = BasicVariantGenerator(
-            param_space, tune_config.num_samples, tune_config.seed)
-        self.trials = [
-            Trial(trial_id=f"{i:05d}", config=cfg,
-                  experiment_dir=self.experiment_dir)
-            for i, cfg in enumerate(generator)
-        ]
+        self.search_alg = getattr(tune_config, "search_alg", None)
+        if self.search_alg is not None:
+            # suggest-based search: trials materialize lazily so each
+            # suggestion can condition on completed results
+            self.search_alg.set_space(param_space, tune_config.seed)
+            self.trials: List[Trial] = []
+            self._target_trials = tune_config.num_samples
+        else:
+            generator = BasicVariantGenerator(
+                param_space, tune_config.num_samples, tune_config.seed)
+            self.trials = [
+                Trial(trial_id=f"{i:05d}", config=cfg,
+                      experiment_dir=self.experiment_dir)
+                for i, cfg in enumerate(generator)
+            ]
+            self._target_trials = len(self.trials)
         self._fn_blob = cloudpickle.dumps(trainable)
         self._actors: Dict[str, Any] = {}
+        # trial_id -> (actor, start_ref, deadline): launches in flight.
+        # Starts are NON-blocking — a synchronous get on actor.start
+        # head-of-line blocks the control loop, so finished trials are
+        # never torn down and their resources never free (deadlock when
+        # free CPUs < max_concurrent, e.g. other actors on the cluster)
+        self._starting: Dict[str, tuple] = {}
         self._retries: Dict[str, int] = {}
 
     # --- resource gating ---
@@ -68,6 +83,8 @@ class TuneController:
 
     # --- actor lifecycle ---
 
+    START_TIMEOUT_S = 120.0
+
     def _launch(self, trial: Trial,
                 restore_blob: Optional[bytes] = None) -> None:
         from .. import remote
@@ -78,22 +95,45 @@ class TuneController:
         actor = actor_cls.options(
             num_cpus=cpus, resources=res or None, max_restarts=0,
         ).remote(trial.trial_id, trial.local_dir)
-        from .. import get, kill
-
-        try:
-            get(actor.start.remote(self._fn_blob, trial.config, restore_blob),
-                timeout=120)
-        except Exception:
-            try:
-                kill(actor)  # don't leak a half-started runner
-            except Exception:
-                pass
-            raise
-        self._actors[trial.trial_id] = actor
+        ref = actor.start.remote(self._fn_blob, trial.config, restore_blob)
+        self._starting[trial.trial_id] = (
+            actor, ref, time.monotonic() + self.START_TIMEOUT_S)
         trial.status = TrialStatus.RUNNING
 
+    def _poll_starting(self) -> None:
+        """Absorb completed (or timed-out) non-blocking launches."""
+        from .. import get, kill, wait
+        from .. import exceptions as exc
+
+        for tid, (actor, ref, deadline) in list(self._starting.items()):
+            trial = next(t for t in self.trials if t.trial_id == tid)
+            ready, _ = wait([ref], num_returns=1, timeout=0)
+            if not ready:
+                if time.monotonic() > deadline:
+                    del self._starting[tid]
+                    try:
+                        kill(actor)  # don't leak a half-started runner
+                    except Exception:
+                        pass
+                    self._on_trial_error(trial, "trial start timed out")
+                continue
+            del self._starting[tid]
+            try:
+                get(ref, timeout=10)
+            except Exception as e:
+                try:
+                    kill(actor)
+                except Exception:
+                    pass
+                self._on_trial_error(trial, f"trial start failed: {e}")
+                continue
+            self._actors[tid] = actor
+
     def _teardown(self, trial: Trial) -> None:
+        starting = self._starting.pop(trial.trial_id, None)
         actor = self._actors.pop(trial.trial_id, None)
+        if actor is None and starting is not None:
+            actor = starting[0]
         if actor is None:
             return
         from .. import get, kill
@@ -148,11 +188,14 @@ class TuneController:
     def run(self) -> List[Trial]:
         try:
             while True:
+                self._top_up_from_searcher()
                 self._launch_pending()
-                if not self._actors:
-                    if all(t.status in (TrialStatus.TERMINATED,
-                                        TrialStatus.ERROR)
-                           for t in self.trials):
+                self._poll_starting()
+                if not self._actors and not self._starting:
+                    if (len(self.trials) >= self._target_trials
+                            and all(t.status in (TrialStatus.TERMINATED,
+                                                 TrialStatus.ERROR)
+                                    for t in self.trials)):
                         break
                 self._poll_once()
                 time.sleep(self.POLL_INTERVAL_S)
@@ -161,8 +204,26 @@ class TuneController:
                 self._teardown(trial)
         return self.trials
 
+    def _top_up_from_searcher(self) -> None:
+        """Materialize trials from the searcher up to the concurrency
+        window — later suggestions then see earlier completions."""
+        if self.search_alg is None:
+            return
+        pending = sum(t.status == TrialStatus.PENDING for t in self.trials)
+        while (len(self.trials) < self._target_trials
+               and pending < self._max_concurrent()):
+            tid = f"{len(self.trials):05d}"
+            cfg = self.search_alg.suggest(tid)
+            if cfg is None:  # searcher exhausted: shrink the target
+                self._target_trials = len(self.trials)
+                return
+            self.trials.append(Trial(trial_id=tid, config=cfg,
+                                     experiment_dir=self.experiment_dir))
+            pending += 1
+
     def _launch_pending(self) -> None:
-        budget = self._max_concurrent() - len(self._actors)
+        budget = (self._max_concurrent() - len(self._actors)
+                  - len(self._starting))
         for trial in self.trials:
             if budget <= 0:
                 break
@@ -172,7 +233,7 @@ class TuneController:
                     # (None for fresh trials)
                     self._launch(trial,
                                  restore_blob=self._checkpoint_blob(trial))
-                except Exception as e:  # actor start failed: a per-trial
+                except Exception as e:  # actor submit failed: a per-trial
                     # failure, not a sweep abort — route through the same
                     # retry policy as a mid-run crash
                     self._on_trial_error(trial, f"trial start failed: {e}")
@@ -222,6 +283,9 @@ class TuneController:
     def _finish_trial(self, trial: Trial) -> None:
         self._teardown(trial)
         trial.status = TrialStatus.TERMINATED
+        if self.search_alg is not None:
+            self.search_alg.on_trial_complete(trial.trial_id,
+                                              trial.last_result or {})
 
     def _on_trial_error(self, trial: Trial, error: str) -> None:
         self._teardown(trial)
@@ -246,6 +310,13 @@ class TuneController:
         else:
             trial.status = TrialStatus.ERROR
             trial.error = error
+            if self.search_alg is not None:
+                # clear the pending slot WITHOUT a metric: an errored
+                # trial's last intermediate result must not become a
+                # finished observation (TPE would concentrate on a
+                # config region that cannot complete; ref: searcher
+                # on_trial_complete(error=True) drops the metric)
+                self.search_alg.on_trial_complete(trial.trial_id, {})
 
     def _exploit(self, trial: Trial) -> bool:
         """PBT exploit/explore: restart this trial from a donor's

@@ -144,6 +144,165 @@ def _set_path(cfg: Dict[str, Any], path: Tuple[str, ...], value: Any):
     node[path[-1]] = value
 
 
+class Searcher:
+    """Suggest-based search interface (ref: tune/search/searcher.py):
+    the controller asks for one config per new trial and reports final
+    results back."""
+
+    def set_space(self, param_space: Dict[str, Any],
+                  seed: Optional[int]) -> None:
+        raise NotImplementedError
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Dict[str, Any]) -> None:
+        pass
+
+
+class TPESearcher(Searcher):
+    """Native Tree-structured Parzen Estimator (the hyperopt algorithm;
+    ref: tune/search/hyperopt/ adapter — this environment has no
+    hyperopt, so the estimator itself lives here). Observations split
+    into good (top ``gamma`` quantile) and bad; each dimension draws
+    candidates from a KDE over the good values and keeps the candidate
+    maximizing the good/bad density ratio l(x)/g(x). Dimensions factor
+    independently (standard TPE simplification)."""
+
+    def __init__(self, metric: str, mode: str = "min", *,
+                 n_initial: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._space: Dict[Tuple[str, ...], Any] = {}
+        self._constants: Dict[Tuple[str, ...], Any] = {}
+        self._rng = random.Random()
+        self._pending: Dict[str, Dict[Tuple[str, ...], Any]] = {}
+        self._obs: List[Tuple[Dict[Tuple[str, ...], Any], float]] = []
+
+    def set_space(self, param_space: Dict[str, Any],
+                  seed: Optional[int]) -> None:
+        self._rng = random.Random(seed)
+        for path, leaf in _walk(param_space):
+            if isinstance(leaf, Grid):
+                raise ValueError(
+                    "TPESearcher does not support grid_search axes; use "
+                    "tune.choice for categorical dimensions")
+            if isinstance(leaf, Domain):
+                self._space[path] = leaf
+            else:
+                self._constants[path] = leaf
+
+    # --- sampling ---
+
+    def _random_flat(self) -> Dict[Tuple[str, ...], Any]:
+        return {p: d.sample(self._rng) for p, d in self._space.items()}
+
+    @staticmethod
+    def _kde_pdf(x: float, points: List[float], bw: float) -> float:
+        import math
+
+        if not points:
+            return 1e-12
+        acc = 0.0
+        for mu in points:
+            z = (x - mu) / bw
+            acc += math.exp(-0.5 * z * z)
+        return acc / (len(points) * bw) + 1e-12
+
+    def _suggest_dim(self, dom: Domain, good: List[Any],
+                     bad: List[Any]) -> Any:
+        import math
+
+        if isinstance(dom, Categorical):
+            cats = dom.categories
+            g = {c: 1.0 for c in range(len(cats))}  # +1 smoothing
+            b = {c: 1.0 for c in range(len(cats))}
+            for v in good:
+                g[cats.index(v)] += 1.0
+            for v in bad:
+                b[cats.index(v)] += 1.0
+            scores = [g[i] / b[i] for i in range(len(cats))]
+            total = sum(scores)
+            r = self._rng.random() * total
+            for i, s in enumerate(scores):  # sample ∝ ratio: explore too
+                r -= s
+                if r <= 0:
+                    return cats[i]
+            return cats[-1]
+        if isinstance(dom, (Float, Integer)):
+            log = bool(getattr(dom, "log", False))
+
+            def fwd(v):
+                return math.log(v) if log else float(v)
+
+            def inv(x):
+                return math.exp(x) if log else x
+
+            lo, hi = fwd(dom.lower), fwd(dom.upper)
+            gx = [fwd(v) for v in good]
+            bx = [fwd(v) for v in bad]
+            spread = (hi - lo) or 1.0
+            mean = sum(gx) / len(gx)
+            var = sum((v - mean) ** 2 for v in gx) / len(gx)
+            bw = max(1.06 * math.sqrt(var) * len(gx) ** -0.2,
+                     0.01 * spread)
+            best_x, best_score = None, -1.0
+            for _ in range(self.n_candidates):
+                mu = self._rng.choice(gx)
+                x = min(max(self._rng.gauss(mu, bw), lo), hi)
+                score = (self._kde_pdf(x, gx, bw)
+                         / self._kde_pdf(x, bx, bw))
+                if score > best_score:
+                    best_x, best_score = x, score
+            value = inv(best_x)
+            if isinstance(dom, Integer):
+                return int(min(max(round(value), dom.lower),
+                               dom.upper - 1))
+            q = getattr(dom, "q", None)
+            if q:
+                value = round(value / q) * q
+            return min(max(value, dom.lower), dom.upper)
+        return dom.sample(self._rng)  # Function and friends: random
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        scored = self._obs
+        if len(scored) < max(self.n_initial, 2):
+            flat = self._random_flat()
+        else:
+            ordered = sorted(scored, key=lambda o: o[1],
+                             reverse=(self.mode == "max"))
+            n_good = max(1, int(len(ordered) * self.gamma))
+            good_obs = ordered[:n_good]
+            bad_obs = ordered[n_good:] or ordered[-1:]
+            flat = {}
+            for path, dom in self._space.items():
+                good = [o[0][path] for o in good_obs if path in o[0]]
+                bad = [o[0][path] for o in bad_obs if path in o[0]]
+                flat[path] = (self._suggest_dim(dom, good, bad)
+                              if good and bad else dom.sample(self._rng))
+        self._pending[trial_id] = flat
+        cfg: Dict[str, Any] = {}
+        for path, val in self._constants.items():
+            _set_path(cfg, path, val)
+        for path, val in flat.items():
+            _set_path(cfg, path, val)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Dict[str, Any]) -> None:
+        flat = self._pending.pop(trial_id, None)
+        if flat is None or self.metric not in result:
+            return
+        self._obs.append((flat, float(result[self.metric])))
+
+
 class BasicVariantGenerator:
     """Resolve a param_space into concrete trial configs: the cross product
     of every grid axis, repeated ``num_samples`` times with fresh random

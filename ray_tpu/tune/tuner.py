@@ -26,6 +26,10 @@ class TuneConfig:
     # (ref: air.RunConfig(stop=...); kept here so RunConfig stays shared
     # with Train)
     stop: Optional[Dict[str, float]] = None
+    # suggest-based searcher (ref: tune/search/ — optuna/hyperopt
+    # adapters there; here the native TPESearcher or any Searcher
+    # subclass). None = BasicVariantGenerator grid/random resolution.
+    search_alg: Optional[Any] = None
 
 
 class Tuner:

@@ -89,6 +89,26 @@ def get_metrics(name: Optional[str] = None) -> List[Dict[str, Any]]:
     return core.io.run(core.gcs.call("get_metrics", {"name": name}))
 
 
+def list_cluster_events(source: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        limit: int = 1000) -> List[Dict[str, Any]]:
+    """Structured lifecycle events (ref: dashboard event module backed
+    by util/event.h records): node/actor/job transitions plus
+    application events recorded via record_event()."""
+    core = _core()
+    return core.io.run(core.gcs.call("list_events", {
+        "source": source, "severity": severity, "limit": limit}))
+
+
+def record_event(message: str, *, severity: str = "INFO",
+                 source: str = "APP", **fields) -> None:
+    """Append an application event to the cluster event stream."""
+    core = _core()
+    core.io.run(core.gcs.call("report_event", {
+        "source": source, "severity": severity, "message": message,
+        "fields": fields}))
+
+
 def _raylet_call(node_id: Optional[str], method: str, payload: dict):
     """RPC a node's raylet (this node's by default) — the log-monitor
     access path (ref: util/state log APIs backed by per-node agents)."""

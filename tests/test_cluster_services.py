@@ -183,6 +183,85 @@ def test_cli_start_status_worker_stop(tmp_path):
 
 # ------------------------------------------------------------ autoscaler
 
+def test_subprocess_node_provider(tmp_path):
+    """Real worker-node subprocesses join and leave the cluster through
+    the provider interface (ref: autoscaler local provider)."""
+    from ray_tpu.autoscaler.providers import SubprocessNodeProvider
+
+    env = {**os.environ}
+    env.pop("RAY_TPU_ADDRESS", None)
+    head = subprocess.run(CLI + ["start", "--head", "--num-cpus", "1"],
+                          capture_output=True, text=True, timeout=120,
+                          env=env)
+    assert head.returncode == 0, head.stderr
+    address = head.stdout.split("started: ")[1].split(" ")[0].strip()
+    try:
+        provider = SubprocessNodeProvider(address)
+        handle = provider.create_node({"CPU": 2.0})
+        assert provider.non_terminated_nodes() == [handle]
+
+        ray_tpu.init(address=address)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if sum(n["Alive"] for n in ray_tpu.nodes()) == 2:
+                break
+            time.sleep(0.5)
+        assert sum(n["Alive"] for n in ray_tpu.nodes()) == 2
+
+        @ray_tpu.remote(num_cpus=2)
+        def on_worker():
+            return os.environ["RAY_TPU_NODE_ID"]
+
+        # 2 CPUs only exist on the provider's node
+        assert ray_tpu.get(on_worker.remote(), timeout=60)
+        provider.terminate_node(handle)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if sum(n["Alive"] for n in ray_tpu.nodes()) == 1:
+                break
+            time.sleep(0.5)
+        assert sum(n["Alive"] for n in ray_tpu.nodes()) == 1
+        assert provider.non_terminated_nodes() == []
+        ray_tpu.shutdown()
+    finally:
+        subprocess.run(CLI + ["stop"], capture_output=True, timeout=60,
+                       env=env)
+
+
+def test_tpu_queued_resource_provider_commands():
+    """The gcloud command layer (zero-egress: injected runner records
+    the exact invocations; control logic is what's under test)."""
+    from ray_tpu.autoscaler.providers import TpuQueuedResourceProvider
+
+    calls = []
+
+    def fake_runner(cmd):
+        calls.append(cmd)
+        if "list" in cmd:
+            return json.dumps([
+                {"name": "projects/p/locations/z/queuedResources/ray-tpu-abc",
+                 "state": {"state": "ACTIVE"}},
+                {"name": ".../ray-tpu-dead", "state": {"state": "FAILED"}},
+                {"name": ".../other-thing", "state": {"state": "ACTIVE"}},
+            ])
+        return ""
+
+    provider = TpuQueuedResourceProvider(
+        project="p", zone="us-central2-b", accelerator_type="v5litepod-8",
+        runtime_version="v2-alpha-tpuv5-lite",
+        cluster_address="10.0.0.1:6379", runner=fake_runner)
+    name = provider.create_node({"TPU": 8.0})
+    create = calls[0]
+    assert create[:6] == ["gcloud", "compute", "tpus", "queued-resources",
+                          "create", name]
+    assert "--accelerator-type" in create and "v5litepod-8" in create
+    assert any("10.0.0.1:6379" in part for part in create)  # startup join
+    live = provider.non_terminated_nodes()
+    assert live == ["ray-tpu-abc"]  # FAILED + foreign names filtered
+    provider.terminate_node(name)
+    assert calls[-1][4] == "delete" and name in calls[-1]
+
+
 def test_autoscaler_scales_up_and_down():
     from ray_tpu.autoscaler import (
         Autoscaler, AutoscalerConfig, LocalNodeProvider)

@@ -152,3 +152,97 @@ def test_map_fusion_collapses_stages(ray_cluster):
     assert len(names) == 2, names
     rows = sorted(r["z"] for r in ds.iter_rows())
     assert rows == [i * 2 + 1 for i in range(32) if (i * 2) % 4 == 0]
+
+
+def test_read_text_and_binary(ray_cluster, tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_text("hello\n\nworld\n")
+    ds = rdata.read_text(str(p))
+    assert [r["text"] for r in ds.iter_rows()] == ["hello", "world"]
+    ds2 = rdata.read_text(str(p), drop_empty_lines=False)
+    assert [r["text"] for r in ds2.iter_rows()] == ["hello", "", "world"]
+
+    raw = tmp_path / "blob.bin"
+    raw.write_bytes(b"\x00\x01payload")
+    rows = list(rdata.read_binary_files(str(raw)).iter_rows())
+    assert rows[0]["bytes"] == b"\x00\x01payload"
+    assert rows[0]["path"].endswith("blob.bin")
+
+
+def test_read_sql_sqlite(ray_cluster, tmp_path):
+    """DB-API datasource against sqlite3, incl. sharded reads
+    (ref: _internal/datasource/sql_datasource.py)."""
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO items VALUES (?, ?)",
+                     [(i, f"n{i}") for i in range(20)])
+    conn.commit()
+    conn.close()
+
+    def factory(db=db):
+        import sqlite3 as s
+
+        return s.connect(db)
+
+    ds = rdata.read_sql("SELECT * FROM items", factory)
+    rows = sorted(ds.iter_rows(), key=lambda r: r["id"])
+    assert len(rows) == 20 and rows[3]["name"] == "n3"
+
+    sharded = rdata.read_sql("SELECT * FROM items", factory,
+                             shard_key="id", shards=4)
+    ids = sorted(int(r["id"]) for r in sharded.iter_rows())
+    assert ids == list(range(20))
+
+
+def test_read_webdataset(ray_cluster, tmp_path):
+    import io
+    import json as _json
+    import tarfile
+
+    tar_path = tmp_path / "shard-000.tar"
+    with tarfile.open(tar_path, "w") as tf:
+        for key in ("s1", "s2"):
+            for ext, payload in (("txt", f"caption {key}".encode()),
+                                 ("json", _json.dumps({"k": key}).encode()),
+                                 ("bin", b"\x01" + key.encode())):
+                info = tarfile.TarInfo(f"{key}.{ext}")
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+    rows = list(rdata.read_webdataset(str(tar_path)).iter_rows())
+    assert [r["__key__"] for r in rows] == ["s1", "s2"]
+    assert rows[0]["txt"] == "caption s1"
+    assert rows[1]["json"] == {"k": "s2"}
+    assert rows[0]["bin"] == b"\x01s1"
+
+
+def test_pandas_and_torch_interop(ray_cluster):
+    import pandas as pd
+    import torch
+
+    df = pd.DataFrame({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    ds = rdata.from_pandas(df)
+    out = ds.to_pandas()
+    assert sorted(out["x"].tolist()) == [1, 2, 3]
+
+    class TDs(torch.utils.data.Dataset):
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return i * 10
+
+    rows = sorted(r["item"] for r in rdata.from_torch(TDs()).iter_rows())
+    assert rows == [0, 10, 20, 30, 40, 50]
+
+
+def test_from_huggingface(ray_cluster):
+    import datasets as hf
+
+    hds = hf.Dataset.from_dict({"text": [f"t{i}" for i in range(10)],
+                                "label": list(range(10))})
+    ds = rdata.from_huggingface(hds, parallelism=3)
+    rows = sorted(ds.iter_rows(), key=lambda r: int(r["label"]))
+    assert len(rows) == 10 and rows[7]["text"] == "t7"

@@ -95,3 +95,35 @@ def test_actor_no_restart_dead(ray_start_regular):
         pass
     with pytest.raises(ray_tpu.exceptions.RayTpuError):
         ray_tpu.get(a.f.remote(), timeout=30)
+
+
+def test_dead_owner_leases_reaped(ray_start_regular):
+    """Leases OWNED by a killed worker process (fast lanes it opened for
+    its own subtasks) release on its death — a leaked owner-held lease
+    permanently shrinks the node (observed: a killed SplitCoordinator's
+    lane lease wedging later pipelines)."""
+    total = ray_tpu.cluster_resources()["CPU"]
+
+    @ray_tpu.remote(num_cpus=0.5)
+    class Owner:
+        def spawn_subtasks(self):
+            @ray_tpu.remote
+            def sub(x):
+                return x + 1
+
+            # subtasks from inside the actor open the actor's own lanes
+            return ray_tpu.get([sub.remote(i) for i in range(8)],
+                               timeout=60)
+
+    owner = Owner.remote()
+    assert ray_tpu.get(owner.spawn_subtasks.remote(), timeout=60) == \
+        list(range(1, 9))
+    ray_tpu.kill(owner)
+    deadline = time.time() + 30
+    avail = None
+    while time.time() < deadline:
+        avail = ray_tpu.available_resources().get("CPU")
+        if avail == total:
+            break
+        time.sleep(0.25)
+    assert avail == total, f"leaked leases: {avail}/{total} CPUs available"

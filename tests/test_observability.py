@@ -167,3 +167,36 @@ def test_worker_log_capture(ray_cluster):
             break
         _time.sleep(0.3)
     assert "OBS_LOG_MARKER_42" in joined
+
+
+def test_structured_cluster_events(ray_start_regular):
+    """Lifecycle + application events land in the GCS event stream
+    (ref: util/event.h + dashboard event module)."""
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote(max_restarts=0)
+    class Doomed:
+        def ping(self):
+            return 1
+
+    a = Doomed.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    ray_tpu.kill(a)
+    state_api.record_event("custom marker", severity="WARNING",
+                           source="TEST", run="r1")
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        events = state_api.list_cluster_events()
+        msgs = [e["message"] for e in events]
+        if "custom marker" in msgs and any(
+                "actor registered" in m for m in msgs):
+            break
+        time.sleep(0.2)
+    srcs = {e["source"] for e in events}
+    assert {"NODE", "ACTOR", "JOB", "TEST"} <= srcs, srcs
+    marker = next(e for e in events if e["message"] == "custom marker")
+    assert marker["severity"] == "WARNING" and marker["run"] == "r1"
+    # filters
+    only_test = state_api.list_cluster_events(source="TEST")
+    assert all(e["source"] == "TEST" for e in only_test) and only_test

@@ -61,6 +61,39 @@ def test_async_deployment_and_method_routing(serve_cluster):
     assert ray_tpu.get(double.remote({"x": 21}), timeout=60) == 42
 
 
+def test_grpc_proxy_roundtrip(serve_cluster):
+    """Generic gRPC ingress: unary calls route to deployment methods;
+    unknown deployments surface NOT_FOUND, user errors INTERNAL (ref:
+    the reference serve proxy's gRPC listener)."""
+    import grpc
+
+    @serve.deployment
+    class Math:
+        def __call__(self, x):
+            return x * 2
+
+        def add(self, a, b=0):
+            return a + b
+
+        def explode(self):
+            raise RuntimeError("kaboom")
+
+    serve.run(Math.bind())
+    port = serve.start_grpc()
+    addr = f"127.0.0.1:{port}"
+    assert serve.grpc_call(addr, "Math", "__call__", 21) == 42
+    assert serve.grpc_call(addr, "Math", "add", 1, b=2) == 3
+    with pytest.raises(grpc.RpcError) as err:
+        serve.grpc_call(addr, "Math", "explode")
+    assert err.value.code() == grpc.StatusCode.INTERNAL
+    assert "kaboom" in err.value.details()
+    with pytest.raises(grpc.RpcError) as err:
+        serve.grpc_call(addr, "NoSuchApp", "__call__", 1)
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    # idempotent start: same port back
+    assert serve.start_grpc() == port
+
+
 def test_http_proxy_roundtrip(serve_cluster):
     @serve.deployment
     class Adder:
