@@ -47,7 +47,9 @@ def _cheap_size_bound(value, limit: int, _depth: int = 2) -> bool:
     the reference's async actors (whose returns also serialize on the
     loop thread that ran the task)."""
     nb = getattr(value, "nbytes", None)
-    if nb is not None:
+    # int check matters: objects with dynamic __getattr__ (actor
+    # handles) synthesize a non-numeric .nbytes
+    if isinstance(nb, int):
         return nb <= limit
     if isinstance(value, (bytes, bytearray, str)):
         return len(value) <= limit

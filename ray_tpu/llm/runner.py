@@ -118,6 +118,93 @@ def prefill_bucket(seq_len: int, max_seq: int, floor: int = 16) -> int:
     return min(b, max_seq)
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
+                                                             "cache_v"))
+def prefill_chunk(params, cache_k, cache_v, tokens, start_pos, chunk_len,
+                  block_tables, cos, sin, *, cfg: LlamaConfig):
+    """One CHUNK of a long prompt (vLLM's chunked prefill, rebuilt for
+    static shapes): tokens [1, C] are positions
+    [start_pos, start_pos+chunk_len), attending causally within the
+    chunk AND over the pages written by earlier chunks. One compiled
+    executable per (C, table-span) pair serves prompts of every length —
+    and decode bursts for other requests interleave between chunks, so a
+    long prompt no longer stalls running streams for its whole prefill.
+
+    Returns (logits [1, vocab] of the chunk's LAST VALID token,
+    cache_k, cache_v).
+    """
+    B, C = tokens.shape
+    page_size = cache_k.shape[2]
+    Spast = block_tables.shape[1] * page_size
+    x = jnp.take(params["embed"], tokens, axis=0)
+    pos_grid = start_pos + jnp.arange(C)[None, :]          # [1, C]
+    valid = jnp.arange(C)[None, :] < chunk_len
+    write_pos = jnp.where(valid, pos_grid, -1)
+    # past pages hold positions < start_pos (written by earlier chunks)
+    past_mask = jnp.arange(Spast)[None, :] < start_pos     # [1, Spast]
+    chunk_mask = (jnp.arange(C)[None, :, None]
+                  >= jnp.arange(C)[None, None, :]) & valid[:, None, :]
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = apply_rotary(q, cos, sin, positions=pos_grid)
+        k = apply_rotary(k, cos, sin, positions=pos_grid)
+        ck = _write_pages(ck, k, block_tables, write_pos, page_size)
+        cv = _write_pages(cv, v, block_tables, write_pos, page_size)
+        pk = jnp.take(ck, block_tables, axis=0).reshape(
+            B, Spast, *k.shape[2:])
+        pv = jnp.take(cv, block_tables, axis=0).reshape(
+            B, Spast, *v.shape[2:])
+        kvh, hd = cfg.n_kv_heads, cfg.head_dim
+        rep = cfg.n_heads // kvh
+        qg = q.reshape(B, C, kvh, rep, hd)
+        scale = hd ** -0.5
+        s_past = jnp.einsum("bcgrd,bsgd->bcgrs", qg, pk,
+                            preferred_element_type=jnp.float32)
+        s_self = jnp.einsum("bcgrd,btgd->bcgrt", qg, k,
+                            preferred_element_type=jnp.float32)
+        s_past = jnp.where(past_mask[:, None, None, None, :],
+                           s_past * scale, -jnp.inf)
+        s_self = jnp.where(chunk_mask[:, :, None, None, :],
+                           s_self * scale, -jnp.inf)
+        p = jax.nn.softmax(
+            jnp.concatenate([s_past, s_self], axis=-1), axis=-1
+        ).astype(pk.dtype)
+        o = (jnp.einsum("bcgrs,bsgd->bcgrd", p[..., :Spast], pv,
+                        preferred_element_type=jnp.float32)
+             + jnp.einsum("bcgrt,btgd->bcgrd", p[..., Spast:], v,
+                          preferred_element_type=jnp.float32))
+        o = o.reshape(B, C, cfg.n_heads, hd).astype(x.dtype)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(h, lp, cfg)
+        return x, (ck, cv)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache_k, cache_v))
+    idx = jnp.broadcast_to(jnp.maximum(chunk_len - 1, 0).reshape(1, 1, 1),
+                           (B, 1, 1))
+    x_last = jnp.take_along_axis(x, idx, axis=1)[:, 0]
+    x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x_last.astype(cfg.dtype),
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, cache_k, cache_v
+
+
+@jax.jit
+def sample_logits(logits, seed, temperature, top_k, top_p):
+    """Standalone sampler dispatch (the chunked-prefill tail — the
+    whole-prompt path fuses sampling into prefill_sample instead)."""
+    from .sampling import sample_from_logits
+
+    return sample_from_logits(logits, seed, temperature, top_k, top_p)
+
+
 # --- fused step functions: model + sampler in ONE dispatch ------------------
 # Over the axon relay (remote TPU) every dispatch pays a network round
 # trip; fusing sampling into the step cuts per-token latency by ~the RTT.

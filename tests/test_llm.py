@@ -83,6 +83,72 @@ def test_paged_greedy_batch_and_page_boundaries(tiny_params):
     assert gots == wants
 
 
+def test_chunked_prefill_matches_oracle(tiny_params):
+    """Chunked prefill (prompt processed in C-token chunks across
+    engine steps) generates EXACTLY what whole-prompt prefill does —
+    chunk boundaries, page boundaries and the final partial chunk must
+    all be attention-exact (vLLM chunked-prefill analog)."""
+    prompts = [[5, 17, 99, 3, 42, 7, 1, 88, 23, 11, 2, 9, 31],  # 13 toks
+               [4, 8, 15, 16, 23]]
+    n_gen = 8
+    wants = [_reference_greedy(tiny_params, p, n_gen) for p in prompts]
+
+    engine = LLMEngine(tiny_params, CFG, EngineConfig(
+        max_num_seqs=2, page_size=4, num_pages=64, max_seq_len=64,
+        prefill_chunk=4))  # 13 tokens -> 4 chunks incl. a partial one
+    gots = engine.generate(prompts,
+                           SamplingParams(temperature=0.0,
+                                          max_tokens=n_gen))
+    assert gots == wants
+
+    # decode really interleaves between chunks: with one long prompt
+    # mid-prefill and one short already decoding, the short one streams
+    engine2 = LLMEngine(tiny_params, CFG, EngineConfig(
+        max_num_seqs=2, page_size=4, num_pages=64, max_seq_len=64,
+        prefill_chunk=4, decode_burst=2))  # small bursts: the short
+    # stream must still be emitting while the long prompt prefills
+    greedy = SamplingParams(temperature=0.0, max_tokens=n_gen)
+    r_short = engine2.add_request(prompts[1], greedy)
+    engine2.step()                       # 5-token prompt: chunk 1 of 2
+    engine2.step()                       # chunk 2 -> fully prefilled
+    # prefill complete (ctx_len counts decoded tokens too by now)
+    assert engine2.requests[r_short].ctx_len >= len(prompts[1])
+    r_long = engine2.add_request(prompts[0], greedy)
+    short_tokens_during_long_prefill = 0
+    for _ in range(3):                   # 13 toks / chunk 4 -> 4 chunks
+        outs = engine2.step()
+        short_tokens_during_long_prefill += sum(
+            1 for o in outs if o.request_id == r_short)
+    assert short_tokens_during_long_prefill > 0
+    while engine2.has_unfinished():
+        engine2.step()
+    assert engine2.requests[r_long].output == wants[0]
+    assert engine2.requests[r_short].output == wants[1]
+
+    # shortest-remaining-first: a short prompt admitted BEHIND a long
+    # one starts streaming after its own chunk count, not the long one's
+    engine3 = LLMEngine(tiny_params, CFG, EngineConfig(
+        max_num_seqs=2, page_size=4, num_pages=64, max_seq_len=64,
+        prefill_chunk=4, decode_burst=2))
+    r_long3 = engine3.add_request(prompts[0], greedy)   # 4 chunks
+    engine3.step()                                      # long chunk 1
+    r_short3 = engine3.add_request(prompts[1], greedy)  # 2 chunks
+    first_short = first_long = None
+    for i in range(16):
+        for o in engine3.step():
+            if o.request_id == r_short3 and first_short is None:
+                first_short = i
+            if o.request_id == r_long3 and first_long is None:
+                first_long = i
+        if first_short is not None and first_long is not None:
+            break
+    assert first_short is not None and first_short < first_long
+    while engine3.has_unfinished():
+        engine3.step()
+    assert engine3.requests[r_long3].output == wants[0]
+    assert engine3.requests[r_short3].output == wants[1]
+
+
 def test_continuous_batching_staggered_arrivals(tiny_params):
     """A request added mid-decode joins the running batch and both finish
     with oracle-exact outputs."""
