@@ -131,6 +131,7 @@ class LLMEngine:
         # admitted (slot+pages held) but not yet fully prefilled; one
         # prefill work unit runs per step — a whole prompt, or one chunk
         self._prefill_queue: Deque[RequestState] = collections.deque()
+        self._prefill_skips: Dict[str, int] = {}  # SRF aging counters
         self.slots: List[Optional[RequestState]] = (
             [None] * self.ecfg.max_num_seqs)
         self.requests: Dict[str, RequestState] = {}
@@ -178,10 +179,17 @@ class LLMEngine:
         the admission/prefill phase (TTFT measurement, draining a
         prefill backlog before decoding)."""
         outputs: List[StepOutput] = []
-        admitted = self._admit()
-        while admitted is not None:  # admission never blocks on prefill
-            self._prefill_queue.append(admitted)
+        # admission never blocks on prefill, but the queue is capped:
+        # admission reserves the WHOLE sequence's pages, so admitting
+        # every waiting request up front would pin pages that running
+        # streams need (recompute-preemption cost). Whole-prompt mode
+        # caps at 1 — exactly the old admit-and-prefill-per-step pace.
+        cap = 1 if self.ecfg.prefill_chunk <= 0 else 2
+        while len(self._prefill_queue) < cap:
             admitted = self._admit()
+            if admitted is None:
+                break
+            self._prefill_queue.append(admitted)
         pref = self._next_prefill()
         if pref is not None:
             outputs.extend(self._run_prefill(pref))
@@ -196,13 +204,18 @@ class LLMEngine:
             outputs.extend(self._run_decode())
         return outputs
 
+    # consecutive work units a queued prefill may be passed over before
+    # it runs regardless of length (anti-starvation aging for SRF)
+    _PREFILL_MAX_SKIPS = 8
+
     def _next_prefill(self) -> Optional[RequestState]:
         """Pick this round's prefill work unit. Whole-prompt mode keeps
         FIFO order. Chunked mode picks the request with the FEWEST
-        remaining prefill tokens (arrival-order tiebreak): a short
-        prompt admitted behind a long one starts streaming after its
-        own chunk count, not the long one's — the fairness vLLM's
-        chunked prefill gets from its token budget."""
+        remaining prefill tokens (arrival-order tiebreak) — a short
+        prompt admitted behind a long one starts streaming after its own
+        chunk count — with aging: the oldest queued request runs after
+        at most _PREFILL_MAX_SKIPS pass-overs, so a sustained stream of
+        short prompts cannot starve a long one indefinitely."""
         while self._prefill_queue and (
                 self._prefill_queue[0].slot < 0
                 or self._prefill_queue[0].finished):
@@ -213,8 +226,21 @@ class LLMEngine:
             return None
         if self.ecfg.prefill_chunk <= 0:
             return live[0]
-        return min(live, key=lambda s: (
-            len(s.prompt) + len(s.output) - s.prefill_pos, s.arrival_t))
+        oldest = min(live, key=lambda s: s.arrival_t)
+        if self._prefill_skips.get(oldest.request_id, 0) \
+                >= self._PREFILL_MAX_SKIPS:
+            pick = oldest
+        else:
+            pick = min(live, key=lambda s: (
+                len(s.prompt) + len(s.output) - s.prefill_pos,
+                s.arrival_t))
+        for s in live:
+            if s is pick:
+                self._prefill_skips.pop(s.request_id, None)
+            else:
+                self._prefill_skips[s.request_id] = (
+                    self._prefill_skips.get(s.request_id, 0) + 1)
+        return pick
 
     def generate(self, prompts: List[List[int]],
                  params: Optional[SamplingParams] = None) -> List[List[int]]:

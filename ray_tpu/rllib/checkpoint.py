@@ -1,0 +1,65 @@
+"""Algorithm checkpointing (ref: rllib/utils/checkpoints.py
+Checkpointable — save_to_path/restore_from_path on Algorithm)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+
+class CheckpointableAlgorithm:
+    """Mixin: save/restore learner state (params, opt state, iteration).
+    Env-runner actors are rebuilt from config on restore and re-receive
+    the weights via the algorithm's normal broadcast."""
+
+    def _extra_state(self) -> Dict[str, Any]:
+        return {}
+
+    def _apply_extra_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+    def save_to_path(self, path: str) -> str:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "params": jax.tree.map(np.asarray, self.params),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "iteration": self.iteration,
+            "config": self.config,
+            **self._extra_state(),
+        }
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    def _apply_state(self, state: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree.map(
+            lambda a: jnp.asarray(a) if isinstance(a, np.ndarray) else a,
+            state["opt_state"])
+        self.iteration = state["iteration"]
+        self._apply_extra_state(state)
+        self._broadcast()
+
+    def restore_from_path(self, path: str) -> None:
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self._apply_state(state)
+
+    @classmethod
+    def from_checkpoint(cls, path: str):
+        """Rebuild the algorithm (and its runner actors) from a saved
+        state's embedded config, then restore weights — the state file
+        is read and unpickled ONCE (it holds the full params)."""
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        algo = cls(state["config"])
+        algo._apply_state(state)
+        return algo

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .checkpoint import CheckpointableAlgorithm
 from .env import make_env
 from .ppo import init_policy  # same MLP trunk; the pi head doubles as Q
 
@@ -213,7 +214,7 @@ class DQNConfig:
         return DQN(self)
 
 
-class DQN:
+class DQN(CheckpointableAlgorithm):
     """Algorithm driver (ref: algorithms/dqn/dqn.py training_step):
     sample in parallel -> replay add -> minibatch updates -> periodic
     target sync -> broadcast."""
@@ -244,6 +245,22 @@ class DQN:
             for i in range(config.num_env_runners)
         ]
         self._broadcast()
+
+    def _extra_state(self):
+        import jax
+
+        # replay buffer intentionally excluded (refills from sampling);
+        # the target net is learner state and must survive
+        return {"target_params": jax.tree.map(np.asarray,
+                                              self.target_params)}
+
+    def _apply_extra_state(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        if "target_params" in state:
+            self.target_params = jax.tree.map(jnp.asarray,
+                                              state["target_params"])
 
     def _broadcast(self) -> None:
         import jax

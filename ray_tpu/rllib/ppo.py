@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .checkpoint import CheckpointableAlgorithm
 from .env import make_env
 
 # ---------------------------------------------------------------------------
@@ -274,7 +275,7 @@ class PPOConfig:
         return PPO(self)
 
 
-class PPO:
+class PPO(CheckpointableAlgorithm):
     """The Algorithm: env-runner actors sample in parallel, the jitted
     learner updates, new weights broadcast (ref: algorithm.py
     training_step:1749)."""

@@ -1,0 +1,18 @@
+"""Accelerator type constants for `accelerator_type=` scheduling
+(ref: python/ray/util/accelerators/accelerators.py — there the
+constants name GPU SKUs; here the first-class citizens are TPU
+generations, matched against node labels the raylet publishes from its
+chip inventory)."""
+
+TPU_V2 = "TPU-V2"
+TPU_V3 = "TPU-V3"
+TPU_V4 = "TPU-V4"
+TPU_V5E = "TPU-V5LITE"
+TPU_V5P = "TPU-V5P"
+TPU_V6E = "TPU-V6E"
+
+# CPU-side constants kept for API familiarity (tasks pinned to plain
+# hosts in a mixed cluster)
+CPU_HOST = "CPU-HOST"
+
+ALL_TPU = (TPU_V2, TPU_V3, TPU_V4, TPU_V5E, TPU_V5P, TPU_V6E)

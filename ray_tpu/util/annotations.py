@@ -1,0 +1,61 @@
+"""API stability annotations (ref: python/ray/util/annotations.py —
+the @PublicAPI/@DeveloperAPI governance contract: public APIs carry
+compatibility guarantees, developer APIs may change between releases,
+deprecated APIs warn with a replacement pointer)."""
+
+from __future__ import annotations
+
+import functools
+import warnings
+from typing import Any, Callable, Optional
+
+
+def _tag(obj: Any, kind: str, stability: Optional[str] = None):
+    obj._annotated = kind
+    if stability:
+        obj._annotated_stability = stability
+    return obj
+
+
+def PublicAPI(obj: Any = None, *, stability: str = "stable"):
+    """Stable public surface; ``stability="alpha"|"beta"`` marks
+    public-but-evolving APIs."""
+    if obj is None:
+        return lambda o: _tag(o, "PublicAPI", stability)
+    return _tag(obj, "PublicAPI", stability)
+
+
+def DeveloperAPI(obj: Any = None):
+    """Internal extension points: stable enough to build on, but may
+    change between minor versions."""
+    if obj is None:
+        return lambda o: _tag(o, "DeveloperAPI")
+    return _tag(obj, "DeveloperAPI")
+
+
+def Deprecated(obj: Any = None, *, message: str = ""):
+    """Warns once per call site category on use."""
+
+    def wrap(o: Callable) -> Callable:
+        note = message or f"{getattr(o, '__qualname__', o)} is deprecated"
+        if isinstance(o, type):
+            orig_init = o.__init__
+
+            @functools.wraps(orig_init)
+            def init(self, *a, **kw):
+                warnings.warn(note, DeprecationWarning, stacklevel=2)
+                orig_init(self, *a, **kw)
+
+            o.__init__ = init
+            return _tag(o, "Deprecated")
+
+        @functools.wraps(o)
+        def fn(*a, **kw):
+            warnings.warn(note, DeprecationWarning, stacklevel=2)
+            return o(*a, **kw)
+
+        return _tag(fn, "Deprecated")
+
+    if obj is None:
+        return wrap
+    return wrap(obj)

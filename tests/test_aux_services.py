@@ -296,3 +296,37 @@ def test_runtime_env_pip_local_package(tmp_path):
         assert ray_tpu.get(use_dep.remote(), timeout=300) == "dep-magic-42"
     finally:
         ray_tpu.shutdown()
+
+
+def test_annotations_api():
+    """@PublicAPI/@DeveloperAPI/@Deprecated governance decorators
+    (ref: util/annotations.py)."""
+    import warnings
+
+    from ray_tpu.util.annotations import Deprecated, DeveloperAPI, PublicAPI
+    from ray_tpu.util import accelerators
+
+    @PublicAPI
+    def f():
+        return 1
+
+    @PublicAPI(stability="alpha")
+    def g():
+        return 2
+
+    @DeveloperAPI
+    class K:
+        pass
+
+    @Deprecated(message="use f")
+    def old():
+        return 3
+
+    assert f._annotated == "PublicAPI" and f() == 1
+    assert g._annotated_stability == "alpha"
+    assert K._annotated == "DeveloperAPI"
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old() == 3
+    assert any("use f" in str(x.message) for x in w)
+    assert accelerators.TPU_V5E == "TPU-V5LITE"
