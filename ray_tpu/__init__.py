@@ -41,7 +41,7 @@ _OPTION_KEYS = {
     "retry_exceptions", "max_restarts", "max_task_retries", "max_concurrency",
     "name", "namespace", "scheduling_strategy", "runtime_env", "lifetime",
     "placement_group", "placement_group_bundle_index",
-    "generator_backpressure_num_objects",
+    "generator_backpressure_num_objects", "accelerator_type",
 }
 
 

@@ -951,21 +951,31 @@ class CoreWorker:
 
     @staticmethod
     def _resolve_strategy(opts: dict):
-        """scheduling_strategy option, with the `placement_group=` shorthand
-        folded in (ref: ray_option_utils.py placement-group option group)."""
+        """scheduling_strategy option, with the `placement_group=` and
+        `accelerator_type=` shorthands folded in (ref:
+        ray_option_utils.py option groups; accelerator_type maps to a
+        hard node-label match like the reference's
+        accelerator-type-to-label resolution)."""
         strategy = opts.get("scheduling_strategy")
         pg = opts.get("placement_group")
+        acc = opts.get("accelerator_type")
+        if sum(x is not None for x in (strategy, pg, acc)) > 1:
+            raise ValueError(
+                "scheduling_strategy=, placement_group= and "
+                "accelerator_type= are mutually exclusive")
         if strategy is not None:
-            if pg is not None:
-                raise ValueError(
-                    "placement_group= and scheduling_strategy= are mutually "
-                    "exclusive; put the group in the strategy")
             return strategy
         if pg is not None:
             return PlacementGroupSchedulingStrategy(
                 placement_group_id=getattr(pg, "id", pg),
                 placement_group_bundle_index=opts.get(
                     "placement_group_bundle_index", -1))
+        if acc is not None:
+            from ..util.scheduling_strategies import (
+                In, NodeLabelSchedulingStrategy)
+
+            return NodeLabelSchedulingStrategy(
+                hard={"accelerator_type": In(str(acc))})
         return DefaultSchedulingStrategy()
 
     @staticmethod

@@ -49,6 +49,26 @@ def _detect_tpu_chips() -> int:
         return 0
 
 
+def _detect_accelerator_type() -> str:
+    """TPU generation label from the VM metadata env TPU runtimes set
+    (ref: accelerators/tpu.py get_current_node_accelerator_type —
+    there read from instance metadata; queued-resources/GKE export it
+    as TPU_ACCELERATOR_TYPE, e.g. 'v5litepod-8'). Values align with
+    ray_tpu.util.accelerators constants; tasks target them via
+    ``@remote(accelerator_type=...)``."""
+    acc = (os.environ.get("TPU_ACCELERATOR_TYPE")
+           or os.environ.get("ACCELERATOR_TYPE", ""))
+    if not acc:
+        return ""
+    gen = acc.split("-")[0].lower()
+    mapping = {"v2": "TPU-V2", "v3": "TPU-V3", "v4": "TPU-V4",
+               "v5litepod": "TPU-V5LITE", "v5e": "TPU-V5LITE",
+               "v5p": "TPU-V5P", "v6e": "TPU-V6E"}
+    # unknown generations publish NOTHING: fabricating "TPU-NVIDIA" from
+    # a GPU VM's ACCELERATOR_TYPE would pollute the label namespace
+    return mapping.get(gen, "")
+
+
 class Node:
     """A head (GCS + raylet) or worker (raylet only) node."""
 
@@ -112,6 +132,10 @@ class Node:
                 self.gcs_address,
                 journal_path=os.path.join(self.session_dir, "gcs_journal.bin"),
                 advertise_host=self.node_ip)
+        node_labels = dict(labels or {})
+        acc_type = _detect_accelerator_type()
+        if acc_type and "accelerator_type" not in node_labels:
+            node_labels["accelerator_type"] = acc_type
         self.raylet = Raylet(
             node_id=self.node_id,
             session_name=self.session_name,
@@ -119,7 +143,7 @@ class Node:
             gcs_address=self.gcs_address,
             resources=resources or default_resources(),
             store=self.store,
-            labels=labels,
+            labels=node_labels,
             advertise_host=self.node_ip,
         )
         self._started = False

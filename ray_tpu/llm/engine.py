@@ -179,6 +179,12 @@ class LLMEngine:
         the admission/prefill phase (TTFT measurement, draining a
         prefill backlog before decoding)."""
         outputs: List[StepOutput] = []
+        # purge stale entries (aborted/preempted mid-queue) FIRST: they
+        # must neither count toward the admission cap nor linger
+        if any(s.slot < 0 or s.finished for s in self._prefill_queue):
+            self._prefill_queue = collections.deque(
+                s for s in self._prefill_queue
+                if s.slot >= 0 and not s.finished)
         # admission never blocks on prefill, but the queue is capped:
         # admission reserves the WHOLE sequence's pages, so admitting
         # every waiting request up front would pin pages that running
@@ -222,6 +228,11 @@ class LLMEngine:
             self._prefill_queue.popleft()  # preempted/aborted
         live = [s for s in self._prefill_queue
                 if s.slot >= 0 and not s.finished]
+        # aging counters live exactly as long as their queue entry
+        # (aborted/preempted requests must not leak entries)
+        live_ids = {s.request_id for s in live}
+        for rid in [r for r in self._prefill_skips if r not in live_ids]:
+            del self._prefill_skips[rid]
         if not live:
             return None
         if self.ecfg.prefill_chunk <= 0:

@@ -3,11 +3,23 @@ Checkpointable — save_to_path/restore_from_path on Algorithm)."""
 
 from __future__ import annotations
 
+import contextvars
 import os
 import pickle
 from typing import Any, Dict
 
+import cloudpickle
 import numpy as np
+
+# set while from_checkpoint constructs the algorithm: the constructor's
+# initial broadcast of random weights would be immediately overwritten
+# by the restored ones (two full broadcasts for one restore)
+_RESTORING: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_rllib_restoring", default=False)
+
+
+def broadcast_suppressed() -> bool:
+    return _RESTORING.get()
 
 
 class CheckpointableAlgorithm:
@@ -32,8 +44,16 @@ class CheckpointableAlgorithm:
             "config": self.config,
             **self._extra_state(),
         }
-        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
-            pickle.dump(state, f)
+        # atomic: a crash mid-pickle must not destroy the previous
+        # checkpoint at the same path
+        final = os.path.join(path, "algorithm_state.pkl")
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            # cloudpickle: configs may carry callable env factories
+            # (make_env supports them); plain pickle would crash here.
+            # pickle.load reads cloudpickle output fine.
+            cloudpickle.dump(state, f)
+        os.replace(tmp, final)
         return path
 
     def _apply_state(self, state: Dict[str, Any]) -> None:
@@ -57,9 +77,14 @@ class CheckpointableAlgorithm:
     def from_checkpoint(cls, path: str):
         """Rebuild the algorithm (and its runner actors) from a saved
         state's embedded config, then restore weights — the state file
-        is read and unpickled ONCE (it holds the full params)."""
+        is read once, and the constructor's initial random-weight
+        broadcast is suppressed (the restore broadcasts the real ones)."""
         with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
             state = pickle.load(f)
-        algo = cls(state["config"])
+        token = _RESTORING.set(True)
+        try:
+            algo = cls(state["config"])
+        finally:
+            _RESTORING.reset(token)
         algo._apply_state(state)
         return algo

@@ -244,7 +244,11 @@ class DQN(CheckpointableAlgorithm):
                               config.seed + 200 + i)
             for i in range(config.num_env_runners)
         ]
-        self._broadcast()
+        from .checkpoint import broadcast_suppressed
+
+        if not broadcast_suppressed():  # from_checkpoint
+            # restores real weights right after construction
+            self._broadcast()
 
     def _extra_state(self):
         import jax

@@ -304,7 +304,11 @@ class PPO(CheckpointableAlgorithm):
                               config.seed + 100 + i)
             for i in range(config.num_env_runners)
         ]
-        self._broadcast()
+        from .checkpoint import broadcast_suppressed
+
+        if not broadcast_suppressed():  # from_checkpoint
+            # restores real weights right after construction
+            self._broadcast()
 
     def _broadcast(self) -> None:
         import ray_tpu

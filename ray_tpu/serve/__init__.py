@@ -18,6 +18,7 @@ from .api import (
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .grpc_proxy import grpc_call
+from .schema import build_application, run_config
 from .handle import DeploymentHandle
 
 __all__ = [
@@ -25,5 +26,6 @@ __all__ = [
     "deployment", "run", "start", "start_grpc", "status",
     "delete", "shutdown", "grpc_call",
     "get_deployment_handle", "batch", "multiplexed",
+    "run_config", "build_application",
     "get_multiplexed_model_id",
 ]

@@ -1,8 +1,10 @@
-"""Accelerator type constants for `accelerator_type=` scheduling
-(ref: python/ray/util/accelerators/accelerators.py — there the
-constants name GPU SKUs; here the first-class citizens are TPU
-generations, matched against node labels the raylet publishes from its
-chip inventory)."""
+"""Accelerator type constants for ``@remote(accelerator_type=...)``
+scheduling (ref: python/ray/util/accelerators/accelerators.py — there
+the constants name GPU SKUs; here the first-class citizens are TPU
+generations). The option resolves to a hard node-label match on
+``accelerator_type``, which each node auto-publishes from its TPU VM
+metadata env (``TPU_ACCELERATOR_TYPE``, see node.py
+_detect_accelerator_type) or from an operator-set node label."""
 
 TPU_V2 = "TPU-V2"
 TPU_V3 = "TPU-V3"

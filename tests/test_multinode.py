@@ -90,6 +90,33 @@ def test_locality_aware_leasing(cluster2):
     assert val == 0.0
 
 
+def test_accelerator_type_scheduling(monkeypatch):
+    """@remote(accelerator_type=...) lands on the node publishing that
+    generation label (auto-detected from TPU VM metadata env; ref:
+    util/accelerators + accelerators/tpu.py)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.accelerators import TPU_V4
+
+    # the axon harness ambiently exports TPU_ACCELERATOR_TYPE for the
+    # real chip; clear it so only OUR worker node carries a label
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    monkeypatch.delenv("ACCELERATOR_TYPE", raising=False)
+    cluster = Cluster(head_node_args={"resources": {"CPU": 2.0}},
+                      connect=True)
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    tpu_node = cluster.add_node(num_cpus=2)  # label auto-published
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE")
+    try:
+        @ray_tpu.remote(num_cpus=1, accelerator_type=TPU_V4)
+        def where():
+            return os.environ["RAY_TPU_NODE_ID"]
+
+        assert ray_tpu.get(where.remote(), timeout=60) == \
+            tpu_node.node_id.hex()
+    finally:
+        cluster.shutdown()
+
+
 def test_node_death_loses_objects(cluster2):
     cluster, node2 = cluster2
 
