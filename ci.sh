@@ -9,6 +9,42 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# --sanitize: ASAN pass over the native plane (ref: .bazelrc asan
+# configs). Rebuilds the C++ libs instrumented and runs the
+# native-heavy suites under libasan. Run BEFORE the normal suite so a
+# corrupted cache dir never leaks into it.
+if [[ "${1:-}" == "--sanitize" ]]; then
+    echo "== ASAN: native rebuild + native-plane suites =="
+    rm -rf ray_tpu/_native/build
+    LIBASAN="$(g++ -print-file-name=libasan.so)"
+    # the instrumented lib must actually LOAD under the preload —
+    # otherwise get_lib()'s graceful Python fallback would let the
+    # whole lane "pass" with zero native coverage
+    RAY_TPU_NATIVE_SANITIZE=address \
+    LD_PRELOAD="$LIBASAN" \
+    ASAN_OPTIONS="detect_leaks=0" \
+    python - <<'PY'
+from ray_tpu._native import get_lib, native_unavailable_reason
+assert get_lib() is not None, \
+    f"ASAN-instrumented native lib failed to load: {native_unavailable_reason()}"
+print("instrumented native lib loaded")
+PY
+    # -k "not tensor": the tensor-lane tests initialize jax, whose
+    # UNinstrumented jaxlib crashes under the libasan preload — the
+    # ASAN lane targets the native C++ plane (store index, rings,
+    # channels, core tables), not the device plane
+    RAY_TPU_NATIVE_SANITIZE=address \
+    LD_PRELOAD="$LIBASAN" \
+    ASAN_OPTIONS="detect_leaks=0" \
+    JAX_PLATFORMS=cpu \
+    timeout "${CI_ASAN_TIMEOUT_S:-1200}" \
+        python -m pytest tests/test_native_store.py tests/test_fastlane.py \
+            tests/test_dag.py -q -k "not tensor"
+    rm -rf ray_tpu/_native/build   # drop instrumented builds
+    echo "ASAN PASSED"
+    exit 0
+fi
+
 echo "== [1/3] native build =="
 rm -rf ray_tpu/_native/build
 python - <<'PY'

@@ -34,18 +34,26 @@ _SOURCES = ("store_index.cc", "fastlane.cc", "core_tables.cc")
 
 def _build_lib() -> str:
     srcs = [os.path.join(_SRC, s) for s in _SOURCES]
+    # sanitizer build mode (ref: the reference's .bazelrc tsan/asan
+    # configs): RAY_TPU_NATIVE_SANITIZE=address|thread recompiles the
+    # native libs instrumented; ci.sh --sanitize wires the LD_PRELOAD
+    extra = []
+    san = os.environ.get("RAY_TPU_NATIVE_SANITIZE", "")
+    if san:
+        extra = [f"-fsanitize={san}", "-fno-omit-frame-pointer", "-g"]
     h = hashlib.sha256()
     for s in srcs:
         with open(s, "rb") as f:
             h.update(f.read())
+    h.update(san.encode())  # sanitized builds cache separately
     out = os.path.join(_BUILD, f"libray_tpu_core_{h.hexdigest()[:16]}.so")
     if os.path.exists(out):
         return out
     os.makedirs(_BUILD, exist_ok=True)
     tmp = out + f".tmp.{os.getpid()}"
     subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, *srcs,
-         "-lpthread"],
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", *extra,
+         "-o", tmp, *srcs, "-lpthread"],
         check=True, capture_output=True, timeout=180)
     os.replace(tmp, out)  # atomic: concurrent builders race safely
     # sweep superseded builds (best effort)

@@ -1556,6 +1556,12 @@ class CoreWorker:
             except Exception:
                 pass  # worker already gone — the retry loop sees `canceled`
         else:
+            # queued on the fast-lane feeder: fail it immediately (a
+            # dispatch-time check alone could be a full task-runtime
+            # away when the lane window is occupied)
+            if self._lane_pool is not None and \
+                    self._lane_pool.cancel_queued(task_id):
+                return
             # no worker yet: the lease request may be queued at a raylet
             # behind resources that never free — fail it there so the submit
             # coroutine wakes up (ref: node_manager CancelWorkerLease)
@@ -1565,6 +1571,27 @@ class CoreWorker:
                                   {"task_id": task_id}, timeout=5)
             except Exception:
                 pass
+            # fast-lane window: the task may still DISPATCH right after
+            # this cancel (feeder re-checks the flag, but a ring push
+            # already in flight sets worker_address moments later).
+            # Chase it: deliver the cancel once an address appears.
+            self.io.spawn(self._chase_cancel(task_id, force))
+
+    async def _chase_cancel(self, task_id: TaskID, force: bool):
+        for _ in range(50):
+            await asyncio.sleep(0.1)
+            info = self._inflight.get(task_id)
+            if info is None:
+                return  # finished or errored meanwhile
+            address = info.get("worker_address")
+            if address:
+                try:
+                    client = await self._client_for(address)
+                    await client.call("cancel_task", {
+                        "task_id": task_id, "force": force}, timeout=5)
+                except Exception:
+                    pass
+                return
 
     # ------------------------------------------------------------- actors
     def submit_actor_creation(self, cls: Any, args: tuple, kwargs: dict, opts: dict) -> ActorID:

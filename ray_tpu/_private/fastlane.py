@@ -50,6 +50,23 @@ def _spec_deps(spec: TaskSpec) -> List[ObjectID]:
 _RING_CAP = 8 << 20
 
 
+def _finalize_lane_task(core, spec: TaskSpec, event: threading.Event,
+                        err: BaseException) -> None:
+    """Single copy of the lane-task terminal teardown: error stored
+    BEFORE the event fires, inflight/lane-event/dep bookkeeping cleaned,
+    terminal task event recorded (shared by _fail_pending, the feeder's
+    cancelled-drop path, and queue-side cancellation)."""
+    core._store_error(spec, err)
+    core._record_task_event(spec.task_id, state="FAILED",
+                            end_time=time.time(), error=str(err))
+    core._inflight.pop(spec.task_id, None)
+    for oid in spec.return_ids():
+        core._lane_events.pop(oid, None)
+    for oid in _spec_deps(spec):
+        core._unpin_task_dep(oid)
+    event.set()
+
+
 def lanes_enabled() -> bool:
     if os.environ.get("RAY_TPU_FASTLANE", "1") == "0":
         return False
@@ -275,16 +292,7 @@ class _Lane:
                 else:
                     err = exc.WorkerCrashedError(
                         f"fast-lane worker {self.worker_address} died")
-                self.core._store_error(spec, err)
-                self.core._record_task_event(
-                    spec.task_id, state="FAILED", end_time=time.time(),
-                    error=str(err))
-                self.core._inflight.pop(spec.task_id, None)
-                for oid in spec.return_ids():
-                    self.core._lane_events.pop(oid, None)
-                for oid in _spec_deps(spec):
-                    self.core._unpin_task_dep(oid)
-                event.set()
+                _finalize_lane_task(self.core, spec, event, err)
 
     def close(self, *, release_lease: bool = True):
         self._mark_dead()
@@ -405,6 +413,24 @@ class LanePool:
                 del self._queue[:take]
             if not chunk:
                 continue
+            # a task cancelled while queued here must NOT dispatch — at
+            # cold start the cancel can land before any lane (or even
+            # lease) exists, and nothing downstream would re-check
+            # (observed: force-cancelled 60s sleeper running to
+            # completion, its get() timing out)
+            live_chunk = []
+            for spec, event in chunk:
+                info = self.core._inflight.get(spec.task_id)
+                if info is not None and info.get("canceled"):
+                    _finalize_lane_task(
+                        self.core, spec, event, exc.TaskCancelledError(
+                            f"task {spec.function.repr_name} "
+                            f"was cancelled"))
+                else:
+                    live_chunk.append((spec, event))
+            chunk = live_chunk
+            if not chunk:
+                continue
             rc = best.submit_many(chunk)
             if rc == 0:  # lane died mid-flight: requeue for another lane
                 with self._qlock:
@@ -417,6 +443,26 @@ class LanePool:
                 if len(chunk) == 1 and best.submit_many(chunk) < 1:
                     # a single spec that outsizes the ring: asyncio path
                     self._fallback(*chunk[0])
+
+    def cancel_queued(self, task_id) -> bool:
+        """Remove a not-yet-dispatched task from the feeder queue and
+        fail it as cancelled IMMEDIATELY — without this, a queued task's
+        cancellation only lands at the next dispatch attempt, which can
+        be a full task-runtime away when the lane window is occupied."""
+        with self._qlock:
+            hit = None
+            for i, (spec, event) in enumerate(self._queue):
+                if spec.task_id == task_id:
+                    hit = (spec, event)
+                    del self._queue[i]
+                    break
+        if hit is None:
+            return False
+        _finalize_lane_task(self.core, hit[0], hit[1],
+                            exc.TaskCancelledError(
+                                f"task {hit[0].function.repr_name} "
+                                f"was cancelled"))
+        return True
 
     def _fallback(self, spec: TaskSpec, event: threading.Event):
         async def _run(spec=spec, event=event):

@@ -237,3 +237,28 @@ def test_unrecoverable_without_retries(cluster2):
     cluster.remove_node(node2)
     with pytest.raises(ray_tpu.exceptions.ObjectLostError):
         ray_tpu.get(big, timeout=60)
+
+
+def test_cancel_queued_lane_task_prompt(ray_cluster):
+    """A lane task cancelled while still QUEUED on the feeder fails
+    promptly — not a full task-runtime later (the cold-start wedge:
+    cancel used to land before any lane existed and the task ran to
+    completion anyway)."""
+    @ray_tpu.remote(max_retries=0)
+    def blocker():
+        time.sleep(30)
+
+    @ray_tpu.remote(max_retries=0)
+    def queued():
+        return 1
+
+    blockers = [blocker.remote() for _ in range(8)]  # occupy lanes/CPUs
+    ref = queued.remote()
+    time.sleep(0.3)
+    t0 = time.time()
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+    assert time.time() - t0 < 10, "cancellation not prompt"
+    for b in blockers:
+        ray_tpu.cancel(b, force=True)
