@@ -46,7 +46,11 @@ def _build_lib() -> str:
         with open(s, "rb") as f:
             h.update(f.read())
     h.update(san.encode())  # sanitized builds cache separately
-    out = os.path.join(_BUILD, f"libray_tpu_core_{h.hexdigest()[:16]}.so")
+    # variant tag in the name: the sweep below must only reap builds of
+    # the SAME variant — a sanitize run deleting the normal build would
+    # drop concurrent normal processes onto the pure-Python fallback
+    variant = f"libray_tpu_core_{san or 'std'}"
+    out = os.path.join(_BUILD, f"{variant}_{h.hexdigest()[:16]}.so")
     if os.path.exists(out):
         return out
     os.makedirs(_BUILD, exist_ok=True)
@@ -56,9 +60,9 @@ def _build_lib() -> str:
          "-o", tmp, *srcs, "-lpthread"],
         check=True, capture_output=True, timeout=180)
     os.replace(tmp, out)  # atomic: concurrent builders race safely
-    # sweep superseded builds (best effort)
+    # sweep superseded builds of this variant only (best effort)
     for f in os.listdir(_BUILD):
-        if f.startswith("libray_tpu_") and f.endswith(".so") \
+        if f.startswith(variant) and f.endswith(".so") \
                 and os.path.join(_BUILD, f) != out:
             try:
                 os.unlink(os.path.join(_BUILD, f))

@@ -111,6 +111,11 @@ class Config:
     # (engine buckets it), so each (span, path) pair is its own compiled
     # executable — flipping per round costs nothing at steady state.
     llm_paged_kernel_min_ctx_pages: int = 0
+    # bind host for the per-process PJRT transfer server backing
+    # DeviceChannel (experimental/device_channel.py); must be routable
+    # from peer hosts — "" = loopback (single host). TPU pods set the
+    # node's DCN-reachable IP.
+    device_transfer_host: str = ""
     mesh_compile_cache_dir: str = ""
     default_device_platform: str = ""         # "" = jax default
     ici_mesh_auto_axis_order: bool = True

@@ -1555,6 +1555,11 @@ class CoreWorker:
                     "task_id": task_id, "force": force}, timeout=5)
             except Exception:
                 pass  # worker already gone — the retry loop sees `canceled`
+            # lane tasks dispatched into a ring may sit behind long
+            # tasks on the lane's serial worker: finalize promptly
+            # owner-side (the worker's eventual skip-reply is dropped)
+            if self._lane_pool is not None:
+                self._lane_pool.cancel_pending(task_id)
         else:
             # queued on the fast-lane feeder: fail it immediately (a
             # dispatch-time check alone could be a full task-runtime
@@ -1591,6 +1596,8 @@ class CoreWorker:
                         "task_id": task_id, "force": force}, timeout=5)
                 except Exception:
                     pass
+                if self._lane_pool is not None:
+                    self._lane_pool.cancel_pending(task_id)
                 return
 
     # ------------------------------------------------------------- actors

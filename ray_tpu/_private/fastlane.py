@@ -464,6 +464,32 @@ class LanePool:
                                 f"was cancelled"))
         return True
 
+    def cancel_pending(self, task_id) -> bool:
+        """Fail a lane task that already DISPATCHED into a ring but may
+        sit behind long tasks on the lane's serial worker. The owner
+        finalizes promptly; the worker (told separately via cancel_task)
+        skips or interrupts the execution, and its eventual reply for
+        the forgotten seq is dropped by the reply loop."""
+        for lane in list(self.lanes):
+            with lane._lock:
+                hit_seq = None
+                for seq, (spec, event) in lane.pending.items():
+                    if spec.task_id == task_id:
+                        hit_seq = seq
+                        break
+                if hit_seq is None:
+                    continue
+                spec, event = lane.pending.pop(hit_seq)
+                lane.outstanding -= 1
+            _finalize_lane_task(self.core, spec, event,
+                                exc.TaskCancelledError(
+                                    f"task {spec.function.repr_name} "
+                                    f"was cancelled"))
+            if lane.on_slot is not None:
+                lane.on_slot()
+            return True
+        return False
+
     def _fallback(self, spec: TaskSpec, event: threading.Event):
         async def _run(spec=spec, event=event):
             try:
