@@ -288,7 +288,7 @@ def test_llm_server_over_serve_http(tiny_params):
         # direct handle call
         out = ray_tpu.get(handle.options(method_name="completions").remote(
             {"prompt_ids": [5, 17, 99, 3], "temperature": 0.0,
-             "max_tokens": 5}), timeout=120)
+             "max_tokens": 5}), timeout=300)
         toks = out["choices"][0]["token_ids"]
         assert len(toks) == 5
         assert out["choices"][0]["finish_reason"] == "length"
@@ -303,7 +303,7 @@ def test_llm_server_over_serve_http(tiny_params):
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/llm", data=body,
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=120) as resp:
+        with urllib.request.urlopen(req, timeout=300) as resp:
             data = _json.loads(resp.read())
         assert data["result"]["choices"][0]["token_ids"] == toks
 
@@ -314,7 +314,7 @@ def test_llm_server_over_serve_http(tiny_params):
         sreq = urllib.request.Request(
             f"http://127.0.0.1:{port}/llm", data=sbody,
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(sreq, timeout=120) as resp:
+        with urllib.request.urlopen(sreq, timeout=300) as resp:
             raw = resp.read().decode()
         chunks = [_json.loads(line[len("data: "):])
                   for line in raw.strip().split("\n\n")]
