@@ -39,7 +39,7 @@ PY
     JAX_PLATFORMS=cpu \
     timeout "${CI_ASAN_TIMEOUT_S:-1200}" \
         python -m pytest tests/test_native_store.py tests/test_fastlane.py \
-            tests/test_dag.py -q -k "not tensor and not device_channel"
+            tests/test_dag.py -q -k "not tensor and not device"
     rm -rf ray_tpu/_native/build   # drop instrumented builds
     echo "ASAN PASSED"
     exit 0

@@ -34,12 +34,21 @@ def _dag_exec_loop(actor_self, spec_blob: bytes):
     standing execution loop of this actor's DAG partition
     (ref: compiled_dag_node.py _execute_until / do_exec_tasks)."""
     spec = cloudpickle.loads(spec_blob)
+    device_paths = set(spec.get("device_paths", ()))
+
+    def _open(path: str):
+        if path in device_paths:
+            from ..experimental.device_channel import DeviceChannel
+
+            return DeviceChannel(path)
+        return Channel(path)
+
     readers: Dict[str, Channel] = {}
     writers: Dict[str, Channel] = {}
     for path in spec["read_paths"]:
-        readers[path] = Channel(path)
+        readers[path] = _open(path)
     for path in spec["write_paths"]:
-        writers[path] = Channel(path)
+        writers[path] = _open(path)
 
     def shutdown():
         for ch in writers.values():
@@ -175,7 +184,25 @@ class CompiledDAG:
         self._row_vals: List[Any] = []
         self._pending: Dict[int, CompiledDAGRef] = {}
         self._torn_down = False
-        self._build(root)
+        # defaults BEFORE _build so a mid-build validation error leaves
+        # teardown()-able state (channels allocate in topo order — the
+        # ones created before the raise must not leak their shm files)
+        self._channels: List[Channel] = []
+        self._device_paths: set = set()
+        self._input_channel = None
+        self._outputs: List[Tuple[Channel, int, Any]] = []
+        self._loop_refs: List[Any] = []
+        try:
+            self._build(root)
+        except BaseException:
+            for ch in self._channels:
+                try:
+                    ch.close()
+                    ch.unlink()
+                except Exception:
+                    pass
+            self._torn_down = True
+            raise
 
     # --- compilation ---
 
@@ -260,7 +287,6 @@ class CompiledDAG:
             note_consumer(out, None)
 
         # channels: one per produced value that crosses a process boundary
-        self._channels: List[Channel] = []
         chan_of: Dict[int, Channel] = {}
         slot_of: Dict[Tuple[int, str], int] = {}
         for n in order:
@@ -272,7 +298,22 @@ class CompiledDAG:
             if not isinstance(n, (InputNode, ClassMethodNode,
                                   CollectiveNode)):
                 continue
-            ch = Channel(num_readers=n_readers, capacity=self.buffer_size)
+            if getattr(n, "device_transport", False):
+                # with_device_transport(): this edge's jax arrays move
+                # peer-to-peer over the PJRT transfer fabric
+                if driver_reads.get(pid) or len(consumers) != 1:
+                    raise ValueError(
+                        "with_device_transport() edges need exactly one "
+                        "remote consumer and no driver read (DeviceChannel "
+                        "is 1:1; route driver-bound values over the "
+                        "default shm lane)")
+                from ..experimental.device_channel import DeviceChannel
+
+                ch = DeviceChannel(capacity=self.buffer_size)
+                self._device_paths.add(ch.path)
+            else:
+                ch = Channel(num_readers=n_readers,
+                             capacity=self.buffer_size)
             self._channels.append(ch)
             chan_of[pid] = ch
             for slot, actor in enumerate(consumers):
@@ -419,6 +460,7 @@ class CompiledDAG:
             payload = dict(spec)
             payload["read_paths"] = sorted(payload["read_paths"])
             payload["write_paths"] = sorted(payload["write_paths"])
+            payload["device_paths"] = sorted(self._device_paths)
             method = ActorMethod(handle, "_rtpu_dyn_call")
             self._loop_refs.append(
                 method.remote(loop_blob, cloudpickle.dumps(payload)))

@@ -12,6 +12,28 @@ from typing import Any, Dict, List, Optional, Tuple
 class DAGNode:
     """Base: something that produces a value per DAG execution."""
 
+    # set by with_device_transport(): this node's output edge moves as
+    # device tensors over the PJRT transfer fabric in compiled DAGs
+    device_transport: bool = False
+
+    def with_device_transport(self) -> "DAGNode":
+        """Mark this node's output for device-to-device transport (ref:
+        with_tensor_transport / TorchTensorType hints — the TPU analog
+        rides experimental.DeviceChannel). Compiled DAGs then move this
+        edge's jax arrays peer-to-peer through the transfer fabric
+        instead of the host-shm lane. Requires exactly one remote
+        consumer and no driver read of this node."""
+        if isinstance(self, (AttributeNode, InputAttributeNode,
+                             MultiOutputNode)):
+            # the compiler checks the flag on the PRODUCER node; letting
+            # a wrapper carry it would silently ride the shm lane
+            raise TypeError(
+                "with_device_transport() applies to the producing node "
+                "— call it on the .bind(...) result before indexing/"
+                "wrapping")
+        self.device_transport = True
+        return self
+
     def experimental_compile(self, *, buffer_size_bytes: int = 1 << 20,
                              max_inflight: int = 2):
         from .compiled import CompiledDAG

@@ -102,12 +102,23 @@ class DeviceChannel:
     def write(self, arrays: Any, timeout: Optional[float] = None) -> None:
         import jax
 
-        server = _transfer_server()
         flat, treedef = jax.tree.flatten(arrays)
-        if not all(isinstance(a, jax.Array) for a in flat):
-            raise TypeError(
-                "DeviceChannel payloads must be pytrees of jax arrays "
-                "(use experimental.channel.Channel for host data)")
+        if not flat or not all(isinstance(a, jax.Array) for a in flat):
+            # tensor-bearing payloads that just aren't jax arrays must
+            # NOT silently degrade to host pickling — the whole point
+            # of this channel is the device fabric
+            import numpy as np
+
+            if any(isinstance(a, np.ndarray) for a in flat):
+                raise TypeError(
+                    "DeviceChannel payloads must be pytrees of jax "
+                    "arrays; for numpy/host data use "
+                    "experimental.channel.Channel's tensor lane")
+            # non-tensor payloads (compiled-DAG error markers, small
+            # control values) ride the control lane inline
+            self._control.write({"inline": arrays}, timeout=timeout)
+            return
+        server = _transfer_server()
         uid = secrets.randbits(62)
         # metadata publishes FIRST: a control-write timeout then pins
         # nothing (await_pull has no unregister — registering first
@@ -127,11 +138,17 @@ class DeviceChannel:
 
     # --- reader ---
 
-    def read(self, timeout: Optional[float] = None) -> Any:
+    def read(self, slot: int = 0, timeout: Optional[float] = None) -> Any:
+        """``slot`` kept for Channel signature compatibility (compiled
+        DAG exec loops call read(slot)); DeviceChannel is 1:1, slot 0."""
         import jax
         import jax.numpy as jnp
 
+        if slot != 0:
+            raise ValueError("DeviceChannel is single-reader (slot 0)")
         meta = self._control.read(0, timeout=timeout)
+        if "inline" in meta:
+            return meta["inline"]
         conn = _connection(meta["address"])
         sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
         specs = [jax.ShapeDtypeStruct(shape, jnp.dtype(dtype),

@@ -461,3 +461,34 @@ def test_device_channel_cross_process(ray_cluster):
     assert ray_tpu.get(done, timeout=60) == "done"
     ch.close()
     ch.unlink()
+
+
+def test_compiled_dag_with_device_transport(ray_cluster):
+    """with_device_transport(): a compiled-DAG edge moves its jax
+    arrays over the PJRT transfer fabric (DeviceChannel) instead of the
+    shm lane (ref: with_tensor_transport / TorchTensorType hints)."""
+    import numpy as np
+    from ray_tpu.experimental.device_channel import DeviceChannel
+
+    a = ray_tpu.remote(TensorWorker).remote()
+    b = ray_tpu.remote(TensorWorker).remote()
+    with InputNode() as inp:
+        dag = b.shift.bind(a.scale.bind(inp).with_device_transport())
+    compiled = dag.experimental_compile()
+    try:
+        assert len(compiled._device_paths) == 1  # the a->b edge
+        assert any(isinstance(c, DeviceChannel)
+                   for c in compiled._channels)
+        for i in range(3):
+            x = np.full((4, 4), float(i), np.float32)
+            out = compiled.execute(x).get(timeout=60)
+            np.testing.assert_allclose(np.asarray(out), x * 2.0 + 1.0)
+    finally:
+        compiled.teardown()
+
+    # driver-read device edges are rejected (DeviceChannel is 1:1)
+    a2 = ray_tpu.remote(TensorWorker).remote()
+    with InputNode() as inp:
+        bad = a2.scale.bind(inp).with_device_transport()
+    with pytest.raises(ValueError, match="device_transport"):
+        bad.experimental_compile()
