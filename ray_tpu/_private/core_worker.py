@@ -326,6 +326,19 @@ class CoreWorker:
         # process exit reclaims it.
 
     async def _shutdown(self):
+        # final task-event drain: events recorded moments before
+        # shutdown would otherwise miss the 250ms flusher and vanish
+        # from the state API / `timeline` (observed: a short driver's
+        # FINISHED events lost)
+        with self._task_events_lock:
+            flush, self._task_events = self._task_events, []
+        if flush and not self.gcs.closed:
+            try:
+                # 1s cap: this whole coroutine runs under a 5s budget
+                # and driver_exit + connection closes must still fit
+                await asyncio.wait_for(self._send_task_events(flush), 1)
+            except Exception:
+                pass
         if self.mode == "driver" and not self.gcs.closed:
             try:
                 # clean detach: the GCS tears down this job's non-detached

@@ -198,7 +198,10 @@ class CompiledDAG:
             for ch in self._channels:
                 try:
                     ch.close()
-                    ch.unlink()
+                except Exception:
+                    pass
+                try:  # unlink even when close() raised — the shm file
+                    ch.unlink()  # is what must not leak
                 except Exception:
                     pass
             self._torn_down = True

@@ -31,6 +31,12 @@ class DAGNode:
                 "with_device_transport() applies to the producing node "
                 "— call it on the .bind(...) result before indexing/"
                 "wrapping")
+        if isinstance(self, InputNode):
+            # the DRIVER writes the input edge; it feeds host values, so
+            # a device channel there fails at the first execute()
+            raise TypeError(
+                "with_device_transport() cannot apply to the InputNode "
+                "(the driver writes that edge with host values)")
         self.device_transport = True
         return self
 

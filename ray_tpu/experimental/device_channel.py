@@ -104,16 +104,18 @@ class DeviceChannel:
 
         flat, treedef = jax.tree.flatten(arrays)
         if not flat or not all(isinstance(a, jax.Array) for a in flat):
-            # tensor-bearing payloads that just aren't jax arrays must
-            # NOT silently degrade to host pickling — the whole point
-            # of this channel is the device fabric
+            # tensor-bearing payloads that aren't PURE jax-array pytrees
+            # must NOT silently degrade to host pickling — the whole
+            # point of this channel is the device fabric. That includes
+            # mixed pytrees (a device array next to a scalar would drag
+            # the array through the pickled control lane).
             import numpy as np
 
-            if any(isinstance(a, np.ndarray) for a in flat):
+            if any(isinstance(a, (jax.Array, np.ndarray)) for a in flat):
                 raise TypeError(
-                    "DeviceChannel payloads must be pytrees of jax "
-                    "arrays; for numpy/host data use "
-                    "experimental.channel.Channel's tensor lane")
+                    "DeviceChannel payloads must be pytrees whose "
+                    "leaves are ALL jax arrays; split host scalars out, "
+                    "or use experimental.channel.Channel for host data")
             # non-tensor payloads (compiled-DAG error markers, small
             # control values) ride the control lane inline
             self._control.write({"inline": arrays}, timeout=timeout)

@@ -247,6 +247,44 @@ def cmd_state(args) -> int:
     return 0
 
 
+def cmd_timeline(args) -> int:
+    """Dump task events as a chrome://tracing JSON file (ref:
+    `ray timeline`; open in Perfetto)."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    ray_tpu.init(address=_resolve_address(args))
+    events = tracing.timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_memory(args) -> int:
+    """Per-node store usage + per-lease resource holdings + object
+    directory (ref: `ray memory` — the leak-hunting view)."""
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(address=_resolve_address(args))
+    for node in ray_tpu.nodes():
+        if not node.get("Alive"):
+            continue
+        stats = state_api._raylet_call(node["NodeID"], "node_stats", {})
+        print(json.dumps({
+            "node_id": node["NodeID"],
+            "store_used_bytes": stats["store_used_bytes"],
+            "num_objects": stats["num_objects"],
+            "workers": stats["num_workers"],
+            "leases": stats["leases"],
+            "resources_available": stats["resources_available"],
+        }, default=str))
+    for row in state_api.list_objects():
+        print(json.dumps({"object": row}, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
 # ------------------------------------------------------------------ main
 
 def build_parser() -> argparse.ArgumentParser:
@@ -294,6 +332,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("kind", choices=["nodes", "actors", "tasks", "objects"])
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_state)
+
+    sp = sub.add_parser("timeline",
+                        help="dump task events as chrome-trace JSON")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("memory",
+                        help="store usage, leases, object directory")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_memory)
     return p
 
 

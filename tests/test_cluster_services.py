@@ -302,3 +302,50 @@ def test_autoscaler_scales_up_and_down():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_cli_timeline_and_memory(tmp_path):
+    """`timeline` dumps chrome-trace JSON; `memory` dumps per-node
+    store/lease state (ref: `ray timeline` / `ray memory`)."""
+    env = {**os.environ}
+    env.pop("RAY_TPU_ADDRESS", None)
+    head = subprocess.run(CLI + ["start", "--head", "--num-cpus", "2"],
+                          capture_output=True, text=True, timeout=120,
+                          env=env)
+    assert head.returncode == 0, head.stderr
+    address = head.stdout.split("started: ")[1].split(" ")[0].strip()
+    try:
+        # run some tasks so the timeline has events
+        script = tmp_path / "drive.py"
+        script.write_text(
+            "import ray_tpu\n"
+            f"ray_tpu.init(address='{address}')\n"
+            "@ray_tpu.remote\n"
+            "def f(x):\n"
+            "    return x + 1\n"
+            "print(ray_tpu.get([f.remote(i) for i in range(4)],"
+            " timeout=60))\n"
+            "ray_tpu.shutdown()\n")
+        run = subprocess.run([sys.executable, str(script)],
+                             capture_output=True, text=True, timeout=120,
+                             env=env)
+        assert run.returncode == 0, run.stderr
+
+        out_json = tmp_path / "timeline.json"
+        tl = subprocess.run(
+            CLI + ["timeline", "--address", address,
+                   "--output", str(out_json)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert tl.returncode == 0, tl.stderr
+        events = json.loads(out_json.read_text())
+        assert any(e["name"].startswith("f") for e in events), events[:3]
+
+        mem = subprocess.run(CLI + ["memory", "--address", address],
+                             capture_output=True, text=True, timeout=120,
+                             env=env)
+        assert mem.returncode == 0, mem.stderr
+        first = json.loads(mem.stdout.splitlines()[0])
+        assert "store_used_bytes" in first and "leases" in first
+    finally:
+        subprocess.run(CLI + ["stop"], capture_output=True, timeout=60,
+                       env=env)
