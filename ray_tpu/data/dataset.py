@@ -439,6 +439,132 @@ class Dataset:
             for i, shard in enumerate(shards)
         ]
 
+    # ------------------------------------------------ row-index splits
+
+    def _split_rows(self, bounds: Optional[List[int]] = None,
+                    fractions: Optional[List[float]] = None
+                    ) -> List["Dataset"]:
+        """Carve at absolute row indices (or fraction-derived ones) with
+        ONE plan execution — the blocks fetched here are both the row
+        counter and the split material."""
+        from .. import put
+
+        blocks = list(self.iter_blocks())
+        total = sum(block_num_rows(b) for b in blocks)
+        if fractions is not None:
+            bounds, acc = [], 0
+            for f in fractions:
+                acc += int(total * f)
+                bounds.append(acc)
+        pieces: List[List[Any]] = [[] for _ in range(len(bounds) + 1)]
+        pos = 0
+        for block in blocks:
+            n = block_num_rows(block)
+            for piece_i in range(len(pieces)):
+                lo = 0 if piece_i == 0 else bounds[piece_i - 1]
+                hi = bounds[piece_i] if piece_i < len(bounds) else pos + n
+                s = max(lo, pos) - pos
+                e = min(hi, pos + n) - pos
+                if e > s:
+                    pieces[piece_i].append(slice_block(block, s, e))
+            pos += n
+        return [
+            Dataset([_LogicalOp("refs", f"rowsplit_{i}",
+                                {"refs": [put(b) for b in piece]})],
+                    self._parallelism)
+            for i, piece in enumerate(pieces)
+        ]
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Split at absolute row indices (ref: dataset.py
+        split_at_indices): len(indices)+1 datasets."""
+        if sorted(indices) != list(indices) or any(i < 0 for i in indices):
+            raise ValueError("indices must be non-negative and sorted")
+        return self._split_rows(bounds=list(indices))
+
+    def split_proportionately(self, fractions: List[float]) -> List["Dataset"]:
+        """Split by fractions (ref: dataset.py split_proportionately):
+        len(fractions)+1 datasets, the last taking the remainder."""
+        if any(not 0 < f < 1 for f in fractions) or sum(fractions) >= 1:
+            raise ValueError("fractions must be in (0,1) and sum to < 1")
+        return self._split_rows(fractions=fractions)
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None) -> List["Dataset"]:
+        """(train, test) by fraction (ref: dataset.py train_test_split)."""
+        if not 0 < test_size < 1:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        return ds.split_proportionately([1.0 - test_size])
+
+    # ------------------------------------------------ column utilities
+
+    def add_column(self, name: str, fn) -> "Dataset":
+        """Append a computed column: fn(columnar_batch) -> array (ref:
+        dataset.py add_column). map_batches already hands the fn a
+        columnar dict."""
+        def block_fn(batch):
+            cols = dict(batch)
+            cols[name] = fn(cols)
+            return cols
+
+        return self.map_batches(block_fn, batch_size=None)
+
+    def drop_columns(self, cols) -> "Dataset":
+        """Remove the named columns (ref: dataset.py drop_columns)."""
+        drop = set(cols)
+
+        def block_fn(batch):
+            return {k: v for k, v in batch.items() if k not in drop}
+
+        return self.map_batches(block_fn, batch_size=None)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        """Rename columns by {old: new} (ref: dataset.py rename_columns)."""
+        def block_fn(batch):
+            return {mapping.get(k, k): v for k, v in batch.items()}
+
+        return self.map_batches(block_fn, batch_size=None)
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (ref: dataset.py unique).
+        Row-iterated so list blocks (from_items) work too."""
+        seen = set()
+        for row in self.iter_rows():
+            v = row[column]
+            seen.add(v.item() if hasattr(v, "item") else v)
+        try:
+            return sorted(seen)          # natural order when comparable
+        except TypeError:
+            return sorted(seen, key=repr)  # mixed types: stable fallback
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (ref: dataset.py random_sample)."""
+        if not 0 <= fraction <= 1:
+            raise ValueError("fraction must be in [0, 1]")
+
+        def block_fn(batch, _frac=fraction, _seed=seed):
+            import numpy as np
+
+            n = block_num_rows(batch)
+            if _seed is None:
+                rng = np.random.default_rng()  # fresh entropy per block
+            else:
+                # per-block sub-seed derived from content: a bare _seed
+                # would give every block the IDENTICAL keep-mask
+                # (correlated sampling). Identical duplicate blocks still
+                # correlate — acceptable for a deterministic sample.
+                first = np.ascontiguousarray(
+                    np.asarray(next(iter(batch.values()))))
+                digest = int(first.view(np.uint8)[:4096].sum()) + n
+                rng = np.random.default_rng([_seed, digest])
+            mask = rng.random(n) < _frac
+            return {k: np.asarray(v)[mask] for k, v in batch.items()}
+
+        return self.map_batches(block_fn, batch_size=None)
+
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None) -> List["DataIterator"]:
         """n iterators fed concurrently from ONE streaming execution

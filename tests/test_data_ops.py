@@ -246,3 +246,36 @@ def test_from_huggingface(ray_cluster):
     ds = rdata.from_huggingface(hds, parallelism=3)
     rows = sorted(ds.iter_rows(), key=lambda r: int(r["label"]))
     assert len(rows) == 10 and rows[7]["text"] == "t7"
+
+
+def test_split_and_column_utilities(ray_cluster):
+    """split_at_indices / split_proportionately / train_test_split +
+    add/drop/rename columns, unique, random_sample (ref: the dataset.py
+    public API surface)."""
+    ds = rdata.range(20)
+
+    parts = ds.split_at_indices([5, 12])
+    assert [p.count() for p in parts] == [5, 7, 8]
+    assert [int(r["id"]) for r in parts[1].iter_rows()] == list(range(5, 12))
+
+    props = ds.split_proportionately([0.25, 0.25])
+    assert [p.count() for p in props] == [5, 5, 10]
+
+    train, test = ds.train_test_split(0.3, shuffle=True, seed=4)
+    assert train.count() == 14 and test.count() == 6
+    all_ids = sorted(int(r["id"]) for p in (train, test)
+                     for r in p.iter_rows())
+    assert all_ids == list(range(20))
+
+    ds2 = (rdata.range(6)
+           .add_column("sq", lambda cols: cols["id"] ** 2)
+           .rename_columns({"id": "n"}))
+    rows = sorted(ds2.iter_rows(), key=lambda r: int(r["n"]))
+    assert int(rows[3]["sq"]) == 9 and set(rows[0]) == {"n", "sq"}
+    assert set(ds2.drop_columns(["sq"]).schema()) == {"n"}
+
+    mixed = rdata.from_items([{"k": v} for v in (3, 1, 3, 2, 1)])
+    assert mixed.unique("k") == [1, 2, 3]
+
+    sampled = rdata.range(4000).random_sample(0.5, seed=7).count()
+    assert 1700 < sampled < 2300
