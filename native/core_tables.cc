@@ -98,6 +98,7 @@ struct PendingLease {
   Vec req;
   int32_t flags;          // bit0: spread, bit1: no_spill (local only)
   uint64_t affinity_node; // nonzero: hard node affinity
+  uint32_t skips = 0;     // sweeps this lease was passed over (aging)
 };
 
 struct Sched {
@@ -286,17 +287,47 @@ uint64_t rtpu_sched_pending(void* h) {
 // Hybrid policy (ref: hybrid_scheduling_policy.h:50): local node first
 // unless SPREAD, else round-robin over fitting remotes (spillback);
 // hard affinity pins to one node. Resources are debited here. Writes up
-// to `max` (req_id, node) pairs; returns the count. FIFO with
-// head-of-line blocking per identical shape, like the reference's
-// scheduling classes: a non-fitting request does NOT block differently
-// shaped requests behind it.
+// to `max` (req_id, node) pairs; returns the count.
+//
+// Ordering: FIFO with per-sweep skip of non-fitting leases — a
+// non-fitting request does NOT block differently shaped requests
+// behind it. To keep a large lease from being starved forever by a
+// stream of smaller later arrivals, a lease skipped kAgingSweeps
+// times becomes a barrier: once it fails to place, the sweep stops
+// granting, so freed capacity accumulates for the oldest starved
+// lease instead of being re-consumed by newer small ones. A lease
+// that can NEVER place (dead affinity node, req bigger than any
+// node's total) must not become a forever-barrier, so the barrier
+// only arms for leases feasible against some node's TOTAL capacity.
+constexpr uint32_t kAgingSweeps = 64;
+
 uint64_t rtpu_sched_pump(void* h, uint64_t* out_req, uint64_t* out_node,
                          uint64_t max) {
   Sched* s = static_cast<Sched*>(h);
   std::lock_guard<std::mutex> g(s->mu);
   uint64_t granted = 0;
   std::deque<PendingLease> keep;
+  bool barrier = false;
+  auto feasible = [&](const PendingLease& p) {
+    if (p.affinity_node != 0) {
+      auto it = s->nodes.find(p.affinity_node);
+      return it != s->nodes.end() && it->second.alive &&
+             p.req.fits_in(it->second.total);
+    }
+    bool no_spill = p.flags & 2;
+    for (auto& kv : s->nodes) {
+      if (!kv.second.alive) continue;
+      if (no_spill && kv.first != s->local_node) continue;
+      if (p.req.fits_in(kv.second.total)) return true;
+    }
+    return false;
+  };
   while (!s->queue.empty() && granted < max) {
+    if (barrier) {
+      keep.push_back(std::move(s->queue.front()));
+      s->queue.pop_front();
+      continue;
+    }
     PendingLease p = std::move(s->queue.front());
     s->queue.pop_front();
     uint64_t chosen = 0;
@@ -333,6 +364,8 @@ uint64_t rtpu_sched_pump(void* h, uint64_t* out_req, uint64_t* out_node,
       out_node[granted] = chosen;
       granted++;
     } else {
+      p.skips++;
+      if (p.skips >= kAgingSweeps && feasible(p)) barrier = true;
       keep.push_back(std::move(p));
     }
   }

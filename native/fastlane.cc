@@ -42,7 +42,8 @@ namespace {
 constexpr uint32_t kMagic = 0x464C4E31;  // "FLN1"
 
 struct Header {
-  uint32_t magic;
+  std::atomic<uint32_t> magic;  // release-published last by init,
+                                // acquire-spun by open (cross-process)
   uint32_t capacity;                 // data area bytes
   std::atomic<uint64_t> head;        // bytes ever written
   std::atomic<uint64_t> tail;        // bytes ever consumed
@@ -145,8 +146,10 @@ void* rtpu_ring_create(const char* path, uint32_t capacity) {
   h->closed.store(0);
   h->push_lock.store(0);
   h->pop_lock.store(0);
-  std::atomic_thread_fence(std::memory_order_seq_cst);
-  h->magic = kMagic;  // published last: rtpu_ring_open spins on it
+  // release store publishes every prior header field; the opener's
+  // acquire load pairs with it (a plain store + seq-cst fence leaves
+  // the reader side unordered — formally a data race)
+  h->magic.store(kMagic, std::memory_order_release);
   Ring* r = new Ring{h, static_cast<uint8_t*>(mem) + sizeof(Header), len, fd};
   return r;
 }
@@ -175,8 +178,10 @@ void* rtpu_ring_open(const char* path) {
     return nullptr;
   }
   Header* h = static_cast<Header*>(mem);
-  for (int i = 0; i < 500 && h->magic != kMagic; i++) usleep(1000);
-  if (h->magic != kMagic) {
+  for (int i = 0;
+       i < 500 && h->magic.load(std::memory_order_acquire) != kMagic; i++)
+    usleep(1000);
+  if (h->magic.load(std::memory_order_acquire) != kMagic) {
     munmap(mem, st.st_size);
     close(fd);
     return nullptr;

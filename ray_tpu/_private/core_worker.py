@@ -634,6 +634,44 @@ class CoreWorker:
         finally:
             self._notify_unblocked()
 
+    async def _probe_owner(self, owner: str, oid: ObjectID,
+                           rpc_timeout: float = 10.0) -> str:
+        """One non-blocking probe of an object's owner. "ok" lands the
+        bytes in the local memory store; "pending" means the creating
+        task is still running there. Returns
+        "ok" | "pending" | "gone" | "unreachable"."""
+        try:
+            client = await self._client_for(owner)
+            reply = await client.call("fetch_object",
+                                      {"object_id": oid},
+                                      timeout=rpc_timeout)
+        except Exception:
+            return "unreachable"  # owner dead, hung, or not serving
+        if reply is None or reply.get("status") == "gone":
+            return "gone"
+        if reply["status"] == "ok":
+            self.memory_store.put(oid, reply["data"])
+            return "ok"
+        return "pending"
+
+    async def _owner_gone_policy(self, oid: ObjectID,
+                                 gone_strikes: Dict[ObjectID, int]) -> str:
+        """Shared _get/_wait policy when an owner reports gone or is
+        unreachable: the owner holds nothing IN MEMORY, but a large
+        result seals into plasma on the EXECUTING node, so give the
+        raylet directory a few passes (with a grace window for the
+        batched seal report) before attempting lineage recovery.
+        Returns "directory" (keep consulting the directory),
+        "recovered", or "lost"."""
+        strikes = gone_strikes.get(oid, 0) + 1
+        gone_strikes[oid] = strikes
+        if strikes < 4:
+            return "directory"
+        if await self._try_recover([oid]):
+            gone_strikes.pop(oid, None)
+            return "recovered"
+        return "lost"
+
     async def _fetch_from_owner(self, owner: str, oid: ObjectID,
                                 deadline: Optional[float]) -> str:
         """Pull one object from its owner into the local memory store
@@ -642,17 +680,9 @@ class CoreWorker:
         Returns "ok" | "gone" | "unreachable" | "timeout"."""
         delay = 0.005
         while True:
-            try:
-                client = await self._client_for(owner)
-                reply = await client.call("fetch_object",
-                                          {"object_id": oid}, timeout=10)
-            except Exception:
-                return "unreachable"  # owner dead or not serving
-            if reply is None or reply.get("status") == "gone":
-                return "gone"
-            if reply["status"] == "ok":
-                self.memory_store.put(oid, reply["data"])
-                return "ok"
+            status = await self._probe_owner(owner, oid)
+            if status != "pending":
+                return status
             if (deadline is not None
                     and asyncio.get_event_loop().time() > deadline):
                 return "timeout"
@@ -693,18 +723,11 @@ class CoreWorker:
                         progressed = True
                         continue
                     if status in ("gone", "unreachable"):
-                        # The owner has nothing IN MEMORY — but a large
-                        # result seals into plasma on the EXECUTING node,
-                        # so consult the directory (with a grace window
-                        # for the batched seal report) before declaring
-                        # loss. Repeated strikes with an empty directory
-                        # → lineage recovery or ObjectLostError.
-                        strikes = gone_strikes.get(oid, 0) + 1
-                        gone_strikes[oid] = strikes
-                        if strikes >= 4:
-                            if await self._try_recover([oid]):
-                                gone_strikes.pop(oid, None)
-                                continue
+                        verdict = await self._owner_gone_policy(
+                            oid, gone_strikes)
+                        if verdict == "recovered":
+                            continue
+                        if verdict == "lost":
                             raise exc.ObjectLostError(oid)
                         plasma_wait.append(oid)
                         continue
@@ -817,27 +840,35 @@ class CoreWorker:
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         oids = [r.id() for r in refs]
-        ready_ids = self.io.run(self._wait(oids, num_returns, timeout))
+        owners = {r.id(): r.owner_address for r in refs if r.owner_address}
+        ready_ids = self.io.run(self._wait(oids, num_returns, timeout, owners))
         ready_set = set(ready_ids[:num_returns]) if len(ready_ids) > num_returns else set(ready_ids)
         ready, not_ready = [], []
         for ref in refs:
             (ready if ref.id() in ready_set and len(ready) < num_returns else not_ready).append(ref)
         return ready, not_ready
 
-    async def _wait(self, oids, num_returns, timeout):
+    async def _wait(self, oids, num_returns, timeout, owners=None):
         """Readiness: local stores first; owned in-flight tasks (fast
         lane / asyncio) complete into the memory store, so they are
         polled locally — small returns never reach the plasma
-        directory; everything else blocks on the raylet wait manager.
-        Lost objects count as ready: their get() surfaces
-        ObjectLostError (matches the reference, where a failed
-        reconstruction stores an error object)."""
+        directory; borrowed refs with a known foreign owner are probed
+        at that owner (small objects never get a directory entry, so
+        the raylet wait manager alone would never report them ready);
+        everything else blocks on the raylet wait manager. Lost
+        objects count as ready: their get() surfaces ObjectLostError
+        (matches the reference, where a failed reconstruction stores
+        an error object)."""
         loop = asyncio.get_event_loop()
         deadline = None if timeout is None else loop.time() + timeout
+        owners = owners or {}
         delay = 0.002
+        lost_here: set = set()
+        gone_strikes: Dict[ObjectID, int] = {}
         while True:
             ready = [oid for oid in oids
-                     if self.memory_store.contains(oid)
+                     if oid in lost_here
+                     or self.memory_store.contains(oid)
                      or self.store.contains(oid)]
             if len(ready) >= num_returns:
                 return ready
@@ -847,9 +878,40 @@ class CoreWorker:
                             and (oid in self._lane_events
                                  or oid.task_id() in self._inflight
                                  or oid.task_id() in self._streams)}
+            owner_served = [oid for oid in oids
+                            if oid not in ready_set
+                            and oid not in pending_here
+                            and owners.get(oid) not in (None, self.address)]
+            owner_set = set(owner_served)
             remote = [oid for oid in oids
-                      if oid not in ready_set and oid not in pending_here]
-            if remote and not pending_here:
+                      if oid not in ready_set and oid not in pending_here
+                      and oid not in owner_set]
+            progressed = False
+            for oid in owner_served:
+                # cap each probe RPC by the caller's remaining budget so
+                # a hung owner cannot make wait(timeout=0.5) take 10 s
+                left = (None if deadline is None
+                        else max(0.0, deadline - loop.time()))
+                rpc_t = 10.0 if left is None else max(0.05, min(10.0, left))
+                status = await self._probe_owner(owners[oid], oid,
+                                                 rpc_timeout=rpc_t)
+                if status == "ok":
+                    progressed = True
+                elif status in ("gone", "unreachable"):
+                    # lost counts as ready; get() raises there
+                    verdict = await self._owner_gone_policy(
+                        oid, gone_strikes)
+                    if verdict in ("recovered", "lost"):
+                        if verdict == "lost":
+                            lost_here.add(oid)
+                        progressed = True
+                    else:
+                        remote.append(oid)
+                if deadline is not None and loop.time() >= deadline:
+                    break
+            if progressed:
+                continue
+            if remote and not pending_here and not owner_served:
                 left = (None if deadline is None
                         else max(0.0, deadline - loop.time()))
                 reply = await self.raylet.call("wait_objects", {
@@ -868,7 +930,13 @@ class CoreWorker:
             if deadline is not None and loop.time() >= deadline:
                 return ready
             await asyncio.sleep(delay)
-            delay = min(delay * 2, 0.05)
+            # owner-probe-only passes may spin for a task's whole
+            # runtime (no blocking park exists for borrowed pending
+            # objects) — back off further so a minutes-long wait costs
+            # ~4 RPCs/s, not ~20; local in-flight completion still
+            # polls at the tight cap.
+            cap = 0.25 if (owner_served and not pending_here) else 0.05
+            delay = min(delay * 2, cap)
 
     def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()

@@ -172,7 +172,13 @@ class _Lane:
             try:
                 seq, reply = pickle.loads(frame)
             except Exception:
-                continue
+                # An undecodable reply means the ring is corrupt or the
+                # worker wrote garbage — its pending[seq] entry can never
+                # be matched, so skipping would leak the window slot and
+                # block the submitter's get() for the lane's lifetime.
+                # Treat it as lane-fatal: _fail_pending below resubmits
+                # or errors every outstanding task.
+                break
             with self._lock:
                 entry = self.pending.pop(seq, None)
                 if entry is not None:

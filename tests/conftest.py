@@ -9,10 +9,22 @@ config back to cpu before any backend is initialized.
 """
 
 import os
+import sys
 
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                            " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Spawned drivers / CLI heads import ray_tpu by module name; a clean
+# shell has no PYTHONPATH entry for the repo root, so child processes
+# would die with ModuleNotFoundError even though pytest itself found
+# the package via rootdir. Prepend the repo root for every subprocess.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+_pp = os.environ.get("PYTHONPATH", "")
+if _REPO_ROOT not in _pp.split(os.pathsep):
+    os.environ["PYTHONPATH"] = (_REPO_ROOT + os.pathsep + _pp) if _pp else _REPO_ROOT
 
 import jax
 
