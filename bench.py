@@ -241,6 +241,42 @@ def _bench_core_summary():
     }
 
 
+def _bench_envelope_summary():
+    """Scalability-envelope families at reference-envelope depth
+    (bench_envelope.py; ref: release/benchmarks/README.md:9-31 — 100k
+    queued, 5k in-flight, 1k actors, 1 GiB broadcast, 10k-object get,
+    10 GiB object, 1M native queued leases). Runs in a subprocess so
+    cluster teardown cannot disturb the device-plane benches."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    out = {}
+    env = dict(os.environ)
+    # the envelope is pure control plane: keep every spawned worker off
+    # the (exclusive) TPU tunnel
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [_sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "bench_envelope.py"),
+         "sched", "queued", "inflight", "actors", "getmany", "bigobj",
+         "broadcast"],
+        env=env, capture_output=True, text=True, timeout=1500)
+    for line in proc.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        name = rec.pop("bench", None) or rec.pop("suite", None)
+        if name and name != "envelope":
+            out[name] = rec
+    if not out:
+        out["envelope_error"] = (proc.stderr or proc.stdout)[-300:]
+    return out
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -311,6 +347,10 @@ def main():
         core_metrics = _bench_core_summary()
     except Exception as e:  # control-plane bench must not sink the number
         core_metrics = {"core_bench_error": repr(e)[:200]}
+    try:
+        core_metrics["envelope"] = _bench_envelope_summary()
+    except Exception as e:
+        core_metrics["envelope"] = {"envelope_error": repr(e)[:200]}
 
     print(json.dumps({
         "metric": f"llama_{name}_train_tokens_per_sec_per_chip",

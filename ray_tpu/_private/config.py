@@ -59,9 +59,18 @@ class Config:
     # --- fast lane (native shm task plane; ray_tpu/_private/fastlane.py) ---
     fastlane_width: int = 4                   # max lanes (leased workers)
     fastlane_window: int = 32                 # in-flight tasks per lane
+    # max actors with an open fast lane per owner (each lane = 2 shm
+    # rings + 2 threads); calls beyond the cap ride the asyncio path
+    actor_lane_max: int = 64
     # --- workers ---
     num_workers_soft_limit: int = -1          # -1: num_cpus
     worker_startup_timeout_s: float = 60.0
+    # forkserver worker factory (worker_factory.py): pay worker imports
+    # once per node, fork per worker. Off = cold Popen per worker.
+    worker_factory_enabled: bool = True
+    # max workers mid-startup at once (fork-storm guard for envelope-
+    # depth actor counts; dedicated spawns queue behind the burst)
+    worker_spawn_burst: int = 16
     # dialing an already-registered worker (its RPC server is live): short
     worker_dial_timeout_s: float = 3.0
     worker_register_timeout_s: float = 30.0

@@ -101,6 +101,13 @@ class _LeasePool:
         self.in_flight = 0                  # lease requests outstanding
         self.waiters: List[asyncio.Future] = []
 
+    def wake_one(self) -> None:
+        while self.waiters:
+            waiter = self.waiters.pop(0)
+            if not waiter.done():
+                waiter.set_result(None)
+                return
+
 
 class CoreWorker:
     def __init__(
@@ -139,6 +146,7 @@ class CoreWorker:
         self._put_index = 0
         self._put_lock = threading.Lock()
         self._subscribed_channels: set = set()
+        self._actor_sub_tasks: Dict[str, asyncio.Task] = {}
         self._block_depth = 0          # worker dep-block nesting
         self._block_lock = threading.Lock()
 
@@ -264,8 +272,10 @@ class CoreWorker:
         await self.raylet.connect()
         self.gcs.on_push("pubsub:actor", self._on_actor_update)
         self.raylet.on_push("reclaim_lease", self._on_reclaim_lease)
-        self._subscribed_channels = {"actor"}
-        await self.gcs.call("subscribe", {"channels": ["actor"]})
+        # actor updates are subscribed PER ACTOR (actor:<hex>) on first
+        # contact with a handle — a blanket "actor" subscription from
+        # every worker makes each lifecycle event an O(workers) fan-out
+        # (quadratic at 1k-actor envelope depth)
         self.gcs.on_reconnect.append(self._resubscribe_gcs)
         if self.mode == "driver" and not self.address:
             await self._start_owner_server()
@@ -550,8 +560,22 @@ class CoreWorker:
         with self._put_lock:
             self._put_index += 1
             oid = ObjectID.for_put(self.current_task_id, self._put_index)
-        data = ser.serialize(value)
-        self._store_object(oid, data)
+        parts = ser.serialize_parts(value)
+        if parts.total <= _SMALL:
+            self._store_object(oid, parts.to_bytes())
+        else:
+            # large objects serialize straight into the shm mapping —
+            # one write pass instead of assemble + bytes() + store copy
+            buf = self.store.create(oid, parts.total)
+            try:
+                parts.write_into(buf)
+            except BaseException:
+                self.store.abort(oid)
+                raise
+            self.store.seal(oid)
+            self._owned_in_plasma.add(oid)
+            self._note_locality(oid, self.node_id.hex(), parts.total)
+            self.io.spawn(self._notify_sealed(oid, parts.total))
         return ObjectRef(oid, self.address)
 
     def _store_object(self, oid: ObjectID, data: bytes, memory_only: bool = False):
@@ -1291,6 +1315,12 @@ class CoreWorker:
                     return await self._request_lease(spec)
                 finally:
                     pool.in_flight -= 1
+                    # the freed request slot must wake a queued submission:
+                    # an actor-creation grant is pinned for life and never
+                    # passes through _release_lease, so without this wake
+                    # the 11th+ queued creation in a scheduling class waits
+                    # forever (envelope: 1k actors of one class)
+                    pool.wake_one()
             # saturated: wait for a slot, then retry the whole acquisition
             fut = asyncio.get_event_loop().create_future()
             pool.waiters.append(fut)
@@ -1439,11 +1469,7 @@ class CoreWorker:
                     pass
         # always wake one waiter — even on the failure path, so queued
         # submissions retry instead of stranding
-        while pool.waiters:
-            waiter = pool.waiters.pop(0)
-            if not waiter.done():
-                waiter.set_result(None)
-                break
+        pool.wake_one()
 
     _raylet_clients: Dict[str, RpcClient]
 
@@ -1714,6 +1740,9 @@ class CoreWorker:
         state.creation_spec = spec
         state.owned = True
         self._actors[actor_id] = state
+        # subscribe BEFORE registering: the owner must see every
+        # lifecycle transition (it drives restarts off RESTARTING)
+        self.io.run(self._ensure_actor_sub(actor_id))
         self.io.run(self.gcs.call("register_actor", {
             "actor_id": actor_id,
             "name": spec.actor_name,
@@ -1778,6 +1807,8 @@ class CoreWorker:
             lane = self._actor_lanes.pop(info.actor_id, None)
             if lane is not None:
                 lane.close()
+        if info.state == "DEAD":
+            self._drop_actor_sub(info.actor_id)
         if info.state in ("ALIVE", "DEAD"):
             state.restart_in_flight = False
             for fut in state.waiters:
@@ -1794,7 +1825,41 @@ class CoreWorker:
             spec.task_id = TaskID.for_actor_task(info.actor_id)
             self.io.spawn(self._submit_actor_creation(spec, []))
 
+    async def _ensure_actor_sub(self, actor_id: ActorID) -> None:
+        """Per-actor keyed subscription (gcs.py _publish_actor).
+        Concurrent callers share one in-flight subscribe task, so a
+        failure is seen by ALL of them (a flag-only guard would let the
+        second caller proceed unsubscribed and stall out its alive-wait
+        when the first caller's RPC failed)."""
+        channel = "actor:" + actor_id.hex()
+        if channel in self._subscribed_channels:
+            return
+        task = self._actor_sub_tasks.get(channel)
+        if task is None:
+            async def _sub():
+                await self.gcs.call("subscribe", {"channels": [channel]})
+                self._subscribed_channels.add(channel)
+
+            task = self._actor_sub_tasks[channel] = \
+                asyncio.ensure_future(_sub())
+            task.add_done_callback(
+                lambda _: self._actor_sub_tasks.pop(channel, None))
+        await asyncio.shield(task)
+
+    def _drop_actor_sub(self, actor_id: ActorID) -> None:
+        """DEAD is terminal: release the keyed subscription on both
+        sides (the GCS pops its index when it PUBLISHES the death, but a
+        borrower that subscribed after that publish re-created it)."""
+        channel = "actor:" + actor_id.hex()
+        if channel in self._subscribed_channels:
+            self._subscribed_channels.discard(channel)
+            self.io.spawn(self.gcs.call(
+                "unsubscribe", {"channels": [channel]}))
+
     async def _wait_actor_alive(self, actor_id: ActorID, timeout: float = 120.0) -> _ActorState:
+        # subscribe-then-read: the authoritative get_actor below runs
+        # AFTER the subscription is live, so no transition is missed
+        await self._ensure_actor_sub(actor_id)
         state = self._actors.get(actor_id)
         if state is None:
             info = await self.gcs.call("get_actor", {"actor_id": actor_id})
@@ -1804,6 +1869,9 @@ class CoreWorker:
                 state.death_cause = info.death_cause
         while state.state != "ALIVE":
             if state.state == "DEAD":
+                # covers the borrow-after-death path, where no DEAD
+                # update will ever arrive to trigger the drop
+                self._drop_actor_sub(actor_id)
                 raise exc.ActorDiedError(actor_id, state.death_cause)
             fut = asyncio.get_event_loop().create_future()
             state.waiters.append(fut)
@@ -1856,6 +1924,13 @@ class CoreWorker:
         lane = self._actor_lanes.get(spec.actor_id)
         if lane is None:
             if deps or spec.actor_id in self._actor_lane_blocked:
+                return False
+            if len(self._actor_lanes) >= self.cfg.actor_lane_max:
+                # each lane costs two shm rings + a flusher/reply thread
+                # pair; at envelope actor counts (1k+) that is thousands
+                # of threads — beyond the cap, calls stay on the asyncio
+                # path (the lane is a hot-actor latency optimization,
+                # not a correctness feature)
                 return False
             from .fastlane import ActorLane
 

@@ -270,6 +270,35 @@ class GcsServer:
             self._subs.setdefault(channel, set()).add(conn)
         return True
 
+    async def handle_unsubscribe(self, payload, conn):
+        for channel in payload["channels"]:
+            conns = self._subs.get(channel)
+            if conns is not None:
+                conns.discard(conn)
+                if not conns:
+                    self._subs.pop(channel, None)
+        return True
+
+    async def _publish_actor(self, actor):
+        """Actor updates go to per-actor subscribers (``actor:<hex>``)
+        plus any blanket ``actor`` subscribers (dashboard, state API).
+        Blanket delivery to every core worker would be O(actors x
+        workers) pushes through this one loop at envelope depth (1k+
+        actors); the reference pubsub indexes subscriptions per entity
+        key for the same reason (ref: src/ray/pubsub/publisher.h
+        SubscriptionIndex)."""
+        payload = {"actor": actor}
+        blanket = self._subs.get("actor", set())
+        for conn in list(blanket):
+            await conn.push("pubsub:actor", payload)
+        key = "actor:" + actor.actor_id.hex()
+        for conn in list(self._subs.get(key, ())):
+            if conn not in blanket:
+                await conn.push("pubsub:actor", payload)
+        if actor.state == DEAD:
+            # terminal: nobody will see another update on this key
+            self._subs.pop(key, None)
+
     async def handle_publish(self, payload, conn):
         """Application-level pubsub fan-out (the reference's long-poll
         broadcast role, ref: python/ray/serve/_private/long_poll.py:66
@@ -335,7 +364,7 @@ class GcsServer:
                 actor.state = DEAD
                 actor.death_cause = "creating driver exited"
                 self._persist("actors", actor.actor_id.hex(), actor)
-                await self._publish("actor", {"actor": actor})
+                await self._publish_actor(actor)
                 if address:
                     asyncio.ensure_future(self._kill_actor_process(address))
 
@@ -467,7 +496,7 @@ class GcsServer:
             self.named_actors[key] = info.actor_id
         self.actors[info.actor_id] = info
         self._persist("actors", info.actor_id.hex(), info)
-        await self._publish("actor", {"actor": info})
+        await self._publish_actor(info)
         self._event("ACTOR", "INFO", "actor registered",
                     actor_id=info.actor_id.hex(),
                     class_name=info.class_name, name=info.name)
@@ -487,7 +516,7 @@ class GcsServer:
         actor.address = payload["address"]
         actor.node_id = payload.get("node_id")
         self._persist("actors", actor.actor_id.hex(), actor)
-        await self._publish("actor", {"actor": actor})
+        await self._publish_actor(actor)
         return True
 
     async def handle_actor_failed(self, payload, conn):
@@ -513,7 +542,7 @@ class GcsServer:
             actor.state = RESTARTING
             actor.address = ""
             self._persist("actors", actor.actor_id.hex(), actor)
-            await self._publish("actor", {"actor": actor})
+            await self._publish_actor(actor)
             self._event("ACTOR", "WARNING",
                         f"actor restarting ({actor.num_restarts}/"
                         f"{actor.max_restarts}): {cause}",
@@ -526,7 +555,7 @@ class GcsServer:
             actor.death_cause = cause
             actor.address = ""
             self._persist("actors", actor.actor_id.hex(), actor)
-            await self._publish("actor", {"actor": actor})
+            await self._publish_actor(actor)
             self._event("ACTOR", "ERROR", f"actor died: {cause}",
                         actor_id=actor.actor_id.hex(),
                         class_name=actor.class_name)
@@ -540,7 +569,7 @@ class GcsServer:
             actor.state = DEAD
             actor.death_cause = payload.get("cause", "ray_tpu.kill")
             self._persist("actors", actor.actor_id.hex(), actor)
-            await self._publish("actor", {"actor": actor})
+            await self._publish_actor(actor)
         return True
 
     async def handle_get_actor(self, payload, conn):

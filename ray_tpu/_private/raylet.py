@@ -26,6 +26,7 @@ Design deltas from the reference, driven by the TPU runtime model:
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import subprocess
 import sys
@@ -36,7 +37,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from .config import global_config, session_log_dir
 from .ids import ActorID, NodeID, ObjectID, WorkerID
 from .object_store import SharedObjectStore
-from .rpc import RpcClient, RpcServer, ServerConnection
+from .rpc import ConnectionLost, RpcClient, RpcServer, ServerConnection
 from .task_spec import (
     DefaultSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
@@ -235,6 +236,12 @@ class Raylet:
         self._spill_rr = 0
         self._resource_seq = 0
         self._subprocs: List[subprocess.Popen] = []
+        # forkserver worker factory (see _spawn_via_factory)
+        self._factory_proc: Optional[subprocess.Popen] = None
+        self._factory_reader = None
+        self._factory_writer = None
+        self._factory_lock = asyncio.Lock()
+        self._factory_pids: List[int] = []
         # (pg_id, bundle_idx) -> bundle-local resource accounting: reserved
         # total + what's still leasable within it (ref:
         # placement_group_resource_manager.h bundle resource bookkeeping)
@@ -371,11 +378,13 @@ class Raylet:
         await self.gcs.close()
         for client in self._peer_clients.values():
             await client.close()
+        await self._factory_teardown()
         for proc in self._subprocs:
             try:
                 proc.terminate()
             except Exception:
                 pass
+        self._signal_factory_workers(15)
         deadline = time.monotonic() + 3
         for proc in self._subprocs:
             try:
@@ -385,6 +394,8 @@ class Raylet:
                     proc.kill()
                 except Exception:
                     pass
+        self._await_factory_workers(deadline)
+        self._signal_factory_workers(9)
 
     async def die(self):
         """Abrupt node death for fault-injection tests (the cluster_utils
@@ -393,6 +404,12 @@ class Raylet:
         for proc in self._subprocs:
             try:
                 proc.kill()
+            except Exception:
+                pass
+        self._signal_factory_workers(9)
+        if self._factory_proc is not None:
+            try:
+                self._factory_proc.kill()
             except Exception:
                 pass
         # drop the GCS connection first — that's the death signal the GCS
@@ -450,6 +467,13 @@ class Raylet:
     # ---------------------------------------------------------- worker pool
     def _spawn_worker(self) -> None:
         self._starting += 1
+        env, log_path = self._worker_env()
+        if self.cfg.worker_factory_enabled:
+            asyncio.ensure_future(self._spawn_via_factory(env, log_path))
+        else:
+            self._popen_worker(env, log_path)
+
+    def _worker_env(self) -> tuple:
         env = dict(os.environ)
         # propagate the driver's import surface so by-reference pickles resolve
         # (the minimal working_dir runtime-env; ref: _private/runtime_env/working_dir.py)
@@ -482,6 +506,9 @@ class Raylet:
         self._worker_seq += 1
         log_path = os.path.join(
             log_dir, f"worker-{self.node_id.hex()[:8]}-{self._worker_seq}.log")
+        return env, log_path
+
+    def _popen_worker(self, env: dict, log_path: str) -> None:
         log_file = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
@@ -492,6 +519,130 @@ class Raylet:
         )
         log_file.close()  # the child holds its own fd
         self._subprocs.append(proc)
+
+    # ---------------------------------------------- worker factory (fork)
+    # A cold worker pays ~0.7 s of interpreter+import startup; the factory
+    # (worker_factory.py) imports once and forks per worker, which is what
+    # makes envelope-depth actor counts (1k+ live actors on one host)
+    # reachable (ref: worker_pool.h prestart amortization).
+    async def _spawn_via_factory(self, env: dict, log_path: str) -> None:
+        try:
+            pid = await self._factory_request(
+                {"cmd": "spawn", "log_path": log_path, "env": env})
+            self._factory_pids.append(pid)
+        except Exception as e:
+            # factory unavailable (failed to start, died mid-request):
+            # cold-start this worker and let the next spawn retry the
+            # factory from scratch
+            print(f"[raylet] worker factory spawn failed "
+                  f"({type(e).__name__}: {e}); falling back to cold start",
+                  file=sys.stderr)
+            await self._factory_teardown()
+            try:
+                self._popen_worker(env, log_path)
+            except Exception:
+                self._starting = max(0, self._starting - 1)
+
+    async def _factory_request(self, req: dict) -> int:
+        async with self._factory_lock:
+            if self._factory_writer is None:
+                await self._factory_start_locked()
+            writer = self._factory_writer
+            reader = self._factory_reader
+            writer.write(json.dumps(req).encode() + b"\n")
+            await writer.drain()
+            line = await asyncio.wait_for(
+                reader.readline(), self.cfg.worker_startup_timeout_s)
+        if not line:
+            raise ConnectionLost("worker factory closed its socket")
+        reply = json.loads(line)
+        if "error" in reply:
+            raise RuntimeError(f"worker factory: {reply['error']}")
+        pid = reply.get("pid")
+        if not isinstance(pid, int) or pid <= 0:
+            # never let a malformed reply become pid 0/-1 — os.kill(0)
+            # signals this whole process group at shutdown
+            raise RuntimeError(f"worker factory: bad spawn reply {reply!r}")
+        return pid
+
+    async def _factory_start_locked(self) -> None:
+        sock_path = os.path.join(
+            session_log_dir(self.session_name),
+            f"factory-{self.node_id.hex()[:8]}.sock")
+        os.makedirs(os.path.dirname(sock_path), exist_ok=True)
+        env, _ = self._worker_env()
+        env["RAY_TPU_FACTORY_SOCKET"] = sock_path
+        log_path = os.path.join(session_log_dir(self.session_name),
+                                f"factory-{self.node_id.hex()[:8]}.log")
+        log_file = open(log_path, "ab")
+        self._factory_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_factory"],
+            env=env, stdout=log_file, stderr=log_file)
+        log_file.close()
+        # the factory binds its socket only after the worker stack is
+        # imported, so connect-success == ready
+        deadline = time.monotonic() + self.cfg.worker_startup_timeout_s
+        while True:
+            try:
+                reader, writer = await asyncio.open_unix_connection(sock_path)
+                break
+            except (FileNotFoundError, ConnectionRefusedError, OSError):
+                if (time.monotonic() > deadline
+                        or self._factory_proc.poll() is not None):
+                    proc, self._factory_proc = self._factory_proc, None
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+                    raise TimeoutError("worker factory did not come up")
+                await asyncio.sleep(0.05)
+        self._factory_reader, self._factory_writer = reader, writer
+
+    async def _factory_teardown(self) -> None:
+        async with self._factory_lock:
+            if self._factory_writer is not None:
+                try:
+                    self._factory_writer.write(b'{"cmd": "exit"}\n')
+                    await self._factory_writer.drain()
+                    self._factory_writer.close()
+                except Exception:
+                    pass
+                self._factory_reader = self._factory_writer = None
+            if self._factory_proc is not None:
+                proc, self._factory_proc = self._factory_proc, None
+                try:
+                    proc.terminate()
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, lambda: proc.wait(timeout=3))
+                except Exception:
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+
+    def _signal_factory_workers(self, sig: int) -> None:
+        for pid in list(self._factory_pids):
+            try:
+                os.kill(pid, sig)
+            except ProcessLookupError:
+                self._factory_pids.remove(pid)
+            except PermissionError:
+                pass
+
+    def _await_factory_workers(self, deadline: float) -> None:
+        """Give SIGTERM'd factory workers the same grace window Popen
+        workers get before the SIGKILL pass (they are the factory's
+        children, not ours — no waitpid, poll liveness instead)."""
+        while self._factory_pids and time.monotonic() < deadline:
+            for pid in list(self._factory_pids):
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    self._factory_pids.remove(pid)
+                except PermissionError:
+                    pass
+            if self._factory_pids:
+                time.sleep(0.05)
 
     async def handle_register_worker(self, payload, conn):
         worker = WorkerHandle(
@@ -569,6 +720,12 @@ class Raylet:
         if worker is None:
             return
         worker.alive = False
+        # a gone worker's pid may be recycled by the kernel — never keep
+        # it on the factory kill list
+        try:
+            self._factory_pids.remove(worker.pid)
+        except ValueError:
+            pass
         if worker in self._idle:
             self._idle.remove(worker)
         if worker.lease is not None:
@@ -611,11 +768,27 @@ class Raylet:
             await self._report_resources()
         await self._pump_pending()
 
-    async def _pop_worker(self) -> Optional[WorkerHandle]:
+    async def _pop_worker(self, dedicated: bool = False) -> Optional[WorkerHandle]:
         while self._idle:
             worker = self._idle.pop()
             if worker.alive:
                 return worker
+        if dedicated:
+            # an actor pins its worker for life, so the pool soft limit
+            # must not gate it — the limit sizes the REUSABLE pool, and a
+            # pinned worker never returns to it (ref: worker_pool.h —
+            # dedicated workers bypass the soft cap). Spawns are bounded
+            # by actual dedicated demand (this request + queued actor
+            # leases) and burst-throttled so 1k queued creations don't
+            # fork-storm — without the demand bound, every pump pass
+            # during one worker's startup window would fork another.
+            demand = 1 + sum(
+                1 for p in self._pending_leases
+                if p.payload.get("actor_id") is not None
+                and not p.future.done())
+            if self._starting < min(self.cfg.worker_spawn_burst, demand):
+                self._spawn_worker()
+            return None
         # dep-blocked workers released their CPU but still sit in the
         # pool: they must not count against the cap, or the freed CPU is
         # ungrantable (no worker to run on) and dependency chains starve
@@ -730,7 +903,8 @@ class Raylet:
                 return None
         elif not self.resources.try_allocate(resources):
             return None
-        worker = await self._pop_worker()
+        worker = await self._pop_worker(
+            dedicated=payload.get("actor_id") is not None)
         if worker is None:
             if alloc_key is not None:
                 self._pg_bundles[alloc_key].release(resources)

@@ -255,6 +255,9 @@ class RpcClient:
         # client (ref: gcs_redis_failure_detector.h + the reference's
         # client-side resubscribe on GCS restart)
         self.on_reconnect: list = []
+        # sync callback fired when the transport drops (recv loop exit),
+        # clean or abrupt — a worker uses this to die with its raylet
+        self.on_close: Optional[Callable[[], None]] = None
 
     def on_push(self, method: str, handler: Callable[[Any], Any]) -> None:
         self._push_handlers[method] = handler
@@ -307,6 +310,11 @@ class RpcClient:
             pass
         finally:
             self.closed = True
+            if self.on_close is not None:
+                try:
+                    self.on_close()
+                except Exception:
+                    pass
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionLost(self.socket_path))

@@ -759,6 +759,12 @@ async def _amain():
 
     # register with raylet last — once registered, tasks may arrive
     raylet.on_push("shutdown", lambda payload: shutdown_event.set())
+    # die with the raylet: an abrupt raylet death (SIGKILL, node crash)
+    # sends no shutdown push, and an orphaned worker would outlive the
+    # whole cluster (ref: core_worker shuts down when the local raylet
+    # connection breaks). call_soon_threadsafe not needed — the recv
+    # loop runs on this same loop.
+    raylet.on_close = shutdown_event.set
     await raylet.call("register_worker", {
         "worker_id": worker_id,
         "pid": os.getpid(),

@@ -1,0 +1,165 @@
+"""Scalability-envelope regression tests (scaled-down bench_envelope.py
+families; ref: release/benchmarks/README.md:9-31 + the distributed
+many_nodes/many_actors release suites).
+
+Depths here are sized for suite time; the full depths (100k queued, 1k
+actors, 1M native leases, 10 GiB objects) run in bench_envelope.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as ray
+
+
+def test_actor_creations_beyond_lease_request_cap(ray_start_regular):
+    """More queued creations of ONE scheduling class than
+    max_pending_lease_requests_per_scheduling_class (10): regression for
+    the freed request slot never waking queued submissions (actor
+    creation leases are pinned for life and skip _release_lease)."""
+
+    @ray.remote(num_cpus=0)
+    class Cell:
+        def ping(self):
+            return 1
+
+    actors = [Cell.remote() for _ in range(24)]
+    out = ray.get([a.ping.remote() for a in actors], timeout=120)
+    assert out == [1] * 24
+    for a in actors:
+        ray.kill(a)
+
+
+def test_actor_count_beyond_worker_pool_cap(ray_start_regular):
+    """Zero-CPU actors must not be capped by the worker-pool soft limit
+    (num_cpus=4 here): dedicated (actor) leases spawn beyond it."""
+
+    @ray.remote(num_cpus=0)
+    class Cell:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    n = 16
+    actors = [Cell.remote(i) for i in range(n)]
+    assert ray.get([a.who.remote() for a in actors], timeout=120) == list(range(n))
+    for a in actors:
+        ray.kill(a)
+
+
+def test_actor_lane_cap_falls_back_to_asyncio():
+    """Actors beyond actor_lane_max get no fast lane; calls still work."""
+    import os
+    os.environ["RAY_TPU_ACTOR_LANE_MAX"] = "2"
+    from ray_tpu._private.config import reset_global_config
+    reset_global_config()
+    ray.init(num_cpus=2)
+    try:
+        @ray.remote(num_cpus=0)
+        class Cell:
+            def ping(self):
+                return "pong"
+
+        actors = [Cell.remote() for _ in range(5)]
+        assert ray.get([a.ping.remote() for a in actors],
+                       timeout=60) == ["pong"] * 5
+    finally:
+        ray.shutdown()
+        os.environ.pop("RAY_TPU_ACTOR_LANE_MAX", None)
+        reset_global_config()
+
+
+def test_inflight_calls_at_depth(ray_start_regular):
+    """Hundreds of simultaneously in-flight async-actor calls."""
+
+    @ray.remote(num_cpus=0)
+    class Sleeper:
+        async def snooze(self, sec):
+            import asyncio
+            await asyncio.sleep(sec)
+            return True
+
+    actors = [Sleeper.options(max_concurrency=200).remote()
+              for _ in range(2)]
+    ray.get([a.snooze.remote(0) for a in actors])
+    t0 = time.perf_counter()
+    refs = [actors[i % 2].snooze.remote(3.0) for i in range(300)]
+    submit_s = time.perf_counter() - t0
+    assert submit_s < 3.0, "submission must finish while all are in flight"
+    assert ray.get(refs, timeout=60) == [True] * 300
+
+
+def test_queued_task_backlog_drains(ray_start_regular):
+    """A few thousand queued trivial tasks submit and drain cleanly."""
+
+    @ray.remote
+    def nop(i):
+        return i
+
+    n = 2000
+    refs = [nop.remote(i) for i in range(n)]
+    out = ray.get(refs, timeout=180)
+    assert out == list(range(n))
+
+
+def test_native_sched_queue_depth():
+    """The native lease queue holds and drains 100k queued leases."""
+    import ctypes
+
+    from ray_tpu._native import get_lib, native_unavailable_reason
+
+    if native_unavailable_reason():
+        pytest.skip(native_unavailable_reason())
+    lib = get_lib()
+    n = 100_000
+    h = lib.rtpu_sched_open(1)
+    ids = (ctypes.c_uint32 * 1)(0)
+    amts = (ctypes.c_double * 1)(1.0)
+    caps = (ctypes.c_double * 1)(float(n))
+    lib.rtpu_sched_node_upsert(h, 1, ids, caps, caps, 1)
+    for req in range(1, n + 1):
+        lib.rtpu_sched_queue_push(h, req, ids, amts, 1, 0, 0)
+    assert lib.rtpu_sched_pending(h) == n
+    batch = 4096
+    out_req = (ctypes.c_uint64 * batch)()
+    out_node = (ctypes.c_uint64 * batch)()
+    granted = 0
+    while True:
+        got = lib.rtpu_sched_pump(h, out_req, out_node, batch)
+        if not got:
+            break
+        granted += got
+    lib.rtpu_sched_close(h)
+    assert granted == n
+
+
+def test_large_object_single_pass_put(ray_start_regular):
+    """Multi-hundred-MiB numpy put serializes straight into shm (one
+    write pass) and round-trips zero-copy."""
+    import numpy as np
+
+    data = np.arange(64 << 20, dtype=np.uint8)  # 64 MiB
+    ref = ray.put(data)
+    out = ray.get(ref)
+    assert out.nbytes == data.nbytes
+    assert out[0] == 0 and int(out[-1]) == int(data[-1])
+
+
+def test_worker_factory_spawns_workers(ray_start_regular):
+    """With the factory enabled (default), pool workers fork from the
+    factory rather than cold-starting."""
+    from ray_tpu import _worker_api
+
+    @ray.remote
+    def pid():
+        import os
+        return os.getpid()
+
+    pids = set(ray.get([pid.remote() for _ in range(4)]))
+    raylet = _worker_api._node.raylet
+    assert raylet._factory_proc is not None
+    assert set(raylet._factory_pids) & pids, \
+        "at least one executing worker should be factory-forked"
