@@ -56,6 +56,10 @@ class Config:
     memory_monitor_test_file: str = ""        # tests: file with a fraction
     max_grpc_message_bytes: int = 512 * 1024**2
     object_transfer_chunk_bytes: int = 8 * 1024**2
+    # bulk transfer plane (object_transfer.py): parallel raw-frame
+    # connections per pull, and the PullManager's bytes-in-flight budget
+    object_transfer_streams: int = 4
+    object_transfer_max_inflight_bytes: int = 512 * 1024**2
     # --- fast lane (native shm task plane; ray_tpu/_private/fastlane.py) ---
     fastlane_width: int = 4                   # max lanes (leased workers)
     fastlane_window: int = 32                 # in-flight tasks per lane

@@ -301,14 +301,21 @@ class CoreWorker:
         self.address = self._owner_server.address
 
     async def _handle_fetch_object(self, payload, conn):
-        """Serve one owned object: {"status": ok|pending|gone, "data"}.
+        """Serve one owned object: {"status": ok|in_plasma|pending|gone}.
         pending = the creating task is still in flight here, the
-        borrower should retry."""
+        borrower should retry. in_plasma = the object is sealed in this
+        node's store and too large to pickle through the control RPC —
+        the borrower pulls it through its raylet (the bulk transfer
+        plane), landing it sealed in ITS node store where every local
+        worker shares it."""
         oid = payload["object_id"]
         data = self.memory_store.get(oid)
         if data is None:
             view = self.store.get(oid)
             if view is not None:
+                if len(view) > self.cfg.object_store_small_object_threshold:
+                    return {"status": "in_plasma", "size": len(view),
+                            "data": None}
                 data = bytes(view)
         if data is not None:
             return {"status": "ok", "data": data}
@@ -676,6 +683,8 @@ class CoreWorker:
         if reply["status"] == "ok":
             self.memory_store.put(oid, reply["data"])
             return "ok"
+        if reply["status"] == "in_plasma":
+            return "in_plasma"  # caller routes through the raylet pull
         return "pending"
 
     async def _owner_gone_policy(self, oid: ObjectID,
@@ -701,7 +710,7 @@ class CoreWorker:
         """Pull one object from its owner into the local memory store
         (small objects never seal into plasma — the owner serves them).
         Retries while the owner reports the creating task pending.
-        Returns "ok" | "gone" | "unreachable" | "timeout"."""
+        Returns "ok" | "in_plasma" | "gone" | "unreachable" | "timeout"."""
         delay = 0.005
         while True:
             status = await self._probe_owner(owner, oid)
@@ -745,6 +754,11 @@ class CoreWorker:
                                                           deadline)
                     if status == "ok":
                         progressed = True
+                        continue
+                    if status == "in_plasma":
+                        # sealed + large at the owner's node: pull it
+                        # through the raylet (bulk transfer plane)
+                        plasma_wait.append(oid)
                         continue
                     if status in ("gone", "unreachable"):
                         verdict = await self._owner_gone_policy(
@@ -921,6 +935,8 @@ class CoreWorker:
                                                  rpc_timeout=rpc_t)
                 if status == "ok":
                     progressed = True
+                elif status == "in_plasma":
+                    remote.append(oid)  # directory wait pulls it locally
                 elif status in ("gone", "unreachable"):
                     # lost counts as ready; get() raises there
                     verdict = await self._owner_gone_policy(

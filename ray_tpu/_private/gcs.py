@@ -53,6 +53,10 @@ class NodeInfo:
     # resource shapes of leases queued on this raylet (the autoscaler's
     # demand signal; ref: autoscaler v2 cluster-status resource demands)
     pending_demands: list = field(default_factory=list)
+    # bulk object-transfer listener (object_transfer.py); "" = peer
+    # predates the transfer plane, pulls fall back to control-RPC chunks
+    # (wire schema rule: appended field, decode fills the default)
+    transfer_address: str = ""
 
 
 @dataclass
@@ -923,14 +927,16 @@ class GcsServer:
                 for oid, nodes in self.object_locations.items()}
 
     async def handle_get_object_locations(self, payload, conn):
-        """oid -> [(node_id, raylet_address)] for live holders."""
+        """oid -> [(node_id, raylet_address, transfer_address)] for live
+        holders."""
         out = {}
         for oid in payload["object_ids"]:
             holders = []
             for node_id in self.object_locations.get(oid, ()):
                 info = self.nodes.get(node_id)
                 if info is not None and info.alive:
-                    holders.append((node_id, info.address))
+                    holders.append((node_id, info.address,
+                                    info.transfer_address))
             out[oid] = holders
         return out
 

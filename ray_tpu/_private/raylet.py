@@ -187,9 +187,17 @@ class Raylet:
         self.server.on_disconnect = self._on_disconnect
         # constructed in start() from the (possibly port-resolved) gcs_address
         self.gcs: RpcClient = None  # type: ignore[assignment]
+        self.transfer = None
 
         cfg = global_config()
         self.cfg = cfg
+        # bulk transfer plane: listener constructed in start() (needs the
+        # resolved server address); the PullManager lives from birth so a
+        # wait_objects arriving in the start() window can't hit None
+        from .object_transfer import PullManager
+
+        self.pulls = PullManager(
+            cfg.object_transfer_max_inflight_bytes, self._pull)
         # worker pool
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: List[WorkerHandle] = []
@@ -226,7 +234,6 @@ class Raylet:
         self._lost_objects: Set[ObjectID] = set()
         # inter-node object transfer (ref: object_manager/pull_manager.h:57,
         # push_manager.h:32 — chunked transfer over the control transport)
-        self._pulls_in_flight: Dict[ObjectID, asyncio.Task] = {}
         self._peer_clients: Dict[str, RpcClient] = {}
         # cluster view (for spillback) — node_id -> (address, available)
         self._remote_nodes: Dict[NodeID, Tuple[str, ResourceSet]] = {}
@@ -257,6 +264,21 @@ class Raylet:
     async def start(self):
         await self.server.start()
         self.socket_path = self.server.address  # resolved (TCP port 0)
+        # bulk transfer plane: its own listener so gigabyte chunk streams
+        # never head-of-line-block control RPCs (object_transfer.py)
+        from .object_transfer import TransferServer, _parse_addr
+
+        kind = _parse_addr(self.server.address)
+        if kind[0] == "unix":
+            self.transfer = TransferServer(
+                self.store, self.server.address + ".xfer")
+        else:
+            # bind-all, advertise the node's routable IP — same split the
+            # control server uses (NAT/container hosts can't bind the
+            # address they advertise)
+            self.transfer = TransferServer(
+                self.store, "0.0.0.0:0", advertise_host=kind[1])
+        await self.transfer.start()
         self.gcs = RpcClient(self.gcs_address)
         await self.gcs.connect()
         self.gcs.on_push("pubsub:resources", self._on_remote_resources)
@@ -271,6 +293,7 @@ class Raylet:
             "slice_name": self.labels.get("slice_name", ""),
             "host_index": int(self.labels.get("host_index", 0)),
             "store_dir": self.store.dir,
+            "transfer_address": self.transfer.address,
         })
         self._node_labels[self.node_id] = dict(self.labels)
         for info in reply["nodes"]:
@@ -301,6 +324,7 @@ class Raylet:
                 "slice_name": self.labels.get("slice_name", ""),
                 "host_index": int(self.labels.get("host_index", 0)),
                 "store_dir": self.store.dir,
+                "transfer_address": self.transfer.address,
             })
             await self.gcs.call(
                 "subscribe",
@@ -375,6 +399,8 @@ class Raylet:
             if worker.conn is not None:
                 await worker.conn.push("shutdown", {})
         await self.server.stop()
+        if self.transfer is not None:
+            await self.transfer.stop()
         await self.gcs.close()
         for client in self._peer_clients.values():
             await client.close()
@@ -416,6 +442,8 @@ class Raylet:
         # health path turns into node-dead + object-lost events
         await self.gcs.close()
         await self.server.stop()
+        if self.transfer is not None:
+            await self.transfer.stop()
         for client in self._peer_clients.values():
             await client.close()
 
@@ -1325,46 +1353,75 @@ class Raylet:
             self._peer_clients[address] = client
         return client
 
-    def _start_pull(self, oid: ObjectID) -> None:
-        """Idempotently kick off a background pull of oid to the local store
-        (ref: pull_manager.h:57 — retries while there are active waiters)."""
-        task = self._pulls_in_flight.get(oid)
-        if task is not None and not task.done():
-            return
-        self._pulls_in_flight[oid] = asyncio.ensure_future(self._pull(oid))
+    def _start_pull(self, oid: ObjectID, prio: int = 1) -> None:
+        """Idempotently request a pull of oid to the local store through
+        the admission-controlled PullManager (ref: pull_manager.h:57 —
+        byte budget + priority classes; retries while waiters exist)."""
+        self.pulls.request(oid, prio, size_hint=self._sealed.get(oid, 0))
 
-    async def _pull(self, oid: ObjectID) -> None:
-        try:
-            backoff = 0.02
-            while True:
-                if self.store.contains(oid) or oid in self._lost_objects:
-                    return
-                if oid not in self._object_waiters:
-                    return  # nobody waiting anymore
+    async def _pull(self, oid: ObjectID) -> Optional[int]:
+        backoff = 0.02
+        while True:
+            if self.store.contains(oid) or oid in self._lost_objects:
+                return self._sealed.get(oid, 0)
+            if oid not in self._object_waiters:
+                return None  # nobody waiting anymore
+            try:
+                locs = await self.gcs.call(
+                    "get_object_locations", {"object_ids": [oid]})
+            except Exception:
+                locs = {oid: []}
+            for loc in locs.get(oid, []):
+                node_id, address = loc[0], loc[1]
+                xfer_address = loc[2] if len(loc) > 2 else ""
+                if node_id == self.node_id:
+                    continue
                 try:
-                    locs = await self.gcs.call(
-                        "get_object_locations", {"object_ids": [oid]})
+                    size = await self._fetch_via(oid, address, xfer_address)
+                    if size is not None:
+                        self._sealed[oid] = size
+                        self._mark_local_sealed(oid, size)
+                        asyncio.ensure_future(self._report_location(oid))
+                        return size
+                    # holder no longer has it: drop the stale location
+                    await self.gcs.call("remove_object_location", {
+                        "object_id": oid, "node_id": node_id})
                 except Exception:
-                    locs = {oid: []}
-                for node_id, address in locs.get(oid, []):
-                    if node_id == self.node_id:
-                        continue
-                    try:
-                        if await self._fetch_from(oid, address):
-                            self._mark_local_sealed(oid, self._sealed.get(oid, 0))
-                            asyncio.ensure_future(self._report_location(oid))
-                            return
-                        # holder no longer has it: drop the stale location
-                        await self.gcs.call("remove_object_location", {
-                            "object_id": oid, "node_id": node_id})
-                    except Exception:
-                        continue
-                await asyncio.sleep(backoff)
-                # cap grows to 2s: pending-local objects (task still running
-                # here) shouldn't hammer the GCS with location polls
-                backoff = min(2.0, backoff * 2)
-        finally:
-            self._pulls_in_flight.pop(oid, None)
+                    continue
+            await asyncio.sleep(backoff)
+            # cap grows to 2s: pending-local objects (task still running
+            # here) shouldn't hammer the GCS with location polls
+            backoff = min(2.0, backoff * 2)
+
+    async def _fetch_via(self, oid: ObjectID, address: str,
+                         xfer_address: str) -> Optional[int]:
+        """Pull one object from one holder: parallel raw-frame streams on
+        the transfer plane when the holder advertises one, control-RPC
+        chunks otherwise. A transfer-plane transport failure retries once
+        through the RPC path before the holder is given up on — a dropped
+        stream must not fail the pull while the holder is still alive
+        (chaos: tests/test_chaos.py transfer-drop)."""
+        if xfer_address:
+            from .object_transfer import fetch_object
+
+            if self.store.contains(oid):
+                return self._sealed.get(oid, 0)
+            try:
+                return await fetch_object(
+                    xfer_address, oid,
+                    lambda size: self.store.create(oid, size),
+                    streams=self.cfg.object_transfer_streams,
+                    chunk_bytes=self.cfg.object_transfer_chunk_bytes,
+                    seal=lambda: self.store.seal(oid),
+                    abort=lambda: self.store.abort(oid),
+                    admit_bytes=lambda n: self.pulls.acquire_bytes(oid, n))
+            except Exception:
+                pass  # plane unreachable/dropped: fall through to RPC
+            finally:
+                self.pulls.release_bytes(oid)
+        if await self._fetch_from(oid, address):
+            return self._sealed.get(oid, 0)
+        return None
 
     async def _fetch_from(self, oid: ObjectID, address: str) -> bool:
         """Chunked fetch of a sealed object from a peer raylet into the local
@@ -1437,12 +1494,13 @@ class Raylet:
         if len(ready) >= num_returns or len(ready) + len(lost) >= len(oids):
             return {"ready": ready, "lost": lost}
         futures = {}
+        prio = payload.get("prio", 1)  # 0 = a worker is blocked on args
         for oid in oids:
             if oid not in self._sealed and oid not in self._lost_objects:
                 fut = asyncio.get_event_loop().create_future()
                 self._object_waiters.setdefault(oid, []).append(fut)
                 futures[oid] = fut
-                self._start_pull(oid)
+                self._start_pull(oid, prio)
         deadline = None if timeout is None else asyncio.get_event_loop().time() + timeout
         while len(ready) < num_returns and len(ready) + len(lost) < len(oids):
             remaining = None if deadline is None else max(0.0, deadline - asyncio.get_event_loop().time())

@@ -224,6 +224,7 @@ class TaskExecutor:
                     "object_ids": plasma_wait,
                     "num_returns": len(plasma_wait),
                     "timeout": None,
+                    "prio": 0,  # this worker is blocked on its task args
                 }))
         finally:
             if missing:
