@@ -20,12 +20,11 @@ import asyncio
 import json
 import os
 import pickle
-
-from . import wire
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from .gcs_storage import RemoteStoreClient, Storage
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
 from .rpc import RpcServer, ServerConnection
 
@@ -77,89 +76,27 @@ class ActorInfo:
     creation_spec: Any = None         # pickled TaskSpec for restarts
 
 
-class Storage:
-    """In-memory KV with optional append-only journal for GCS restart
-    (the redis_store_client.h analog, file-backed)."""
-
-    def __init__(self, journal_path: Optional[str] = None):
-        self._kv: Dict[str, Dict[str, bytes]] = {}
-        self._journal_path = journal_path
-        self._journal = None
-        if journal_path:
-            self._replay(journal_path)
-            # compact on startup: the journal is append-only (every actor
-            # state transition appends a full record), so a restart rewrites
-            # it as a snapshot of live state — replay time and disk stay
-            # bounded by state size, not cluster age
-            self._compact(journal_path)
-            self._journal = open(journal_path, "ab")
-
-    def _compact(self, path: str) -> None:
-        # every record is rewritten at the CURRENT wire version here —
-        # this is how a journal written by an older build migrates
-        tmp = path + ".compact"
-        with open(tmp, "wb") as f:
-            for ns, table in self._kv.items():
-                for key, val in table.items():
-                    body = wire.journal_encode("put", ns, key, val)
-                    f.write(len(body).to_bytes(4, "little") + body)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-
-    def _replay(self, path: str) -> None:
-        if not os.path.exists(path):
-            return
-        with open(path, "rb") as f:
-            while True:
-                header = f.read(4)
-                if len(header) < 4:
-                    break
-                length = int.from_bytes(header, "little")
-                body = f.read(length)
-                if len(body) < length:
-                    break
-                op, ns, key, val = wire.journal_decode(body)
-                if op == "put":
-                    self._kv.setdefault(ns, {})[key] = val
-                elif op == "del":
-                    self._kv.get(ns, {}).pop(key, None)
-
-    def _log(self, op: str, ns: str, key: str, val: Optional[bytes]) -> None:
-        if self._journal is not None:
-            body = wire.journal_encode(op, ns, key, val)
-            self._journal.write(len(body).to_bytes(4, "little") + body)
-            self._journal.flush()
-
-    def put(self, ns: str, key: str, val: bytes) -> None:
-        self._kv.setdefault(ns, {})[key] = val
-        self._log("put", ns, key, val)
-
-    def get(self, ns: str, key: str) -> Optional[bytes]:
-        return self._kv.get(ns, {}).get(key)
-
-    def delete(self, ns: str, key: str) -> bool:
-        existed = key in self._kv.get(ns, {})
-        self._kv.get(ns, {}).pop(key, None)
-        self._log("del", ns, key, None)
-        return existed
-
-    def keys(self, ns: str, prefix: str = "") -> List[str]:
-        return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
-
-    def close(self):
-        if self._journal is not None:
-            self._journal.close()
-
-
 class GcsServer:
     def __init__(self, socket_path: str, journal_path: Optional[str] = None,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 external_store_address: Optional[str] = None,
+                 on_storage_failure=None):
         self.server = RpcServer(socket_path, name="gcs",
                                 advertise_host=advertise_host)
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
-        self.storage = Storage(journal_path)
+        # persistence ladder (gcs_storage.py): external store > local
+        # journal > memory-only. With an external store the head node's
+        # DISK is expendable — a replacement GCS anywhere re-seeds from
+        # the store (ref: redis_store_client.h:111 + gcs_init_data.h)
+        self._remote_store: Optional[RemoteStoreClient] = None
+        self._on_storage_failure = on_storage_failure
+        self._storage_health_task: Optional[asyncio.Task] = None
+        if external_store_address:
+            self._remote_store = RemoteStoreClient(external_store_address)
+            self.storage = Storage(journal_path, remote=self._remote_store)
+        else:
+            self.storage = Storage(journal_path)
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
@@ -190,7 +127,9 @@ class GcsServer:
 
         self.events: "_collections.deque" = _collections.deque(maxlen=5000)
         self._next_job = 1
-        self._restore_tables()
+        if self._remote_store is None:
+            self._restore_tables()
+        # else: tables restore in start(), after the async snapshot load
 
     # ---- journal-backed table persistence (the Redis-persistence analog:
     #      gcs_table_storage.h + gcs_init_data.h restart rebuild) ----
@@ -220,6 +159,14 @@ class GcsServer:
             self._next_job = max(self._next_job, int(key) + 1)
 
     async def start(self):
+        if self._remote_store is not None:
+            # seed tables from the external store BEFORE listening — a
+            # client must never observe a half-restored GCS
+            await self._remote_store.connect()
+            await self.storage.load_remote()
+            self._restore_tables()
+            self._storage_health_task = asyncio.ensure_future(
+                self._storage_failure_detector())
         await self.server.start()
         # restored placement groups that never finished reserving resume
         # scheduling now that the loop is live (restart recovery)
@@ -227,15 +174,51 @@ class GcsServer:
             if pg["state"] in ("PENDING", "RESCHEDULING"):
                 self._kick_pg_scheduler(pg["pg_id"])
 
+    async def _storage_failure_detector(self):
+        """Ping the external store; a sustained outage is fatal for the
+        GCS (its writes are no longer durable), so after the threshold
+        it reports and — like the reference — dies for a supervisor to
+        restart it against a healthy store (ref:
+        gcs_redis_failure_detector.h). Tests inject on_storage_failure
+        to observe the trip without losing the process."""
+        from .config import global_config
+
+        cfg = global_config()
+        period = max(0.2, cfg.health_check_period_ms / 1000.0)
+        strikes = 0
+        while True:
+            await asyncio.sleep(period)
+            if await self._remote_store.ping():
+                strikes = 0
+                continue
+            strikes += 1
+            if strikes >= cfg.health_check_failure_threshold:
+                self._event("GCS_STORAGE", "ERROR",
+                            "external store unreachable; GCS writes are "
+                            "no longer durable",
+                            address=self._remote_store.address)
+                if self._on_storage_failure is not None:
+                    self._on_storage_failure()
+                    strikes = 0  # injected handler chose to continue
+                else:
+                    os._exit(1)
+
     async def stop(self):
         for task in list(self._pg_tasks.values()):
             task.cancel()
+        if self._storage_health_task is not None:
+            self._storage_health_task.cancel()
         for client in self._pg_raylet_clients.values():
             try:
                 await client.close()
             except Exception:
                 pass
         await self.server.stop()
+        if self._remote_store is not None:
+            try:
+                await self._remote_store.close()
+            except Exception:
+                pass
         self.storage.close()
 
     # ---- structured events (ref: util/event.h EventManager) ----
@@ -927,17 +910,24 @@ class GcsServer:
                 for oid, nodes in self.object_locations.items()}
 
     async def handle_get_object_locations(self, payload, conn):
-        """oid -> [(node_id, raylet_address, transfer_address)] for live
-        holders."""
+        """oid -> [(node_id, raylet_address)] for live holders, plus a
+        "__transfer__" side map {node_hex: transfer_address}. The holder
+        tuples stay 2-wide on purpose: a pre-transfer-plane raylet
+        unpacks `for node_id, address in ...` and a widened tuple would
+        break ITS pulls, while an extra top-level key is invisible to
+        it (wire-compat: additive only)."""
         out = {}
+        transfer = {}
         for oid in payload["object_ids"]:
             holders = []
             for node_id in self.object_locations.get(oid, ()):
                 info = self.nodes.get(node_id)
                 if info is not None and info.alive:
-                    holders.append((node_id, info.address,
-                                    info.transfer_address))
+                    holders.append((node_id, info.address))
+                    if info.transfer_address:
+                        transfer[node_id.hex()] = info.transfer_address
             out[oid] = holders
+        out["__transfer__"] = transfer
         return out
 
     # ---- metrics (ref: stats/metric.h registry + metrics agent; the GCS

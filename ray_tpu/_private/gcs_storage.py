@@ -1,0 +1,210 @@
+"""GCS persistence seam: pluggable store clients behind one Storage facade.
+
+TPU-native analog of the reference store-client layer (ref:
+src/ray/gcs/store_client/store_client.h:33 — the interface;
+in_memory_store_client.h — volatile; redis_store_client.h:111 — the
+external persistent backend the reference leans on for head fault
+tolerance; observer wiring gcs/gcs_server/gcs_init_data.h — rebuild on
+restart). Three backends here:
+
+  * in-memory only (no persistence) — tests, ephemeral clusters;
+  * file journal — append-only + startup compaction; survives a GCS
+    process restart on the same disk (the default);
+  * remote store — a socket client to an external `kv_server.py`
+    process (Redis's role): survives loss of the head node's disk
+    entirely. Writes stream through an ordered async queue (the
+    reference's Redis writes are similarly async); reads are served
+    from the in-memory tables, which a restart re-seeds from the
+    remote snapshot before the GCS starts listening.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import wire
+
+
+class RemoteStoreClient:
+    """Async client for the external KV store (kv_server.py).
+
+    Writes are enqueued in order and drained by a writer task with
+    retry — a transient store outage delays persistence but never
+    blocks a GCS handler. The failure detector (GcsServer) decides when
+    an outage is fatal; this client just keeps trying."""
+
+    def __init__(self, address: str):
+        from .rpc import RpcClient
+
+        self.address = address
+        self._client = RpcClient(address)
+        self._queue: deque = deque()
+        self._wake = asyncio.Event()
+        self._writer_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    async def connect(self, timeout: float = 10.0) -> None:
+        await self._client.connect(timeout=timeout)
+        self._writer_task = asyncio.ensure_future(self._writer_loop())
+
+    async def snapshot(self) -> List[Tuple[str, str, bytes]]:
+        records = await self._client.call("store_snapshot", {}, timeout=60)
+        return [(ns, key, val) for ns, key, val in records]
+
+    def write(self, op: str, ns: str, key: str,
+              val: Optional[bytes]) -> None:
+        self._queue.append((op, ns, key, val))
+        self._wake.set()
+
+    async def ping(self, timeout: float = 2.0) -> bool:
+        try:
+            return bool(await self._client.call("store_ping", {},
+                                                timeout=timeout))
+        except Exception:
+            return False
+
+    async def flush(self, timeout: float = 10.0) -> None:
+        """Wait until every enqueued write has been ACKED by the store
+        (writes stay in the queue until their batch RPC succeeds, so
+        queue-empty means durably delivered, not merely in flight)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self._queue and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+
+    async def _writer_loop(self) -> None:
+        import itertools
+
+        while True:
+            if not self._queue:
+                if self._closed:
+                    return  # drained: safe to exit
+                self._wake.clear()
+                if self._closed:  # closed raced the clear
+                    return
+                await self._wake.wait()
+                continue
+            # peek a batch; it leaves the queue only on ACK, so a crash
+            # or close() mid-RPC can never drop acknowledged-looking
+            # writes (new appends only touch the right end — safe)
+            batch = list(itertools.islice(self._queue, 512))
+            try:
+                await self._client.call_retrying(
+                    "store_write_batch", {"ops": batch},
+                    attempts=5, per_try_timeout=5.0)
+            except Exception:
+                await asyncio.sleep(0.5)
+                continue
+            for _ in range(len(batch)):
+                self._queue.popleft()
+
+    async def close(self) -> None:
+        # drain BEFORE tearing down: dropping the tail of the write
+        # stream at clean shutdown would hand a replacement head stale
+        # tables — the exact failure this backend exists to prevent
+        await self.flush(timeout=10.0)
+        self._closed = True
+        self._wake.set()
+        if self._writer_task is not None:
+            self._writer_task.cancel()
+        await self._client.close()
+
+
+class Storage:
+    """In-memory KV tables + optional persistence backend.
+
+    `journal_path` — append-only local file, compacted at startup (every
+    record rewritten at the current wire version: the journal migration
+    path). `remote` — a RemoteStoreClient; callers must `await
+    load_remote()` before serving (GcsServer.start does)."""
+
+    def __init__(self, journal_path: Optional[str] = None,
+                 remote: Optional[RemoteStoreClient] = None):
+        self._kv: Dict[str, Dict[str, bytes]] = {}
+        self._journal_path = journal_path
+        self._journal = None
+        self._remote = remote
+        if remote is not None:
+            # the external store is AUTHORITATIVE: replaying a stale
+            # local journal under it would resurrect records another
+            # head already deleted remotely (and re-compact them into
+            # the journal). Remote mode therefore journals nothing
+            # locally — exactly the reference's Redis mode.
+            self._journal_path = None
+        elif journal_path:
+            self._replay(journal_path)
+            self._compact(journal_path)
+            self._journal = open(journal_path, "ab")
+
+    # ---- local journal ----
+    def _compact(self, path: str) -> None:
+        tmp = path + ".compact"
+        with open(tmp, "wb") as f:
+            for ns, table in self._kv.items():
+                for key, val in table.items():
+                    body = wire.journal_encode("put", ns, key, val)
+                    f.write(len(body).to_bytes(4, "little") + body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _replay(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(4)
+                if len(header) < 4:
+                    break
+                length = int.from_bytes(header, "little")
+                body = f.read(length)
+                if len(body) < length:
+                    break
+                op, ns, key, val = wire.journal_decode(body)
+                if op == "put":
+                    self._kv.setdefault(ns, {})[key] = val
+                elif op == "del":
+                    self._kv.get(ns, {}).pop(key, None)
+
+    def _log(self, op: str, ns: str, key: str, val: Optional[bytes]) -> None:
+        if self._journal is not None:
+            body = wire.journal_encode(op, ns, key, val)
+            self._journal.write(len(body).to_bytes(4, "little") + body)
+            self._journal.flush()
+        if self._remote is not None:
+            self._remote.write(op, ns, key, val)
+
+    # ---- remote backend ----
+    async def load_remote(self) -> None:
+        for ns, key, val in await self._remote.snapshot():
+            self._kv.setdefault(ns, {})[key] = val
+
+    # ---- table interface ----
+    def put(self, ns: str, key: str, val: bytes) -> None:
+        self._kv.setdefault(ns, {})[key] = val
+        self._log("put", ns, key, val)
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        return self._kv.get(ns, {}).get(key)
+
+    def delete(self, ns: str, key: str) -> bool:
+        existed = key in self._kv.get(ns, {})
+        self._kv.get(ns, {}).pop(key, None)
+        self._log("del", ns, key, None)
+        return existed
+
+    def keys(self, ns: str, prefix: str = "") -> List[str]:
+        return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
+
+    def records(self):
+        """Every (ns, key, value) — the snapshot interface kv_server
+        serves and RemoteStoreClient.snapshot consumes."""
+        for ns, table in self._kv.items():
+            for key, val in table.items():
+                yield ns, key, val
+
+    def close(self):
+        if self._journal is not None:
+            self._journal.close()

@@ -1,0 +1,95 @@
+"""Standalone persistent KV store process — Redis's role for the GCS.
+
+The reference achieves GCS fault tolerance by keeping its tables in an
+external Redis (ref: src/ray/gcs/store_client/redis_store_client.h:111,
+gcs_redis_failure_detector.h): losing the head node — its process AND
+its disk — loses nothing, because a new GCS rebuilds from the store.
+This process plays that role natively: the GCS's Storage facade streams
+writes to it (`store_write_batch`), a (re)starting GCS seeds its tables
+from `store_snapshot`, and the GCS's failure detector `store_ping`s it.
+
+Persistence is the same journal machinery the local-file backend uses
+(gcs_storage.Storage with a journal under --data), so compaction and
+wire-version migration behave identically wherever the tables live. Run
+it on a machine that survives the head node:
+
+    python -m ray_tpu._private.kv_server --address /tmp/rtpu_kv.sock \
+        --data /var/lib/rtpu_kv
+    python -m ray_tpu._private.kv_server --address 0.0.0.0:6379 \
+        --data /var/lib/rtpu_kv
+
+or `ray-tpu kv-server` (scripts/cli.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+from typing import Optional
+
+from .gcs_storage import Storage
+from .rpc import RpcServer
+
+
+class KvServer:
+    def __init__(self, address: str, data_dir: str,
+                 advertise_host: Optional[str] = None):
+        os.makedirs(data_dir, exist_ok=True)
+        self.storage = Storage(
+            journal_path=os.path.join(data_dir, "kv_journal.bin"))
+        self.server = RpcServer(address, name="rtpu-kv",
+                                advertise_host=advertise_host)
+        self.server.register("store_write_batch", self.handle_write_batch)
+        self.server.register("store_snapshot", self.handle_snapshot)
+        self.server.register("store_ping", self.handle_ping)
+
+    async def start(self) -> str:
+        await self.server.start()
+        return self.server.address
+
+    async def stop(self) -> None:
+        await self.server.stop()
+        self.storage.close()
+
+    async def handle_write_batch(self, payload, conn):
+        for op, ns, key, val in payload["ops"]:
+            if op == "put":
+                self.storage.put(ns, key, val)
+            elif op == "del":
+                self.storage.delete(ns, key)
+        return True
+
+    async def handle_snapshot(self, payload, conn):
+        return list(self.storage.records())
+
+    async def handle_ping(self, payload, conn):
+        return True
+
+
+async def _amain(address: str, data_dir: str) -> None:
+    server = KvServer(address, data_dir)
+    resolved = await server.start()
+    print(f"rtpu-kv serving on {resolved} (data: {data_dir})", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="ray_tpu external GCS store (the Redis role)")
+    parser.add_argument("--address", required=True,
+                        help="unix socket path or host:port")
+    parser.add_argument("--data", required=True,
+                        help="directory for the persistent journal")
+    args = parser.parse_args()
+    try:
+        asyncio.run(_amain(args.address, args.data))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

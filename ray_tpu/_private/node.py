@@ -83,6 +83,7 @@ class Node:
         object_store_memory: Optional[int] = None,
         port: Optional[int] = None,
         node_ip: Optional[str] = None,
+        external_store_address: Optional[str] = None,
     ):
         """``port``: bind the head GCS on TCP (0 = ephemeral) so worker nodes
         on other hosts can join over DCN; default is a unix socket
@@ -131,7 +132,10 @@ class Node:
             self.gcs_server = GcsServer(
                 self.gcs_address,
                 journal_path=os.path.join(self.session_dir, "gcs_journal.bin"),
-                advertise_host=self.node_ip)
+                advertise_host=self.node_ip,
+                # external kv_server (the Redis role): head-disk loss
+                # becomes survivable — a new head re-seeds from it
+                external_store_address=external_store_address)
         node_labels = dict(labels or {})
         acc_type = _detect_accelerator_type()
         if acc_type and "accelerator_type" not in node_labels:

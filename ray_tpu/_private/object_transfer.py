@@ -37,6 +37,10 @@ from .ids import ObjectID
 _REQ_LEN = struct.Struct("<I")
 _RESP = struct.Struct("<QQ")   # (total object size, this payload length)
 _ABSENT = (1 << 64) - 1
+# per-chunk I/O deadline: generous for a saturated DCN link moving one
+# chunk, but bounded — an unbounded read against a half-open peer would
+# wedge the pull AND its PullManager byte reservation forever
+_IO_TIMEOUT_S = 60.0
 
 
 def _parse_addr(address: str):
@@ -236,8 +240,9 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
     try:
         # chunk 0 doubles as the size probe
         probe = bytearray(chunk_bytes)
-        total, got = await first.fetch_range(oid, 0, chunk_bytes,
-                                             memoryview(probe))
+        total, got = await asyncio.wait_for(
+            first.fetch_range(oid, 0, chunk_bytes, memoryview(probe)),
+            _IO_TIMEOUT_S)
         if total < 0:
             return None
         if admit_bytes is not None:
@@ -261,8 +266,9 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
             nonlocal next_i
             if stream is None:
                 stream = _Stream(address)
-                await stream.connect()
+                await asyncio.wait_for(stream.connect(), _IO_TIMEOUT_S)
                 opened.append(stream)
+            retries = 0
             while True:
                 i = next_i
                 if i >= len(offsets):
@@ -270,11 +276,35 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
                 next_i = i + 1
                 off = offsets[i]
                 length = min(chunk_bytes, total - off)
-                t, n = await stream.fetch_range(
-                    oid, off, length, buf[off:off + length])
-                if t < 0 or n < length:
-                    raise ConnectionError(
-                        "holder dropped object mid-transfer")
+                while True:
+                    try:
+                        # per-chunk deadline: a half-open holder (no
+                        # FIN/RST) must not hang the pull — a wedged pull
+                        # never releases its byte-budget reservation
+                        t, n = await asyncio.wait_for(
+                            stream.fetch_range(oid, off, length,
+                                               buf[off:off + length]),
+                            _IO_TIMEOUT_S)
+                    except (ConnectionError, OSError,
+                            asyncio.TimeoutError):
+                        # one dropped stream must not demote a mostly-
+                        # done pull to the control-RPC path: retry this
+                        # chunk on a FRESH connection; only a holder
+                        # that refuses reconnection fails the pull
+                        stream.close()
+                        retries += 1
+                        if retries > 2:
+                            raise
+                        stream = _Stream(address)
+                        await asyncio.wait_for(stream.connect(),
+                                               _IO_TIMEOUT_S)
+                        opened.append(stream)
+                        continue
+                    if t < 0 or n < length:
+                        raise ConnectionError(
+                            "holder dropped object mid-transfer")
+                    retries = 0
+                    break
 
         tasks = [asyncio.ensure_future(run_stream(first))]
         tasks += [asyncio.ensure_future(run_stream(None))

@@ -1371,9 +1371,10 @@ class Raylet:
                     "get_object_locations", {"object_ids": [oid]})
             except Exception:
                 locs = {oid: []}
+            transfer_map = locs.get("__transfer__", {})
             for loc in locs.get(oid, []):
                 node_id, address = loc[0], loc[1]
-                xfer_address = loc[2] if len(loc) > 2 else ""
+                xfer_address = transfer_map.get(node_id.hex(), "")
                 if node_id == self.node_id:
                     continue
                 try:

@@ -55,6 +55,18 @@ def _resolve_address(args) -> str:
 
 # ------------------------------------------------------------------ start
 
+def cmd_kv_server(args) -> int:
+    import asyncio
+
+    from ray_tpu._private.kv_server import _amain
+
+    try:
+        asyncio.run(_amain(args.address, args.data))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_start(args) -> int:
     if args.block:
         return _start_blocking(args)
@@ -75,6 +87,8 @@ def cmd_start(args) -> int:
         cmd += ["--num-cpus", str(args.num_cpus)]
     if args.object_store_memory is not None:
         cmd += ["--object-store-memory", str(args.object_store_memory)]
+    if getattr(args, "external_store", None):
+        cmd += ["--external-store", args.external_store]
     proc = subprocess.Popen(cmd, start_new_session=True,
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
@@ -102,7 +116,9 @@ def _start_blocking(args) -> int:
     if args.head:
         node = Node(head=True, port=args.port if args.port is not None else 0,
                     resources=resources, node_ip=args.node_ip,
-                    object_store_memory=args.object_store_memory)
+                    object_store_memory=args.object_store_memory,
+                    external_store_address=getattr(args, "external_store",
+                                                   None))
     else:
         if not args.address:
             raise SystemExit("worker start needs --address HOST:PORT")
@@ -302,7 +318,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--node-ip", default=None)
     sp.add_argument("--block", action="store_true",
                     help="run in the foreground")
+    sp.add_argument("--external-store", default=None,
+                    help="address of a ray-tpu kv-server; the GCS "
+                         "persists its tables there (head-disk loss "
+                         "becomes survivable)")
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("kv-server",
+                        help="run the external GCS store (the Redis role)")
+    sp.add_argument("--address", required=True,
+                    help="unix socket path or host:port")
+    sp.add_argument("--data", required=True,
+                    help="directory for the persistent journal")
+    sp.set_defaults(fn=cmd_kv_server)
 
     sp = sub.add_parser("stop", help="stop the node started on this host")
     sp.set_defaults(fn=cmd_stop)
