@@ -123,6 +123,11 @@ class Config:
     # kernel anyway (0 disables auto-select). The span is a static shape
     # (engine buckets it), so each (span, path) pair is its own compiled
     # executable — flipping per round costs nothing at steady state.
+    # Re-measured r4 at TRUE 8k occupancy (400m, B=4, ctx=7650, 120/120
+    # pages resident, v5e): gather 486 tok/s vs paged kernel 127 tok/s
+    # — the burst design's once-per-32-steps contiguous gather beats
+    # per-step paged DMA at every feasible occupancy on this chip, so
+    # auto-select stays disabled BY MEASUREMENT, not by default.
     llm_paged_kernel_min_ctx_pages: int = 0
     # bind host for the per-process PJRT transfer server backing
     # DeviceChannel (experimental/device_channel.py); must be routable

@@ -61,18 +61,28 @@ class RemoteStoreClient:
 
     async def ping(self, timeout: float = 2.0) -> bool:
         try:
-            return bool(await self._client.call("store_ping", {},
-                                                timeout=timeout))
+            # retrying: a plain call() fails permanently once the
+            # transport dropped (closed=True) even after the store
+            # recovered — an idle GCS would then false-trip its failure
+            # detector and die against a healthy store
+            return bool(await self._client.call_retrying(
+                "store_ping", {}, attempts=2, per_try_timeout=timeout))
         except Exception:
             return False
 
     async def flush(self, timeout: float = 10.0) -> None:
         """Wait until every enqueued write has been ACKED by the store
         (writes stay in the queue until their batch RPC succeeds, so
-        queue-empty means durably delivered, not merely in flight)."""
+        queue-empty means durably delivered, not merely in flight).
+        Raises TimeoutError when writes remain — a silent return would
+        let close() discard the tail as if it were drained."""
         deadline = asyncio.get_event_loop().time() + timeout
         while self._queue and asyncio.get_event_loop().time() < deadline:
             await asyncio.sleep(0.01)
+        if self._queue:
+            raise TimeoutError(
+                f"{len(self._queue)} writes still un-ACKed by the "
+                f"external store after {timeout}s")
 
     async def _writer_loop(self) -> None:
         import itertools
@@ -104,7 +114,14 @@ class RemoteStoreClient:
         # drain BEFORE tearing down: dropping the tail of the write
         # stream at clean shutdown would hand a replacement head stale
         # tables — the exact failure this backend exists to prevent
-        await self.flush(timeout=10.0)
+        try:
+            await self.flush(timeout=10.0)
+        except TimeoutError as e:
+            import sys
+
+            print(f"[gcs] WARNING: external store close dropped writes "
+                  f"({e}); a replacement head may see stale tables",
+                  file=sys.stderr)
         self._closed = True
         self._wake.set()
         if self._writer_task is not None:

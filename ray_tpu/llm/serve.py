@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 from typing import Any, Dict, List, Optional
 
 from ..models.llama import LLAMA_CONFIGS, LlamaConfig, init_params
@@ -34,8 +35,30 @@ class LLMServer:
                  tokenizer: Optional[str] = None, seed: int = 0):
         import jax
 
-        cfg = LLAMA_CONFIGS[model]
-        if params_path:
+        if model in LLAMA_CONFIGS:
+            cfg = LLAMA_CONFIGS[model]
+        elif os.path.isdir(model):
+            cfg = None  # an HF checkpoint directory IS the model source
+        else:
+            raise ValueError(f"unknown model {model!r}: not a named "
+                             f"config or an HF checkpoint dir")
+        if cfg is None or init == "hf":
+            # real weights: HF safetensors directory (hf_interop.py) —
+            # the vLLM-engine weight-loading analog
+            from ..models.hf_interop import load_hf_checkpoint
+
+            path = model if cfg is None else (params_path or model)
+            if not os.path.isdir(path):
+                raise ValueError(
+                    f"init='hf' needs an HF checkpoint directory; "
+                    f"{path!r} is not one (pass it as `model` or "
+                    f"`params_path`)")
+            params, cfg = load_hf_checkpoint(path)
+            params = jax.device_put(params)
+            if tokenizer is None and os.path.exists(
+                    os.path.join(path, "tokenizer_config.json")):
+                tokenizer = path
+        elif params_path:
             import pickle
 
             with open(params_path, "rb") as f:

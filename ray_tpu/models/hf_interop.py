@@ -1,0 +1,255 @@
+"""HuggingFace checkpoint interop for the Llama family.
+
+The reference reaches HF weights through its integration layers (ref:
+python/ray/train/huggingface/, python/ray/llm/.. vLLM engine weight
+loading); here the mapping is native: safetensors shards <-> the stacked
+jax param tree models/llama.py trains and serves. This is the door real
+Llama-3 weights walk through to enter the framework.
+
+Layout notes (checked against transformers' LlamaForCausalLM):
+  * HF linears store (out_features, in_features); our kernels store
+    (in, out) [+ head split], so every projection transposes on import.
+  * Our rotary (ops/rotary.py) is the half-split GPT-NeoX convention —
+    the SAME one HF safetensors use — so q/k need no column permutation
+    (Meta's original interleaved layout would).
+  * Our per-layer params are stacked on a leading "layers" axis (scan);
+    HF keeps one tensor per layer. Import stacks, export unstacks.
+  * `tie_word_embeddings` checkpoints omit lm_head: it becomes
+    embed.T, exactly how HF ties them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig
+
+__all__ = ["config_from_hf", "config_to_hf", "load_hf_checkpoint",
+           "save_hf_checkpoint"]
+
+
+# ---------------------------------------------------------------------------
+# safetensors IO, implemented directly over numpy/ml_dtypes.
+#
+# The format is deliberately trivial (u64 header length + JSON header of
+# {name: {dtype, shape, data_offsets}} + raw row-major bytes), and doing
+# it by hand avoids a real landed bug: safetensors' flax backend reads
+# the XLA device buffer's raw bytes, whose layout XLA may choose to be
+# non-row-major for larger 2-D arrays — save+load through that backend
+# silently transposes tensors (verified in this environment: a (256,64)
+# f32 round-trips transposed while a (3,4) survives). np.asarray()
+# performs the layout-correct copy; these helpers build on that.
+# ---------------------------------------------------------------------------
+
+_ST_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U8": np.uint8, "BOOL": np.bool_,
+}
+
+
+def _st_name(dtype) -> str:
+    import ml_dtypes
+
+    if dtype == ml_dtypes.bfloat16:
+        return "BF16"
+    for name, np_dtype in _ST_DTYPES.items():
+        if dtype == np_dtype:
+            return name
+    raise ValueError(f"unsupported safetensors dtype {dtype}")
+
+
+def _st_dtype(name: str):
+    import ml_dtypes
+
+    if name == "BF16":
+        return ml_dtypes.bfloat16
+    return np.dtype(_ST_DTYPES[name])
+
+
+def write_safetensors(tensors: Dict[str, Any], path: str) -> None:
+    header: Dict[str, Any] = {}
+    offset = 0
+    arrays = []
+    for name, value in tensors.items():
+        arr = np.ascontiguousarray(np.asarray(value))
+        nbytes = arr.nbytes
+        header[name] = {"dtype": _st_name(arr.dtype),
+                        "shape": list(arr.shape),
+                        "data_offsets": [offset, offset + nbytes]}
+        arrays.append(arr)
+        offset += nbytes
+    blob = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(len(blob).to_bytes(8, "little"))
+        f.write(blob)
+        for arr in arrays:
+            f.write(arr.tobytes())
+
+
+def read_safetensors(path: str) -> Dict[str, np.ndarray]:
+    with open(path, "rb") as f:
+        header_len = int.from_bytes(f.read(8), "little")
+        header = json.loads(f.read(header_len))
+        base = 8 + header_len
+        out: Dict[str, np.ndarray] = {}
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            start, end = meta["data_offsets"]
+            f.seek(base + start)
+            buf = f.read(end - start)
+            out[name] = np.frombuffer(
+                buf, dtype=_st_dtype(meta["dtype"])
+            ).reshape(meta["shape"])
+    return out
+
+
+def config_from_hf(hf: Dict[str, Any], **overrides) -> LlamaConfig:
+    cfg = LlamaConfig(
+        vocab=hf["vocab_size"],
+        dim=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads",
+                          hf["num_attention_heads"]),
+        mlp_dim=hf["intermediate_size"],
+        max_seq=hf.get("max_position_embeddings", 8192),
+        rope_theta=float(hf.get("rope_theta", 500000.0)),
+        norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+    )
+    if overrides:
+        cfg = LlamaConfig(**{**cfg.__dict__, **overrides})
+    return cfg
+
+
+def config_to_hf(cfg: LlamaConfig) -> Dict[str, Any]:
+    return {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": cfg.vocab,
+        "hidden_size": cfg.dim,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "intermediate_size": cfg.mlp_dim,
+        "max_position_embeddings": cfg.max_seq,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.norm_eps,
+        "head_dim": cfg.head_dim,
+        "tie_word_embeddings": False,
+        "torch_dtype": "bfloat16",
+    }
+
+
+def _load_shards(path: str) -> Dict[str, Any]:
+    """All tensors of a single-file or index-sharded safetensors
+    checkpoint, as a flat {hf_name: numpy array} dict."""
+    index_path = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            weight_map = json.load(f)["weight_map"]
+        shards = sorted(set(weight_map.values()))
+    else:
+        shards = ["model.safetensors"]
+    tensors: Dict[str, Any] = {}
+    for shard in shards:
+        tensors.update(read_safetensors(os.path.join(path, shard)))
+    return tensors
+
+
+def load_hf_checkpoint(path: str, dtype: Optional[Any] = None,
+                       **config_overrides) -> Tuple[Dict, LlamaConfig]:
+    """Import an HF-format Llama checkpoint directory -> (params, cfg).
+
+    `path` holds config.json + model.safetensors (or sharded files with
+    an index). `dtype` overrides the storage dtype (default: the
+    config's, bf16)."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf_cfg = json.load(f)
+    if dtype is not None:
+        config_overrides.setdefault("dtype", dtype)
+    cfg = config_from_hf(hf_cfg, **config_overrides)
+    t = _load_shards(path)
+    d, h, hkv, hd = cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cast = lambda x: jnp.asarray(x, cfg.dtype)  # noqa: E731
+
+    def stack(fmt: str):
+        return [t[fmt.format(i)] for i in range(cfg.n_layers)]
+
+    def proj(fmt: str, shape) -> jnp.ndarray:
+        # HF (out, in) -> ours (in, out[, split head dims])
+        return cast(jnp.stack(
+            [w.T.reshape(shape) for w in stack(fmt)]))
+
+    layers = {
+        "attn_norm": cast(jnp.stack(
+            stack("model.layers.{}.input_layernorm.weight"))),
+        "wq": proj("model.layers.{}.self_attn.q_proj.weight", (d, h, hd)),
+        "wk": proj("model.layers.{}.self_attn.k_proj.weight", (d, hkv, hd)),
+        "wv": proj("model.layers.{}.self_attn.v_proj.weight", (d, hkv, hd)),
+        # o_proj is (d, h*hd): transpose -> (h*hd, d) -> (h, hd, d)
+        "wo": proj("model.layers.{}.self_attn.o_proj.weight", (h, hd, d)),
+        "mlp_norm": cast(jnp.stack(
+            stack("model.layers.{}.post_attention_layernorm.weight"))),
+        "w_gate": proj("model.layers.{}.mlp.gate_proj.weight",
+                       (d, cfg.mlp_dim)),
+        "w_up": proj("model.layers.{}.mlp.up_proj.weight",
+                     (d, cfg.mlp_dim)),
+        "w_down": proj("model.layers.{}.mlp.down_proj.weight",
+                       (cfg.mlp_dim, d)),
+    }
+    embed = cast(t["model.embed_tokens.weight"])
+    if "lm_head.weight" in t:
+        lm_head = cast(t["lm_head.weight"].T)
+    else:  # tie_word_embeddings
+        lm_head = embed.T
+    params = {
+        "embed": embed,
+        "layers": layers,
+        "final_norm": cast(t["model.norm.weight"]),
+        "lm_head": lm_head,
+    }
+    return params, cfg
+
+
+def save_hf_checkpoint(params: Dict, cfg: LlamaConfig, path: str) -> None:
+    """Export params to an HF-format directory (config.json +
+    model.safetensors) loadable by transformers/vLLM — and by
+    load_hf_checkpoint for the round-trip test."""
+
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "HF export for MoE configs is not wired up (mixtral-format "
+            "expert naming differs); dense Llama only")
+    os.makedirs(path, exist_ok=True)
+    d = cfg.dim
+    t: Dict[str, Any] = {
+        "model.embed_tokens.weight": params["embed"],
+        "model.norm.weight": params["final_norm"],
+        "lm_head.weight": params["lm_head"].T,
+    }
+    lp = params["layers"]
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+        t[pre + "input_layernorm.weight"] = lp["attn_norm"][i]
+        t[pre + "self_attn.q_proj.weight"] = \
+            lp["wq"][i].reshape(d, -1).T
+        t[pre + "self_attn.k_proj.weight"] = \
+            lp["wk"][i].reshape(d, -1).T
+        t[pre + "self_attn.v_proj.weight"] = \
+            lp["wv"][i].reshape(d, -1).T
+        t[pre + "self_attn.o_proj.weight"] = \
+            lp["wo"][i].reshape(-1, d).T
+        t[pre + "post_attention_layernorm.weight"] = lp["mlp_norm"][i]
+        t[pre + "mlp.gate_proj.weight"] = lp["w_gate"][i].T
+        t[pre + "mlp.up_proj.weight"] = lp["w_up"][i].T
+        t[pre + "mlp.down_proj.weight"] = lp["w_down"][i].T
+    write_safetensors(t, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(config_to_hf(cfg), f, indent=2)
