@@ -1,9 +1,18 @@
 """ray_tpu.rllib: RL training library (ref: rllib/ — new API stack:
-EnvRunner sampling actors + a jitted jax Learner; SURVEY §2.4)."""
+EnvRunner sampling actors + a jitted jax Learner; SURVEY §2.4).
+
+Algorithm families: PPO (on-policy), IMPALA (async + v-trace), DQN
+(off-policy value), BC/MARWIL (offline), GRPO (LLM RLHF)."""
 
 from .env import CartPole, make_env
 from .dqn import DQN, DQNConfig
+from .grpo import GRPO, GRPOConfig
+from .impala import IMPALA, IMPALAConfig
+from .offline import (BC, BCConfig, MARWIL, MARWILConfig,
+                      record_rollouts, rollout_dataset)
 from .ppo import PPO, PPOConfig, EnvRunner
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "EnvRunner",
-           "CartPole", "make_env"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
+           "IMPALAConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig",
+           "GRPO", "GRPOConfig", "EnvRunner", "CartPole", "make_env",
+           "record_rollouts", "rollout_dataset"]

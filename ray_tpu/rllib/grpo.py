@@ -1,0 +1,214 @@
+"""GRPO: group-relative policy optimization for LLM RLHF.
+
+The RLHF path named in BASELINE.json ("PPO / GRPO RLHF with Ray-RLlib on
+TPU"). GRPO (Shao et al. 2024, DeepSeekMath) removes PPO's value network:
+G completions are sampled per prompt, and each completion's advantage is
+its reward standardized WITHIN its group — the group mean is the
+baseline. The update is a token-level policy gradient on completion
+tokens plus a KL penalty to the frozen reference policy (the k3
+estimator, Schulman 2020), all in one jitted function.
+
+The policy is the Llama family itself (models/llama.py) — the same
+params train.make_train_step pretrains and llm.LLMEngine serves, so RLHF
+composes with the rest of the stack instead of living beside it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..models import LLAMA_CONFIGS, forward, init_params
+from ..models.llama import LlamaConfig
+
+__all__ = ["GRPO", "GRPOConfig"]
+
+_UPDATE_JIT = {}
+_SAMPLE_FWD_JIT = {}
+
+
+def _forward_jit(cfg: LlamaConfig):
+    """Per-config jitted forward (LlamaConfig is a frozen dataclass —
+    hashable — so the config itself is the cache key; a fresh lambda per
+    call would retrace and recompile every sampling step)."""
+    fn = _SAMPLE_FWD_JIT.get(cfg)
+    if fn is None:
+        import jax
+
+        fn = _SAMPLE_FWD_JIT[cfg] = jax.jit(
+            lambda p, t: forward(p, t, cfg))
+    return fn
+
+
+@dataclass
+class GRPOConfig:
+    model: str = "tiny"               # LLAMA_CONFIGS key or cfg via .llama_config
+    llama_config: Optional[LlamaConfig] = None
+    group_size: int = 8               # completions per prompt (G)
+    max_prompt_len: int = 16
+    max_tokens: int = 16              # completion budget
+    temperature: float = 1.0
+    lr: float = 1e-4
+    kl_coef: float = 0.02
+    adv_clip: float = 5.0
+    seed: int = 0
+
+    def training(self, **kwargs) -> "GRPOConfig":
+        for key, val in kwargs.items():
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training option {key!r}")
+            setattr(self, key, val)
+        return self
+
+    def build(self, params=None) -> "GRPO":
+        return GRPO(self, params=params)
+
+
+def _sample_group(params, cfg: LlamaConfig, prompt: Sequence[int],
+                  group: int, max_tokens: int, temperature: float,
+                  key):
+    """G sampled continuations of one prompt -> (tokens[G, P+T],
+    completion_mask[G, P+T]). Greedy when temperature == 0."""
+    import jax
+    import jax.numpy as jnp
+
+    plen = len(prompt)
+    total = plen + max_tokens
+    tokens = jnp.tile(jnp.asarray(prompt, jnp.int32)[None, :], (group, 1))
+    tokens = jnp.pad(tokens, ((0, 0), (0, max_tokens)))
+
+    fwd = _forward_jit(cfg)
+    for t in range(plen, total):
+        logits = fwd(params, tokens)[:, t - 1, :]
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(
+                sub, logits / temperature, axis=-1).astype(jnp.int32)
+        tokens = tokens.at[:, t].set(nxt)
+    mask = jnp.zeros((group, total), jnp.float32).at[:, plen:].set(1.0)
+    return np.asarray(tokens), np.asarray(mask)
+
+
+def _grpo_update(params, ref_params, opt_state, batch, lr, *,
+                 kl_coef: float, cfg: LlamaConfig):
+    # keyed on the FULL (frozen, hashable) config: a name- or
+    # shape-derived key would collide for distinct custom configs and
+    # silently run the wrong architecture's closed-over cfg
+    fn = _UPDATE_JIT.get(cfg)
+    if fn is None:
+        import jax
+
+        fn = _UPDATE_JIT[cfg] = jax.jit(
+            lambda p, rp, o, b, lr_, kl: _grpo_impl(
+                p, rp, o, b, lr_, kl, cfg))
+    return fn(params, ref_params, opt_state, batch, lr, kl_coef)
+
+
+def _grpo_impl(params, ref_params, opt_state, batch, lr, kl_coef, cfg):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.adam(lr)
+
+    def token_logp(p, tokens):
+        logits = forward(p, tokens, cfg).astype(jnp.float32)
+        logp_all = jax.nn.log_softmax(logits[:, :-1, :])
+        return jnp.take_along_axis(
+            logp_all, tokens[:, 1:, None], axis=-1)[..., 0]
+
+    def loss_fn(p):
+        tokens = batch["tokens"]
+        mask = batch["mask"][:, 1:]            # predicts token t from t-1
+        logp = token_logp(p, tokens)
+        ref_logp = jax.lax.stop_gradient(token_logp(ref_params, tokens))
+        adv = batch["advantages"][:, None]     # per-sequence, broadcast
+        denom = mask.sum() + 1e-8
+        pg = -(adv * logp * mask).sum() / denom
+        # k3 KL estimator: e^(ref-pi) - (ref-pi) - 1 >= 0, low variance
+        diff = ref_logp - logp
+        kl = ((jnp.exp(diff) - diff - 1.0) * mask).sum() / denom
+        total = pg + kl_coef * kl
+        return total, (pg, kl, (logp * mask).sum() / denom)
+
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, {"total_loss": loss, "pg_loss": aux[0],
+                               "kl": aux[1], "mean_logp": aux[2]}
+
+
+class GRPO:
+    """train(prompts, reward_fn) — one GRPO iteration: sample G
+    completions per prompt, group-standardize rewards, policy-gradient
+    update with reference-KL."""
+
+    def __init__(self, config: GRPOConfig, params=None):
+        import jax
+        import optax
+
+        self.config = config
+        self.cfg = config.llama_config or LLAMA_CONFIGS[config.model]
+        if params is None:
+            params = init_params(jax.random.PRNGKey(config.seed), self.cfg)
+        self.params = params
+        # the frozen reference policy the KL tethers to
+        self.ref_params = jax.tree.map(lambda x: x, params)
+        self.opt_state = optax.adam(config.lr).init(params)
+        self._key = jax.random.PRNGKey(config.seed + 1)
+        self.iteration = 0
+
+    def train(self, prompts: Sequence[Sequence[int]],
+              reward_fn: Callable[[List[List[int]]], Sequence[float]]
+              ) -> Dict[str, Any]:
+        """reward_fn receives the COMPLETION token lists (prompt
+        stripped) for all groups flattened, returns one float each."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg, ccfg = self.cfg, self.config
+        all_tokens, all_masks, all_advs, all_rewards = [], [], [], []
+        for prompt in prompts:
+            prompt = list(prompt)[: ccfg.max_prompt_len]
+            self._key, sub = jax.random.split(self._key)
+            tokens, mask = _sample_group(
+                self.params, cfg, prompt, ccfg.group_size,
+                ccfg.max_tokens, ccfg.temperature, sub)
+            completions = [row[len(prompt):].tolist() for row in tokens]
+            rewards = np.asarray(reward_fn(completions), np.float32)
+            # group-relative: the group's mean IS the baseline
+            adv = (rewards - rewards.mean()) / (rewards.std() + 1e-6)
+            adv = np.clip(adv, -ccfg.adv_clip, ccfg.adv_clip)
+            all_tokens.append(tokens)
+            all_masks.append(mask)
+            all_advs.append(adv)
+            all_rewards.extend(rewards.tolist())
+        # mixed prompt lengths: right-pad every group to the longest
+        # total. Pads sit AFTER each row's completion, so causal
+        # attention never lets them influence scored positions, and the
+        # mask (0 on pads) excludes them from the loss.
+        width = max(t.shape[1] for t in all_tokens)
+        all_tokens = [np.pad(t, ((0, 0), (0, width - t.shape[1])))
+                      for t in all_tokens]
+        all_masks = [np.pad(m, ((0, 0), (0, width - m.shape[1])))
+                     for m in all_masks]
+        batch = {
+            "tokens": jnp.asarray(np.concatenate(all_tokens)),
+            "mask": jnp.asarray(np.concatenate(all_masks)),
+            "advantages": jnp.asarray(np.concatenate(all_advs)),
+        }
+        self.params, self.opt_state, losses = _grpo_update(
+            self.params, self.ref_params, self.opt_state, batch,
+            ccfg.lr, kl_coef=ccfg.kl_coef, cfg=cfg)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "reward_mean": float(np.mean(all_rewards)),
+            "reward_std": float(np.std(all_rewards)),
+            "num_completions": len(all_rewards),
+            **{k: float(v) for k, v in losses.items()},
+        }

@@ -1,0 +1,243 @@
+"""IMPALA: asynchronous sampling + V-trace off-policy correction.
+
+Reference analog: rllib/algorithms/impala/ (the async Learner stack).
+Rebuilt TPU-first: the whole V-trace + policy-gradient update is ONE
+jitted function; asynchrony comes from the task plane — every EnvRunner
+actor keeps a sample() in flight, the learner consumes fragments as
+ray_tpu.wait surfaces them and pushes fresh weights only to the runner
+it just drained, so slow actors never gate fast ones (the architecture's
+point; Espeholt et al. 2018 defines the v-trace targets used here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .checkpoint import CheckpointableAlgorithm
+from .env import make_env
+from .ppo import EnvRunner, init_policy, policy_forward
+
+_IMPALA_UPDATE_JIT = None
+
+
+def impala_update(params, opt_state, batch, lr, *, gamma: float,
+                  vf_coef: float, ent_coef: float, rho_bar: float,
+                  c_bar: float):
+    global _IMPALA_UPDATE_JIT
+    if _IMPALA_UPDATE_JIT is None:
+        import jax
+
+        _IMPALA_UPDATE_JIT = jax.jit(
+            _impala_update_impl,
+            static_argnames=("gamma", "vf_coef", "ent_coef", "rho_bar",
+                             "c_bar"))
+    return _IMPALA_UPDATE_JIT(params, opt_state, batch, lr, gamma=gamma,
+                              vf_coef=vf_coef, ent_coef=ent_coef,
+                              rho_bar=rho_bar, c_bar=c_bar)
+
+
+def _impala_update_impl(params, opt_state, batch, lr, *, gamma: float,
+                        vf_coef: float, ent_coef: float, rho_bar: float,
+                        c_bar: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.adam(lr)
+
+    def loss_fn(p):
+        logits, values = policy_forward(p, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1)[:, 0]
+        # importance ratios vs the BEHAVIOR policy that sampled
+        rhos = jnp.exp(logp - batch["logp"])
+        clipped_rho = jnp.minimum(rho_bar, rhos)
+        clipped_c = jnp.minimum(c_bar, rhos)
+        nonterminal = 1.0 - batch["dones"]
+        values_next = jnp.concatenate(
+            [values[1:], batch["bootstrap_value"][None]])
+        # v-trace: vs_t = V_t + delta_t + gamma c_t (vs_{t+1} - V_{t+1}),
+        # swept right-to-left (stop-gradient through targets)
+        v = jax.lax.stop_gradient(values)
+        v_next = jax.lax.stop_gradient(values_next)
+        deltas = clipped_rho * (
+            batch["rewards"] + gamma * nonterminal * v_next - v)
+
+        def scan_fn(carry, inp):
+            delta, c, nt, v_nx = inp
+            acc = delta + gamma * nt * c * carry
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            scan_fn, jnp.float32(0.0),
+            (deltas, clipped_c, nonterminal, v_next), reverse=True)
+        vs = v + vs_minus_v
+        vs_next = jnp.concatenate([vs[1:], v_next[-1:]])
+        pg_adv = clipped_rho * (
+            batch["rewards"] + gamma * nonterminal * vs_next - v)
+        pi_loss = -(jax.lax.stop_gradient(pg_adv) * logp).mean()
+        vf_loss = jnp.square(values - jax.lax.stop_gradient(vs)).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pi_loss + vf_coef * vf_loss - ent_coef * entropy
+        return total, (pi_loss, vf_loss, entropy, rhos.mean())
+
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, {
+        "total_loss": loss, "policy_loss": aux[0], "vf_loss": aux[1],
+        "entropy": aux[2], "mean_rho": aux[3]}
+
+
+@dataclass
+class IMPALAConfig:
+    env: Any = "CartPole-v1"
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 256
+    lr: float = 6e-4
+    gamma: float = 0.99
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    vtrace_rho_clip: float = 1.0
+    vtrace_c_clip: float = 1.0
+    # how many fragments one train() call consumes (each triggers an
+    # update — IMPALA updates per-fragment, not per-epoch)
+    fragments_per_iter: int = 4
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "IMPALAConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "IMPALAConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "IMPALAConfig":
+        for key, val in kwargs.items():
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training option {key!r}")
+            setattr(self, key, val)
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALA(CheckpointableAlgorithm):
+    """Async actor-learner: one sample() stays in flight per runner;
+    fragments are consumed in completion order (ray_tpu.wait), each
+    immediately updating the learner and refreshing only the drained
+    runner's weights."""
+
+    def __init__(self, config: IMPALAConfig):
+        import jax
+        import optax
+
+        import ray_tpu
+
+        self.config = config
+        probe = make_env(config.env, seed=0)
+        self.obs_dim = probe.observation_dim
+        self.act_dim = probe.action_dim
+        self.params = init_policy(jax.random.PRNGKey(config.seed),
+                                  self.obs_dim, self.act_dim,
+                                  config.hidden)
+        self.opt_state = optax.adam(config.lr).init(self.params)
+        self.iteration = 0
+
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            runner_cls.remote(config.env, config.hidden,
+                              config.seed + 100 + i)
+            for i in range(config.num_env_runners)
+        ]
+        self._inflight: Dict[Any, Any] = {}  # ref -> runner
+        from .checkpoint import broadcast_suppressed
+
+        if not broadcast_suppressed():
+            self._broadcast_all()
+
+    def _host_params(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def _broadcast_all(self) -> None:
+        import ray_tpu
+
+        ray_tpu.get([r.set_params.remote(self._host_params())
+                     for r in self.runners], timeout=120)
+
+    def _launch(self, runner) -> None:
+        ref = runner.sample.remote(self.config.rollout_fragment_length)
+        self._inflight[ref] = runner
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        import ray_tpu
+
+        cfg = self.config
+        for runner in self.runners:
+            if runner not in self._inflight.values():
+                self._launch(runner)
+        losses: Dict[str, float] = {}
+        ep_returns: list = []
+        consumed = 0
+        while consumed < cfg.fragments_per_iter:
+            ready, _ = ray_tpu.wait(list(self._inflight),
+                                    num_returns=1, timeout=300)
+            if not ready:
+                raise TimeoutError("no fragment arrived within 300 s")
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            frag = ray_tpu.get(ref)
+            batch = {
+                "obs": jnp.asarray(frag["obs"]),
+                "actions": jnp.asarray(frag["actions"]),
+                "rewards": jnp.asarray(frag["rewards"]),
+                "dones": jnp.asarray(frag["dones"]),
+                "logp": jnp.asarray(frag["logp"]),
+                "bootstrap_value": jnp.asarray(frag["bootstrap_value"]),
+            }
+            self.params, self.opt_state, losses = impala_update(
+                self.params, self.opt_state, batch, cfg.lr,
+                gamma=cfg.gamma, vf_coef=cfg.vf_loss_coeff,
+                ent_coef=cfg.entropy_coeff,
+                rho_bar=cfg.vtrace_rho_clip, c_bar=cfg.vtrace_c_clip)
+            ep_returns.extend(frag["episode_returns"].tolist())
+            # fresh weights to the runner we just drained, then relaunch
+            ray_tpu.get(runner.set_params.remote(self._host_params()),
+                        timeout=60)
+            self._launch(runner)
+            consumed += 1
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(ep_returns))
+                                    if ep_returns else float("nan")),
+            "episodes_this_iter": len(ep_returns),
+            "timesteps_this_iter": consumed * cfg.rollout_fragment_length,
+            **{k: float(v) for k, v in losses.items()},
+        }
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for runner in self.runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:
+                pass
+        self.runners = []
+        self._inflight.clear()

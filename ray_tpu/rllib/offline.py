@@ -1,0 +1,283 @@
+"""Offline RL: rollout recording, a dataset reader, BC and MARWIL.
+
+Reference analog: rllib/offline/ (dataset writers/readers feeding
+offline algorithms) + rllib/algorithms/{bc,marwil}. The dataset rides
+ray_tpu.data (npz shards -> Dataset), so offline training composes with
+the same data plane everything else uses.
+
+  * BC — behavior cloning: maximize log pi(a|s) over the dataset.
+  * MARWIL — advantage-weighted BC (Wang et al. 2018): a value baseline
+    is regressed on monte-carlo returns and the imitation term is
+    weighted exp(beta * normalized advantage), so better-than-average
+    behavior is imitated harder. beta=0 reduces exactly to BC.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .checkpoint import CheckpointableAlgorithm
+from .env import make_env
+from .ppo import init_policy, policy_forward
+
+__all__ = ["record_rollouts", "read_rollouts", "rollout_dataset",
+           "BC", "BCConfig", "MARWIL", "MARWILConfig"]
+
+
+# ---------------------------------------------------------------------------
+# Dataset: write/read npz shards of (obs, action, reward, done) steps.
+# ---------------------------------------------------------------------------
+
+
+def record_rollouts(env_spec: Any, path: str, *, num_steps: int,
+                    policy_params=None, hidden=(64, 64), seed: int = 0,
+                    shard_steps: int = 4096) -> List[str]:
+    """Roll a policy (random when params is None) and write npz shards.
+    Returns the shard paths (ref: rllib/offline/output_writer)."""
+    env = make_env(env_spec, seed=seed)
+    rng = np.random.default_rng(seed)
+    os.makedirs(path, exist_ok=True)
+    obs, _ = env.reset(seed=seed)
+    shards: List[str] = []
+    buf: Dict[str, list] = {k: [] for k in
+                            ("obs", "actions", "rewards", "dones")}
+
+    def flush():
+        if not buf["obs"]:
+            return
+        shard_path = os.path.join(path, f"shard_{len(shards):05d}.npz")
+        np.savez(shard_path,
+                 obs=np.asarray(buf["obs"], np.float32),
+                 actions=np.asarray(buf["actions"], np.int32),
+                 rewards=np.asarray(buf["rewards"], np.float32),
+                 dones=np.asarray(buf["dones"], np.float32))
+        shards.append(shard_path)
+        for v in buf.values():
+            v.clear()
+
+    for _ in range(num_steps):
+        if policy_params is None:
+            action = int(rng.integers(env.action_dim))
+        else:
+            import jax.numpy as jnp
+
+            logits, _ = policy_forward(policy_params,
+                                       jnp.asarray(obs[None, :]))
+            logits = np.asarray(logits)[0].astype(np.float64)
+            probs = np.exp(logits - logits.max())
+            probs /= probs.sum()
+            action = int(rng.choice(len(probs), p=probs))
+        nxt, reward, terminated, truncated, _ = env.step(action)
+        buf["obs"].append(obs)
+        buf["actions"].append(action)
+        buf["rewards"].append(reward)
+        buf["dones"].append(float(terminated or truncated))
+        obs = nxt
+        if terminated or truncated:
+            obs, _ = env.reset()
+        if len(buf["obs"]) >= shard_steps:
+            flush()
+    flush()
+    return shards
+
+
+def read_rollouts(path: str) -> Dict[str, np.ndarray]:
+    """All shards under `path`, concatenated (ref: offline input
+    readers)."""
+    shards = sorted(
+        os.path.join(path, f) for f in os.listdir(path)
+        if f.endswith(".npz"))
+    if not shards:
+        raise FileNotFoundError(f"no .npz rollout shards under {path}")
+    parts = [np.load(s) for s in shards]
+    return {k: np.concatenate([p[k] for p in parts])
+            for k in ("obs", "actions", "rewards", "dones")}
+
+
+def rollout_dataset(path: str):
+    """The shards as a ray_tpu.data Dataset of step rows — the offline
+    pipeline entry for transforms/splits before training."""
+    from .. import data as rdata
+
+    rows = read_rollouts(path)
+    n = len(rows["actions"])
+    return rdata.from_items([
+        {k: rows[k][i] for k in rows} for i in range(n)])
+
+
+def _mc_returns(rewards: np.ndarray, dones: np.ndarray,
+                gamma: float) -> np.ndarray:
+    """Monte-carlo return-to-go per step, cut at episode bounds."""
+    out = np.zeros_like(rewards)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        acc = rewards[t] + gamma * acc * (1.0 - dones[t])
+        out[t] = acc
+    return out
+
+
+# ---------------------------------------------------------------------------
+# BC / MARWIL learners (one jitted epoch; beta=0 == BC).
+# ---------------------------------------------------------------------------
+
+_MARWIL_JIT = None
+
+
+def _marwil_update(params, opt_state, batch, lr, *, beta: float,
+                   vf_coef: float):
+    global _MARWIL_JIT
+    if _MARWIL_JIT is None:
+        import jax
+
+        _MARWIL_JIT = jax.jit(_marwil_impl,
+                              static_argnames=("beta", "vf_coef"))
+    return _MARWIL_JIT(params, opt_state, batch, lr, beta=beta,
+                       vf_coef=vf_coef)
+
+
+def _marwil_impl(params, opt_state, batch, lr, *, beta: float,
+                 vf_coef: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.adam(lr)
+
+    def loss_fn(p):
+        logits, values = policy_forward(p, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1)[:, 0]
+        adv = batch["returns"] - jax.lax.stop_gradient(values)
+        if beta > 0.0:
+            norm = jnp.sqrt(jnp.mean(jnp.square(adv)) + 1e-8)
+            weight = jnp.exp(jnp.clip(beta * adv / norm, -5.0, 5.0))
+        else:
+            weight = jnp.ones_like(adv)  # pure BC
+        imitation = -(jax.lax.stop_gradient(weight) * logp).mean()
+        vf_loss = jnp.square(values - batch["returns"]).mean()
+        total = imitation + vf_coef * vf_loss
+        return total, (imitation, vf_loss, logp.mean())
+
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, {"total_loss": loss, "imitation_loss": aux[0],
+                               "vf_loss": aux[1], "mean_logp": aux[2]}
+
+
+@dataclass
+class MARWILConfig:
+    env: Any = "CartPole-v1"          # for obs/act dims + eval
+    input_path: str = ""              # rollout shard directory
+    beta: float = 1.0                 # 0.0 == behavior cloning
+    lr: float = 1e-3
+    gamma: float = 0.99
+    vf_loss_coeff: float = 1.0
+    train_batch_size: int = 512
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "MARWILConfig":
+        self.env = env
+        return self
+
+    def offline_data(self, input_path: str) -> "MARWILConfig":
+        self.input_path = input_path
+        return self
+
+    def training(self, **kwargs) -> "MARWILConfig":
+        for key, val in kwargs.items():
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training option {key!r}")
+            setattr(self, key, val)
+        return self
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+class MARWIL(CheckpointableAlgorithm):
+    def __init__(self, config: MARWILConfig):
+        import jax
+        import optax
+
+        self.config = config
+        probe = make_env(config.env, seed=0)
+        self.obs_dim = probe.observation_dim
+        self.act_dim = probe.action_dim
+        self.params = init_policy(jax.random.PRNGKey(config.seed),
+                                  self.obs_dim, self.act_dim,
+                                  config.hidden)
+        self.opt_state = optax.adam(config.lr).init(self.params)
+        self.iteration = 0
+        rows = read_rollouts(config.input_path)
+        self._data = {
+            "obs": rows["obs"],
+            "actions": rows["actions"],
+            "returns": _mc_returns(rows["rewards"], rows["dones"],
+                                   config.gamma),
+        }
+        self._rng = np.random.default_rng(config.seed)
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        cfg = self.config
+        n = len(self._data["actions"])
+        idx = self._rng.integers(0, n, min(cfg.train_batch_size, n))
+        batch = {
+            "obs": jnp.asarray(self._data["obs"][idx]),
+            "actions": jnp.asarray(self._data["actions"][idx]),
+            "returns": jnp.asarray(self._data["returns"][idx]),
+        }
+        self.params, self.opt_state, losses = _marwil_update(
+            self.params, self.opt_state, batch, cfg.lr,
+            beta=cfg.beta, vf_coef=cfg.vf_loss_coeff)
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "timesteps_this_iter": int(len(idx)),
+                **{k: float(v) for k, v in losses.items()}}
+
+    def evaluate(self, episodes: int = 5) -> Dict[str, float]:
+        """Greedy policy rollouts in a live env — the offline algo's
+        only ground truth."""
+        import jax.numpy as jnp
+
+        env = make_env(self.config.env, seed=self.config.seed + 999)
+        returns = []
+        for ep in range(episodes):
+            obs, _ = env.reset(seed=self.config.seed + 1000 + ep)
+            total, done = 0.0, False
+            while not done:
+                logits, _ = policy_forward(self.params,
+                                           jnp.asarray(obs[None, :]))
+                action = int(np.asarray(logits)[0].argmax())
+                obs, reward, terminated, truncated, _ = env.step(action)
+                total += reward
+                done = terminated or truncated
+            returns.append(total)
+        return {"episode_reward_mean": float(np.mean(returns)),
+                "episodes": episodes}
+
+    def stop(self) -> None:
+        pass
+
+
+@dataclass
+class BCConfig(MARWILConfig):
+    """Behavior cloning == MARWIL with beta pinned to 0
+    (ref: rllib/algorithms/bc — same inheritance relationship)."""
+
+    beta: float = 0.0
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC(MARWIL):
+    pass
