@@ -384,6 +384,31 @@ class SharedObjectStore:
                 or os.path.exists(os.path.join(self.dir,
                                                oid.hex() + ".spilling")))
 
+    def size(self, oid: ObjectID) -> int:
+        """Sealed size WITHOUT mapping the object, touching LRU order,
+        or restoring a spilled copy (admission/budget checks must not
+        re-inflate the memory they exist to bound). 0 = unknown."""
+        if self._idx is not None:
+            state, size = self._idx.lookup(oid.binary(), touch=False)
+            if state == 0:
+                return size
+        else:
+            with self._lock:
+                entry = self._entries.get(oid)
+                if entry is not None and entry.sealed:
+                    return entry.size
+            try:
+                return os.path.getsize(self._path(oid))
+            except OSError:
+                pass
+        path = self._spill_path(oid)
+        if path is not None:
+            try:
+                return os.path.getsize(path)
+            except OSError:
+                pass
+        return 0
+
     def pin(self, oid: ObjectID) -> None:
         if self._idx is not None:
             self._idx.pin(oid.binary())  # node-global: protects from

@@ -27,7 +27,8 @@ import tarfile
 from typing import Any, Dict, List, Optional
 
 _KV_NS = "runtime_envs"
-_ALLOWED = {"env_vars", "working_dir", "py_modules", "config", "pip", "uv"}
+_ALLOWED = {"env_vars", "working_dir", "py_modules", "config", "pip", "uv",
+            "conda", "container"}
 
 
 def _pack_dir(path: str) -> bytes:
@@ -92,10 +93,92 @@ def prepare_runtime_env(core, runtime_env: Optional[dict]) -> Optional[dict]:
                             "strings")
         wire[installer] = sorted(reqs)
         hasher.update(f"{installer}:{wire[installer]!r}".encode())
+    if "conda" in runtime_env:
+        # empty spec is a typo, not a no-op: validate-at-submission
+        wire["conda"] = _canonical_conda_spec(runtime_env["conda"])
+        hasher.update(f"conda:{wire['conda']!r}".encode())
+    if "container" in runtime_env:
+        container = runtime_env["container"]
+        # stub behind a capability check (ref: _private/runtime_env/
+        # image_uri.py): the image field is validated and the missing
+        # runtime reported at SUBMISSION time, not as a worker crash
+        import shutil as _shutil
+
+        if not isinstance(container, dict) or "image" not in container:
+            raise ValueError(
+                'container runtime_env must be {"image": "..."} ')
+        if not (_shutil.which("docker") or _shutil.which("podman")):
+            raise RuntimeError(
+                "container runtime_env requires docker or podman on "
+                "this node; neither is installed")
+        raise NotImplementedError(
+            "container runtime_env: image execution is not wired into "
+            "this deployment's worker launcher yet")
     if not wire:
         return None
     wire["hash"] = hasher.hexdigest()[:16]
     return wire
+
+
+def _canonical_conda_spec(conda) -> dict:
+    """Normalize the conda field (ref: _private/runtime_env/conda.py):
+    a dict environment spec, a path to an environment.yml, or the name
+    of a pre-built env."""
+    if not conda:
+        raise ValueError("conda runtime_env must not be empty")
+    if isinstance(conda, str):
+        if conda.endswith((".yml", ".yaml")):
+            import json as _json
+
+            try:
+                import yaml
+
+                with open(conda) as f:
+                    spec = yaml.safe_load(f)
+            except ImportError:
+                try:
+                    with open(conda) as f:
+                        spec = _json.loads(f.read())
+                except ValueError:
+                    raise RuntimeError(
+                        f"parsing {conda!r} requires pyyaml (not "
+                        "installed); JSON-formatted environment files "
+                        "work without it") from None
+            if not isinstance(spec, dict):
+                raise TypeError(f"conda file {conda!r} must hold a mapping")
+            return {"spec": spec}
+        return {"name": conda}  # existing named env
+    if isinstance(conda, dict):
+        return {"spec": conda}
+    raise TypeError("conda must be a spec dict, a .yml path, or an "
+                    "env name")
+
+
+def _atomic_materialize(root: str, build) -> str:
+    """Build-once local cache: ``build(tmp_dir)`` populates a fresh
+    directory that becomes ``root`` atomically; a concurrent builder
+    loses the rename cleanly and adopts the winner's result. The
+    ``.ready`` marker inside root is the completion witness (a crash
+    mid-build leaves no marker, so the next caller rebuilds)."""
+    import shutil
+
+    marker = os.path.join(root, ".ready")
+    if os.path.exists(marker):
+        return root
+    tmp = root + f".tmp.{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp, exist_ok=True)
+    try:
+        build(tmp)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    open(os.path.join(tmp, ".ready"), "w").close()
+    try:
+        os.rename(tmp, root)
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return root
 
 
 def _materialize_venv(requirements: List[str], installer: str) -> str:
@@ -111,44 +194,117 @@ def _materialize_venv(requirements: List[str], installer: str) -> str:
         f"{installer}:{requirements!r}:{sys.version_info[:2]}".encode()
     ).hexdigest()[:16]
     root = os.path.join("/tmp/ray_tpu_runtime_envs", f"venv_{key}")
-    marker = os.path.join(root, ".ready")
     site = os.path.join(
         root, "lib", f"python{sys.version_info[0]}.{sys.version_info[1]}",
         "site-packages")
-    if os.path.exists(marker):
-        return site
-    tmp = root + f".tmp.{os.getpid()}"
+    def build(tmp):
+        import shutil
+
+        # venv must be created IN PLACE over the pre-made tmp dir
+        shutil.rmtree(tmp, ignore_errors=True)
+        uv = shutil.which("uv") if installer == "uv" else None
+        if uv:
+            subprocess.run([uv, "venv", "--system-site-packages", tmp],
+                           check=True, capture_output=True, timeout=300)
+            install = [uv, "pip", "install", "--python",
+                       os.path.join(tmp, "bin", "python")] \
+                + list(requirements)
+        else:
+            subprocess.run([sys.executable, "-m", "venv",
+                            "--system-site-packages", tmp],
+                           check=True, capture_output=True, timeout=300)
+            # --no-build-isolation: sdists build against the venv's
+            # visible setuptools (system-site) instead of pip fetching a
+            # build env from an index — keeps air-gapped clusters working
+            install = [os.path.join(tmp, "bin", "python"), "-m", "pip",
+                       "install", "--no-input", "--no-build-isolation"] \
+                + list(requirements)
+        proc = subprocess.run(install, capture_output=True, timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"runtime_env {installer} install failed: "
+                f"{proc.stderr.decode(errors='replace')[-2000:]}")
+
+    _atomic_materialize(root, build)
+    return site
+
+
+def _conda_binary() -> str:
+    """The node's conda-compatible solver, capability-checked (ref:
+    _private/runtime_env/conda.py get_conda_activate_commands)."""
     import shutil
 
-    shutil.rmtree(tmp, ignore_errors=True)
-    uv = shutil.which("uv") if installer == "uv" else None
-    if uv:
-        subprocess.run([uv, "venv", "--system-site-packages", tmp],
-                       check=True, capture_output=True, timeout=300)
-        install = [uv, "pip", "install", "--python",
-                   os.path.join(tmp, "bin", "python")] + list(requirements)
-    else:
-        subprocess.run([sys.executable, "-m", "venv",
-                        "--system-site-packages", tmp],
-                       check=True, capture_output=True, timeout=300)
-        # --no-build-isolation: sdists build against the venv's visible
-        # setuptools (system-site) instead of pip fetching a build env
-        # from an index — keeps air-gapped clusters working
-        install = [os.path.join(tmp, "bin", "python"), "-m", "pip",
-                   "install", "--no-input", "--no-build-isolation"] \
-            + list(requirements)
-    proc = subprocess.run(install, capture_output=True, timeout=1800)
-    if proc.returncode != 0:
-        shutil.rmtree(tmp, ignore_errors=True)
+    for name in ("mamba", "micromamba", "conda"):
+        path = shutil.which(name)
+        if path:
+            return path
+    raise RuntimeError(
+        "conda runtime_env requires conda/mamba/micromamba on this "
+        "node; none is installed")
+
+
+def _conda_site_packages(env_root: str) -> str:
+    import glob
+
+    hits = sorted(glob.glob(os.path.join(env_root, "lib", "python*",
+                                         "site-packages")))
+    if not hits:
         raise RuntimeError(
-            f"runtime_env {installer} install failed: "
-            f"{proc.stderr.decode(errors='replace')[-2000:]}")
-    open(os.path.join(tmp, ".ready"), "w").close()
-    try:
-        os.rename(tmp, root)  # atomic; concurrent builder loses cleanly
-    except OSError:
-        shutil.rmtree(tmp, ignore_errors=True)
-    return site
+            f"conda env at {env_root} has no python site-packages")
+    return hits[-1]
+
+
+def _materialize_conda(canonical: dict) -> str:
+    """Create (or reuse) the conda env; returns its site-packages.
+
+    Adoption model matches the pip/uv path: the env's site-packages is
+    prepended to sys.path of the (base-interpreter) worker — pure-python
+    and ABI-compatible deps resolve from the env. (The reference swaps
+    the whole worker interpreter; that needs per-lease worker exec and
+    is stated, not hidden.) Cache key = canonical spec, so every worker
+    on the node shares one materialized env per spec."""
+    import json as _json
+    import subprocess
+
+    conda = _conda_binary()
+    if "name" in canonical:
+        # pre-built named env: resolve its prefix via the solver
+        proc = subprocess.run([conda, "env", "list", "--json"],
+                              capture_output=True, timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "conda env list failed: "
+                + proc.stderr.decode(errors="replace")[-500:])
+        try:
+            envs = _json.loads(proc.stdout or b"{}").get("envs", [])
+        except ValueError:
+            raise RuntimeError(
+                "conda env list produced non-JSON output: "
+                + proc.stdout.decode(errors="replace")[:500]) from None
+        for prefix in envs:
+            if os.path.basename(prefix) == canonical["name"]:
+                return _conda_site_packages(prefix)
+        raise RuntimeError(
+            f"conda env {canonical['name']!r} not found on this node")
+    spec = canonical["spec"]
+    key = hashlib.sha256(
+        _json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+    root = os.path.join("/tmp/ray_tpu_runtime_envs", f"conda_{key}")
+
+    def build(tmp):
+        spec_file = os.path.join(tmp, "environment.json")
+        with open(spec_file, "w") as f:
+            _json.dump(spec, f)
+        proc = subprocess.run(
+            [conda, "env", "create", "-p", tmp, "-f", spec_file, "--yes"],
+            capture_output=True, timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "conda env create failed: "
+                + proc.stderr.decode(errors="replace")[-2000:])
+
+    _atomic_materialize(root, build)
+    return _conda_site_packages(root)
 
 
 def apply_runtime_env(core, wire: Optional[dict],
@@ -162,25 +318,17 @@ def apply_runtime_env(core, wire: Optional[dict],
         return
 
     def materialize(key: str) -> str:
-        root = os.path.join("/tmp/ray_tpu_runtime_envs", key)
-        marker = os.path.join(root, ".ready")
-        if not os.path.exists(marker):
+        def build(tmp):
             blob = core.io.run(core.gcs.call(
                 "kv_get", {"ns": _KV_NS, "key": key}))
             if blob is None:
-                raise RuntimeError(f"runtime_env blob {key} missing from GCS")
-            tmp = root + f".tmp.{os.getpid()}"
-            os.makedirs(tmp, exist_ok=True)
+                raise RuntimeError(
+                    f"runtime_env blob {key} missing from GCS")
             with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
                 tar.extractall(tmp, filter="data")
-            open(os.path.join(tmp, ".ready"), "w").close()
-            try:
-                os.rename(tmp, root)  # atomic; loser cleans up
-            except OSError:
-                import shutil
 
-                shutil.rmtree(tmp, ignore_errors=True)
-        return root
+        return _atomic_materialize(
+            os.path.join("/tmp/ray_tpu_runtime_envs", key), build)
 
     for key, value in (wire.get("env_vars") or {}).items():
         os.environ[key] = value
@@ -190,6 +338,10 @@ def apply_runtime_env(core, wire: Optional[dict],
             site = _materialize_venv(reqs, installer)
             if site not in sys.path:
                 sys.path.insert(0, site)
+    if wire.get("conda"):
+        site = _materialize_conda(wire["conda"])
+        if site not in sys.path:
+            sys.path.insert(0, site)
     for key in wire.get("py_module_keys") or []:
         path = materialize(key)
         if path not in sys.path:

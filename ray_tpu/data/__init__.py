@@ -22,6 +22,8 @@ from .datasource import (
     RangeDatasource,
     ReadTask,
 )
+from .aggregate import (AbsMax, AggregateFn, Count, Max, Mean, Min, Std,
+                        Sum)
 from .grouped import GroupedData
 
 _DEFAULT_PARALLELISM = 8
@@ -207,6 +209,7 @@ def from_torch(torch_dataset, *,
 __all__ = [
     "Block", "Dataset", "DataIterator", "Datasource", "ReadTask",
     "GroupedData",
+    "AggregateFn", "Count", "Sum", "Min", "Max", "Mean", "Std", "AbsMax",
     "read_datasource", "range", "from_items", "read_parquet", "read_json",
     "read_numpy", "read_csv", "read_tfrecords", "read_images",
     "read_text", "read_binary_files", "read_sql", "read_webdataset",

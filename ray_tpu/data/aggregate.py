@@ -1,0 +1,134 @@
+"""User-defined aggregations (ref: python/ray/data/aggregate.py
+AggregateFn + the built-in Count/Sum/Min/Max/Mean/Std/AbsMax family,
+driven by GroupedData.aggregate at grouped_data.py:49).
+
+An AggregateFn is the classic fold triple: `init(key)` makes an
+accumulator, `accumulate_block(acc, rows)` folds one block's rows of a
+group into it, `merge(a, b)` combines accumulators from different
+blocks, `finalize(acc)` produces the output value. Per-block
+accumulation runs as remote tasks (one per input block), so only
+accumulator-sized state — not rows — crosses the exchange.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+__all__ = ["AggregateFn", "Count", "Sum", "Min", "Max", "Mean", "Std",
+           "AbsMax"]
+
+
+class AggregateFn:
+    def __init__(self, *,
+                 init: Callable[[Any], Any],
+                 merge: Callable[[Any, Any], Any],
+                 accumulate_row: Optional[Callable[[Any, dict], Any]] = None,
+                 accumulate_block: Optional[Callable[[Any, List[dict]], Any]] = None,
+                 finalize: Optional[Callable[[Any], Any]] = None,
+                 name: str = "agg"):
+        if accumulate_row is None and accumulate_block is None:
+            raise ValueError(
+                "provide accumulate_row or accumulate_block")
+        if accumulate_block is None:
+            def accumulate_block(acc, rows,
+                                 _row_fn=accumulate_row):
+                for row in rows:
+                    acc = _row_fn(acc, row)
+                return acc
+        self.init = init
+        self.merge = merge
+        self.accumulate_block = accumulate_block
+        self.finalize = finalize or (lambda acc: acc)
+        self.name = name
+
+
+def Count() -> AggregateFn:
+    return AggregateFn(
+        init=lambda k: 0,
+        accumulate_block=lambda acc, rows: acc + len(rows),
+        merge=lambda a, b: a + b,
+        name="count()")
+
+
+def _np_fold(value_key: str, np_fn, merge, name, finalize=None,
+             empty=None) -> AggregateFn:
+    import numpy as np
+
+    def accumulate_block(acc, rows):
+        vals = np.asarray([row[value_key] for row in rows])
+        part = np_fn(vals) if len(vals) else empty
+        if part is None:
+            return acc
+        return part if acc is None else merge(acc, part)
+
+    return AggregateFn(
+        init=lambda k: None,
+        accumulate_block=accumulate_block,
+        merge=lambda a, b: (b if a is None else a if b is None
+                            else merge(a, b)),
+        finalize=finalize or (lambda acc: acc),
+        name=f"{name}({value_key})")
+
+
+def Sum(on: str) -> AggregateFn:
+    import numpy as np
+
+    return _np_fold(on, np.sum, lambda a, b: a + b, "sum")
+
+
+def Min(on: str) -> AggregateFn:
+    import numpy as np
+
+    return _np_fold(on, np.min, min, "min")
+
+
+def Max(on: str) -> AggregateFn:
+    import numpy as np
+
+    return _np_fold(on, np.max, max, "max")
+
+
+def AbsMax(on: str) -> AggregateFn:
+    import numpy as np
+
+    return _np_fold(on, lambda v: np.max(np.abs(v)), max, "abs_max")
+
+
+def Mean(on: str) -> AggregateFn:
+    import numpy as np
+
+    def accumulate_block(acc, rows):
+        vals = np.asarray([row[on] for row in rows], np.float64)
+        return (acc[0] + vals.sum(), acc[1] + len(vals))
+
+    return AggregateFn(
+        init=lambda k: (0.0, 0),
+        accumulate_block=accumulate_block,
+        merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        finalize=lambda acc: acc[0] / acc[1] if acc[1] else float("nan"),
+        name=f"mean({on})")
+
+
+def Std(on: str, ddof: int = 0) -> AggregateFn:
+    """Merged via count/sum/sum-of-squares so block accumulators
+    combine exactly."""
+    import numpy as np
+
+    def accumulate_block(acc, rows):
+        vals = np.asarray([row[on] for row in rows], np.float64)
+        return (acc[0] + len(vals), acc[1] + vals.sum(),
+                acc[2] + np.square(vals).sum())
+
+    def finalize(acc):
+        n, s, ss = acc
+        if n - ddof <= 0:
+            return float("nan")
+        var = (ss - s * s / n) / (n - ddof)
+        return float(np.sqrt(max(var, 0.0)))
+
+    return AggregateFn(
+        init=lambda k: (0, 0.0, 0.0),
+        accumulate_block=accumulate_block,
+        merge=lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
+        finalize=finalize,
+        name=f"std({on})")

@@ -34,6 +34,11 @@ class _LogicalOp:
     name: str
     args: Dict[str, Any] = field(default_factory=dict)
     remote_args: Dict[str, Any] = field(default_factory=dict)
+    # per-operator execution budget (ref: _internal/execution/
+    # resource_manager.py operator budgets): max_inflight caps this
+    # op's concurrent tasks, memory_budget_bytes caps the summed size
+    # of its in-flight input blocks
+    budget: Dict[str, Any] = field(default_factory=dict)
 
 
 def _norm_remote_args(kwargs: dict) -> dict:
@@ -44,6 +49,18 @@ def _norm_remote_args(kwargs: dict) -> dict:
     if kwargs:
         raise ValueError(f"unknown remote args: {sorted(kwargs)}")
     return out
+
+
+def _pop_budget(kwargs: dict) -> dict:
+    """Split per-operator budget options off the ray remote args
+    (concurrency/memory caps govern dispatch, not the task itself)."""
+    budget = {}
+    if "max_inflight" in kwargs:
+        budget["max_inflight"] = int(kwargs.pop("max_inflight"))
+    if "memory_budget_bytes" in kwargs:
+        budget["memory_budget_bytes"] = int(
+            kwargs.pop("memory_budget_bytes"))
+    return budget
 
 
 class Dataset:
@@ -63,6 +80,7 @@ class Dataset:
                     batch_format: str = "numpy", **ray_remote_args) -> "Dataset":
         """Apply fn to batches (ref: dataset.py:408). fn: dict[str, ndarray]
         -> dict[str, ndarray] under the default numpy format."""
+        budget = _pop_budget(ray_remote_args)
         remote_args = _norm_remote_args(ray_remote_args)
 
         def block_fn(block):
@@ -81,9 +99,10 @@ class Dataset:
 
         return self._append(_LogicalOp(
             "map_block", f"map_batches({getattr(fn, '__name__', 'fn')})",
-            {"block_fn": block_fn}, remote_args))
+            {"block_fn": block_fn}, remote_args, budget))
 
     def map(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        budget = _pop_budget(ray_remote_args)
         remote_args = _norm_remote_args(ray_remote_args)
 
         def block_fn(block):
@@ -91,9 +110,10 @@ class Dataset:
 
         return self._append(_LogicalOp(
             "map_block", f"map({getattr(fn, '__name__', 'fn')})",
-            {"block_fn": block_fn}, remote_args))
+            {"block_fn": block_fn}, remote_args, budget))
 
     def flat_map(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        budget = _pop_budget(ray_remote_args)
         remote_args = _norm_remote_args(ray_remote_args)
 
         def block_fn(block):
@@ -103,9 +123,11 @@ class Dataset:
             return out
 
         return self._append(_LogicalOp(
-            "map_block", "flat_map", {"block_fn": block_fn}, remote_args))
+            "map_block", "flat_map", {"block_fn": block_fn}, remote_args,
+            budget))
 
     def filter(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        budget = _pop_budget(ray_remote_args)
         remote_args = _norm_remote_args(ray_remote_args)
 
         def block_fn(block):
@@ -124,7 +146,8 @@ class Dataset:
             return [row for row in block if fn(row)]
 
         return self._append(_LogicalOp(
-            "map_block", "filter", {"block_fn": block_fn}, remote_args))
+            "map_block", "filter", {"block_fn": block_fn}, remote_args,
+            budget))
 
     def select_columns(self, cols) -> "Dataset":
         """Keep only the named columns (ref: dataset.py select_columns).
@@ -278,6 +301,21 @@ class Dataset:
         if not parts:
             return None
         return np.concatenate(parts)
+
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        """Whole-dataset aggregation with AggregateFns (ref:
+        dataset.py Dataset.aggregate) — one accumulator per agg folded
+        over every block, merged, finalized into {name: value}."""
+        accs = [None] * len(aggs)
+        for block in self.iter_blocks():
+            rows = list(rows_of(block))
+            for i, agg in enumerate(aggs):
+                part = agg.accumulate_block(agg.init(None), rows)
+                accs[i] = part if accs[i] is None else \
+                    agg.merge(accs[i], part)
+        return {agg.name: agg.finalize(acc if acc is not None
+                                       else agg.init(None))
+                for agg, acc in zip(aggs, accs)}
 
     def sum(self, key: str):
         col = self._column(key)

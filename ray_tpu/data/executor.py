@@ -212,10 +212,33 @@ class MapStage(_Stage):
     emission is head-of-line."""
 
     def __init__(self, name: str, in_q, out_q, block_fn: Callable,
-                 ray_remote_args: dict):
+                 ray_remote_args: dict, budget: Optional[dict] = None):
         super().__init__(name, out_q, in_q)
         self.block_fn = block_fn
         self.ray_remote_args = ray_remote_args
+        budget = budget or {}
+        self.max_inflight = budget.get("max_inflight",
+                                       MAX_INFLIGHT_PER_STAGE)
+        self.memory_budget = budget.get("memory_budget_bytes")
+
+    @staticmethod
+    def _ref_size(item) -> int:
+        """Plasma size of an input block ref (0 when unknowable) — the
+        basis for the per-operator memory budget. Uses the no-touch
+        store.size(): mapping (or restoring a spilled block) just to
+        read its length would re-create the pressure the budget caps."""
+        try:
+            from .._worker_api import _core
+
+            if _core is None or not hasattr(item, "id"):
+                return 0
+            size = _core.store.size(item.id())
+            if size:
+                return size
+            data = _core.memory_store.get(item.id())
+            return len(data) if data is not None else 0
+        except Exception:
+            return 0
 
     def _run(self):
         import collections
@@ -224,12 +247,13 @@ class MapStage(_Stage):
 
         map_task = remote(**self.ray_remote_args)(self.block_fn)
         inflight: "collections.deque" = collections.deque()
+        inflight_bytes = 0
         eof = False
         while True:
             # keep the task pool full; every wait is bounded so stop_event
             # (limit satisfied, stream torn down) always terminates the
             # stage — a stage thread must never outlive its executor
-            while not eof and len(inflight) < MAX_INFLIGHT_PER_STAGE:
+            while not eof and len(inflight) < self.max_inflight:
                 try:
                     item = self.in_q.get(timeout=0.2)
                 except queue.Empty:
@@ -242,16 +266,35 @@ class MapStage(_Stage):
                 if self.stop_event.is_set():
                     continue  # downstream satisfied: drop, don't dispatch
                 _store_backpressure_wait(self.stop_event)
-                inflight.append(map_task.remote(item))
+                size = 0
+                if self.memory_budget is not None:
+                    size = self._ref_size(item)
+                    # the operator's in-flight input bytes stay under
+                    # budget; a lone over-budget block still dispatches
+                    # so a big block can't wedge the stream
+                    while (inflight
+                           and inflight_bytes + size > self.memory_budget
+                           and not self.stop_event.is_set()):
+                        done, _ = wait([inflight[0][0]], num_returns=1,
+                                       timeout=0.2)
+                        if done:
+                            ref, sz = inflight.popleft()
+                            inflight_bytes -= sz
+                            if self._put_out(ref):
+                                self.stats.blocks_out += 1
+                inflight.append((map_task.remote(item), size))
+                inflight_bytes += size
                 self.stats.tasks_submitted += 1
             if not inflight:
                 if eof:
                     return
                 continue
-            head = inflight[0]
+            head = inflight[0][0]
             ready, _ = wait([head], num_returns=1, timeout=0.2)
             if ready:
-                if self._put_out(inflight.popleft()):
+                ref, size = inflight.popleft()
+                inflight_bytes -= size
+                if self._put_out(ref):
                     self.stats.blocks_out += 1
 
 
@@ -432,7 +475,8 @@ def _fuse_map_ops(plan):
     for op in plan[1:]:
         prev = fused[-1]
         if (op.kind == "map_block" and prev.kind == "map_block"
-                and op.remote_args == prev.remote_args):
+                and op.remote_args == prev.remote_args
+                and op.budget == prev.budget):
             first_fn = prev.args["block_fn"]
             second_fn = op.args["block_fn"]
 
@@ -441,7 +485,7 @@ def _fuse_map_ops(plan):
 
             fused[-1] = _LogicalOp(
                 "map_block", f"{prev.name}->{op.name}",
-                {"block_fn": chained}, prev.remote_args)
+                {"block_fn": chained}, prev.remote_args, prev.budget)
         else:
             fused.append(op)
     return fused
@@ -498,7 +542,7 @@ def build_executor(plan, parallelism: int) -> StreamingExecutor:
         next_q: "queue.Queue" = queue.Queue(maxsize=STAGE_QUEUE_CAP)
         if op.kind == "map_block":
             stages.append(MapStage(op.name, q, next_q, op.args["block_fn"],
-                                   op.remote_args))
+                                   op.remote_args, op.budget))
         elif op.kind == "shuffle":
             stages.append(ShuffleStage(q, next_q, op.args.get("seed"),
                                        op.remote_args))

@@ -16,6 +16,58 @@ class GroupedData:
         self._ds = dataset
         self._key = key
 
+    def aggregate(self, *aggs):
+        """Generic user aggregations (ref: grouped_data.py:49
+        ``aggregate(*AggregateFn)``). Per-block accumulation runs as one
+        remote task per block — only accumulator-sized partials (not
+        rows) cross the exchange — then partials merge per group and
+        finalize into one sorted columnar block."""
+        from .dataset import _LogicalOp
+
+        key = self._key
+        aggs = list(aggs)
+        if not aggs:
+            raise ValueError("aggregate() needs at least one AggregateFn")
+
+        def exchange(refs):
+            import numpy as np
+
+            from .. import get, put, remote
+            from .block import rows_of
+
+            def block_partials(block):
+                """{group: [accumulator per agg]} for one block."""
+                by_key = {}
+                for row in rows_of(block):
+                    k = row[key]
+                    k = k.item() if hasattr(k, "item") else k
+                    by_key.setdefault(k, []).append(row)
+                return {
+                    k: [agg.accumulate_block(agg.init(k), rows)
+                        for agg in aggs]
+                    for k, rows in by_key.items()}
+
+            task = remote(num_cpus=1)(block_partials)
+            partials = get([task.remote(ref) for ref in refs])
+            merged = {}
+            for part in partials:
+                for k, accs in part.items():
+                    cur = merged.get(k)
+                    merged[k] = accs if cur is None else [
+                        agg.merge(a, b)
+                        for agg, a, b in zip(aggs, cur, accs)]
+            keys_sorted = sorted(merged)
+            block = {key: np.asarray(keys_sorted)}
+            for i, agg in enumerate(aggs):
+                block[agg.name] = np.asarray(
+                    [agg.finalize(merged[k][i]) for k in keys_sorted])
+            return [put(block)]
+
+        names = ",".join(agg.name for agg in aggs)
+        return self._ds._append(_LogicalOp(
+            "all_to_all", f"groupby({key}).aggregate({names})",
+            {"fn": exchange}))
+
     def _aggregate(self, name: str,
                    agg_fn: Callable, value_key: Optional[str]):
         from .dataset import Dataset, _LogicalOp

@@ -71,7 +71,7 @@ def test_runtime_env_on_actor(ray_cluster):
 
 def test_runtime_env_rejects_unknown_keys(ray_cluster):
     with pytest.raises(ValueError, match="unsupported runtime_env"):
-        @ray_tpu.remote(runtime_env={"conda": "env"})
+        @ray_tpu.remote(runtime_env={"docker_image": "img"})
         def f():
             return 1
 

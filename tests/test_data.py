@@ -154,3 +154,97 @@ def test_executor_error_propagates(ray_cluster):
     ds = rd.range(10).map_batches(boom)
     with pytest.raises(ray_tpu.exceptions.TaskError, match="bad udf"):
         ds.take_all()
+
+
+def test_generic_aggregate_fns(ray_cluster):
+    """groupby().aggregate(*AggregateFn) with builtins + a custom fold
+    (ref: grouped_data.py:49)."""
+    import ray_tpu.data as rdata
+    from ray_tpu.data import AggregateFn, Count, Max, Mean, Std, Sum
+
+    ds = rdata.from_items([
+        {"g": i % 3, "v": float(i)} for i in range(30)])
+    out = ds.groupby("g").aggregate(
+        Count(), Sum("v"), Mean("v"), Max("v"), Std("v"),
+        AggregateFn(
+            init=lambda k: [],
+            accumulate_row=lambda acc, row: acc + [row["v"]],
+            merge=lambda a, b: a + b,
+            finalize=lambda acc: float(np.median(acc)),
+            name="median(v)"),
+    ).take_all()
+    assert len(out) == 3
+    for row in out:
+        g = row["g"]
+        vals = np.asarray([float(i) for i in range(30) if i % 3 == g])
+        assert row["count()"] == 10
+        np.testing.assert_allclose(row["sum(v)"], vals.sum())
+        np.testing.assert_allclose(row["mean(v)"], vals.mean())
+        np.testing.assert_allclose(row["max(v)"], vals.max())
+        np.testing.assert_allclose(row["std(v)"], vals.std(), rtol=1e-6)
+        np.testing.assert_allclose(row["median(v)"], np.median(vals))
+
+
+def test_dataset_level_aggregate(ray_cluster):
+    import ray_tpu.data as rdata
+    from ray_tpu.data import Mean, Min, Sum
+
+    ds = rdata.range(100)
+    out = ds.aggregate(Sum("id"), Mean("id"), Min("id"))
+    assert out["sum(id)"] == sum(range(100))
+    np.testing.assert_allclose(out["mean(id)"], 49.5)
+    assert out["min(id)"] == 0
+
+
+def test_per_op_max_inflight_budget(ray_cluster, tmp_path):
+    """map_batches(max_inflight=1) serializes that operator's tasks:
+    concurrent executions are observed via a lock-file counter from
+    inside the (separate-process) workers."""
+    import fcntl
+
+    import ray_tpu.data as rdata
+
+    counter = str(tmp_path / "counter")
+    peak_file = str(tmp_path / "peak")
+    for f in (counter, peak_file):
+        with open(f, "w") as fh:
+            fh.write("0")
+
+    def tracked(batch, _c=counter, _p=peak_file):
+        import fcntl as _f
+        import time as _t
+
+        def bump(path, delta):
+            with open(path, "r+") as fh:
+                _f.flock(fh, _f.LOCK_EX)
+                cur = int(fh.read() or 0) + delta
+                fh.seek(0), fh.truncate()
+                fh.write(str(cur))
+                return cur
+
+        cur = bump(_c, +1)
+        with open(_p, "r+") as fh:
+            _f.flock(fh, _f.LOCK_EX)
+            peak = max(int(fh.read() or 0), cur)
+            fh.seek(0), fh.truncate()
+            fh.write(str(peak))
+        _t.sleep(0.1)
+        bump(_c, -1)
+        return batch
+
+    ds = rdata.range(64, parallelism=8).map_batches(
+        tracked, max_inflight=1)
+    assert ds.count() == 64
+    with open(peak_file) as fh:
+        peak = int(fh.read())
+    assert peak == 1, f"budget violated: peak concurrency {peak}"
+
+
+def test_memory_budget_bounds_inflight_bytes(ray_cluster):
+    """A one-block memory budget still completes the whole stream (the
+    lone-block admission rule prevents wedging)."""
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(40, parallelism=4).map_batches(
+        lambda b: b, memory_budget_bytes=1)
+    assert ds.count() == 40
