@@ -292,12 +292,21 @@ def _materialize_conda(canonical: dict) -> str:
     root = os.path.join("/tmp/ray_tpu_runtime_envs", f"conda_{key}")
 
     def build(tmp):
-        spec_file = os.path.join(tmp, "environment.json")
+        # the spec lives BESIDE the prefix: real conda refuses to create
+        # into a non-empty directory
+        spec_file = tmp + ".spec.json"
         with open(spec_file, "w") as f:
             _json.dump(spec, f)
-        proc = subprocess.run(
-            [conda, "env", "create", "-p", tmp, "-f", spec_file, "--yes"],
-            capture_output=True, timeout=1800)
+        try:
+            proc = subprocess.run(
+                [conda, "env", "create", "-p", tmp, "-f", spec_file,
+                 "--yes"],
+                capture_output=True, timeout=1800)
+        finally:
+            try:
+                os.unlink(spec_file)
+            except OSError:
+                pass
         if proc.returncode != 0:
             raise RuntimeError(
                 "conda env create failed: "

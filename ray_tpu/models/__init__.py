@@ -24,10 +24,31 @@ from .vision import (
     resnet_forward,
     resnet_param_axes,
 )
+from .mamba import (
+    MAMBA_CONFIGS,
+    MambaConfig,
+    init_mamba,
+    mamba_forward,
+    mamba_lm_loss,
+    mamba_param_axes,
+)
+from .clip import (
+    CLIP_CONFIGS,
+    CLIPConfig,
+    clip_loss,
+    clip_param_axes,
+    encode_image,
+    encode_text,
+    init_clip,
+)
 
 __all__ = [
     "LlamaConfig", "LLAMA_CONFIGS", "init_params", "param_logical_axes",
     "forward", "lm_loss",
     "ResNetConfig", "RESNET_CONFIGS", "init_resnet", "resnet_forward",
     "image_loss", "resnet_param_axes",
+    "MambaConfig", "MAMBA_CONFIGS", "init_mamba", "mamba_forward",
+    "mamba_lm_loss", "mamba_param_axes",
+    "CLIPConfig", "CLIP_CONFIGS", "init_clip", "encode_image",
+    "encode_text", "clip_loss", "clip_param_axes",
 ]

@@ -1,0 +1,226 @@
+"""CLIP: contrastive image-text pretraining, TPU-first.
+
+One of the BASELINE configs ("ViT-L / CLIP multimodal — Ray Data image
+pipeline -> TPU"). Two towers — a ViT image encoder (patchify = one
+reshaped matmul, so even embedding rides the MXU) and a pre-norm
+transformer text encoder — meet in a shared embedding space under the
+symmetric InfoNCE loss with a learnable temperature (Radford et al.
+2021 defines the objective; the implementation here is a fresh jax
+program sharing this repo's ops and logical-axis sharding rules).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import rms_norm
+from ..ops.attention import blockwise_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    # vision tower
+    image_size: int = 224
+    patch: int = 16
+    v_dim: int = 768
+    v_layers: int = 12
+    v_heads: int = 12
+    # text tower
+    vocab: int = 49408
+    max_text: int = 77
+    t_dim: int = 512
+    t_layers: int = 12
+    t_heads: int = 8
+    # shared space
+    embed_dim: int = 512
+    mlp_ratio: int = 4
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch) ** 2
+
+    def n_params(self) -> int:
+        def tower(dim, layers):
+            attn = 4 * dim * dim
+            mlp = 2 * dim * dim * self.mlp_ratio
+            return layers * (attn + mlp + 2 * dim)
+        v = (self.patch ** 2 * 3 * self.v_dim          # patch embed
+             + (self.n_patches + 1) * self.v_dim       # pos + cls
+             + tower(self.v_dim, self.v_layers)
+             + self.v_dim * self.embed_dim)
+        t = (self.vocab * self.t_dim
+             + self.max_text * self.t_dim
+             + tower(self.t_dim, self.t_layers)
+             + self.t_dim * self.embed_dim)
+        return v + t + 1
+
+
+CLIP_CONFIGS: Dict[str, CLIPConfig] = {
+    "tiny": CLIPConfig(image_size=32, patch=8, v_dim=64, v_layers=2,
+                       v_heads=4, vocab=256, max_text=16, t_dim=64,
+                       t_layers=2, t_heads=4, embed_dim=32,
+                       dtype=jnp.float32, remat=False),
+    # ViT-B/16-class two-tower (the classic CLIP-B recipe)
+    "vit_b16": CLIPConfig(),
+}
+
+
+def _tower_axes(prefix):
+    return {
+        "attn_norm": ("layers", prefix),
+        "wqkv": ("layers", prefix, "heads_qkv"),
+        "wo": ("layers", "heads_qkv", prefix),
+        "mlp_norm": ("layers", prefix),
+        "w_up": ("layers", prefix, "mlp"),
+        "w_down": ("layers", "mlp", prefix),
+    }
+
+
+def clip_param_axes(cfg: CLIPConfig):
+    return {
+        "vision": {
+            "patch_embed": (None, "embed"),
+            "cls": (None, None, "embed"),
+            "pos": (None, "embed"),
+            "tower": _tower_axes("embed"),
+            "norm": ("embed",),
+            "proj": ("embed", "clip"),
+        },
+        "text": {
+            "embed": ("vocab", "embed"),
+            "pos": (None, "embed"),
+            "tower": _tower_axes("embed"),
+            "norm": ("embed",),
+            "proj": ("embed", "clip"),
+        },
+        "logit_scale": (),
+    }
+
+
+def _init_tower(key, dim: int, layers: int, mlp: int, dtype):
+    ks = jax.random.split(key, 4)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "attn_norm": jnp.ones((layers, dim), dtype),
+        "wqkv": w(ks[0], (layers, dim, 3 * dim), dim),
+        "wo": w(ks[1], (layers, dim, dim), dim),
+        "mlp_norm": jnp.ones((layers, dim), dtype),
+        "w_up": w(ks[2], (layers, dim, mlp), dim),
+        "w_down": w(ks[3], (layers, mlp, dim), mlp),
+    }
+
+
+def init_clip(key, cfg: CLIPConfig):
+    ks = jax.random.split(key, 8)
+    pd = cfg.patch * cfg.patch * 3
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    return {
+        "vision": {
+            "patch_embed": w(ks[0], (pd, cfg.v_dim), pd),
+            "cls": jnp.zeros((1, 1, cfg.v_dim), cfg.dtype),
+            "pos": w(ks[1], (cfg.n_patches + 1, cfg.v_dim), cfg.v_dim),
+            "tower": _init_tower(ks[2], cfg.v_dim, cfg.v_layers,
+                                 cfg.v_dim * cfg.mlp_ratio, cfg.dtype),
+            "norm": jnp.ones((cfg.v_dim,), cfg.dtype),
+            "proj": w(ks[3], (cfg.v_dim, cfg.embed_dim), cfg.v_dim),
+        },
+        "text": {
+            "embed": w(ks[4], (cfg.vocab, cfg.t_dim), cfg.t_dim),
+            "pos": w(ks[5], (cfg.max_text, cfg.t_dim), cfg.t_dim),
+            "tower": _init_tower(ks[6], cfg.t_dim, cfg.t_layers,
+                                 cfg.t_dim * cfg.mlp_ratio, cfg.dtype),
+            "norm": jnp.ones((cfg.t_dim,), cfg.dtype),
+            "proj": w(ks[7], (cfg.t_dim, cfg.embed_dim), cfg.t_dim),
+        },
+        # exp(logit_scale) starts at 1/0.07, the CLIP-standard init
+        "logit_scale": jnp.asarray(jnp.log(1.0 / 0.07), jnp.float32),
+    }
+
+
+def _run_tower(x, tower, heads: int, cfg: CLIPConfig, causal: bool):
+    head_dim = x.shape[-1] // heads
+
+    def layer(x, lp):
+        B_, S, d = x.shape
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        qkv = (h @ lp["wqkv"]).reshape(B_, S, 3, heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = blockwise_attention(q, k, v, causal=causal)
+        x = x + (att.reshape(B_, S, d) @ lp["wo"]).astype(x.dtype)
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + (jax.nn.gelu(h @ lp["w_up"]) @ lp["w_down"]).astype(x.dtype)
+        return x, None
+
+    body = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = jax.lax.scan(body, x, tower)
+    return x
+
+
+def encode_image(params, images, cfg: CLIPConfig):
+    """images: (B, H, W, 3) -> L2-normalized (B, embed_dim)."""
+    vp = params["vision"]
+    B_ = images.shape[0]
+    p, g = cfg.patch, cfg.image_size // cfg.patch
+    # patchify as a reshape: (B, g, p, g, p, 3) -> (B, g*g, p*p*3)
+    x = images.astype(cfg.dtype).reshape(B_, g, p, g, p, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B_, g * g, p * p * 3)
+    x = x @ vp["patch_embed"]
+    cls = jnp.broadcast_to(vp["cls"], (B_, 1, cfg.v_dim))
+    x = jnp.concatenate([cls, x], axis=1) + vp["pos"][None]
+    x = _run_tower(x, vp["tower"], cfg.v_heads, cfg, causal=False)
+    pooled = rms_norm(x[:, 0], vp["norm"], cfg.norm_eps)
+    emb = (pooled @ vp["proj"]).astype(jnp.float32)
+    return emb / (jnp.linalg.norm(emb, axis=-1, keepdims=True) + 1e-8)
+
+
+def encode_text(params, tokens, cfg: CLIPConfig):
+    """tokens: (B, T) int32, 0 = pad -> L2-normalized (B, embed_dim).
+    Pooling reads the LAST non-pad position (causal tower), CLIP's
+    EOT-pooling shape."""
+    tp = params["text"]
+    T = tokens.shape[1]
+    x = tp["embed"][tokens].astype(cfg.dtype) + tp["pos"][None, :T]
+    x = _run_tower(x, tp["tower"], cfg.t_heads, cfg, causal=True)
+    lengths = jnp.maximum((tokens != 0).sum(axis=1) - 1, 0)
+    pooled = jnp.take_along_axis(
+        x, lengths[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    pooled = rms_norm(pooled, tp["norm"], cfg.norm_eps)
+    emb = (pooled @ tp["proj"]).astype(jnp.float32)
+    return emb / (jnp.linalg.norm(emb, axis=-1, keepdims=True) + 1e-8)
+
+
+def clip_outputs(params, batch, cfg: CLIPConfig):
+    """Symmetric InfoNCE over the batch's (image, text) pairs, with
+    diagnostics."""
+    img = encode_image(params, batch["images"], cfg)
+    txt = encode_text(params, batch["tokens"], cfg)
+    scale = jnp.exp(jnp.clip(params["logit_scale"], -10.0, jnp.log(100.0)))
+    logits = img @ txt.T * scale
+    labels = jnp.arange(logits.shape[0])
+    li = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=1),
+                              labels[:, None], axis=1).mean()
+    lt = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=0),
+                              labels[None, :], axis=0).mean()
+    loss = 0.5 * (li + lt)
+    acc = (logits.argmax(axis=1) == labels).mean()
+    return {"loss": loss, "contrastive_acc": acc, "logit_scale": scale}
+
+
+def clip_loss(params, batch, cfg: CLIPConfig, **_):
+    """Scalar loss — the make_train_step contract."""
+    return clip_outputs(params, batch, cfg)["loss"]

@@ -1,0 +1,201 @@
+"""Mamba-2 family: selective state-space LM, TPU-first.
+
+One of the BASELINE configs ("Mamba-2 / Jamba hybrid — state-space ops").
+The core op is ops/ssd.py's chunked SSD — the state-space-duality form
+whose FLOPs are einsums the MXU tiles natively; the per-chunk scan is
+the only sequential dependency (seq/chunk steps instead of seq).
+
+Block layout follows Mamba-2's parallel projection: one in_proj emits
+[z | x | B | C | dt], a short causal depthwise conv warms x/B/C locally,
+SSD mixes along the sequence, the gate z modulates, out_proj returns to
+the residual stream. Params carry logical axes so the same
+dp/fsdp/tp/sp rule table shards this family too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import rms_norm
+from ..ops.ssd import ssd_chunked
+from ..parallel.sharding import with_sharding_constraint_logical
+
+
+@dataclasses.dataclass(frozen=True)
+class MambaConfig:
+    vocab: int = 32768
+    dim: int = 768
+    n_layers: int = 24
+    state_dim: int = 64
+    head_dim: int = 64
+    expand: int = 2
+    conv_width: int = 4
+    chunk: int = 64
+    dt_min: float = 0.001
+    dt_max: float = 0.1
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def inner(self) -> int:
+        return self.expand * self.dim
+
+    @property
+    def n_heads(self) -> int:
+        return self.inner // self.head_dim
+
+    def n_params(self) -> int:
+        d, di, H = self.dim, self.inner, self.n_heads
+        # in_proj emits z(di) + x(di) + B(N) + C(N) + dt(H) per token
+        proj_in = d * (2 * di + 2 * self.state_dim + H)
+        conv = self.conv_width * (di + 2 * self.state_dim)
+        per_layer = proj_in + conv + di * d + 3 * H + d
+        return self.vocab * d * 2 + self.n_layers * per_layer + d
+
+
+MAMBA_CONFIGS: Dict[str, MambaConfig] = {
+    "tiny": MambaConfig(vocab=256, dim=64, n_layers=2, state_dim=16,
+                        head_dim=32, chunk=16, dtype=jnp.float32,
+                        remat=False),
+    # ~130M class, single-chip bench size
+    "130m": MambaConfig(vocab=32768, dim=768, n_layers=24),
+    "1b": MambaConfig(vocab=32768, dim=2048, n_layers=48),
+}
+
+
+def mamba_param_axes(cfg: MambaConfig):
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "norm": ("layers", "embed"),
+            "w_in": ("layers", "embed", "mlp"),
+            "conv": ("layers", "conv", "mlp"),
+            "dt_bias": ("layers", "heads"),
+            "A_log": ("layers", "heads"),
+            "Dp": ("layers", "heads"),
+            "w_out": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_mamba(key, cfg: MambaConfig):
+    d, di, N, H = cfg.dim, cfg.inner, cfg.state_dim, cfg.n_heads
+    L = cfg.n_layers
+    proj_width = 2 * di + 2 * N + H
+    ks = jax.random.split(key, 7)
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    # dt bias: softplus(bias) spans [dt_min, dt_max] log-uniformly;
+    # the decay magnitude |A| in [1, 16) draws INDEPENDENTLY (coupling
+    # them would make fast-timestep heads systematically fast-decaying)
+    u = jax.random.uniform(ks[3], (L, H), jnp.float32)
+    ua = jax.random.uniform(ks[6], (L, H), jnp.float32)
+    dt_init = jnp.exp(u * (jnp.log(cfg.dt_max) - jnp.log(cfg.dt_min))
+                      + jnp.log(cfg.dt_min))
+    dt_bias = dt_init + jnp.log(-jnp.expm1(-dt_init))  # inv softplus
+    return {
+        "embed": norm_init(ks[0], (cfg.vocab, d), d),
+        "layers": {
+            "norm": jnp.ones((L, d), cfg.dtype),
+            "w_in": norm_init(ks[1], (L, d, proj_width), d),
+            "conv": (jax.random.normal(
+                ks[2], (L, cfg.conv_width, di + 2 * N), jnp.float32)
+                * (cfg.conv_width ** -0.5)).astype(cfg.dtype),
+            "dt_bias": dt_bias,
+            # A in [-16, -1]: exp(A_log) gives the magnitude
+            "A_log": jnp.log(1.0 + ua * 15.0),
+            "Dp": jnp.ones((L, H), jnp.float32),
+            "w_out": norm_init(ks[4], (L, di, d), di),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": norm_init(ks[5], (d, cfg.vocab), d),
+    }
+
+
+def _causal_depthwise_conv(x, w):
+    """x: (B, S, C), w: (K, C) — causal depthwise conv along S."""
+    K = w.shape[0]
+    pad = jnp.pad(x, ((0, 0), (K - 1, 0), (0, 0)))
+    # unrolled taps: K is 4 — cheaper to fuse than to dispatch conv
+    out = jnp.zeros_like(x)
+    for k in range(K):
+        out = out + pad[:, k:k + x.shape[1], :] * w[k][None, None, :]
+    return out
+
+
+def _block(x, lp, cfg: MambaConfig, csl):
+    B_, S, d = x.shape
+    di, N, H, P = cfg.inner, cfg.state_dim, cfg.n_heads, cfg.head_dim
+    h = rms_norm(x, lp["norm"], cfg.norm_eps)
+    proj = h @ lp["w_in"]
+    z, xs, Bc, Cc, dt_raw = jnp.split(
+        proj, [di, 2 * di, 2 * di + N, 2 * di + 2 * N], axis=-1)
+    # local conv over the SSD operands (x, B, C together, mamba-2 style)
+    conv_in = jnp.concatenate([xs, Bc, Cc], axis=-1)
+    conv_out = jax.nn.silu(_causal_depthwise_conv(conv_in, lp["conv"]))
+    xs, Bc, Cc = jnp.split(conv_out, [di, di + N], axis=-1)
+    xs = csl(xs.reshape(B_, S, H, P), ("batch", "seq", "heads", None))
+    dt = jax.nn.softplus(
+        dt_raw.astype(jnp.float32)
+        + lp["dt_bias"].astype(jnp.float32)[None, None, :])
+    A = -jnp.exp(lp["A_log"].astype(jnp.float32))
+    # B/C shared across heads (single group): broadcast over H
+    Bm = jnp.repeat(Bc[:, :, None, :], H, axis=2)
+    Cm = jnp.repeat(Cc[:, :, None, :], H, axis=2)
+    y = ssd_chunked(xs, dt, A, Bm, Cm, lp["Dp"], cfg.chunk)
+    y = y.reshape(B_, S, di) * jax.nn.silu(z)
+    return x + (y @ lp["w_out"]).astype(x.dtype)
+
+
+def mamba_forward(params, tokens, cfg: MambaConfig, *,
+                  mesh: Optional[Any] = None, rules=None):
+    def csl(t, axes):
+        if mesh is None:
+            return t
+        from ..parallel.sharding import DEFAULT_RULES
+
+        return with_sharding_constraint_logical(
+            t, axes, rules or DEFAULT_RULES, mesh)
+
+    # the chunked SSD needs seq % chunk == 0: right-pad with zeros (a
+    # causal model's outputs at real positions can't see the pad tail)
+    S = tokens.shape[1]
+    pad = (-S) % cfg.chunk
+    if pad:
+        tokens = jnp.pad(tokens, ((0, 0), (0, pad)))
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = csl(x, ("batch", "seq", "embed"))
+
+    def layer(x, lp):
+        return _block(x, lp, cfg, csl), None
+
+    body = layer
+    if cfg.remat:
+        body = jax.checkpoint(layer)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if pad:
+        x = x[:, :S]
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def mamba_lm_loss(params, batch, cfg: MambaConfig, *,
+                  mesh: Optional[Any] = None, rules=None):
+    """Scalar next-token loss — the make_train_step contract."""
+    tokens = batch["tokens"]
+    logits = mamba_forward(params, tokens[:, :-1], cfg,
+                           mesh=mesh, rules=rules)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()

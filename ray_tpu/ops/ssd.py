@@ -1,0 +1,115 @@
+"""SSD: the Mamba-2 state-space op in chunked (matmul) form.
+
+State-space duality (Dao & Gu 2024) rewrites the selective-scan
+recurrence
+
+    h_t = a_t * h_{t-1} + (dt_t x_t) outer B_t
+    y_t = C_t . h_t + D * x_t          (a_t = exp(dt_t * A), A < 0)
+
+as chunked matmuls: within a chunk the output is an attention-like
+product (C B^T masked by the 1-semiseparable decay L), and chunks
+exchange only a (head_dim x state) state through a short lax.scan.
+That is the TPU-first form — the FLOPs land in einsums the MXU tiles
+natively, and the sequential dependency shrinks from seq to seq/chunk.
+``ssd_reference`` is the literal recurrence, kept as the test oracle.
+
+Shapes (B=batch, S=seq, H=heads, P=head_dim, N=state):
+    x: (B, S, H, P)   dt: (B, S, H)   A: (H,)
+    Bm/Cm: (B, S, H, N)   D: (H,)   -> y: (B, S, H, P)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ssd_chunked", "ssd_reference"]
+
+
+def ssd_reference(x, dt, A, Bm, Cm, D):
+    """Sequential recurrence oracle (f32)."""
+    xf = x.astype(jnp.float32)
+    dtf = dt.astype(jnp.float32)
+    a = jnp.exp(dtf * A.astype(jnp.float32))          # (B, S, H)
+    Bf = Bm.astype(jnp.float32)
+    Cf = Cm.astype(jnp.float32)
+
+    def step(h, inp):
+        a_t, bx_t, c_t = inp
+        h = a_t[..., None, None] * h + bx_t
+        y = jnp.einsum("bhn,bhpn->bhp", c_t, h)
+        return h, y
+
+    bx = jnp.einsum("bsh,bshp,bshn->bshpn", dtf, xf, Bf)
+    B_, S, H, P = x.shape
+    N = Bm.shape[-1]
+    h0 = jnp.zeros((B_, H, P, N), jnp.float32)
+    _, ys = jax.lax.scan(
+        step, h0,
+        (jnp.moveaxis(a, 1, 0), jnp.moveaxis(bx, 1, 0),
+         jnp.moveaxis(Cf, 1, 0)))
+    y = jnp.moveaxis(ys, 0, 1)
+    return (y + xf * D.astype(jnp.float32)[None, None, :, None]
+            ).astype(x.dtype)
+
+
+def ssd_chunked(x, dt, A, Bm, Cm, D, chunk: int = 64):
+    """Chunked SSD. seq must be a multiple of `chunk` (pad upstream)."""
+    B_, S, H, P = x.shape
+    N = Bm.shape[-1]
+    if S % chunk:
+        raise ValueError(f"seq {S} not a multiple of chunk {chunk}")
+    C_ = S // chunk
+    f32 = jnp.float32
+    xf = x.astype(f32).reshape(B_, C_, chunk, H, P)
+    dtf = dt.astype(f32).reshape(B_, C_, chunk, H)
+    Bf = Bm.astype(f32).reshape(B_, C_, chunk, H, N)
+    Cf = Cm.astype(f32).reshape(B_, C_, chunk, H, N)
+
+    # log-decay cumulative within each chunk (inclusive of own step)
+    log_a = dtf * A.astype(f32)                       # (B, C, Q, H)
+    cum = jnp.cumsum(log_a, axis=2)
+    # L[i, j] = exp(cum[i] - cum[j]) for j <= i (decay j+1..i).
+    # cum is decreasing (A < 0), so every CAUSAL entry has exponent
+    # <= 0 — the clamp is exact there and exists purely to keep the
+    # anti-causal branch finite: where() still evaluates it, and its
+    # overflowing exp turns into inf*0 = NaN in the BACKWARD pass (the
+    # classic where-grad trap; seen as grad_norm=nan at step 0 on the
+    # 130m config).
+    li = cum[:, :, :, None, :]                        # (B, C, Q, 1, H)
+    lj = cum[:, :, None, :, :]                        # (B, C, 1, Q, H)
+    Q = chunk
+    causal = jnp.tril(jnp.ones((Q, Q), bool))[None, None, :, :, None]
+    L = jnp.where(causal, jnp.exp(jnp.minimum(li - lj, 0.0)), 0.0)
+
+    dx = dtf[..., None] * xf                          # (B, C, Q, H, P)
+    # intra-chunk: (C_i . B_j) * L[i,j] applied to dx_j
+    scores = jnp.einsum("bcihn,bcjhn->bcijh", Cf, Bf) * L
+    y_intra = jnp.einsum("bcijh,bcjhp->bcihp", scores, dx)
+
+    # per-chunk aggregate state + total decay
+    last = cum[:, :, -1:, :]                          # (B, C, 1, H)
+    decay_to_end = jnp.exp(last - cum)                # (B, C, Q, H)
+    S_c = jnp.einsum("bcjh,bcjhp,bcjhn->bchpn", decay_to_end, dx, Bf)
+    chunk_decay = jnp.exp(last[:, :, 0, :])           # (B, C, H)
+
+    # inter-chunk: H_c = chunk_decay_c * H_{c-1} + S_c (scan over C_)
+    def step(h, inp):
+        dec, s = inp
+        h_prev = h
+        h = dec[..., None, None] * h + s
+        return h, h_prev
+
+    h0 = jnp.zeros((B_, H, P, N), f32)
+    _, h_prevs = jax.lax.scan(
+        step, h0,
+        (jnp.moveaxis(chunk_decay, 1, 0), jnp.moveaxis(S_c, 1, 0)))
+    h_prev = jnp.moveaxis(h_prevs, 0, 1)              # (B, C, H, P, N)
+
+    # carry-in contribution at position i: exp(cum[i]) * C_i . H_{c-1}
+    y_inter = jnp.einsum("bcih,bcihn,bchpn->bcihp",
+                         jnp.exp(cum), Cf, h_prev)
+
+    y = (y_intra + y_inter).reshape(B_, S, H, P)
+    return (y + x.astype(f32) * D.astype(f32)[None, None, :, None]
+            ).astype(x.dtype)
