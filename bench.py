@@ -266,7 +266,7 @@ def _bench_envelope_summary():
          os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "bench_envelope.py"),
          "sched", "queued", "inflight", "actors", "getmany", "bigobj",
-         "broadcast"],
+         "broadcast", "syncer"],
         env=env, capture_output=True, text=True, timeout=1500)
     for line in proc.stdout.splitlines():
         try:

@@ -293,9 +293,84 @@ def bench_bigobj(results, size_gb=10.0):
         put_gb_per_s=size_gb / t_put, get_gb_per_s=size_gb / t_get))
 
 
+# ---------------------------------------------------------------- syncer
+def bench_syncer(results, nodes=64, reports=8000):
+    """Where the hub resource-sync ceiling sits: sustained
+    report_resources/s through ONE GCS loop with `nodes` subscriber
+    connections each receiving the fan-out — the O(N^2) path gossip
+    mode replaces (ray_tpu/_private/syncer.py)."""
+    import asyncio
+    import tempfile
+
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.rpc import RpcClient
+
+    if QUICK:
+        nodes, reports = 8, 500
+
+    async def go():
+        tmp = tempfile.mkdtemp(prefix="rtpu_sync_bench_")
+        sock = f"{tmp}/gcs.sock"
+        gcs = GcsServer(sock)
+        await gcs.start()
+        clients = []
+        node_ids = []
+        for i in range(nodes):
+            c = RpcClient(sock)
+            await c.connect()
+            nid = NodeID.from_random()
+            await c.call("register_node", {
+                "node_id": nid, "address": f"fake-{i}",
+                "resources_total": {"CPU": 8.0},
+                "resources_available": {"CPU": 8.0}})
+            # every node subscribes: each report fans out to all N
+            await c.call("subscribe", {"channels": ["resources"]})
+            clients.append(c)
+            node_ids.append(nid)
+        seqs = [0] * nodes
+        t0 = time.perf_counter()
+
+        async def one(i, k):
+            seqs[i] += 1
+            await clients[i].call("report_resources", {
+                "node_id": node_ids[i],
+                "available": {"CPU": float(k % 8)},
+                "seq": seqs[i]})
+
+        # bounded concurrency so the measurement is throughput, not
+        # queue depth
+        sem = asyncio.Semaphore(64)
+
+        async def guarded(i, k):
+            async with sem:
+                await one(i, k)
+
+        await asyncio.gather(*(guarded(k % nodes, k)
+                               for k in range(reports)))
+        dt = time.perf_counter() - t0
+        for c in clients:
+            await c.close()
+        await gcs.stop()
+        return reports / dt
+
+    loop = asyncio.new_event_loop()
+    try:
+        rate = loop.run_until_complete(go())
+    finally:
+        loop.close()
+    results.append(emit(
+        "envelope_hub_sync", nodes=nodes, reports=reports,
+        hub_reports_per_s=rate,
+        # each report pushes to `nodes` subscribers: the loop moves
+        # rate*nodes messages/s at saturation
+        hub_fanout_msgs_per_s=rate * nodes))
+
+
 ALL = {
     "queued": bench_queued,
     "sched": bench_sched,
+    "syncer": bench_syncer,
     "inflight": bench_inflight,
     "actors": bench_actors,
     "broadcast": bench_broadcast,

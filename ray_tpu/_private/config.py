@@ -85,6 +85,13 @@ class Config:
     actor_max_restarts_default: int = 0
     health_check_period_ms: int = 1000
     health_check_failure_threshold: int = 5
+    # resource view propagation (syncer.py): "hub" = GCS pubsub fan-out
+    # (O(N^2) msgs/interval through one loop), "gossip" = push-pull
+    # anti-entropy, O(fanout) per node, O(log N) rounds to converge
+    # (ref: ray_syncer.h:83)
+    resource_sync_mode: str = "hub"
+    resource_sync_interval_s: float = 1.0
+    resource_sync_fanout: int = 2
     lineage_pinning_enabled: bool = True
     max_lineage_bytes: int = 1024**3
     # --- chaos / testing (mirrors rpc_chaos.h fault injection) ---

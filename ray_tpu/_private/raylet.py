@@ -188,6 +188,7 @@ class Raylet:
         # constructed in start() from the (possibly port-resolved) gcs_address
         self.gcs: RpcClient = None  # type: ignore[assignment]
         self.transfer = None
+        self.syncer = None
 
         cfg = global_config()
         self.cfg = cfg
@@ -300,7 +301,25 @@ class Raylet:
             if info.node_id != self.node_id and info.alive:
                 self._remote_nodes[info.node_id] = (info.address, ResourceSet(info.resources_available))
                 self._node_labels[info.node_id] = dict(info.labels or {})
-        await self.gcs.call("subscribe", {"channels": ["resources", "node", "object"]})
+        if self.cfg.resource_sync_mode == "gossip":
+            # peer availability rides anti-entropy rounds, not a hub
+            # fan-out: the GCS stays out of the O(N^2) broadcast path
+            # (node/object events remain hub channels — membership and
+            # the object directory are authoritative state, not gossip)
+            from .syncer import ResourceSyncer
+
+            self.syncer = ResourceSyncer(
+                self, interval_s=self.cfg.resource_sync_interval_s,
+                fanout=self.cfg.resource_sync_fanout)
+            self.syncer.local_update(
+                self.resources.available.to_dict(), [],
+                self._resource_seq)
+            self.syncer.start()
+            await self.gcs.call(
+                "subscribe", {"channels": ["node", "object"]})
+        else:
+            await self.gcs.call(
+                "subscribe", {"channels": ["resources", "node", "object"]})
         self.gcs.on_reconnect.append(self._on_gcs_reconnect)
         if self.cfg.prestart_workers:
             for _ in range(min(2, self.max_workers)):
@@ -328,7 +347,8 @@ class Raylet:
             })
             await self.gcs.call(
                 "subscribe",
-                {"channels": ["resources", "node", "object"]})
+                {"channels": (["node", "object"] if self.syncer is not None
+                              else ["resources", "node", "object"])})
             await self._report_resources()
         except Exception:
             pass  # next retrying call reconnects and refires this hook
@@ -398,6 +418,8 @@ class Raylet:
         for worker in self._workers.values():
             if worker.conn is not None:
                 await worker.conn.push("shutdown", {})
+        if self.syncer is not None:
+            self.syncer.stop()
         await self.server.stop()
         if self.transfer is not None:
             await self.transfer.stop()
@@ -457,6 +479,29 @@ class Raylet:
             if self._pending_leases:  # capacity elsewhere: try spillback
                 asyncio.ensure_future(self._pump_pending())
 
+    def _apply_peer_resources(self, node_hex: str, address: str,
+                              available: dict) -> None:
+        """Gossip-learned availability (syncer.py) feeding the same
+        spillback view the hub pushes maintain. Availability ONLY:
+        membership stays hub-authoritative (node channel), so a stale
+        gossip entry can never resurrect a removed node into the
+        spillback picker — unknown nodes are dropped here and evicted
+        from the gossip view."""
+        node_id = NodeID.from_hex(node_hex)
+        entry = self._remote_nodes.get(node_id)
+        if entry is None:
+            if self.syncer is not None and node_id != self.node_id:
+                self.syncer.evict(node_hex)
+            return
+        self._remote_nodes[node_id] = (entry[0], ResourceSet(available))
+        if self._pending_leases:
+            asyncio.ensure_future(self._pump_pending())
+
+    async def handle_syncer_sync(self, payload, conn):
+        if self.syncer is None:
+            return {"entries": {}}
+        return await self.syncer.handle_sync(payload)
+
     def _on_node_event(self, payload):
         if payload["event"] == "added":
             info = payload["node"]
@@ -466,7 +511,10 @@ class Raylet:
                 if self._pending_leases:  # a new node may fit queued work
                     asyncio.ensure_future(self._pump_pending())
         elif payload["event"] == "removed":
-            self._remote_nodes.pop(payload.get("node_id"), None)
+            node_id = payload.get("node_id")
+            self._remote_nodes.pop(node_id, None)
+            if self.syncer is not None and node_id is not None:
+                self.syncer.evict(node_id.hex())
 
     async def _report_resources(self):
         """Fire-and-forget availability report. Never awaited into the lease
@@ -482,6 +530,9 @@ class Raylet:
             "pending": [p.resources.to_dict()
                         for p in self._pending_leases],
         }
+        if self.syncer is not None:
+            self.syncer.local_update(payload["available"],
+                                     payload["pending"], payload["seq"])
 
         async def _send():
             try:

@@ -1,0 +1,132 @@
+"""Gossip resource syncer: peer-to-peer eventual consistency.
+
+TPU-native analog of the reference resource syncer (ref:
+src/ray/common/ray_syncer/ray_syncer.h:83 — bidirectional streaming of
+versioned resource views with eventual consistency). The default
+hub-and-spoke path (raylet -> GCS report -> pubsub fan-out) makes every
+availability change O(nodes) pushes through ONE asyncio loop — O(N²)
+messages per interval cluster-wide, all on the head. Gossip mode
+replaces the fan-out: each raylet keeps a versioned view
+{node: (seq, available, pending)} and runs push-pull anti-entropy
+rounds with `fanout` random peers; information spreads in O(log N)
+rounds while per-node load stays O(fanout) regardless of cluster size.
+The GCS still receives each node's own reports (observability,
+autoscaler) — it just stops being the broadcast hub.
+
+Protocol (one raylet->raylet RPC per round, "syncer_sync"):
+    -> {"from": hex, "digest": {node_hex: seq}, "entries": {...}}
+    <- {"entries": {node_hex: entry}}   # what the caller was missing
+The request carries entries the CALLER believes the callee lacks (push),
+the reply returns what the CALLEE has newer (pull).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["ResourceSyncer"]
+
+
+class ResourceSyncer:
+    def __init__(self, raylet, interval_s: float = 1.0, fanout: int = 2):
+        self.raylet = raylet
+        self.interval_s = interval_s
+        self.fanout = fanout
+        # node_hex -> {"seq", "available", "pending", "address", "ts"}
+        self.view: Dict[str, Dict[str, Any]] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.rounds = 0
+
+    # ------------------------------------------------------------ local
+    def local_update(self, available: dict, pending: list,
+                     seq: int) -> None:
+        self.view[self.raylet.node_id.hex()] = {
+            "seq": seq, "available": available, "pending": pending,
+            "address": self.raylet.server.address, "ts": time.time(),
+        }
+
+    def evict(self, node_hex: str) -> None:
+        """Drop a node from the gossip view (death/removal is
+        hub-authoritative; without eviction dead entries gossip
+        forever and the view grows with churn)."""
+        self.view.pop(node_hex, None)
+
+    def digest(self) -> Dict[str, int]:
+        return {node: entry["seq"] for node, entry in self.view.items()}
+
+    def entries_newer_than(self, digest: Dict[str, int]) -> Dict[str, dict]:
+        return {node: entry for node, entry in self.view.items()
+                if entry["seq"] > digest.get(node, -1)}
+
+    def apply(self, entries: Dict[str, dict]) -> int:
+        """Merge peer entries (last-writer-wins by seq). Returns how
+        many were news. Freshly learned availability feeds the same
+        spillback view the hub pushes maintained."""
+        applied = 0
+        my_hex = self.raylet.node_id.hex()
+        for node, entry in entries.items():
+            if node == my_hex:
+                continue  # own state is authoritative locally
+            cur = self.view.get(node)
+            if cur is not None and cur["seq"] >= entry["seq"]:
+                continue
+            self.view[node] = entry
+            applied += 1
+            self.raylet._apply_peer_resources(
+                node, entry["address"], entry["available"])
+        return applied
+
+    # ----------------------------------------------------------- gossip
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.interval_s)
+                await self._round()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                continue  # a bad peer/round must not stop anti-entropy
+
+    async def _round(self) -> None:
+        peers = [(nid, addr) for nid, (addr, _)
+                 in self.raylet._remote_nodes.items()]
+        if not peers:
+            return
+        random.shuffle(peers)
+        for node_id, address in peers[: self.fanout]:
+            try:
+                client = await self.raylet._peer_client(address)
+                # push-pull: the request carries our WHOLE view (N
+                # entries of ~100 bytes — the peer's seqs dedupe on
+                # apply), the reply returns only what we lack per our
+                # digest. Per-peer delta tracking would trim the push
+                # half; the reply half is already delta-sized.
+                reply = await client.call("syncer_sync", {
+                    "from": self.raylet.node_id.hex(),
+                    "digest": self.digest(),
+                    "entries": self.view,
+                }, timeout=5.0)
+                if reply:
+                    self.apply(reply.get("entries", {}))
+            except Exception:
+                continue
+        self.rounds += 1
+
+    # ------------------------------------------------------------ server
+    async def handle_sync(self, payload: dict) -> dict:
+        """Peer round: absorb its entries, answer with what it lacks."""
+        self.apply(payload.get("entries", {}))
+        return {"entries": self.entries_newer_than(
+            payload.get("digest", {}))}

@@ -149,10 +149,10 @@ def _block(x, lp, cfg: MambaConfig, csl):
         dt_raw.astype(jnp.float32)
         + lp["dt_bias"].astype(jnp.float32)[None, None, :])
     A = -jnp.exp(lp["A_log"].astype(jnp.float32))
-    # B/C shared across heads (single group): broadcast over H
-    Bm = jnp.repeat(Bc[:, :, None, :], H, axis=2)
-    Cm = jnp.repeat(Cc[:, :, None, :], H, axis=2)
-    y = ssd_chunked(xs, dt, A, Bm, Cm, lp["Dp"], cfg.chunk)
+    # B/C shared across heads (single group): the (B,S,1,N) shape lets
+    # ssd_chunked compute the shared contractions once and broadcast
+    y = ssd_chunked(xs, dt, A, Bc[:, :, None, :], Cc[:, :, None, :],
+                    lp["Dp"], cfg.chunk)
     y = y.reshape(B_, S, di) * jax.nn.silu(z)
     return x + (y @ lp["w_out"]).astype(x.dtype)
 

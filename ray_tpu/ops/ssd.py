@@ -54,17 +54,24 @@ def ssd_reference(x, dt, A, Bm, Cm, D):
 
 
 def ssd_chunked(x, dt, A, Bm, Cm, D, chunk: int = 64):
-    """Chunked SSD. seq must be a multiple of `chunk` (pad upstream)."""
+    """Chunked SSD. seq must be a multiple of `chunk` (pad upstream).
+
+    Bm/Cm may be head-shared — shape (B, S, 1, N) — in which case the
+    C.B^T score and state contractions compute ONCE and broadcast over
+    heads (materializing the repeat would multiply those einsums' FLOPs
+    by H for identical results)."""
     B_, S, H, P = x.shape
     N = Bm.shape[-1]
     if S % chunk:
         raise ValueError(f"seq {S} not a multiple of chunk {chunk}")
     C_ = S // chunk
     f32 = jnp.float32
+    shared_bc = Bm.shape[2] == 1 and Cm.shape[2] == 1 and H > 1
     xf = x.astype(f32).reshape(B_, C_, chunk, H, P)
     dtf = dt.astype(f32).reshape(B_, C_, chunk, H)
-    Bf = Bm.astype(f32).reshape(B_, C_, chunk, H, N)
-    Cf = Cm.astype(f32).reshape(B_, C_, chunk, H, N)
+    Hbc = 1 if shared_bc else H
+    Bf = Bm.astype(f32).reshape(B_, C_, chunk, Hbc, N)
+    Cf = Cm.astype(f32).reshape(B_, C_, chunk, Hbc, N)
 
     # log-decay cumulative within each chunk (inclusive of own step)
     log_a = dtf * A.astype(f32)                       # (B, C, Q, H)
@@ -84,13 +91,22 @@ def ssd_chunked(x, dt, A, Bm, Cm, D, chunk: int = 64):
 
     dx = dtf[..., None] * xf                          # (B, C, Q, H, P)
     # intra-chunk: (C_i . B_j) * L[i,j] applied to dx_j
-    scores = jnp.einsum("bcihn,bcjhn->bcijh", Cf, Bf) * L
+    if shared_bc:
+        inner = jnp.einsum("bcin,bcjn->bcij",
+                           Cf[:, :, :, 0], Bf[:, :, :, 0])
+        scores = inner[..., None] * L                 # broadcast over H
+    else:
+        scores = jnp.einsum("bcihn,bcjhn->bcijh", Cf, Bf) * L
     y_intra = jnp.einsum("bcijh,bcjhp->bcihp", scores, dx)
 
     # per-chunk aggregate state + total decay
     last = cum[:, :, -1:, :]                          # (B, C, 1, H)
     decay_to_end = jnp.exp(last - cum)                # (B, C, Q, H)
-    S_c = jnp.einsum("bcjh,bcjhp,bcjhn->bchpn", decay_to_end, dx, Bf)
+    if shared_bc:
+        S_c = jnp.einsum("bcjh,bcjhp,bcjn->bchpn",
+                         decay_to_end, dx, Bf[:, :, :, 0])
+    else:
+        S_c = jnp.einsum("bcjh,bcjhp,bcjhn->bchpn", decay_to_end, dx, Bf)
     chunk_decay = jnp.exp(last[:, :, 0, :])           # (B, C, H)
 
     # inter-chunk: H_c = chunk_decay_c * H_{c-1} + S_c (scan over C_)
@@ -107,8 +123,12 @@ def ssd_chunked(x, dt, A, Bm, Cm, D, chunk: int = 64):
     h_prev = jnp.moveaxis(h_prevs, 0, 1)              # (B, C, H, P, N)
 
     # carry-in contribution at position i: exp(cum[i]) * C_i . H_{c-1}
-    y_inter = jnp.einsum("bcih,bcihn,bchpn->bcihp",
-                         jnp.exp(cum), Cf, h_prev)
+    if shared_bc:
+        y_inter = jnp.einsum("bcih,bcin,bchpn->bcihp",
+                             jnp.exp(cum), Cf[:, :, :, 0], h_prev)
+    else:
+        y_inter = jnp.einsum("bcih,bcihn,bchpn->bcihp",
+                             jnp.exp(cum), Cf, h_prev)
 
     y = (y_intra + y_inter).reshape(B_, S, H, P)
     return (y + x.astype(f32) * D.astype(f32)[None, None, :, None]

@@ -148,3 +148,19 @@ def test_clip_encoders_normalized():
                                1.0, rtol=1e-4)
     np.testing.assert_allclose(np.linalg.norm(np.asarray(txt), axis=-1),
                                1.0, rtol=1e-4)
+
+
+def test_ssd_shared_bc_matches_per_head():
+    """Head-shared (B,S,1,N) B/C must equal the materialized repeat."""
+    k = jax.random.split(jax.random.PRNGKey(5), 5)
+    B, S, H, P, N = 2, 32, 4, 4, 8
+    x = jax.random.normal(k[0], (B, S, H, P))
+    dt = jax.nn.softplus(jax.random.normal(k[1], (B, S, H)))
+    A = -jnp.exp(jax.random.normal(k[2], (H,)))
+    B1 = jax.random.normal(k[3], (B, S, 1, N))
+    C1 = jax.random.normal(k[4], (B, S, 1, N))
+    D = jnp.zeros((H,))
+    shared = np.asarray(ssd_chunked(x, dt, A, B1, C1, D, 16))
+    rep = np.asarray(ssd_chunked(
+        x, dt, A, jnp.repeat(B1, H, 2), jnp.repeat(C1, H, 2), D, 16))
+    np.testing.assert_allclose(shared, rep, atol=1e-5, rtol=1e-5)
