@@ -29,17 +29,19 @@ assert get_lib() is not None, \
     f"ASAN-instrumented native lib failed to load: {native_unavailable_reason()}"
 print("instrumented native lib loaded")
 PY
-    # -k "not tensor": the tensor-lane tests initialize jax, whose
-    # UNinstrumented jaxlib crashes under the libasan preload — the
-    # ASAN lane targets the native C++ plane (store index, rings,
-    # channels, core tables), not the device plane
+    # test_tensor_lane_asan.py drives the raw-tensor ring with numpy/
+    # ml_dtypes only, so the native tensor path gets sanitizer coverage;
+    # -k "not tensor and not device" still excludes the jax-INITIALIZING
+    # tensor/DeviceChannel tests (uninstrumented jaxlib crashes under
+    # the libasan preload once a backend comes up)
     RAY_TPU_NATIVE_SANITIZE=address \
     LD_PRELOAD="$LIBASAN" \
     ASAN_OPTIONS="detect_leaks=0" \
     JAX_PLATFORMS=cpu \
     timeout "${CI_ASAN_TIMEOUT_S:-1200}" \
         python -m pytest tests/test_native_store.py tests/test_fastlane.py \
-            tests/test_dag.py -q -k "not tensor and not device"
+            tests/test_dag.py tests/test_tensor_lane_asan.py \
+            -q -k "(not tensor and not device) or tensor_lane_asan"
     rm -rf ray_tpu/_native/build   # drop instrumented builds
     echo "ASAN PASSED"
     exit 0

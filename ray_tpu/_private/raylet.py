@@ -449,6 +449,10 @@ class Raylet:
         """Abrupt node death for fault-injection tests (the cluster_utils
         `remove_node` analog): SIGKILL workers, drop connections ungracefully
         so the GCS health path — not a clean unregister — detects it."""
+        if self.syncer is not None:
+            # a "dead" node must stop gossiping, or it keeps re-opening
+            # peer connections die() just severed
+            self.syncer.stop()
         for proc in self._subprocs:
             try:
                 proc.kill()
@@ -479,7 +483,7 @@ class Raylet:
             if self._pending_leases:  # capacity elsewhere: try spillback
                 asyncio.ensure_future(self._pump_pending())
 
-    def _apply_peer_resources(self, node_hex: str, address: str,
+    def _apply_peer_resources(self, node_hex: str,
                               available: dict) -> None:
         """Gossip-learned availability (syncer.py) feeding the same
         spillback view the hub pushes maintain. Availability ONLY:

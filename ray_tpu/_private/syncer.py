@@ -35,7 +35,7 @@ class ResourceSyncer:
         self.raylet = raylet
         self.interval_s = interval_s
         self.fanout = fanout
-        # node_hex -> {"seq", "available", "pending", "address", "ts"}
+        # node_hex -> {"seq", "available"}
         self.view: Dict[str, Dict[str, Any]] = {}
         self._task: Optional[asyncio.Task] = None
         self.rounds = 0
@@ -43,9 +43,11 @@ class ResourceSyncer:
     # ------------------------------------------------------------ local
     def local_update(self, available: dict, pending: list,
                      seq: int) -> None:
+        # entries carry ONLY what consumers read (seq ordering +
+        # availability): every extra field ships O(N * fanout) copies
+        # per interval cluster-wide
         self.view[self.raylet.node_id.hex()] = {
-            "seq": seq, "available": available, "pending": pending,
-            "address": self.raylet.server.address, "ts": time.time(),
+            "seq": seq, "available": available,
         }
 
     def evict(self, node_hex: str) -> None:
@@ -75,8 +77,7 @@ class ResourceSyncer:
                 continue
             self.view[node] = entry
             applied += 1
-            self.raylet._apply_peer_resources(
-                node, entry["address"], entry["available"])
+            self.raylet._apply_peer_resources(node, entry["available"])
         return applied
 
     # ----------------------------------------------------------- gossip

@@ -53,6 +53,15 @@ _UI_HTML = """<!doctype html>
  <section><h2>Jobs</h2><div id="jobs"></div></section>
  <section><h2>Task summary</h2><div id="tasks"></div></section>
  <section><h2>Events</h2><div id="events"></div></section>
+ <section><h2>Task timeline</h2>
+  <div style="margin-bottom:6px"><a href="/api/timeline" download="timeline.json">
+   download chrome-trace JSON</a> (open in Perfetto)</div>
+  <div id="timeline"></div></section>
+ <section><h2>Worker logs</h2>
+  <select id="lognode"></select> <select id="logfile"></select>
+  <button onclick="tailLog()">tail</button>
+  <pre id="logview" style="max-height:300px;overflow:auto;background:#111;
+   color:#ddd;padding:8px;font-size:11px"></pre></section>
 </main>
 <script>
 const esc=s=>String(s).replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;',
@@ -97,7 +106,39 @@ async function refresh(){try{
  document.getElementById('status').textContent=
   'updated '+new Date().toLocaleTimeString();
 }catch(e){document.getElementById('status').textContent='error: '+e;}}
-refresh();setInterval(refresh,5000);
+async function refreshTimeline(){try{
+ const tl=await j('/api/timeline');
+ tl.sort((a,b)=>b.ts-a.ts);
+ document.getElementById('timeline').innerHTML=table(tl.slice(0,40).map(e=>({
+  task:e.name,start:new Date(e.ts/1000).toLocaleTimeString(),
+  dur_ms:(e.dur/1000).toFixed(1),state:e.args&&e.args.state||'',
+  error:e.args&&e.args.error||''})),
+  ['task','start','dur_ms','state','error']);
+}catch(e){}}
+async function refreshLogs(){try{
+ const nodes=await j('/api/nodes');
+ const sel=document.getElementById('lognode');
+ const cur=sel.value;
+ sel.innerHTML=nodes.filter(n=>n.Alive).map(n=>
+  '<option value="'+esc(n.NodeID)+'">'+esc((n.NodeID||'').slice(0,12))
+  +'</option>').join('');
+ if(cur)sel.value=cur;
+ const files=await j('/api/logs?node_id='+encodeURIComponent(sel.value||''));
+ const fsel=document.getElementById('logfile');
+ const fcur=fsel.value;
+ fsel.innerHTML=files.map(f=>'<option>'+esc(f)+'</option>').join('');
+ if(fcur)fsel.value=fcur;
+}catch(e){}}
+async function tailLog(){
+ const n=document.getElementById('lognode').value;
+ const f=document.getElementById('logfile').value;
+ if(!f)return;
+ const r=await fetch('/api/logs/tail?node_id='+encodeURIComponent(n)
+  +'&file='+encodeURIComponent(f)+'&lines=200');
+ document.getElementById('logview').textContent=await r.text();}
+refresh();refreshTimeline();refreshLogs();
+setInterval(refresh,5000);setInterval(refreshTimeline,10000);
+setInterval(refreshLogs,15000);
 </script></body></html>
 """
 
@@ -151,6 +192,23 @@ def _routes():
             "task_summary": state_api.summarize_tasks(),
         })
 
+    async def api_timeline(_req):
+        from .util import tracing
+
+        return _json(tracing.timeline())
+
+    async def api_logs(req):
+        node = req.query.get("node_id") or None
+        return _json(state_api.list_logs(node))
+
+    async def api_log_tail(req):
+        node = req.query.get("node_id") or None
+        filename = req.query["file"]
+        lines = int(req.query.get("lines", 200))
+        text = state_api.get_log(filename, node, tail_bytes=lines * 120)
+        return web.Response(text=text or "", content_type="text/plain",
+                            charset="utf-8")
+
     async def prometheus_metrics(_req):
         from ._private.prometheus import render_cluster
 
@@ -171,6 +229,9 @@ def _routes():
     app.router.add_get("/api/metrics", api_metrics)
     app.router.add_get("/api/events", api_events)
     app.router.add_get("/api/cluster_status", api_cluster_status)
+    app.router.add_get("/api/timeline", api_timeline)
+    app.router.add_get("/api/logs", api_logs)
+    app.router.add_get("/api/logs/tail", api_log_tail)
     return app
 
 

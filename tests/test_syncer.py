@@ -38,25 +38,22 @@ def test_syncer_merge_semantics():
         _remote_nodes = {}
 
         @staticmethod
-        def _apply_peer_resources(node, address, available):
+        def _apply_peer_resources(node, available):
             applied.append((node, available))
 
     applied = []
     sync = ResourceSyncer(FakeRaylet, interval_s=99)
     sync.local_update({"CPU": 4.0}, [], seq=3)
     news = sync.apply({
-        "bb" * 16: {"seq": 1, "available": {"CPU": 1.0}, "pending": [],
-                    "address": "addr-b", "ts": 0},
-        "aa" * 16: {"seq": 99, "available": {"CPU": 0.0}, "pending": [],
-                    "address": "evil", "ts": 0},
+        "bb" * 16: {"seq": 1, "available": {"CPU": 1.0}},
+        "aa" * 16: {"seq": 99, "available": {"CPU": 0.0}},
     })
     assert news == 1                       # own entry ignored
     assert sync.view["aa" * 16]["seq"] == 3
     assert applied == [("bb" * 16, {"CPU": 1.0})]
     # stale replay drops
-    assert sync.apply({"bb" * 16: {"seq": 1, "available": {"CPU": 9.0},
-                                   "pending": [], "address": "addr-b",
-                                   "ts": 0}}) == 0
+    assert sync.apply({"bb" * 16: {"seq": 1,
+                                   "available": {"CPU": 9.0}}}) == 0
     # digest answers incremental pulls
     assert sync.entries_newer_than({"bb" * 16: 1}) == \
         {"aa" * 16: sync.view["aa" * 16]}
