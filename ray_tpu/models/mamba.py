@@ -40,6 +40,30 @@ class MambaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Jamba hybrid (BASELINE "Mamba-2 / Jamba hybrid"): every
+    # `attn_period`-th layer group ends with ONE attention layer —
+    # n_layers must divide by attn_period. 0 = pure Mamba. Attention
+    # reuses the llama-family GQA + rotary ops; the scan runs over
+    # PERIODS so the compiled body stays one period regardless of depth.
+    attn_period: int = 0
+    attn_heads: int = 8
+    attn_kv_heads: int = 4
+    rope_theta: float = 10000.0
+
+    def __post_init__(self):
+        if self.attn_period:
+            if self.n_layers % self.attn_period:
+                raise ValueError(
+                    f"n_layers={self.n_layers} must divide by "
+                    f"attn_period={self.attn_period}")
+            if self.dim % self.attn_heads:
+                raise ValueError(
+                    f"dim={self.dim} must divide by "
+                    f"attn_heads={self.attn_heads}")
+            if self.attn_heads % self.attn_kv_heads:
+                raise ValueError(
+                    f"attn_heads={self.attn_heads} must divide by "
+                    f"attn_kv_heads={self.attn_kv_heads}")
 
     @property
     def inner(self) -> int:
@@ -49,13 +73,25 @@ class MambaConfig:
     def n_heads(self) -> int:
         return self.inner // self.head_dim
 
+    @property
+    def n_attn_layers(self) -> int:
+        return self.n_layers // self.attn_period if self.attn_period else 0
+
+    @property
+    def n_mamba_layers(self) -> int:
+        return self.n_layers - self.n_attn_layers
+
     def n_params(self) -> int:
         d, di, H = self.dim, self.inner, self.n_heads
         # in_proj emits z(di) + x(di) + B(N) + C(N) + dt(H) per token
         proj_in = d * (2 * di + 2 * self.state_dim + H)
         conv = self.conv_width * (di + 2 * self.state_dim)
         per_layer = proj_in + conv + di * d + 3 * H + d
-        return self.vocab * d * 2 + self.n_layers * per_layer + d
+        hd = d // self.attn_heads if self.attn_period else 0
+        per_attn = (d * (self.attn_heads + 2 * self.attn_kv_heads) * hd
+                    + self.attn_heads * hd * d + d)
+        return (self.vocab * d * 2 + self.n_mamba_layers * per_layer
+                + self.n_attn_layers * per_attn + d)
 
 
 MAMBA_CONFIGS: Dict[str, MambaConfig] = {
@@ -65,6 +101,14 @@ MAMBA_CONFIGS: Dict[str, MambaConfig] = {
     # ~130M class, single-chip bench size
     "130m": MambaConfig(vocab=32768, dim=768, n_layers=24),
     "1b": MambaConfig(vocab=32768, dim=2048, n_layers=48),
+    # Jamba-style hybrid: 3 mamba layers then 1 attention layer per period
+    "jamba_tiny": MambaConfig(vocab=256, dim=64, n_layers=4, state_dim=16,
+                              head_dim=32, chunk=16, attn_period=4,
+                              attn_heads=4, attn_kv_heads=2,
+                              dtype=jnp.float32, remat=False),
+    "jamba_350m": MambaConfig(vocab=32768, dim=1024, n_layers=32,
+                              attn_period=4, attn_heads=8,
+                              attn_kv_heads=4),
 }
 
 
@@ -82,14 +126,19 @@ def mamba_param_axes(cfg: MambaConfig):
         },
         "final_norm": ("embed",),
         "lm_head": ("embed", "vocab"),
+        **({"attn_layers": {
+            "norm": ("layers", "embed"),
+            "wqkv": ("layers", "embed", "heads_qkv"),
+            "wo": ("layers", "heads_qkv", "embed"),
+        }} if cfg.attn_period else {}),
     }
 
 
 def init_mamba(key, cfg: MambaConfig):
     d, di, N, H = cfg.dim, cfg.inner, cfg.state_dim, cfg.n_heads
-    L = cfg.n_layers
+    L = cfg.n_mamba_layers
     proj_width = 2 * di + 2 * N + H
-    ks = jax.random.split(key, 7)
+    ks = jax.random.split(key, 9)
 
     def norm_init(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32)
@@ -119,6 +168,14 @@ def init_mamba(key, cfg: MambaConfig):
         },
         "final_norm": jnp.ones((d,), cfg.dtype),
         "lm_head": norm_init(ks[5], (d, cfg.vocab), d),
+        **({"attn_layers": {
+            "norm": jnp.ones((cfg.n_attn_layers, d), cfg.dtype),
+            "wqkv": norm_init(
+                ks[7], (cfg.n_attn_layers, d,
+                        (cfg.attn_heads + 2 * cfg.attn_kv_heads)
+                        * (d // cfg.attn_heads)), d),
+            "wo": norm_init(ks[8], (cfg.n_attn_layers, d, d), d),
+        }} if cfg.attn_period else {}),
     }
 
 
@@ -157,6 +214,25 @@ def _block(x, lp, cfg: MambaConfig, csl):
     return x + (y @ lp["w_out"]).astype(x.dtype)
 
 
+def _attn_block(x, ap, cfg: MambaConfig, cos, sin):
+    """One GQA attention layer (the Jamba hybrid's periodic layer),
+    sharing the llama-family attention/rotary ops."""
+    from ..ops import apply_rotary
+    from ..ops.attention import attention
+
+    B_, S, d = x.shape
+    hN, kvN = cfg.attn_heads, cfg.attn_kv_heads
+    hd = d // hN
+    h = rms_norm(x, ap["norm"], cfg.norm_eps)
+    qkv = h @ ap["wqkv"]
+    q, k, v = jnp.split(qkv, [hN * hd, (hN + kvN) * hd], axis=-1)
+    q = apply_rotary(q.reshape(B_, S, hN, hd), cos, sin)
+    k = apply_rotary(k.reshape(B_, S, kvN, hd), cos, sin)
+    v = v.reshape(B_, S, kvN, hd)
+    att = attention(q, k, v, causal=True)
+    return x + (att.reshape(B_, S, hN * hd) @ ap["wo"]).astype(x.dtype)
+
+
 def mamba_forward(params, tokens, cfg: MambaConfig, *,
                   mesh: Optional[Any] = None, rules=None):
     def csl(t, axes):
@@ -176,13 +252,38 @@ def mamba_forward(params, tokens, cfg: MambaConfig, *,
     x = params["embed"][tokens].astype(cfg.dtype)
     x = csl(x, ("batch", "seq", "embed"))
 
-    def layer(x, lp):
-        return _block(x, lp, cfg, csl), None
+    if cfg.attn_period:
+        # Jamba hybrid: scan over PERIODS of (attn_period-1) mamba
+        # layers + 1 attention layer — the compiled body is one period
+        # regardless of depth
+        from ..ops import rope_frequencies
 
-    body = layer
-    if cfg.remat:
-        body = jax.checkpoint(layer)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+        per = cfg.attn_period - 1
+        n_per = cfg.n_attn_layers
+        cos, sin = rope_frequencies(cfg.dim // cfg.attn_heads,
+                                    tokens.shape[1], cfg.rope_theta)
+        mamba_periods = jax.tree.map(
+            lambda a: a.reshape((n_per, per) + a.shape[1:]),
+            params["layers"])
+
+        def period(x, pp):
+            mp, ap = pp
+
+            def inner(x, lp):
+                return _block(x, lp, cfg, csl), None
+
+            x, _ = jax.lax.scan(inner, x, mp)
+            return _attn_block(x, ap, cfg, cos, sin), None
+
+        body = jax.checkpoint(period) if cfg.remat else period
+        x, _ = jax.lax.scan(body, x, (mamba_periods,
+                                      params["attn_layers"]))
+    else:
+        def layer(x, lp):
+            return _block(x, lp, cfg, csl), None
+
+        body = jax.checkpoint(layer) if cfg.remat else layer
+        x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if pad:
         x = x[:, :S]

