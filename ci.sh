@@ -13,6 +13,20 @@ cd "$(dirname "$0")"
 # configs). Rebuilds the C++ libs instrumented and runs the
 # native-heavy suites under libasan. Run BEFORE the normal suite so a
 # corrupted cache dir never leaks into it.
+# --tsan: ThreadSanitizer over the native plane via a standalone C++
+# stress harness (native/tsan_stress.cc) — CPython can't run under TSAN
+# (uninstrumented interpreter + GIL noise), so the C++ engines get their
+# race-detection lane in pure C++ (the .bazelrc tsan-config analog).
+if [[ "${1:-}" == "--tsan" ]]; then
+    echo "== TSAN: native stress harness =="
+    g++ -std=c++17 -O1 -g -fsanitize=thread -pthread \
+        native/tsan_stress.cc native/store_index.cc \
+        native/core_tables.cc native/fastlane.cc -o /tmp/rtpu_tsan
+    TSAN_OPTIONS="halt_on_error=1" timeout 600 /tmp/rtpu_tsan
+    echo "TSAN PASSED"
+    exit 0
+fi
+
 if [[ "${1:-}" == "--sanitize" ]]; then
     echo "== ASAN: native rebuild + native-plane suites =="
     rm -rf ray_tpu/_native/build

@@ -1,0 +1,229 @@
+// ThreadSanitizer stress harness over the native plane.
+//
+// The Python suites can't run under TSAN (uninstrumented CPython + the
+// GIL drown it in noise), so race detection for the C++ components runs
+// here: a standalone binary that hammers each engine from many threads
+// and lets -fsanitize=thread adjudicate the interleavings. This is the
+// .bazelrc tsan-config analog for this repo (SURVEY §5.2); ci.sh --tsan
+// builds and runs it against all three translation units.
+//
+// Build (ci.sh does this):
+//   g++ -std=c++17 -O1 -g -fsanitize=thread -pthread \
+//       native/tsan_stress.cc native/store_index.cc \
+//       native/core_tables.cc native/fastlane.cc -o /tmp/rtpu_tsan
+//
+// Exercised:
+//   * store index   — concurrent reserve/seal/lookup/pin/delete over a
+//                     shared mmap header (process-shared mutex path)
+//   * refcount table— concurrent add/remove/pin/unpin on hot ids
+//   * lease sched   — concurrent queue_push/pump/release
+//   * shm rings     — two producer/consumer pairs across threads
+//
+// Exits 0 iff every invariant held; TSAN reports fail the lane.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+// store_index.cc
+void* rtpu_idx_open(const char* path, uint64_t capacity, uint64_t nslots,
+                    const char* data_dir);
+void rtpu_idx_close(void* h);
+int rtpu_idx_reserve(void* h, const uint8_t* id, uint64_t size,
+                     uint8_t* victims_out, uint32_t max_victims,
+                     uint32_t* n_victims);
+int rtpu_idx_seal(void* h, const uint8_t* id);
+int rtpu_idx_lookup(void* h, const uint8_t* id, uint64_t* size_out,
+                    int touch);
+int rtpu_idx_pin(void* h, const uint8_t* id, int delta);
+int rtpu_idx_delete(void* h, const uint8_t* id);
+uint64_t rtpu_idx_live(void* h);
+// core_tables.cc
+void* rtpu_rc_open();
+void rtpu_rc_close(void* h);
+void rtpu_rc_add_local(void* h, const uint8_t* id);
+int rtpu_rc_remove_local(void* h, const uint8_t* id);
+void rtpu_rc_pin_dep(void* h, const uint8_t* id);
+int rtpu_rc_unpin_dep(void* h, const uint8_t* id);
+int rtpu_rc_contains(void* h, const uint8_t* id);
+uint64_t rtpu_rc_size(void* h);
+void* rtpu_sched_open(uint64_t local_node);
+void rtpu_sched_close(void* h);
+void rtpu_sched_node_upsert(void* h, uint64_t node, const uint32_t* ids,
+                            const double* tot, const double* avail,
+                            uint32_t n);
+void rtpu_sched_queue_push(void* h, uint64_t req_id, const uint32_t* ids,
+                           const double* vals, uint32_t n, int32_t flags,
+                           uint64_t affinity);
+uint64_t rtpu_sched_pump(void* h, uint64_t* out_req, uint64_t* out_node,
+                         uint64_t max);
+void rtpu_sched_release(void* h, uint64_t node, const uint32_t* ids,
+                        const double* vals, uint32_t n);
+// fastlane.cc
+void* rtpu_ring_create(const char* path, uint32_t capacity);
+void* rtpu_ring_open(const char* path);
+int rtpu_ring_push(void* rp, const void* buf, uint32_t len, int timeout_ms);
+int64_t rtpu_ring_pop(void* rp, void* out, uint32_t cap, uint32_t* need_out,
+                      int timeout_ms);
+void rtpu_ring_close(void* rp);
+}
+
+namespace {
+
+constexpr int kThreads = 4;
+constexpr int kOpsPerThread = 2000;
+std::atomic<int> failures{0};
+
+void fill_id(uint8_t* id, int thread, int k) {
+  std::memset(id, 0, 20);
+  std::snprintf(reinterpret_cast<char*>(id), 20, "t%02d-%06d", thread, k);
+}
+
+void stress_index() {
+  const char* path = "/dev/shm/rtpu_tsan_idx";
+  std::remove(path);
+  void* ix = rtpu_idx_open(path, 64 << 20, 1 << 12, nullptr);
+  if (!ix) { failures++; return; }
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; t++) {
+    ts.emplace_back([ix, t] {
+      uint8_t id[20];
+      uint8_t evicted[20 * 64];
+      uint32_t n_evicted = 0;
+      for (int k = 0; k < kOpsPerThread; k++) {
+        fill_id(id, t, k % 97);
+        switch (k % 5) {
+          case 0:
+            if (rtpu_idx_reserve(ix, id, 4096, evicted, 64,
+                                 &n_evicted) == 0)
+              rtpu_idx_seal(ix, id);
+            break;
+          case 1: {
+            uint64_t size = 0;
+            rtpu_idx_lookup(ix, id, &size, 1);
+            break;
+          }
+          case 2:
+            rtpu_idx_pin(ix, id, 1);
+            rtpu_idx_pin(ix, id, -1);
+            break;
+          case 3:
+            rtpu_idx_delete(ix, id);
+            break;
+          default: {
+            uint64_t size = 0;
+            rtpu_idx_lookup(ix, id, &size, 0);
+          }
+        }
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  rtpu_idx_close(ix);
+  std::remove(path);
+}
+
+void stress_refcount() {
+  void* rc = rtpu_rc_open();
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; t++) {
+    ts.emplace_back([rc, t] {
+      uint8_t id[20];
+      for (int k = 0; k < kOpsPerThread; k++) {
+        fill_id(id, t % 2, k % 31);  // two threads share each id range
+        rtpu_rc_add_local(rc, id);
+        rtpu_rc_pin_dep(rc, id);
+        rtpu_rc_contains(rc, id);
+        rtpu_rc_unpin_dep(rc, id);
+        rtpu_rc_remove_local(rc, id);
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  rtpu_rc_close(rc);
+}
+
+void stress_sched() {
+  void* s = rtpu_sched_open(1);
+  uint32_t rid = 0;
+  double cap = 1e9, amt = 1.0;
+  rtpu_sched_node_upsert(s, 1, &rid, &cap, &cap, 1);
+  std::atomic<uint64_t> next_req{1};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; t++) {
+    ts.emplace_back([&, t] {
+      uint32_t id0 = 0;
+      double one = 1.0;
+      uint64_t out_req[64], out_node[64];
+      for (int k = 0; k < kOpsPerThread; k++) {
+        if (t % 2 == 0) {
+          rtpu_sched_queue_push(s, next_req.fetch_add(1), &id0, &one, 1,
+                                0, 0);
+        } else {
+          uint64_t got = rtpu_sched_pump(s, out_req, out_node, 64);
+          for (uint64_t i = 0; i < got; i++)
+            rtpu_sched_release(s, out_node[i], &id0, &one, 1);
+        }
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  rtpu_sched_close(s);
+}
+
+void stress_rings() {
+  const char* base = "/dev/shm/rtpu_tsan_ring";
+  std::remove(base);
+  void* w = rtpu_ring_create(base, 1 << 16);
+  void* r = rtpu_ring_open(base);
+  if (!w || !r) { failures++; return; }
+  std::atomic<long> sum_in{0}, sum_out{0};
+  std::thread producer([&] {
+    char buf[128];
+    for (int k = 0; k < kOpsPerThread * 2; k++) {
+      int len = 16 + (k % 100);
+      std::memset(buf, k & 0xff, len);
+      if (rtpu_ring_push(w, buf, len, 2000) != 0) { failures++; return; }
+      sum_in += len;
+    }
+  });
+  std::thread consumer([&] {
+    char out[256];
+    uint32_t need = 0;
+    for (int k = 0; k < kOpsPerThread * 2; k++) {
+      int64_t got = rtpu_ring_pop(r, out, sizeof(out), &need, 2000);
+      if (got < 0) { failures++; return; }
+      sum_out += got;
+    }
+  });
+  producer.join();
+  consumer.join();
+  if (sum_in.load() != sum_out.load()) failures++;
+  rtpu_ring_close(r);
+  rtpu_ring_close(w);
+  std::remove(base);
+}
+
+}  // namespace
+
+int main() {
+  stress_index();
+  std::printf("index: live=%s ok\n", "done");
+  stress_refcount();
+  std::printf("refcount: ok\n");
+  stress_sched();
+  std::printf("sched: ok\n");
+  stress_rings();
+  std::printf("rings: ok\n");
+  if (failures.load()) {
+    std::printf("FAILURES: %d\n", failures.load());
+    return 1;
+  }
+  std::printf("TSAN STRESS OK\n");
+  return 0;
+}
