@@ -1,0 +1,22 @@
+import time, ray_tpu as ray
+from ray_tpu import _worker_api
+
+@ray.remote(num_cpus=0)
+class Cell:
+    def ping(self):
+        return 1
+
+ray.init(num_cpus=4)
+raylet = _worker_api._node.raylet
+core = _worker_api.core()
+actors = [Cell.remote() for _ in range(1000)]
+for i in range(20):
+    time.sleep(10)
+    alive = sum(1 for s in core._actors.values() if s.state == "ALIVE")
+    print(f"t={10*(i+1)} alive={alive} workers={len(raylet._workers)} "
+          f"starting={raylet._starting} seq={raylet._worker_seq} "
+          f"fpids={len(raylet._factory_pids)} pending={len(raylet._pending_leases)}",
+          flush=True)
+    if alive >= 1000:
+        break
+ray.shutdown()
