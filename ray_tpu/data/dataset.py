@@ -60,6 +60,8 @@ def _pop_budget(kwargs: dict) -> dict:
     if "memory_budget_bytes" in kwargs:
         budget["memory_budget_bytes"] = int(
             kwargs.pop("memory_budget_bytes"))
+    if "autoscale_max" in kwargs:
+        budget["autoscale_max"] = int(kwargs.pop("autoscale_max"))
     return budget
 
 

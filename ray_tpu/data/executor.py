@@ -22,6 +22,7 @@ _SENTINEL = "__stream_end__"
 
 # stage tuning (ref: backpressure_policy/ + resource_manager defaults)
 MAX_INFLIGHT_PER_STAGE = 4
+AUTOSCALE_MAX_INFLIGHT = 12   # per-op autoscaler growth ceiling
 STAGE_QUEUE_CAP = 8
 
 
@@ -58,6 +59,8 @@ class StageStats:
     name: str
     blocks_out: int = 0
     tasks_submitted: int = 0
+    # final in-flight cap (> the default when the autoscaler engaged)
+    max_inflight: int = 0
 
 
 class _Stage(threading.Thread):
@@ -81,10 +84,20 @@ class _Stage(threading.Thread):
         except BaseException as e:  # noqa: BLE001 — surfaced by the executor
             self.error = e
         finally:
-            try:
-                self.out_q.put(_SENTINEL, timeout=1.0)
-            except queue.Full:
-                pass  # stream already abandoned; downstream stops by event
+            # the sentinel must be delivered or the downstream stage
+            # polls its input forever — a SLOW consumer (full queue for
+            # >1s on a loaded box) is not an abandoned one. Always TRY
+            # (a stopped stage's consumer may be the limit's post-stop
+            # drain loop, which needs the eof to finish) and give up
+            # only when stopped AND the queue stays full (the consumer
+            # is truly gone).
+            while True:
+                try:
+                    self.out_q.put(_SENTINEL, timeout=0.2)
+                    break
+                except queue.Full:
+                    if self.stop_event.is_set():
+                        break
 
     def _put_out(self, item) -> bool:
         """Bounded, stop-aware put: returns False (dropping the item) once
@@ -220,6 +233,49 @@ class MapStage(_Stage):
         self.max_inflight = budget.get("max_inflight",
                                        MAX_INFLIGHT_PER_STAGE)
         self.memory_budget = budget.get("memory_budget_bytes")
+        # per-operator autoscaler (ref: data/_internal/execution/
+        # autoscaler/ — the reference sizes each operator's pool from
+        # observed pressure): when this op is the bottleneck (inputs
+        # waiting AND the task pool saturated) its in-flight cap grows,
+        # up to `autoscale_max`; sustained idleness decays it back.
+        # An explicit max_inflight budget pins the cap (user override).
+        self.autoscale_max = (0 if "max_inflight" in budget
+                              else budget.get("autoscale_max",
+                                              AUTOSCALE_MAX_INFLIGHT))
+        if "max_inflight" in budget and "autoscale_max" in budget:
+            raise ValueError(
+                "max_inflight pins the cap; it cannot be combined "
+                "with autoscale_max")
+        if self.autoscale_max and self.autoscale_max < self.max_inflight:
+            # a ceiling below the starting cap IS the cap
+            self.max_inflight = self.autoscale_max
+        self._pressure = 0
+        self._idle_polls = 0
+        self.stats.max_inflight = self.max_inflight
+
+    def _autoscale(self, queue_had_item: bool, pool_full: bool) -> None:
+        if not self.autoscale_max:
+            return
+        if queue_had_item and pool_full:
+            self._pressure += 1
+            self._idle_polls = 0
+            if (self._pressure >= 2
+                    and self.max_inflight < self.autoscale_max):
+                self.max_inflight += 1
+                self.stats.max_inflight = max(self.stats.max_inflight,
+                                              self.max_inflight)
+                self._pressure = 0
+        elif pool_full:
+            # saturated with a momentarily empty queue is BUSY, not
+            # idle — counting it would oscillate the cap on bursty
+            # upstream delivery
+            pass
+        elif not queue_had_item:
+            self._idle_polls += 1
+            if (self._idle_polls >= 16
+                    and self.max_inflight > MAX_INFLIGHT_PER_STAGE):
+                self.max_inflight -= 1
+                self._idle_polls = 0
 
     @staticmethod
     def _ref_size(item) -> int:
@@ -257,6 +313,7 @@ class MapStage(_Stage):
                 try:
                     item = self.in_q.get(timeout=0.2)
                 except queue.Empty:
+                    self._autoscale(False, False)
                     if self.stop_event.is_set() and not inflight:
                         return
                     break
@@ -289,6 +346,20 @@ class MapStage(_Stage):
                 if eof:
                     return
                 continue
+            if not eof and len(inflight) >= self.max_inflight:
+                # saturated right after refill with input still waiting:
+                # this op is the bottleneck — the autoscaler grow signal
+                # (checked HERE, post-fill, because the pop at the end of
+                # each cycle means the top of the loop is never
+                # saturated). The end-of-stream sentinel is not input:
+                # it must not grow the cap when nothing is dispatchable.
+                try:
+                    head_item = self.in_q.queue[0]  # racy peek, read-only
+                except IndexError:
+                    head_item = None
+                self._autoscale(
+                    head_item is not None and head_item is not _SENTINEL,
+                    True)
             head = inflight[0][0]
             ready, _ = wait([head], num_returns=1, timeout=0.2)
             if ready:

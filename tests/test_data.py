@@ -248,3 +248,40 @@ def test_memory_budget_bounds_inflight_bytes(ray_cluster):
     ds = rdata.range(40, parallelism=4).map_batches(
         lambda b: b, memory_budget_bytes=1)
     assert ds.count() == 40
+
+
+def test_per_op_autoscaler_raises_bottleneck_concurrency(tmp_path):
+    """A bottleneck map op (slow tasks, inputs waiting) must have its
+    in-flight cap GROWN by the per-op autoscaler (ref:
+    data/_internal/execution/autoscaler/). Runs in a subprocess with its
+    own 16-CPU session so the module-scoped 4-CPU fixture session is
+    untouched (order-independent)."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "autoscale_probe.py"
+    script.write_text("""
+import time
+import ray_tpu
+import ray_tpu.data as rdata
+from ray_tpu.data.executor import MAX_INFLIGHT_PER_STAGE
+
+def slow(batch):
+    time.sleep(0.4)
+    return batch
+
+ray_tpu.init(num_cpus=16)
+ds = rdata.range(64, parallelism=32).map_batches(slow, num_cpus=0.25)
+assert ds.count() == 64
+stages = ds._last_stats.stages
+map_stage = next(s for s in stages
+                 if s.stage_name.startswith("map_batches"))
+cap = map_stage.stats.max_inflight
+ray_tpu.shutdown()
+assert cap > MAX_INFLIGHT_PER_STAGE, f"autoscaler never engaged: {cap}"
+print("AUTOSCALED_TO", cap)
+""")
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=170)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "AUTOSCALED_TO" in proc.stdout
