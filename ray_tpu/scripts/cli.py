@@ -157,30 +157,87 @@ def _start_blocking(args) -> int:
     signal.signal(signal.SIGINT, _sig)
     while not stop["flag"]:
         time.sleep(0.2)
+    # bounded teardown: a wedged component must not keep a SIGTERM'd
+    # daemon alive forever (observed: heads surviving `stop` for hours)
+    import threading
+
+    killer = threading.Timer(20.0, lambda: os._exit(1))
+    killer.daemon = True
+    killer.start()
     node.stop()
+    killer.cancel()
     return 0
 
 
+def _local_node_pids() -> list:
+    """Every `cli start --block` node process on this host (the
+    reference `ray stop` contract: stop ALL local nodes, not just the
+    last runfile writer — a worker join overwrites the runfile and
+    would otherwise strand the head forever). Matches on parsed argv
+    tokens, so `bash -c "... start --block ..."` wrapper shells and
+    grep bystanders (where the tokens sit inside ONE argv string) are
+    never swept."""
+    pids = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                argv = f.read().decode(errors="replace").split("\0")
+        except OSError:
+            continue
+        if ("ray_tpu.scripts.cli" in argv and "start" in argv
+                and "--block" in argv):
+            pids.append(int(entry))
+    return pids
+
+
 def cmd_stop(args) -> int:
+    pids = _local_node_pids()
     run = _read_runfile()
-    if not run:
-        print("no tracked node on this host")
+    if run and run["pid"] not in pids:
+        # a runfile pid NOT matching the node-argv scan is stale (node
+        # died, pid possibly recycled by an unrelated process): never
+        # signal it — the scan is the verification
+        print(f"runfile pid {run['pid']} is not a node process "
+              f"(stale runfile)")
+    if not pids:
+        if not run:
+            print("no tracked node on this host")
+        try:
+            os.unlink(_ADDR_FILE)
+        except OSError:
+            pass
         return 0
-    try:
-        os.kill(run["pid"], signal.SIGTERM)
-        deadline = time.time() + 15
-        while time.time() < deadline:
+    # signal ALL nodes first, then poll them under one shared deadline,
+    # then SIGKILL survivors — N wedged nodes cost one grace window,
+    # not N of them
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    deadline = time.time() + 25
+    remaining = set(pids)
+    while remaining and time.time() < deadline:
+        for pid in list(remaining):
             try:
-                os.kill(run["pid"], 0)
+                os.kill(pid, 0)
             except ProcessLookupError:
-                break
-            time.sleep(0.1)
-        print(f"stopped pid {run['pid']}")
-    except ProcessLookupError:
-        print(f"pid {run['pid']} already gone")
+                remaining.discard(pid)
+                print(f"stopped pid {pid}")
+            except PermissionError:
+                remaining.discard(pid)  # another user's node: not ours
+        time.sleep(0.1)
+    for pid in remaining:
+        try:
+            os.kill(pid, signal.SIGKILL)
+            print(f"killed pid {pid} (graceful stop timed out)")
+        except (ProcessLookupError, PermissionError):
+            pass
     try:
         os.unlink(_ADDR_FILE)
-    except FileNotFoundError:
+    except OSError:
         pass
     return 0
 
