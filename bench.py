@@ -73,10 +73,17 @@ def _check_pallas_parity():
     return True
 
 
-def _bench_serving(name: str):
+def _bench_serving(name: str, *, quantize: bool = False, B: int = 16,
+                   prefix: str = "serve", max_seq_cap: int = 1024):
     """Continuous-batching decode throughput + TTFT on the chip (the
     BASELINE.json Serve north-star: req/s + p50 TTFT have no published
-    reference value; we report tokens/s/chip and TTFT directly)."""
+    reference value; we report tokens/s/chip and TTFT directly).
+
+    ``quantize``: native per-output-channel int8 weights (ops/quant.py)
+    — the path that puts the 7B-class BASELINE model on ONE 16 GB v5e
+    (8B bf16 params are 16.1 GB; int8 is 8.0 GB). The reference only
+    reaches quantized serving by passing engine kwargs to vLLM
+    (vllm_models.py:59); this engine owns it natively."""
     import numpy as np
     import jax
 
@@ -84,9 +91,13 @@ def _bench_serving(name: str):
     from ray_tpu.models import LLAMA_CONFIGS, init_params
 
     cfg = LLAMA_CONFIGS[name]
-    params = init_params(jax.random.PRNGKey(7), cfg)
-    B = 16
-    max_seq = min(1024, cfg.max_seq)
+    if quantize:
+        from ray_tpu.ops.quant import init_params_quantized
+
+        params = init_params_quantized(jax.random.PRNGKey(7), cfg)
+    else:
+        params = init_params(jax.random.PRNGKey(7), cfg)
+    max_seq = min(max_seq_cap, cfg.max_seq)
     page = 64 if max_seq >= 512 else 16
     engine = LLMEngine(params, cfg, EngineConfig(
         max_num_seqs=B, page_size=page,
@@ -147,23 +158,26 @@ def _bench_serving(name: str):
     for _ in range(steps):
         n_tokens += len(engine.step())
     dt = time.perf_counter() - t0
-    return {
+    out = {
         # which model this family actually ran on (off-TPU smoke runs
         # bench "tiny", and the label must say so — VERDICT r4 weak #9)
-        "serve_model": name,
-        "serve_decode_tokens_per_sec": round(n_tokens / dt, 1),
+        "model": name + ("-int8" if quantize else ""),
+        "decode_tokens_per_sec": round(n_tokens / dt, 1),
         # PRIMARY serving-latency metric: prefill compute. The wall
         # number on this rig is ~90% tunnel RTT to the remote-attached
         # chip — an environment artifact a locally-attached TPU does not
         # pay (VERDICT r3 weak #4: the link share must not masquerade as
         # model latency).
-        "serve_ttft_compute_ms": round(max(0.0, ttft_ms - rtt_ms), 2),
-        "serve_ttft_wall_ms": round(ttft_ms, 2),
-        "serve_link_rtt_ms": round(rtt_ms, 2),
-        "serve_latency_primary": "serve_ttft_compute_ms",
-        "serve_batch": B,
-        "serve_decode_burst": engine.ecfg.decode_burst,
+        "ttft_compute_ms": round(max(0.0, ttft_ms - rtt_ms), 2),
+        "ttft_wall_ms": round(ttft_ms, 2),
+        "link_rtt_ms": round(rtt_ms, 2),
+        "latency_primary": f"{prefix}_ttft_compute_ms",
+        "batch": B,
+        "decode_burst": engine.ecfg.decode_burst,
     }
+    if quantize:
+        out["weight_bytes"] = int(cfg.n_params())  # int8: 1 B/param
+    return {f"{prefix}_{k}": v for k, v in out.items()}
 
 
 def _bench_long_context(name: str):
@@ -389,6 +403,19 @@ def main():
             serve_metrics.update(_bench_long_context("400m"))
         except Exception as e:
             serve_metrics["serve_8k_error"] = repr(e)[:200]
+        # the north-star 7B-class model on the single chip: Llama-3-8B
+        # with native int8 weights (fits 16 GB only quantized)
+        try:
+            # max_seq 512: 8.0 GiB int8 weights + 1.0 GiB KV keep the
+            # whole execution footprint inside the relay-attached v5e's
+            # measured per-execution budget (~13 GiB; the 2 GiB-KV
+            # config ResourceExhausts even though args+temp arithmetic
+            # says 12.6 GiB — donation does not alias over the relay)
+            serve_metrics.update(_bench_serving(
+                "8b", quantize=True, B=8, prefix="serve_8b_int8",
+                max_seq_cap=512))
+        except Exception as e:
+            serve_metrics["serve_8b_int8_error"] = repr(e)[:300]
 
     core_metrics = {}
     try:
@@ -419,8 +446,10 @@ def main():
         "vs_baseline_kind": "proxy_mfu_over_0.40",
         "loss": train["loss"],
         "note_8b": ("Llama-3-8B bf16 params alone (16.1 GB) exceed one "
-                    "16 GB v5e; single-chip headline is the 1b config, "
-                    "8b/70b shardings run in dryrun_multichip"),
+                    "16 GB v5e; the TRAIN headline stays the 1b config "
+                    "(8b/70b shardings run in dryrun_multichip), but 8B "
+                    "SERVES on this chip via native int8 weights — see "
+                    "serve_8b_int8_* metrics"),
         **extras,
         **serve_metrics,
         **core_metrics,

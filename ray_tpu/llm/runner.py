@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..models.llama import LlamaConfig
 from ..ops import apply_rotary, attention, rms_norm, rope_frequencies
+from ..ops.quant import embed_lookup, is_quantized, weight_einsum
 from .cache import KVCache
 
 
@@ -42,9 +43,19 @@ def _mlp(h, lp, cfg: LlamaConfig):
 
         return moe_mlp_dense(h, lp["router"], lp["w_gate"], lp["w_up"],
                              lp["w_down"], top_k=cfg.top_k)
-    g = jnp.einsum("bsd,dm->bsm", h, lp["w_gate"])
-    u = jnp.einsum("bsd,dm->bsm", h, lp["w_up"])
-    return jnp.einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
+    g = weight_einsum("bsd,dm->bsm", h, lp["w_gate"])
+    u = weight_einsum("bsd,dm->bsm", h, lp["w_up"])
+    return weight_einsum("bsm,md->bsd", jax.nn.silu(g) * u, lp["w_down"])
+
+
+def _lm_logits(x_last, params, cfg: LlamaConfig):
+    """Final-norm'd hidden -> f32 logits, raw or int8 lm_head. bf16
+    operands on the MXU with f32 accumulation either way."""
+    lm = params["lm_head"]
+    if not is_quantized(lm):
+        lm = lm.astype(cfg.dtype)
+    return weight_einsum("bd,dv->bv", x_last.astype(cfg.dtype), lm,
+                         preferred_element_type=jnp.float32)
 
 
 def _write_pages(cache_layer, new, block_tables, positions, page_size):
@@ -77,16 +88,16 @@ def prefill(params, cache_k, cache_v, tokens, prompt_lens, block_tables,
     Returns (logits [B, vocab], cache_k, cache_v).
     """
     B, S = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     pos_grid = jnp.arange(S)[None, :].repeat(B, 0)
     write_pos = jnp.where(pos_grid < prompt_lens[:, None], pos_grid, -1)
 
     def layer(x, inputs):
         lp, ck, cv = inputs
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = weight_einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = weight_einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = weight_einsum("bsd,dhk->bshk", h, lp["wv"])
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
         ck = _write_pages(ck, k, block_tables, write_pos, ck.shape[1])
@@ -94,7 +105,7 @@ def prefill(params, cache_k, cache_v, tokens, prompt_lens, block_tables,
         # right padding is safe under the causal mask: a real position
         # only attends to earlier (real) positions
         o = attention(q, k, v, causal=True)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+        x = x + weight_einsum("bshk,hkd->bsd", o, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + _mlp(h, lp, cfg)
         return x, (ck, cv)
@@ -104,9 +115,7 @@ def prefill(params, cache_k, cache_v, tokens, prompt_lens, block_tables,
     x_last = jnp.take_along_axis(
         x, jnp.maximum(prompt_lens - 1, 0)[:, None, None], axis=1)[:, 0]
     x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bd,dv->bv", x_last.astype(cfg.dtype),
-                        params["lm_head"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
+    logits = _lm_logits(x_last, params, cfg)
     return logits, cache_k, cache_v
 
 
@@ -136,7 +145,7 @@ def prefill_chunk(params, cache_k, cache_v, tokens, start_pos, chunk_len,
     B, C = tokens.shape
     page_size = cache_k.shape[2]
     Spast = block_tables.shape[1] * page_size
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     pos_grid = start_pos + jnp.arange(C)[None, :]          # [1, C]
     valid = jnp.arange(C)[None, :] < chunk_len
     write_pos = jnp.where(valid, pos_grid, -1)
@@ -148,9 +157,9 @@ def prefill_chunk(params, cache_k, cache_v, tokens, start_pos, chunk_len,
     def layer(x, inputs):
         lp, ck, cv = inputs
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = weight_einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = weight_einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = weight_einsum("bsd,dhk->bshk", h, lp["wv"])
         q = apply_rotary(q, cos, sin, positions=pos_grid)
         k = apply_rotary(k, cos, sin, positions=pos_grid)
         ck = _write_pages(ck, k, block_tables, write_pos, page_size)
@@ -179,7 +188,7 @@ def prefill_chunk(params, cache_k, cache_v, tokens, start_pos, chunk_len,
              + jnp.einsum("bcgrt,btgd->bcgrd", p[..., Spast:], v,
                           preferred_element_type=jnp.float32))
         o = o.reshape(B, C, cfg.n_heads, hd).astype(x.dtype)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+        x = x + weight_einsum("bshk,hkd->bsd", o, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + _mlp(h, lp, cfg)
         return x, (ck, cv)
@@ -190,9 +199,7 @@ def prefill_chunk(params, cache_k, cache_v, tokens, start_pos, chunk_len,
                            (B, 1, 1))
     x_last = jnp.take_along_axis(x, idx, axis=1)[:, 0]
     x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bd,dv->bv", x_last.astype(cfg.dtype),
-                        params["lm_head"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
+    logits = _lm_logits(x_last, params, cfg)
     return logits, cache_k, cache_v
 
 
@@ -278,7 +285,7 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
     def step(carry, i):
         toks, sk, sv = carry
         pos_i = positions + i
-        x = jnp.take(params["embed"], toks, axis=0)[:, None, :]
+        x = embed_lookup(params["embed"], toks, cfg.dtype)[:, None, :]
         new_mask = jnp.arange(K)[None, :] <= i                 # [1, K]
 
         def attend_gathered(qg, ok, ov, nk, nv):
@@ -310,9 +317,9 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
         def layer(x, inputs):
             lp, ok, ov, nk, nv = inputs
             h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-            q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-            k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-            v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+            q = weight_einsum("bsd,dhk->bshk", h, lp["wq"])
+            k = weight_einsum("bsd,dhk->bshk", h, lp["wk"])
+            v = weight_einsum("bsd,dhk->bshk", h, lp["wv"])
             q = apply_rotary(q, cos, sin, positions=pos_i[:, None])[:, 0]
             k = apply_rotary(k, cos, sin, positions=pos_i[:, None])[:, 0]
             nk = jax.lax.dynamic_update_index_in_dim(
@@ -325,7 +332,7 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
             else:
                 o = attend_gathered(qg, ok, ov, nk, nv)
             o = o.reshape(B, 1, cfg.n_heads, hd).astype(x.dtype)
-            x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+            x = x + weight_einsum("bshk,hkd->bsd", o, lp["wo"])
             h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
             x = x + _mlp(h, lp, cfg)
             return x, (nk, nv)
@@ -348,9 +355,7 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
             x, (sk, sv) = jax.lax.scan(
                 layer, x, (params["layers"], old_k, old_v, sk, sv))
         h = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
-        logits = jnp.einsum("bd,dv->bv", h.astype(cfg.dtype),
-                            params["lm_head"].astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
+        logits = _lm_logits(h, params, cfg)
         newt = sample_from_logits(logits, seed + i, temperature, top_k,
                                   top_p)
         newt = jnp.where(active, newt, toks)

@@ -11,9 +11,14 @@ from .rotary import apply_rotary, rope_frequencies
 from .attention import attention, flash_attention_tpu, naive_attention
 from .ring_attention import ring_attention
 from .moe import moe_dispatch, moe_mlp, moe_mlp_oracle
+from .quant import (
+    dequantize_weight, embed_lookup, init_params_quantized,
+    quantize_params, quantize_weight, weight_einsum)
 
 __all__ = [
     "rms_norm", "apply_rotary", "rope_frequencies",
     "attention", "flash_attention_tpu", "naive_attention",
     "ring_attention", "moe_dispatch", "moe_mlp", "moe_mlp_oracle",
+    "quantize_weight", "dequantize_weight", "weight_einsum",
+    "embed_lookup", "quantize_params", "init_params_quantized",
 ]

@@ -1,0 +1,178 @@
+"""Weight-only int8 quantization (w8a16) for serving.
+
+Why this exists: Llama-3-8B's bf16 parameters are 16.1 GB — more than
+one 16 GB v5e holds — so the BASELINE 7B-class model cannot touch a
+single chip at full precision. Per-output-channel symmetric int8 halves
+weight bytes (8B → 8.0 GB) and the model fits with room for the paged
+KV cache. The reference only reaches quantized serving by passing
+engine kwargs through to vLLM (ref: python/ray/llm/_internal/serve/
+deployments/llm/vllm/vllm_models.py:59 `engine_kwargs`); this framework
+owns its engine, so the path is native.
+
+Design (TPU-first):
+  * a quantized weight is a pytree leaf-dict ``{"q": int8[w.shape],
+    "s": f32[output-dims]}`` — scales are indexed by the NON-contracted
+    (output) dims, so ``einsum(x, q) * s`` is bit-exact with
+    dequantize-then-matmul while the per-channel multiply stays a cheap
+    elementwise epilogue XLA fuses into the matmul consumer;
+  * decode is weight-bandwidth-bound: HBM reads the int8 bytes and the
+    int8→bf16 convert fuses into the dot's operand load, so effective
+    weight bandwidth doubles — int8 is a *throughput* feature on top of
+    the capacity one;
+  * stacked layer weights carry their "layers" axis in BOTH q and s, so
+    ``lax.scan`` / per-layer tree slicing works on quantized trees
+    unchanged;
+  * activations stay bf16 (w8a16). Full-int8 MXU matmuls (w8a8 with
+    dynamic activation scales) are the upgrade path, not the default:
+    decode batch=B matmuls are too skinny for int8 MXU gains to beat
+    the requantize overhead on v5e.
+
+Quantization math: symmetric per-output-channel. ``s = amax_over_
+contracted_dims(|w|) / 127``; ``q = round(w / s)``. Embeddings are
+quantized per-row (each vocab entry its own scale) since lookup is a
+gather, not a matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "quantize_weight", "dequantize_weight", "weight_einsum",
+    "embed_lookup", "quantize_params", "init_params_quantized",
+    "is_quantized",
+]
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def quantize_weight(w, contract_axes: Sequence[int]) -> Dict[str, Any]:
+    """Symmetric per-output-channel int8. ``contract_axes``: the axes a
+    matmul will contract (reduced out of the scale). Works on numpy
+    arrays (host-side checkpoint load) and jax arrays alike."""
+    xp = np if isinstance(w, np.ndarray) else jnp
+    wf = xp.asarray(w, dtype=xp.float32)
+    amax = xp.max(xp.abs(wf), axis=tuple(contract_axes))
+    s = xp.maximum(amax, 1e-8) / 127.0
+    s_b = xp.expand_dims(s, tuple(contract_axes))
+    q = xp.clip(xp.round(wf / s_b), -127, 127).astype(xp.int8)
+    return {"q": q, "s": s.astype(xp.float32)}
+
+
+def dequantize_weight(w: Dict[str, Any], contract_axes: Sequence[int],
+                      dtype=jnp.bfloat16):
+    xp = np if isinstance(w["q"], np.ndarray) else jnp
+    s_b = xp.expand_dims(w["s"], tuple(contract_axes))
+    return (w["q"].astype(xp.float32) * s_b).astype(dtype)
+
+
+def weight_einsum(eq: str, x, w, *, preferred_element_type=None):
+    """``jnp.einsum(eq, x, w)`` that transparently handles quantized
+    ``w``. The scale multiplies the OUTPUT (exact for per-output-channel
+    scales, since scales are constant along contracted dims); the
+    multiply runs in f32 and the result returns in the dtype the
+    unquantized einsum would have produced.
+
+    Requirement on ``eq``: every output dim that belongs to ``w`` is a
+    trailing suffix of the output spec in the same order as in ``s``
+    (true for all y = x @ W projection forms: "...d,dhk->...hk" etc.).
+    """
+    if not is_quantized(w):
+        return jnp.einsum(eq, x, w,
+                          preferred_element_type=preferred_element_type)
+    out = jnp.einsum(eq, x, w["q"].astype(x.dtype),
+                     preferred_element_type=preferred_element_type)
+    scaled = out.astype(jnp.float32) * w["s"]
+    target = out.dtype if preferred_element_type is None \
+        else preferred_element_type
+    return scaled.astype(target)
+
+
+def embed_lookup(embed, tokens, dtype=None):
+    """Embedding-table row gather for raw or per-row-quantized tables."""
+    if not is_quantized(embed):
+        x = jnp.take(embed, tokens, axis=0)
+        return x if dtype is None else x.astype(dtype)
+    rows = jnp.take(embed["q"], tokens, axis=0).astype(jnp.float32)
+    scale = jnp.take(embed["s"], tokens, axis=0)
+    x = rows * scale[..., None]
+    return x.astype(dtype or jnp.bfloat16)
+
+
+# Contract-axis map for the stacked Llama layer tree (leading axis is
+# "layers", never contracted). Matches models/llama.py init_params.
+_LLAMA_LAYER_CONTRACT = {
+    "wq": (1,),      # (L, d, h, hd)   contract d
+    "wk": (1,),
+    "wv": (1,),
+    "wo": (1, 2),    # (L, h, hd, d)   contract h, hd
+    "w_gate": (1,),  # (L, d, m)       contract d
+    "w_up": (1,),
+    "w_down": (1,),  # (L, m, d)       contract m
+}
+
+
+def quantize_params(params: Dict, cfg=None) -> Dict:
+    """Quantize a dense-Llama param tree for serving: all projection
+    matrices + embedding (per-row) + lm_head go int8; norms stay as-is
+    (tiny, precision-sensitive). MoE configs keep expert weights
+    unquantized for now (the dense-mixture serving path would need
+    per-expert scale plumbing) — raise rather than silently skip."""
+    if cfg is not None and getattr(cfg, "n_experts", 0):
+        raise NotImplementedError(
+            "int8 quantization for MoE expert weights is not wired up")
+    layers = dict(params["layers"])
+    for name, axes in _LLAMA_LAYER_CONTRACT.items():
+        if name in layers:
+            layers[name] = quantize_weight(layers[name], axes)
+    return {
+        "embed": quantize_weight(params["embed"], (1,)),   # per-row
+        "layers": layers,
+        "final_norm": params["final_norm"],
+        "lm_head": quantize_weight(params["lm_head"], (0,)),
+    }
+
+
+def init_params_quantized(key, cfg) -> Dict:
+    """Random int8 params DIRECTLY on device — the benchmarking path
+    for configs whose bf16 init cannot exist on one chip (8B: 16.1 GB
+    bf16 vs 8.0 GB int8). ``jax.random.bits`` emits uint8 natively so
+    no 4x int32 intermediate is ever allocated; values are bitcast to
+    int8 and scales chosen so dequantized weights look like the
+    1/sqrt(fan_in) init (uniform int8 has RMS ≈ 74, so
+    s = fan_in**-0.5 / 74 gives unit-variance-scaled projections)."""
+    if getattr(cfg, "n_experts", 0):
+        raise NotImplementedError("quantized init for MoE not wired up")
+    L, d, hd = cfg.n_layers, cfg.dim, cfg.head_dim
+    h, hkv, m = cfg.n_heads, cfg.n_kv_heads, cfg.mlp_dim
+    ks = iter(jax.random.split(key, 16))
+
+    def qrand(shape, fan_in, out_dims: Tuple[int, ...]):
+        bits = jax.random.bits(next(ks), shape, jnp.uint8)
+        q = jax.lax.bitcast_convert_type(bits, jnp.int8)
+        s_shape = tuple(shape[i] for i in out_dims)
+        s = jnp.full(s_shape, (fan_in ** -0.5) / 74.0, jnp.float32)
+        return {"q": q, "s": s}
+
+    return {
+        "embed": qrand((cfg.vocab, d), d, (0,)),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.bfloat16),
+            "wq": qrand((L, d, h, hd), d, (0, 2, 3)),
+            "wk": qrand((L, d, hkv, hd), d, (0, 2, 3)),
+            "wv": qrand((L, d, hkv, hd), d, (0, 2, 3)),
+            "wo": qrand((L, h, hd, d), h * hd, (0, 3)),
+            "mlp_norm": jnp.ones((L, d), jnp.bfloat16),
+            "w_gate": qrand((L, d, m), d, (0, 2)),
+            "w_up": qrand((L, d, m), d, (0, 2)),
+            "w_down": qrand((L, m, d), m, (0, 2)),
+        },
+        "final_norm": jnp.ones((d,), jnp.bfloat16),
+        "lm_head": qrand((d, cfg.vocab), d, (1,)),
+    }
