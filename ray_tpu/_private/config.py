@@ -85,6 +85,10 @@ class Config:
     actor_max_restarts_default: int = 0
     health_check_period_ms: int = 1000
     health_check_failure_threshold: int = 5
+    # per-probe RPC timeout for the GCS's ACTIVE node health checks
+    # (ref: gcs_health_check_manager.h kDefaultTimeoutMs); 0 disables
+    # active probing (disconnect-only death detection)
+    health_check_timeout_ms: int = 2000
     # resource view propagation (syncer.py): "hub" = GCS pubsub fan-out
     # (O(N^2) msgs/interval through one loop), "gossip" = push-pull
     # anti-entropy, O(fanout) per node, O(log N) rounds to converge

@@ -527,6 +527,14 @@ class CoreWorker:
         event = {"task_id": task_id}
         event.update(fields)
         with self._task_events_lock:
+            # bounded buffer: a submit burst must not build an unbounded
+            # flush payload that then monopolizes the GCS loop (observed
+            # r4: flush backlog starving actor creations). Oldest events
+            # drop first, like the reference's ring buffer
+            # (task_event_buffer.h kMaxBufferedTaskEvents).
+            if len(self._task_events) >= self._TASK_EVENT_BUFFER_MAX:
+                del self._task_events[:self._TASK_EVENT_FLUSH_MAX]
+                self._task_events_dropped += self._TASK_EVENT_FLUSH_MAX
             self._task_events.append(event)
             arm = not self._task_event_flusher_armed
             if arm:
@@ -534,9 +542,17 @@ class CoreWorker:
         if arm:
             self.io.spawn(self._task_event_flusher())
 
+    _TASK_EVENT_FLUSH_MAX = 2000     # events per report RPC
+    _TASK_EVENT_BUFFER_MAX = 100_000
+    _task_event_flusher_armed = False
+    _task_events_dropped = 0
+
     async def _task_event_flusher(self):
         """Standing flusher; exits after an idle period so short-lived
-        cores don't keep a wakeup loop alive."""
+        cores don't keep a wakeup loop alive. Flushes in BOUNDED chunks:
+        each chunk is one awaited GCS RPC, so control-plane traffic
+        (lease grants, actor registration) interleaves between chunks
+        instead of queueing behind one giant report."""
         idle = 0
         while idle < 20:
             await asyncio.sleep(0.25)
@@ -544,7 +560,9 @@ class CoreWorker:
                 flush, self._task_events = self._task_events, []
             if flush:
                 idle = 0
-                await self._send_task_events(flush)
+                for i in range(0, len(flush), self._TASK_EVENT_FLUSH_MAX):
+                    await self._send_task_events(
+                        flush[i:i + self._TASK_EVENT_FLUSH_MAX])
             else:
                 idle += 1
         with self._task_events_lock:
@@ -1756,10 +1774,7 @@ class CoreWorker:
         state.creation_spec = spec
         state.owned = True
         self._actors[actor_id] = state
-        # subscribe BEFORE registering: the owner must see every
-        # lifecycle transition (it drives restarts off RESTARTING)
-        self.io.run(self._ensure_actor_sub(actor_id))
-        self.io.run(self.gcs.call("register_actor", {
+        register_payload = {
             "actor_id": actor_id,
             "name": spec.actor_name,
             "namespace": opts.get("namespace", ""),
@@ -1768,12 +1783,48 @@ class CoreWorker:
             "class_name": spec.function.repr_name,
             "max_restarts": spec.actor_max_restarts,
             "creation_spec": cloudpickle.dumps(spec),
-        }))
+            # register + keyed lifecycle subscription in ONE GCS hop
+            # (the subscription is installed server-side before the
+            # registered state publishes, so no transition is missed)
+            "subscribe": True,
+        }
         # restartable actors keep creation args pinned for their lifetime so
         # the creation spec can be resubmitted
-        self.io.spawn(self._submit_actor_creation(
-            spec, [] if spec.actor_max_restarts > 0 else deps))
+        pinned_deps = [] if spec.actor_max_restarts > 0 else deps
+        if spec.actor_name:
+            # named: registration stays synchronous so a duplicate-name
+            # ValueError surfaces at .remote() itself
+            self.io.run(self.gcs.call("register_actor", register_payload))
+            self._subscribed_channels.add("actor:" + actor_id.hex())
+            self.io.spawn(self._submit_actor_creation(spec, pinned_deps))
+        else:
+            # unnamed: the whole register->lease->push chain runs async,
+            # so creations PIPELINE — .remote() costs no GCS round trip
+            # (the r4 envelope measured 90-183 ms/actor, nearly all of
+            # it these two blocking hops queued behind a busy GCS; ref
+            # gcs_actor_manager.cc:394 RegisterActor is async there too)
+            self.io.spawn(self._register_and_create(
+                spec, register_payload, pinned_deps))
         return actor_id
+
+    async def _register_and_create(self, spec: TaskSpec, payload: dict,
+                                   deps: List[ObjectID]):
+        try:
+            await self.gcs.call("register_actor", payload)
+        except asyncio.CancelledError:
+            raise  # loop teardown — not a registration verdict
+        except Exception as e:
+            state = self._actors.get(spec.actor_id)
+            if state is not None:
+                state.state = "DEAD"
+                state.death_cause = f"actor registration failed: {e!r}"
+                for fut in state.waiters:
+                    if not fut.done():
+                        fut.set_result("DEAD")
+                state.waiters.clear()
+            return
+        self._subscribed_channels.add("actor:" + spec.actor_id.hex())
+        await self._submit_actor_creation(spec, deps)
 
     async def _submit_actor_creation(self, spec: TaskSpec, deps: List[ObjectID]):
         try:

@@ -92,6 +92,7 @@ class GcsServer:
         self._remote_store: Optional[RemoteStoreClient] = None
         self._on_storage_failure = on_storage_failure
         self._storage_health_task: Optional[asyncio.Task] = None
+        self._node_health_task: Optional[asyncio.Task] = None
         if external_store_address:
             self._remote_store = RemoteStoreClient(external_store_address)
             self.storage = Storage(journal_path, remote=self._remote_store)
@@ -168,11 +169,71 @@ class GcsServer:
             self._storage_health_task = asyncio.ensure_future(
                 self._storage_failure_detector())
         await self.server.start()
+        from .config import global_config
+
+        if global_config().health_check_timeout_ms > 0:
+            self._node_health_task = asyncio.ensure_future(
+                self._node_health_loop())
         # restored placement groups that never finished reserving resume
         # scheduling now that the loop is live (restart recovery)
         for pg in self.placement_groups.values():
             if pg["state"] in ("PENDING", "RESCHEDULING"):
                 self._kick_pg_scheduler(pg["pg_id"])
+
+    async def _node_health_loop(self):
+        """ACTIVE node liveness probing (ref: gcs_health_check_manager.h:45
+        — periodic per-node probe + consecutive-failure threshold).
+        Socket disconnect alone misses wedged-but-connected raylets
+        (SIGSTOP, half-open TCP, a livelocked event loop): each round
+        calls ``health`` on every alive raylet with a timeout; after
+        health_check_failure_threshold consecutive misses the node is
+        declared dead through the same _mark_node_dead path a disconnect
+        takes (actors failed, objects reaped/lineage-rebuilt, PG bundles
+        rescheduled)."""
+        from .config import global_config
+
+        cfg = global_config()
+        period = max(0.05, cfg.health_check_period_ms / 1000.0)
+        timeout = max(0.05, cfg.health_check_timeout_ms / 1000.0)
+        misses: Dict[NodeID, int] = {}
+        while True:
+            await asyncio.sleep(period)
+            for node_id, info in list(self.nodes.items()):
+                if not info.alive:
+                    misses.pop(node_id, None)
+                    continue
+
+                async def _probe(node_id=node_id, info=info):
+                    try:
+                        client = await asyncio.wait_for(
+                            self._raylet_client(info.address), timeout)
+                        ok = await client.call("health", {}, timeout=timeout)
+                    except Exception:
+                        ok = False
+                    if ok:
+                        misses.pop(node_id, None)
+                        return
+                    n = misses.get(node_id, 0) + 1
+                    misses[node_id] = n
+                    if n >= cfg.health_check_failure_threshold:
+                        misses.pop(node_id, None)
+                        # drop AND close the cached client: a later
+                        # reconnect must not reuse a half-open transport,
+                        # and a wedged peer never closes its end — without
+                        # close() the recv task and fd leak per death
+                        stale = self._pg_raylet_clients.pop(
+                            info.address, None)
+                        if stale is not None:
+                            try:
+                                await stale.close()
+                            except Exception:
+                                pass
+                        await self._mark_node_dead(
+                            node_id, f"health check failed ({n} probes)")
+
+                # probes run concurrently so one wedged node cannot
+                # stretch the round for the others
+                asyncio.ensure_future(_probe())
 
     async def _storage_failure_detector(self):
         """Ping the external store; a sustained outage is fatal for the
@@ -208,6 +269,8 @@ class GcsServer:
             task.cancel()
         if self._storage_health_task is not None:
             self._storage_health_task.cancel()
+        if self._node_health_task is not None:
+            self._node_health_task.cancel()
         for client in self._pg_raylet_clients.values():
             try:
                 await client.close()
@@ -481,6 +544,12 @@ class GcsServer:
             if existing is not None and self.actors[existing].state != DEAD:
                 raise ValueError(f"Actor name '{info.name}' already taken")
             self.named_actors[key] = info.actor_id
+        if payload.get("subscribe"):
+            # owner registers + subscribes to the keyed lifecycle channel
+            # in one hop (half the creation-path RPCs; the subscription
+            # is live before the PENDING_CREATION publish below)
+            self._subs.setdefault(
+                "actor:" + info.actor_id.hex(), set()).add(conn)
         self.actors[info.actor_id] = info
         self._persist("actors", info.actor_id.hex(), info)
         await self._publish_actor(info)

@@ -503,8 +503,20 @@ class Raylet:
 
     async def handle_syncer_sync(self, payload, conn):
         if self.syncer is None:
-            return {"entries": {}}
+            return {"entries": {}, "want": []}
         return await self.syncer.handle_sync(payload)
+
+    async def handle_syncer_push(self, payload, conn):
+        if self.syncer is None:
+            return 0
+        return await self.syncer.handle_push(payload)
+
+    async def handle_health(self, payload, conn):
+        """Target of the GCS's ACTIVE health probe (gcs.py
+        _node_health_loop; ref: gcs_health_check_manager.h). Answering
+        requires THIS event loop to turn — a SIGSTOP'd or livelocked
+        raylet keeps its socket open but fails the probe."""
+        return True
 
     def _on_node_event(self, payload):
         if payload["event"] == "added":

@@ -1,0 +1,95 @@
+"""Active node health probing (ref: gcs_health_check_manager.h:45 —
+periodic probe + consecutive-failure threshold). Disconnect-only death
+detection misses a wedged-but-connected raylet (SIGSTOP, livelocked
+loop, half-open TCP); the GCS's probe loop must declare it dead and run
+the full node-death path (actor failure, object loss)."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import global_config
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def fast_probes():
+    cfg = global_config()
+    old = (cfg.health_check_period_ms, cfg.health_check_timeout_ms,
+           cfg.health_check_failure_threshold)
+    cfg.health_check_period_ms = 100
+    cfg.health_check_timeout_ms = 300
+    cfg.health_check_failure_threshold = 3
+    yield
+    (cfg.health_check_period_ms, cfg.health_check_timeout_ms,
+     cfg.health_check_failure_threshold) = old
+
+
+def _node_alive(node_id) -> bool:
+    core = ray_tpu._worker_api.core()
+    nodes = core.io.run(core.gcs.call("get_all_nodes", {}))
+    by_id = {n.node_id: n for n in nodes}
+    return by_id[node_id].alive
+
+
+def test_wedged_raylet_declared_dead(fast_probes):
+    cluster = Cluster(head_node_args={"resources": {"CPU": 1.0}},
+                      connect=True)
+    try:
+        node2 = cluster.add_node(num_cpus=4)
+        # healthy cluster survives several probe rounds untouched
+        time.sleep(1.0)
+        assert _node_alive(cluster.head_node.node_id)
+        assert _node_alive(node2.node_id)
+
+        # wedge node2's raylet: the socket stays open and accepts, but
+        # ``health`` never answers — the closest in-process analog of a
+        # SIGSTOP'd raylet process
+        async def hang(payload, conn):
+            await asyncio.sleep(3600)
+
+        node2.raylet.server.register("health", hang)
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if not _node_alive(node2.node_id):
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("wedged node never declared dead by the probe")
+        # the healthy head must NOT be collateral damage
+        assert _node_alive(cluster.head_node.node_id)
+    finally:
+        cluster.shutdown()
+
+
+def test_wedged_node_fails_its_actors(fast_probes):
+    cluster = Cluster(head_node_args={"resources": {"CPU": 1.0}},
+                      connect=True)
+    try:
+        node2 = cluster.add_node(num_cpus=4)
+
+        @ray_tpu.remote(num_cpus=2, max_restarts=0)
+        class Pinned:
+            def ping(self):
+                return 1
+
+        a = Pinned.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+
+        async def hang(payload, conn):
+            await asyncio.sleep(3600)
+
+        node2.raylet.server.register("health", hang)
+        # the actor lived on node2 (only node with 2 free CPUs); its
+        # death must surface as ActorDiedError once the probe trips
+        with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                ray_tpu.get(a.ping.remote(), timeout=5)
+                time.sleep(0.2)
+            pytest.fail("actor on wedged node kept answering")
+    finally:
+        cluster.shutdown()
