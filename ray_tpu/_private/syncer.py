@@ -13,11 +13,19 @@ rounds while per-node load stays O(fanout) regardless of cluster size.
 The GCS still receives each node's own reports (observability,
 autoscaler) — it just stops being the broadcast hub.
 
-Protocol (one raylet->raylet RPC per round, "syncer_sync"):
-    -> {"from": hex, "digest": {node_hex: seq}, "entries": {...}}
-    <- {"entries": {node_hex: entry}}   # what the caller was missing
-The request carries entries the CALLER believes the callee lacks (push),
-the reply returns what the CALLEE has newer (pull).
+Protocol (digest-driven deltas; the reference streams deltas, not
+snapshots — ray_syncer.h streaming protocol):
+
+    -> "syncer_sync" {"from": hex, "digest": {node_hex: seq}}
+    <- {"entries": {...},   # what the caller lacks per its digest
+        "want": [hex...]}   # what the CALLEE lacks per that digest
+    -> "syncer_push" {"from": hex, "entries": {...}}  # only if want≠[]
+
+Both directions ship EXACTLY the entries the other side proved it
+needs, so a steady-state round is one digest-sized RPC with zero
+entries — O(changes) bytes, not O(N) (the r4 protocol shipped the full
+view every round). The digest itself stays O(N) but is ~40 bytes/node;
+it is the anti-entropy backbone and the price of exactness.
 """
 
 from __future__ import annotations
@@ -38,7 +46,11 @@ class ResourceSyncer:
         # node_hex -> {"seq", "available"}
         self.view: Dict[str, Dict[str, Any]] = {}
         self._task: Optional[asyncio.Task] = None
+        self._tombstones: Dict[str, float] = {}   # node_hex -> expiry
         self.rounds = 0
+        # delta-efficiency observability (scale tests assert on these)
+        self.entries_pushed = 0
+        self.entries_received = 0
 
     # ------------------------------------------------------------ local
     def local_update(self, available: dict, pending: list,
@@ -50,11 +62,28 @@ class ResourceSyncer:
             "seq": seq, "available": available,
         }
 
+    # how long an evicted node stays tombstoned: long enough for every
+    # peer to hear the (hub-authoritative) death, short enough that the
+    # set shrinks under sustained churn
+    _TOMBSTONE_TTL_S = 60.0
+
     def evict(self, node_hex: str) -> None:
         """Drop a node from the gossip view (death/removal is
         hub-authoritative; without eviction dead entries gossip
-        forever and the view grows with churn)."""
+        forever and the view grows with churn). A TTL'd tombstone
+        stops a laggard peer that hasn't heard the death yet from
+        gossiping the entry straight back in."""
         self.view.pop(node_hex, None)
+        self._tombstones[node_hex] = time.monotonic() + self._TOMBSTONE_TTL_S
+
+    def _tombstoned(self, node_hex: str) -> bool:
+        exp = self._tombstones.get(node_hex)
+        if exp is None:
+            return False
+        if time.monotonic() > exp:
+            del self._tombstones[node_hex]
+            return False
+        return True
 
     def digest(self) -> Dict[str, int]:
         return {node: entry["seq"] for node, entry in self.view.items()}
@@ -72,6 +101,8 @@ class ResourceSyncer:
         for node, entry in entries.items():
             if node == my_hex:
                 continue  # own state is authoritative locally
+            if self._tombstoned(node):
+                continue  # evicted: a laggard peer must not resurrect it
             cur = self.view.get(node)
             if cur is not None and cur["seq"] >= entry["seq"]:
                 continue
@@ -106,28 +137,51 @@ class ResourceSyncer:
         if not peers:
             return
         random.shuffle(peers)
+        my_hex = self.raylet.node_id.hex()
         for node_id, address in peers[: self.fanout]:
             try:
                 client = await self.raylet._peer_client(address)
-                # push-pull: the request carries our WHOLE view (N
-                # entries of ~100 bytes — the peer's seqs dedupe on
-                # apply), the reply returns only what we lack per our
-                # digest. Per-peer delta tracking would trim the push
-                # half; the reply half is already delta-sized.
                 reply = await client.call("syncer_sync", {
-                    "from": self.raylet.node_id.hex(),
+                    "from": my_hex,
                     "digest": self.digest(),
-                    "entries": self.view,
                 }, timeout=5.0)
-                if reply:
-                    self.apply(reply.get("entries", {}))
             except Exception:
                 continue
+            if not reply:
+                continue
+            got = reply.get("entries", {})
+            self.entries_received += len(got)
+            self.apply(got)
+            want = reply.get("want", ())
+            push = {n: self.view[n] for n in want
+                    if n in self.view and not self._tombstoned(n)}
+            if push:
+                self.entries_pushed += len(push)
+                try:
+                    await client.call("syncer_push", {
+                        "from": my_hex, "entries": push}, timeout=5.0)
+                except Exception:
+                    continue
         self.rounds += 1
 
     # ------------------------------------------------------------ server
     async def handle_sync(self, payload: dict) -> dict:
-        """Peer round: absorb its entries, answer with what it lacks."""
-        self.apply(payload.get("entries", {}))
-        return {"entries": self.entries_newer_than(
-            payload.get("digest", {}))}
+        """Digest exchange: answer with what the caller lacks, and name
+        what WE lack per its digest (it follows up with syncer_push)."""
+        digest = payload.get("digest", {})
+        answer = self.entries_newer_than(digest)
+        self.entries_pushed += len(answer)
+        want = [node for node, seq in digest.items()
+                if seq > self._seq_of(node) and not self._tombstoned(node)]
+        return {"entries": answer, "want": want}
+
+    def _seq_of(self, node_hex: str) -> int:
+        entry = self.view.get(node_hex)
+        return -1 if entry is None else entry["seq"]
+
+    async def handle_push(self, payload: dict) -> int:
+        """Second half of a round: the entries we told the caller we
+        want."""
+        got = payload.get("entries", {})
+        self.entries_received += len(got)
+        return self.apply(got)
