@@ -95,6 +95,10 @@ def _bench_serving(name: str, *, quantize: bool = False, B: int = 16,
         from ray_tpu.ops.quant import init_params_quantized
 
         params = init_params_quantized(jax.random.PRNGKey(7), cfg)
+        # barrier: 8 GB of init dispatches must not still be in flight
+        # (holding their transients) when the first prefill lands — the
+        # relay-attached chip has no headroom for the overlap
+        jax.block_until_ready(params)
     else:
         params = init_params(jax.random.PRNGKey(7), cfg)
     max_seq = min(max_seq_cap, cfg.max_seq)

@@ -60,6 +60,12 @@ class Config:
     # connections per pull, and the PullManager's bytes-in-flight budget
     object_transfer_streams: int = 4
     object_transfer_max_inflight_bytes: int = 512 * 1024**2
+    # broadcast tree: a holder grants at most this many concurrent
+    # senders-per-object; denied pullers re-poll the directory and
+    # chain off freshly-completed copies instead of piling onto the one
+    # origin (ref: push_manager.h:32 per-peer in-flight caps; BASELINE
+    # envelope row: 1 GiB broadcast to 50+ nodes). 0 disables gating.
+    object_transfer_max_senders_per_object: int = 2
     # --- fast lane (native shm task plane; ray_tpu/_private/fastlane.py) ---
     fastlane_width: int = 4                   # max lanes (leased workers)
     fastlane_window: int = 32                 # in-flight tasks per lane

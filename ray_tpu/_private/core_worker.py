@@ -1129,7 +1129,8 @@ class CoreWorker:
         return ResourceSet(res)
 
     # ------------------------------------------------------ normal tasks
-    def _prepare_runtime_env(self, opts: dict) -> Optional[dict]:
+    def _prepare_runtime_env(self, opts: dict,
+                             allow_container: bool = True) -> Optional[dict]:
         """Pack a runtime_env option for the wire (ref: runtime envs,
         SURVEY §2.2). Cached per (env-spec, content fingerprint):
         re-tarring a working_dir on every one of thousands of
@@ -1142,6 +1143,15 @@ class CoreWorker:
         env = opts.get("runtime_env")
         if not env:
             return None
+        if not allow_container and isinstance(env, dict) \
+                and env.get("container"):
+            # the per-task-body container model cannot seal a long-lived
+            # actor or a streaming generator — reject LOUDLY at
+            # submission instead of silently running on the host
+            raise ValueError(
+                "container runtime_env supports plain tasks only; "
+                "actors and streaming generators run on the host "
+                "worker (use pip/conda/working_dir envs for those)")
         import json
         import os as _os
 
@@ -1209,7 +1219,8 @@ class CoreWorker:
             backpressure_items=opts.get(
                 "generator_backpressure_num_objects", 0) or 0,
             owner_address=self.address,
-            runtime_env=self._prepare_runtime_env(opts),
+            runtime_env=self._prepare_runtime_env(
+                opts, allow_container=not streaming),
         )
         from ..util.tracing import inject_trace_ctx
 
@@ -1768,7 +1779,8 @@ class CoreWorker:
             actor_max_concurrency=opts.get("max_concurrency") or 0,
             actor_name=opts.get("name") or "",
             owner_address=self.address,
-            runtime_env=self._prepare_runtime_env(opts),
+            runtime_env=self._prepare_runtime_env(
+                opts, allow_container=False),
         )
         state = _ActorState(actor_id=actor_id)
         state.creation_spec = spec

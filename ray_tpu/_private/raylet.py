@@ -28,6 +28,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -236,6 +237,10 @@ class Raylet:
         # inter-node object transfer (ref: object_manager/pull_manager.h:57,
         # push_manager.h:32 — chunked transfer over the control transport)
         self._peer_clients: Dict[str, RpcClient] = {}
+        # broadcast-tree sender slots: oid -> {puller_hex: grant expiry}
+        self._transfer_tokens: Dict[ObjectID, Dict[str, float]] = {}
+        self._transfer_token_high: Dict[ObjectID, int] = {}  # high-water
+        self._pull_sources: Dict[ObjectID, NodeID] = {}   # observability
         # cluster view (for spillback) — node_id -> (address, available)
         self._remote_nodes: Dict[NodeID, Tuple[str, ResourceSet]] = {}
         # node_id -> labels (incl. this node), for label-match scheduling
@@ -1428,6 +1433,7 @@ class Raylet:
 
     async def _pull(self, oid: ObjectID) -> Optional[int]:
         backoff = 0.02
+        denials = 0
         while True:
             if self.store.contains(oid) or oid in self._lost_objects:
                 return self._sealed.get(oid, 0)
@@ -1439,16 +1445,32 @@ class Raylet:
             except Exception:
                 locs = {oid: []}
             transfer_map = locs.get("__transfer__", {})
-            for loc in locs.get(oid, []):
+            candidates = [loc for loc in locs.get(oid, [])
+                          if loc[0] != self.node_id]
+            # broadcast tree: spread pullers over ALL current holders
+            # instead of piling onto the list head (each completed pull
+            # registers a new location, so the source set grows as the
+            # broadcast progresses — ref: push_manager.h:32)
+            random.shuffle(candidates)
+            denied = False
+            for loc in candidates:
                 node_id, address = loc[0], loc[1]
                 xfer_address = transfer_map.get(node_id.hex(), "")
-                if node_id == self.node_id:
+                token = await self._acquire_transfer_token(oid, address)
+                if token is False:
+                    denied = True   # holder at sender cap: try another
                     continue
                 try:
                     size = await self._fetch_via(oid, address, xfer_address)
                     if size is not None:
                         self._sealed[oid] = size
                         self._mark_local_sealed(oid, size)
+                        self._pull_sources[oid] = node_id
+                        # bounded observability maps (free also prunes)
+                        for book in (self._pull_sources,
+                                     self._transfer_token_high):
+                            while len(book) > 4096:
+                                book.pop(next(iter(book)))
                         asyncio.ensure_future(self._report_location(oid))
                         return size
                     # holder no longer has it: drop the stale location
@@ -1456,10 +1478,84 @@ class Raylet:
                         "object_id": oid, "node_id": node_id})
                 except Exception:
                     continue
+                finally:
+                    if token:
+                        asyncio.ensure_future(self._release_transfer_token(
+                            oid, address))
+            if denied:
+                # every holder is saturated: a fresh copy registers soon
+                # — re-poll faster than the cold backoff, but with
+                # jittered exponential growth so a 50-node broadcast's
+                # denied majority doesn't hammer the GCS/holders at a
+                # synchronized 20 Hz for the whole transfer
+                denials += 1
+                wait = min(0.25, 0.05 * (2 ** min(denials, 4)))
+                await asyncio.sleep(wait * (0.5 + random.random()))
+                continue
+            denials = 0
             await asyncio.sleep(backoff)
             # cap grows to 2s: pending-local objects (task still running
             # here) shouldn't hammer the GCS with location polls
             backoff = min(2.0, backoff * 2)
+
+    async def _acquire_transfer_token(self, oid: ObjectID, address: str):
+        """Ask a holder for a sender slot. True = granted, False =
+        holder saturated, None = holder predates tokens / unreachable
+        (proceed ungated — the pull itself will fail if the holder is
+        really gone)."""
+        if self.cfg.object_transfer_max_senders_per_object <= 0:
+            return None
+        try:
+            client = await self._peer_client(address)
+            ok = await client.call("transfer_token", {
+                "object_id": oid, "node_id": self.node_id.hex(),
+            }, timeout=5)
+        except Exception:
+            return None
+        return bool(ok)
+
+    async def _release_transfer_token(self, oid: ObjectID, address: str):
+        try:
+            client = await self._peer_client(address)
+            await client.call("transfer_token_release", {
+                "object_id": oid, "node_id": self.node_id.hex(),
+            }, timeout=5)
+        except Exception:
+            pass
+
+    # sender-slot grants per local object: {oid: {puller_hex: expiry}}
+    _TRANSFER_TOKEN_TTL_S = 120.0
+
+    async def handle_transfer_token(self, payload, conn):
+        cap = self.cfg.object_transfer_max_senders_per_object
+        if cap <= 0:
+            return True
+        oid = payload["object_id"]
+        puller = payload["node_id"]
+        now = time.monotonic()
+        if len(self._transfer_tokens) > 4096:
+            # sweep grants of crashed pullers across ALL objects (the
+            # per-oid sweep below only fires on a repeat acquire)
+            for stale_oid in [o for o, g in self._transfer_tokens.items()
+                              if all(exp < now for exp in g.values())]:
+                del self._transfer_tokens[stale_oid]
+        grants = self._transfer_tokens.setdefault(oid, {})
+        for stale in [p for p, exp in grants.items() if exp < now]:
+            del grants[stale]
+        if puller in grants or len(grants) < cap:
+            grants[puller] = now + self._TRANSFER_TOKEN_TTL_S
+            high = self._transfer_token_high.get(oid, 0)
+            self._transfer_token_high[oid] = max(high, len(grants))
+            return True
+        return False
+
+    async def handle_transfer_token_release(self, payload, conn):
+        grants = self._transfer_tokens.get(payload["object_id"])
+        if grants is not None:
+            grants.pop(payload["node_id"], None)
+            if not grants:
+                self._transfer_tokens.pop(payload["object_id"], None)
+        return True
 
     async def _fetch_via(self, oid: ObjectID, address: str,
                          xfer_address: str) -> Optional[int]:
@@ -1605,6 +1701,9 @@ class Raylet:
             if self._sealed.pop(oid, None) is not None or self.store.contains(oid):
                 asyncio.ensure_future(self._drop_location(oid))
             self.store.delete(oid)
+            self._transfer_tokens.pop(oid, None)
+            self._transfer_token_high.pop(oid, None)
+            self._pull_sources.pop(oid, None)
         return True
 
     async def handle_pin_objects(self, payload, conn):

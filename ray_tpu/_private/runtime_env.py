@@ -99,21 +99,19 @@ def prepare_runtime_env(core, runtime_env: Optional[dict]) -> Optional[dict]:
         hasher.update(f"conda:{wire['conda']!r}".encode())
     if "container" in runtime_env:
         container = runtime_env["container"]
-        # stub behind a capability check (ref: _private/runtime_env/
-        # image_uri.py): the image field is validated and the missing
-        # runtime reported at SUBMISSION time, not as a worker crash
-        import shutil as _shutil
-
+        # capability-checked at SUBMISSION (ref: _private/runtime_env/
+        # image_uri.py): a missing runtime is a driver-side error, not a
+        # worker crash
         if not isinstance(container, dict) or "image" not in container:
             raise ValueError(
                 'container runtime_env must be {"image": "..."} ')
-        if not (_shutil.which("docker") or _shutil.which("podman")):
-            raise RuntimeError(
-                "container runtime_env requires docker or podman on "
-                "this node; neither is installed")
-        raise NotImplementedError(
-            "container runtime_env: image execution is not wired into "
-            "this deployment's worker launcher yet")
+        run_options = container.get("run_options") or []
+        if not all(isinstance(o, str) for o in run_options):
+            raise TypeError("container run_options must be strings")
+        _container_runtime()  # raises if neither docker nor podman
+        wire["container"] = {"image": container["image"],
+                             "run_options": list(run_options)}
+        hasher.update(f"container:{wire['container']!r}".encode())
     if not wire:
         return None
     wire["hash"] = hasher.hexdigest()[:16]
@@ -227,6 +225,88 @@ def _materialize_venv(requirements: List[str], installer: str) -> str:
 
     _atomic_materialize(root, build)
     return site
+
+
+def _container_runtime() -> str:
+    """The node's container runtime, capability-checked (ref:
+    _private/runtime_env/image_uri.py — podman-first there; docker-first
+    here since that is what TPU-VM images ship)."""
+    import shutil
+
+    for name in ("docker", "podman"):
+        path = shutil.which(name)
+        if path:
+            return path
+    raise RuntimeError(
+        "container runtime_env requires docker or podman on this "
+        "node; neither is installed")
+
+
+# The in-container entrypoint: plain pickle suffices to LOAD a
+# cloudpickle blob as long as cloudpickle is importable in the image —
+# the same contract the reference imposes (its images must contain ray).
+_CONTAINER_BOOTSTRAP = """\
+import pickle, sys
+with open(sys.argv[1], "rb") as f:
+    fn, args, kwargs = pickle.load(f)
+out = fn(*args, **kwargs)
+with open(sys.argv[2], "wb") as f:
+    pickle.dump(out, f, protocol=pickle.HIGHEST_PROTOCOL)
+"""
+
+
+def run_task_in_container(container: dict, fn, args, kwargs,
+                          env_vars: Optional[dict] = None):
+    """Execute one task body inside the image (ref: image_uri.py —
+    there the whole worker process lives in the container; here the
+    container is entered per task body, which keeps the pooled-worker
+    model and its shm store host-side). The payload crosses via a
+    bind-mounted scratch dir. A containerized body is a SEALED
+    computation: the image needs python3 + cloudpickle, and the body
+    cannot itself call .remote() (no control sockets are mounted)."""
+    import pickle
+    import shutil
+    import subprocess
+    import tempfile
+    import uuid
+
+    import cloudpickle
+
+    exe = _container_runtime()
+    timeout = float(container.get("timeout_s") or 1800.0)
+    name = f"rtenv_{uuid.uuid4().hex[:12]}"
+    scratch = tempfile.mkdtemp(prefix="rtenv_container_")
+    payload = os.path.join(scratch, "in.pkl")
+    result = os.path.join(scratch, "out.pkl")
+    try:
+        with open(payload, "wb") as f:
+            cloudpickle.dump((fn, args, kwargs), f)
+        cmd = [exe, "run", "--rm", "--name", name,
+               "-v", f"{scratch}:{scratch}"]
+        for key, value in (env_vars or {}).items():
+            cmd += ["-e", f"{key}={value}"]
+        cmd += container.get("run_options") or []
+        cmd += [container["image"], "python3", "-c",
+                _CONTAINER_BOOTSTRAP, payload, result]
+        try:
+            proc = subprocess.run(cmd, capture_output=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired:
+            # killing the CLI client does NOT stop the container; reap
+            # it by name so it can't pin the node (and so --rm fires)
+            subprocess.run([exe, "rm", "-f", name], capture_output=True,
+                           timeout=60)
+            raise RuntimeError(
+                f"container task timed out after {timeout:.0f}s "
+                f"(image {container['image']!r}); container reaped")
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"container task failed (image {container['image']!r}): "
+                + proc.stderr.decode(errors="replace")[-2000:])
+        with open(result, "rb") as f:
+            return pickle.load(f)
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
 
 
 def _conda_binary() -> str:

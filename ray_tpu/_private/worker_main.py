@@ -326,7 +326,16 @@ class TaskExecutor:
             self._register_running(spec.task_id)
             try:
                 with _maybe_span(spec):
-                    values = func(*args, **kwargs)
+                    if spec.runtime_env and spec.runtime_env.get(
+                            "container"):
+                        from .runtime_env import run_task_in_container
+
+                        values = run_task_in_container(
+                            spec.runtime_env["container"], func, args,
+                            kwargs,
+                            env_vars=spec.runtime_env.get("env_vars"))
+                    else:
+                        values = func(*args, **kwargs)
             finally:
                 self._running.pop(spec.task_id, None)
                 self.core.clear_task_context()

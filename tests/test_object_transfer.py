@@ -213,3 +213,45 @@ def test_cross_node_pull_rides_transfer_plane():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_broadcast_chains_off_completed_peers():
+    """Broadcast tree (ref: push_manager.h:32 in-flight caps): with the
+    holder capped at ONE concurrent sender per object, 4 pullers cannot
+    all ride the origin — later pullers must chain off freshly-completed
+    peer copies the directory advertises. Verifies the cap held and at
+    least one pull sourced from a non-origin node."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.config import global_config
+
+    cfg = global_config()
+    old_cap = cfg.object_transfer_max_senders_per_object
+    cfg.object_transfer_max_senders_per_object = 1
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        nodes = [cluster.add_node(num_cpus=1, resources={f"n{i}": 1.0})
+                 for i in range(4)]
+        cluster.connect()
+
+        @ray_tpu.remote
+        def touch(arr):
+            return int(arr[-1])
+
+        data = np.arange(48 << 20, dtype=np.uint8)
+        ref = ray_tpu.put(data)   # seals in the head node's store
+        refs = [touch.options(resources={f"n{i}": 1.0}).remote(ref)
+                for i in range(4)]
+        assert ray_tpu.get(refs, timeout=180) == [int(data[-1])] * 4
+
+        oid = ref.id()
+        head = cluster.head_node.raylet
+        assert head._transfer_token_high.get(oid, 0) <= 1, \
+            "origin exceeded its sender cap"
+        sources = [n.raylet._pull_sources.get(oid) for n in nodes]
+        assert all(s is not None for s in sources), sources
+        assert any(s != head.node_id for s in sources), \
+            f"all pulls rode the origin: {sources}"
+    finally:
+        cfg.object_transfer_max_senders_per_object = old_cap
+        ray_tpu.shutdown()
+        cluster.shutdown()
