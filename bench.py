@@ -17,6 +17,7 @@ torch-DDP-parity system would need to hit on comparable hardware.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -238,6 +239,66 @@ def _bench_long_context(name: str):
     }
 
 
+def _bench_8b_subprocess():
+    """The Llama-3-8B int8 family in its OWN process (see main() —
+    actually invoked FIRST, before this process touches the chip).
+
+    Why a subprocess: the relay-attached chip's admissible footprint
+    degrades across a session — after any ResourceExhausted, later
+    programs (even small ones) fail for minutes, and a long-lived
+    process accumulates server-side state. 8B int8 weights (8.0 GiB)
+    leave the least headroom of any family, so it runs against the
+    freshest possible server state, isolated so a failure cannot poison
+    the train/serve benches, with one delayed retry."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    me = os.path.abspath(__file__)
+    for attempt in range(2):
+        proc = subprocess.run(
+            [_sys.executable, me, "--serve-8b-only"],
+            capture_output=True, text=True, timeout=1200)
+        for line in (proc.stdout or "").splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "serve_8b_int8_model" in rec or "serve_8b_int8_error" in rec:
+                if "serve_8b_int8_error" in rec and attempt == 0:
+                    break  # retry once after a cool-down
+                return rec
+        else:
+            if attempt == 0:
+                time.sleep(120)
+                continue
+            return {"serve_8b_int8_error":
+                    (proc.stderr or proc.stdout or "no output")[-300:]}
+        time.sleep(120)
+    return {"serve_8b_int8_error": "retries exhausted"}
+
+
+def _serve_8b_main():
+    """Subprocess entry: run ONLY the 8B int8 family, print one JSON
+    line. B=4 @ max_seq 512 keeps the footprint ≈ 8.3 GiB (weights
+    8.0 + KV 0.26 + temps) — measured r5: the relay admits ~9 GiB
+    reliably and behaves nondeterministically above that."""
+    import jax
+
+    dev = jax.devices()[0]
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    if not (dev.platform in ("tpu", "axon") or "tpu" in kind):
+        print(json.dumps({"serve_8b_int8_model": "skipped",
+                          "serve_8b_int8_skipped": "no TPU device"}))
+        return
+    try:
+        out = _bench_serving("8b", quantize=True, B=4,
+                             prefix="serve_8b_int8", max_seq_cap=512)
+    except Exception as e:
+        out = {"serve_8b_int8_error": repr(e)[:300]}
+    print(json.dumps(out))
+
+
 def _bench_core_summary():
     """Control-plane microbenchmarks (tasks/s, actor calls/s) folded
     into the bench line — the framework's own speed, not the model's
@@ -297,8 +358,8 @@ def _bench_envelope_summary():
         [_sys.executable,
          os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "bench_envelope.py"),
-         "sched", "queued", "inflight", "actors", "getmany", "bigobj",
-         "broadcast", "syncer"],
+         "sched", "queued", "inflight", "getmany", "bigobj", "actors",
+         "broadcast", "syncer", "gang"],
         env=env, capture_output=True, text=True, timeout=1500)
     for line in proc.stdout.splitlines():
         try:
@@ -364,7 +425,18 @@ def _bench_train(name: str, batch: int, seq: int, steps: int, dev):
 
 
 def main():
+    if "--serve-8b-only" in sys.argv:
+        return _serve_8b_main()
     import jax
+
+    # 8B first, in a subprocess, BEFORE this process claims the chip:
+    # it needs the most headroom of any family (see _bench_8b_subprocess).
+    # The CHILD decides whether a TPU is present (no env-var heuristics
+    # here — they would silently skip the family on a plain TPU VM).
+    try:
+        serve_8b = _bench_8b_subprocess()
+    except Exception as e:
+        serve_8b = {"serve_8b_int8_error": repr(e)[:300]}
 
     dev = jax.devices()[0]
     # The axon relay backend fronts a real TPU but may report its own
@@ -407,19 +479,7 @@ def main():
             serve_metrics.update(_bench_long_context("400m"))
         except Exception as e:
             serve_metrics["serve_8k_error"] = repr(e)[:200]
-        # the north-star 7B-class model on the single chip: Llama-3-8B
-        # with native int8 weights (fits 16 GB only quantized)
-        try:
-            # max_seq 512: 8.0 GiB int8 weights + 1.0 GiB KV keep the
-            # whole execution footprint inside the relay-attached v5e's
-            # measured per-execution budget (~13 GiB; the 2 GiB-KV
-            # config ResourceExhausts even though args+temp arithmetic
-            # says 12.6 GiB — donation does not alias over the relay)
-            serve_metrics.update(_bench_serving(
-                "8b", quantize=True, B=8, prefix="serve_8b_int8",
-                max_seq_cap=512))
-        except Exception as e:
-            serve_metrics["serve_8b_int8_error"] = repr(e)[:300]
+        serve_metrics.update(serve_8b)   # ran first, in a subprocess
 
     core_metrics = {}
     try:

@@ -161,51 +161,169 @@ def bench_inflight(results, n=5_000, width=8):
 def bench_actors(results, n=1_000):
     """n live actors at once (ref: many_actors — 40k cluster-wide).
 
-    Runs in ITS OWN session: the in-session families before it leave
-    ~100k task-event records on the GCS, whose flushing slows late
-    actor creations past the alive-wait cap. First-contact pings retry
-    per actor (a creation still queued behind 900 others may exceed one
-    ping's internal alive-wait without being dead)."""
+    Runs in the SHARED session again (the r4 own-session isolation —
+    9818ad7 — is gone): the task-event flusher is now bounded
+    (core_worker._TASK_EVENT_FLUSH_MAX chunks) and actor registration
+    is one pipelined async GCS hop, so the ~100k task-event backlog the
+    earlier families leave can no longer starve creations. First-contact
+    pings retry per actor (a creation still queued behind 900 others may
+    exceed one ping's internal alive-wait without being dead)."""
     import ray_tpu as ray
 
     n = 50 if QUICK else n
-    ray.init(num_cpus=4, object_store_memory=2 << 30)
+
+    @ray.remote(num_cpus=0)
+    class Cell:
+        def __init__(self):
+            self.v = 0
+
+        def ping(self):
+            self.v += 1
+            return self.v
+
+    t0 = time.perf_counter()
+    actors = [Cell.remote() for _ in range(n)]
+    alive = [False] * n
+    deadline = time.monotonic() + 1200
+    while not all(alive) and time.monotonic() < deadline:
+        for i, a in enumerate(actors):
+            if not alive[i]:
+                try:
+                    assert ray.get(a.ping.remote(), timeout=180) == 1
+                    alive[i] = True
+                except Exception:
+                    pass
+    assert all(alive), f"{alive.count(False)} actors never came up"
+    t_up = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = ray.get([a.ping.remote() for a in actors], timeout=600)
+    t_ping = time.perf_counter() - t0
+    assert out == [2] * n
+    for a in actors:
+        ray.kill(a)
+    results.append(emit(
+        "envelope_many_actors", depth=n,
+        create_and_first_ping_s=t_up, actors_per_s=n / t_up,
+        ping_all_per_s=n / t_ping))
+
+
+# -------------------------------------------------------------- gang restart
+def bench_gang_restart(results):
+    """SURVEY §7.4 fast gang restart, measured: a 2-worker gang loses a
+    rank mid-run; report detect->restore->next-step wall time, plus the
+    cold vs post-restart compile time of the jitted train step (the
+    persistent XLA compilation cache makes the restart recompile warm —
+    train/worker_group.py _enable_compilation_cache)."""
+    import shutil
+    import tempfile
+
+    import ray_tpu as ray
+    from ray_tpu.train import (
+        FailureConfig, RunConfig, ScalingConfig, Trainer)
+
+    cache_dir = tempfile.mkdtemp(prefix="envelope_ccache_")
+    # trace lives OUTSIDE cache_dir: the cache_added entry counts must
+    # see only jax-written cache files
+    trace_dir = tempfile.mkdtemp(prefix="envelope_gangtrace_")
+    trace = os.path.join(trace_dir, "trace.jsonl")
+    # workers read THEIR OWN config from env — mutating the driver's
+    # global_config would not reach them
+    os.environ["RAY_TPU_MESH_COMPILE_CACHE_DIR"] = cache_dir
+    ray.init(num_cpus=4)
     try:
-        @ray.remote(num_cpus=0)
-        class Cell:
-            def __init__(self):
-                self.v = 0
+        def train_fn(config):
+            import json as _json
+            import time as _time
 
-            def ping(self):
-                self.v += 1
-                return self.v
+            import jax
+            import jax.numpy as jnp
 
-        t0 = time.perf_counter()
-        actors = [Cell.remote() for _ in range(n)]
-        alive = [False] * n
-        deadline = time.monotonic() + 1200
-        while not all(alive) and time.monotonic() < deadline:
-            for i, a in enumerate(actors):
-                if not alive[i]:
-                    try:
-                        assert ray.get(a.ping.remote(), timeout=180) == 1
-                        alive[i] = True
-                    except Exception:
-                        pass
-        assert all(alive), f"{alive.count(False)} actors never came up"
-        t_up = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        out = ray.get([a.ping.remote() for a in actors], timeout=600)
-        t_ping = time.perf_counter() - t0
-        assert out == [2] * n
-        for a in actors:
-            ray.kill(a)
+            from ray_tpu import train
+
+            ctx = train.get_context()
+            trace_path = config["trace"]
+
+            def log(**kw):
+                with open(trace_path, "a") as f:
+                    f.write(_json.dumps(kw) + "\n")
+
+            start = 0
+            ckpt = train.get_checkpoint()
+            if ckpt is not None:
+                with open(os.path.join(ckpt.path, "state.json")) as f:
+                    start = _json.load(f)["step"]
+
+            @jax.jit
+            def step_fn(w, x):
+                # big enough that cold XLA compile is measurable vs the
+                # persistent-cache warm path
+                for i in range(12):
+                    x = jnp.tanh(x @ w) + jax.nn.gelu(x) * (0.1 * i)
+                return jax.nn.softmax(x, axis=-1)
+
+            w = jnp.eye(512) * 0.5
+            x = jnp.ones((64, 512))
+            cache_dir = config["cache_dir"]
+            before = len(os.listdir(cache_dir))
+            t0 = _time.perf_counter()
+            step_fn(w, x).block_until_ready()
+            log(rank=ctx.rank, event="compiled", resumed_from=start,
+                compile_s=_time.perf_counter() - t0,
+                cache_added=len(os.listdir(cache_dir)) - before,
+                t=_time.time())
+            for step in range(start + 1, 10):
+                if ctx.rank == 1 and ckpt is None and step == 3:
+                    log(rank=1, event="death", t=_time.time())
+                    os._exit(1)
+                step_fn(w, x).block_until_ready()
+                if ctx.rank == 0:
+                    d = tempfile.mkdtemp()
+                    with open(os.path.join(d, "state.json"), "w") as f:
+                        _json.dump({"step": step}, f)
+                    train.report({"step": step},
+                                 train.Checkpoint(d))
+                log(rank=ctx.rank, event="step", step=step,
+                    resumed=start > 0, t=_time.time())
+                _time.sleep(0.25)
+
+        run_dir = tempfile.mkdtemp(prefix="envelope_gang_")
+        result = Trainer(
+            train_fn, train_loop_config={"trace": trace, "cache_dir": cache_dir},
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                name="gang", storage_path=run_dir,
+                failure_config=FailureConfig(max_failures=2)),
+        ).fit()
+        assert result.error is None, result.error
+        events = [json.loads(l) for l in open(trace)]
+        death_t = next(e["t"] for e in events if e["event"] == "death")
+        after = [e for e in events
+                 if e["event"] == "step" and e.get("resumed")]
+        first_step_after = min(e["t"] for e in after)
+        compiles = [e for e in events if e["event"] == "compiled"]
+        cold = max(e["compile_s"] for e in compiles
+                   if e["resumed_from"] == 0)
+        warm = min(e["compile_s"] for e in compiles
+                   if e["resumed_from"] > 0)
+        # decisive cache evidence: the restarted incarnation's compile
+        # must come from the persistent cache (zero NEW entries written)
+        warm_added = sum(e["cache_added"] for e in compiles
+                         if e["resumed_from"] > 0)
+        cold_added = sum(e["cache_added"] for e in compiles
+                         if e["resumed_from"] == 0)
         results.append(emit(
-            "envelope_many_actors", depth=n,
-            create_and_first_ping_s=t_up, actors_per_s=n / t_up,
-            ping_all_per_s=n / t_ping))
+            "envelope_gang_restart",
+            restart_to_next_step_s=first_step_after - death_t,
+            cold_compile_s=cold, warm_compile_s=warm,
+            cold_cache_entries_written=cold_added,
+            restart_compile_cache_hit=bool(warm_added == 0
+                                           and cold_added > 0),
+            restarts=1))
     finally:
+        os.environ.pop("RAY_TPU_MESH_COMPILE_CACHE_DIR", None)
         ray.shutdown()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        shutil.rmtree(trace_dir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------- broadcast
@@ -393,10 +511,13 @@ ALL = {
     "broadcast": bench_broadcast,
     "getmany": bench_getmany,
     "bigobj": bench_bigobj,
+    "gang": bench_gang_restart,
 }
 
-# families that run inside a ray.init'd single-node session
-_IN_SESSION = {"queued", "inflight", "getmany", "bigobj"}
+# families that run inside a ray.init'd single-node session; "actors"
+# runs LAST so its creations contend with the full task-event backlog
+# the earlier families leave — the regime the r4 bench dodged
+_IN_SESSION = {"queued", "inflight", "getmany", "bigobj", "actors"}
 
 
 def main():

@@ -64,7 +64,10 @@ class Config:
     # senders-per-object; denied pullers re-poll the directory and
     # chain off freshly-completed copies instead of piling onto the one
     # origin (ref: push_manager.h:32 per-peer in-flight caps; BASELINE
-    # envelope row: 1 GiB broadcast to 50+ nodes). 0 disables gating.
+    # envelope row: 1 GiB broadcast to 50+ nodes). Cost: one extra small
+    # acquire RPC per cross-node pull (release is fire-and-forget);
+    # latency-critical small-object workloads can set 0 to disable
+    # gating entirely (no RPC is made then).
     object_transfer_max_senders_per_object: int = 2
     # --- fast lane (native shm task plane; ray_tpu/_private/fastlane.py) ---
     fastlane_width: int = 4                   # max lanes (leased workers)

@@ -111,6 +111,8 @@ def prepare_runtime_env(core, runtime_env: Optional[dict]) -> Optional[dict]:
         _container_runtime()  # raises if neither docker nor podman
         wire["container"] = {"image": container["image"],
                              "run_options": list(run_options)}
+        if container.get("timeout_s"):
+            wire["container"]["timeout_s"] = float(container["timeout_s"])
         hasher.update(f"container:{wire['container']!r}".encode())
     if not wire:
         return None
@@ -247,11 +249,15 @@ def _container_runtime() -> str:
 # the same contract the reference imposes (its images must contain ray).
 _CONTAINER_BOOTSTRAP = """\
 import pickle, sys
+import cloudpickle
 with open(sys.argv[1], "rb") as f:
     fn, args, kwargs = pickle.load(f)
 out = fn(*args, **kwargs)
 with open(sys.argv[2], "wb") as f:
-    pickle.dump(out, f, protocol=pickle.HIGHEST_PROTOCOL)
+    # cloudpickle BOTH ways: a result holding a by-value class (defined
+    # in the driver's __main__, reconstructed here under a synthetic
+    # module) round-trips only by value
+    cloudpickle.dump(out, f, protocol=pickle.HIGHEST_PROTOCOL)
 """
 
 
@@ -307,6 +313,17 @@ def run_task_in_container(container: dict, fn, args, kwargs,
             return pickle.load(f)
     finally:
         shutil.rmtree(scratch, ignore_errors=True)
+        if os.path.exists(scratch):
+            # the container (typically root) may have left root-owned
+            # files a non-root worker can't unlink: widen then retry so
+            # /tmp doesn't grow one payload per containerized task
+            try:
+                for base, dirs, files in os.walk(scratch):
+                    for name in dirs + files:
+                        os.chmod(os.path.join(base, name), 0o700)
+                shutil.rmtree(scratch, ignore_errors=True)
+            except OSError:
+                pass
 
 
 def _conda_binary() -> str:

@@ -79,6 +79,7 @@ class TrainWorker:
         )
         self._session = _init_session(context)
         self._maybe_init_jax_distributed(context, use_tpu)
+        self._enable_compilation_cache()
         train_fn = cloudpickle.loads(train_fn_blob)
 
         def _run():
@@ -99,6 +100,34 @@ class TrainWorker:
                                         name=f"train_fn_rank{self.rank}")
         self._thread.start()
         return True
+
+    def _enable_compilation_cache(self) -> None:
+        """Persistent XLA compilation cache (SURVEY §7.4 fast gang
+        restart). Elastic SPMD restart = re-shard + RECOMPILE + restore;
+        the recompile dominates restart-to-next-step latency, and a
+        restarted gang's train step is byte-identical to the one the
+        dead gang compiled — so the fresh worker processes must find it
+        on disk instead of re-running XLA. Cache dir comes from
+        config.mesh_compile_cache_dir (default: a shared /tmp dir).
+        Harmless if jax was already initialized — the flags apply to
+        subsequent compiles."""
+        from .._private.config import global_config
+
+        path = (global_config().mesh_compile_cache_dir
+                or "/tmp/ray_tpu_compile_cache")
+        try:
+            import jax
+
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:
+            # an exotic jax build without the cache is a slow restart,
+            # not a broken one
+            pass
 
     def _maybe_init_jax_distributed(self, context: TrainContext,
                                     use_tpu: bool) -> None:

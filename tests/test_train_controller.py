@@ -206,3 +206,19 @@ def test_trainer_consumes_streaming_split(ray_cluster, tmp_path):
     assert len(shards[0]) == 32 and len(shards[1]) == 32
     assert set(shards[0]) | set(shards[1]) == set(range(64))
     assert not set(shards[0]) & set(shards[1])
+
+
+def test_gang_restart_compile_hits_persistent_cache(tmp_path):
+    """SURVEY §7.4: the restarted gang's train-step compile must come
+    from the persistent XLA compilation cache — the fresh worker
+    processes write ZERO new cache entries while the cold gang wrote
+    some. Reuses the measured envelope family end to end."""
+    import bench_envelope
+
+    results = []
+    bench_envelope.bench_gang_restart(results)
+    rec = results[0]
+    assert rec["restarts"] == 1
+    assert rec["cold_cache_entries_written"] > 0
+    assert rec["restart_compile_cache_hit"] is True, rec
+    assert rec["restart_to_next_step_s"] < 60, rec
