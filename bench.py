@@ -256,9 +256,18 @@ def _bench_8b_subprocess():
 
     me = os.path.abspath(__file__)
     for attempt in range(2):
-        proc = subprocess.run(
-            [_sys.executable, me, "--serve-8b-only"],
-            capture_output=True, text=True, timeout=1200)
+        try:
+            proc = subprocess.run(
+                [_sys.executable, me, "--serve-8b-only"],
+                capture_output=True, text=True, timeout=1200)
+        except subprocess.TimeoutExpired:
+            # a hang is the documented poisoned-relay mode — exactly
+            # what the delayed retry exists for
+            if attempt == 0:
+                time.sleep(120)
+                continue
+            return {"serve_8b_int8_error": "subprocess timeout (1200s) "
+                                           "twice"}
         for line in (proc.stdout or "").splitlines():
             try:
                 rec = json.loads(line)
@@ -292,7 +301,10 @@ def _serve_8b_main():
                           "serve_8b_int8_skipped": "no TPU device"}))
         return
     try:
-        out = _bench_serving("8b", quantize=True, B=4,
+        # B=8 measured best on the v5e (r5: 227 tok/s vs 110 at B=4 and
+        # 208 at B=16 — beyond 8 slots the gathered-KV decode's HBM
+        # traffic growth beats the batching win)
+        out = _bench_serving("8b", quantize=True, B=8,
                              prefix="serve_8b_int8", max_seq_cap=512)
     except Exception as e:
         out = {"serve_8b_int8_error": repr(e)[:300]}
@@ -359,8 +371,8 @@ def _bench_envelope_summary():
          os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "bench_envelope.py"),
          "sched", "queued", "inflight", "getmany", "bigobj", "actors",
-         "broadcast", "syncer", "gang"],
-        env=env, capture_output=True, text=True, timeout=1500)
+         "broadcast", "syncer", "gang", "spill"],
+        env=env, capture_output=True, text=True, timeout=2700)
     for line in proc.stdout.splitlines():
         try:
             rec = json.loads(line)

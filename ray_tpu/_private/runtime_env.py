@@ -287,7 +287,11 @@ def run_task_in_container(container: dict, fn, args, kwargs,
     try:
         with open(payload, "wb") as f:
             cloudpickle.dump((fn, args, kwargs), f)
+        # run as the worker's uid by default so the container's writes
+        # into the bind-mounted scratch stay deletable by this process;
+        # user run_options come later, so an explicit --user wins
         cmd = [exe, "run", "--rm", "--name", name,
+               "--user", f"{os.getuid()}:{os.getgid()}",
                "-v", f"{scratch}:{scratch}"]
         for key, value in (env_vars or {}).items():
             cmd += ["-e", f"{key}={value}"]
@@ -314,16 +318,16 @@ def run_task_in_container(container: dict, fn, args, kwargs,
     finally:
         shutil.rmtree(scratch, ignore_errors=True)
         if os.path.exists(scratch):
-            # the container (typically root) may have left root-owned
-            # files a non-root worker can't unlink: widen then retry so
-            # /tmp doesn't grow one payload per containerized task
-            try:
-                for base, dirs, files in os.walk(scratch):
-                    for name in dirs + files:
-                        os.chmod(os.path.join(base, name), 0o700)
-                shutil.rmtree(scratch, ignore_errors=True)
-            except OSError:
-                pass
+            # restrictive-mode leftovers (a container ignoring --user
+            # can still create unreadable dirs): widen what we own,
+            # per-entry so one EPERM doesn't abort the sweep, and retry
+            for base, dirnames, filenames in os.walk(scratch):
+                for entry in dirnames + filenames:
+                    try:
+                        os.chmod(os.path.join(base, entry), 0o700)
+                    except OSError:
+                        continue
+            shutil.rmtree(scratch, ignore_errors=True)
 
 
 def _conda_binary() -> str:

@@ -331,16 +331,22 @@ class LLMEngine:
         temp = np.ones(n, np.float32)
         top_k = np.zeros(n, np.int32)
         top_p = np.ones(n, np.float32)
+        # all-greedy rounds compile the argmax-only epilogue (runner
+        # prefill_sample/decode_burst `greedy`): identical outputs,
+        # simpler program (inactive slots count as greedy)
+        greedy = True
         for i, s in enumerate(row_states):
             if s is None:
                 continue
             temp[i] = s.params.temperature
             top_k[i] = s.params.top_k
             top_p[i] = s.params.top_p
+            if s.params.temperature > 0.0:
+                greedy = False
         seed = self._seed
         self._seed += advance  # burst step i uses seed+i: no reuse
         return (seed, jnp.asarray(temp), jnp.asarray(top_k),
-                jnp.asarray(top_p))
+                jnp.asarray(top_p), greedy)
 
     def _run_prefill(self, state: RequestState) -> List[StepOutput]:
         """Prefill the sequence so far (prompt, plus prior output when
@@ -356,13 +362,14 @@ class LLMEngine:
         bucket = prefill_bucket(L, self.ecfg.max_seq_len)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :L] = seq
-        seed, temp, top_k, top_p = self._sampling_arrays([state])
+        seed, temp, top_k, top_p, greedy = self._sampling_arrays([state])
         toks, ck, cv = prefill_sample(
             self.params, self.cache.k, self.cache.v,
             jnp.asarray(tokens), jnp.asarray([L], jnp.int32),
             jnp.asarray(self.seq_table.block_tables[
                 state.slot:state.slot + 1]),
-            self.cos, self.sin, seed, temp, top_k, top_p, cfg=self.cfg)
+            self.cos, self.sin, seed, temp, top_k, top_p, cfg=self.cfg,
+            greedy=greedy)
         self.cache = KVCache(ck, cv)
         state.ctx_len = L
         tok = int(np.asarray(toks)[0])
@@ -391,7 +398,7 @@ class LLMEngine:
         state.prefill_pos = start + n
         if state.prefill_pos < L:
             return []  # more chunks to go; decode interleaves meanwhile
-        seed, temp, top_k, top_p = self._sampling_arrays([state])
+        seed, temp, top_k, top_p, _greedy = self._sampling_arrays([state])
         tok = int(np.asarray(sample_logits(
             logits, seed, temp, top_k, top_p))[0])
         state.ctx_len = L
@@ -473,8 +480,8 @@ class LLMEngine:
             tokens[s.slot] = last
             positions[s.slot] = s.ctx_len
             active[s.slot] = True
-        seed, temp, top_k, top_p = self._sampling_arrays(self.slots,
-                                                         advance=K)
+        seed, temp, top_k, top_p, greedy = self._sampling_arrays(
+            self.slots, advance=K)
         span = self._active_span()
         use_paged = self._paged_kernel or (
             self._paged_min_pages > 0 and span >= self._paged_min_pages)
@@ -484,7 +491,7 @@ class LLMEngine:
             self._bt(span),
             jnp.asarray(active), self.cos, self.sin,
             seed, temp, top_k, top_p, cfg=self.cfg, n_steps=K,
-            paged_kernel=use_paged)
+            paged_kernel=use_paged, greedy=greedy)
         self.cache = KVCache(ck, cv)
         sampled = np.asarray(toks)  # [K, B]
         outs = []

@@ -216,26 +216,38 @@ def sample_logits(logits, seed, temperature, top_k, top_p):
 # Over the axon relay (remote TPU) every dispatch pays a network round
 # trip; fusing sampling into the step cuts per-token latency by ~the RTT.
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
-                                                             "cache_v"))
+@partial(jax.jit, static_argnames=("cfg", "greedy"),
+         donate_argnames=("cache_k", "cache_v"))
 def prefill_sample(params, cache_k, cache_v, tokens, prompt_lens,
                    block_tables, cos, sin, seed, temperature, top_k,
-                   top_p, *, cfg: LlamaConfig):
+                   top_p, *, cfg: LlamaConfig, greedy: bool = False):
+    """``greedy=True`` (every request temperature==0) compiles an
+    argmax-only epilogue — bit-identical results for greedy requests,
+    and a materially simpler program: the top_k/sort/categorical
+    sampler fused behind multi-GiB weight args is the one program class
+    the relay-attached TPU rejects nondeterministically (r5 bisection:
+    model+argmax stable across trials, model+sort-sampler not, at
+    identical HBM footprints)."""
     from .sampling import sample_from_logits
 
     logits, cache_k, cache_v = prefill.__wrapped__(
         params, cache_k, cache_v, tokens, prompt_lens, block_tables,
         cos, sin, cfg=cfg)
-    toks = sample_from_logits(logits, seed, temperature, top_k, top_p)
+    if greedy:
+        toks = jnp.argmax(logits, axis=-1)
+    else:
+        toks = sample_from_logits(logits, seed, temperature, top_k,
+                                  top_p)
     return toks, cache_k, cache_v
 
 
-@partial(jax.jit, static_argnames=("cfg", "n_steps", "paged_kernel"),
+@partial(jax.jit,
+         static_argnames=("cfg", "n_steps", "paged_kernel", "greedy"),
          donate_argnames=("cache_k", "cache_v"))
 def decode_burst(params, cache_k, cache_v, tokens, positions,
                  block_tables, active, cos, sin, seed, temperature,
                  top_k, top_p, *, cfg: LlamaConfig, n_steps: int,
-                 paged_kernel: bool = None):
+                 paged_kernel: bool = None, greedy: bool = False):
     """n_steps fused decode+sample steps, sampled tokens fed back
     ON-DEVICE (multi-step scheduling, vLLM's --num-scheduler-steps
     analog). One host round trip yields n_steps tokens per slot — the
@@ -356,8 +368,11 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
                 layer, x, (params["layers"], old_k, old_v, sk, sv))
         h = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
         logits = _lm_logits(h, params, cfg)
-        newt = sample_from_logits(logits, seed + i, temperature, top_k,
-                                  top_p)
+        if greedy:   # see prefill_sample: argmax-only epilogue
+            newt = jnp.argmax(logits, axis=-1)
+        else:
+            newt = sample_from_logits(logits, seed + i, temperature,
+                                      top_k, top_p)
         newt = jnp.where(active, newt, toks)
         return (newt, sk, sv), newt
 

@@ -35,6 +35,7 @@ gather, not a matmul.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -146,9 +147,18 @@ def init_params_quantized(key, cfg) -> Dict:
     no 4x int32 intermediate is ever allocated; values are bitcast to
     int8 and scales chosen so dequantized weights look like the
     1/sqrt(fan_in) init (uniform int8 has RMS ≈ 74, so
-    s = fan_in**-0.5 / 74 gives unit-variance-scaled projections)."""
+    s = fan_in**-0.5 / 74 gives unit-variance-scaled projections).
+
+    The whole init is ONE jitted program: eagerly it would dispatch
+    ~50 single-op executables, and on remote-attached backends every
+    loaded executable has real server-side cost."""
     if getattr(cfg, "n_experts", 0):
         raise NotImplementedError("quantized init for MoE not wired up")
+    return _init_params_quantized_jit(key, cfg)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _init_params_quantized_jit(key, cfg) -> Dict:
     L, d, hd = cfg.n_layers, cfg.dim, cfg.head_dim
     h, hkv, m = cfg.n_heads, cfg.n_kv_heads, cfg.mlp_dim
     ks = iter(jax.random.split(key, 16))

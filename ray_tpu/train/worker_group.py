@@ -113,15 +113,19 @@ class TrainWorker:
         subsequent compiles."""
         from .._private.config import global_config
 
+        # per-uid default path: a fixed shared /tmp dir breaks when a
+        # second user's workers can't write the first user's 0755 dir
         path = (global_config().mesh_compile_cache_dir
-                or "/tmp/ray_tpu_compile_cache")
+                or f"/tmp/ray_tpu_compile_cache_{os.getuid()}")
         try:
             import jax
 
             os.makedirs(path, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", path)
+            # cache only compiles that cost real time — sub-second ones
+            # would grow the dir without bounding restart latency
             jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.0)
+                "jax_persistent_cache_min_compile_time_secs", 0.2)
             jax.config.update(
                 "jax_persistent_cache_min_entry_size_bytes", -1)
         except Exception:

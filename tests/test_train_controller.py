@@ -218,7 +218,7 @@ def test_gang_restart_compile_hits_persistent_cache(tmp_path):
     results = []
     bench_envelope.bench_gang_restart(results)
     rec = results[0]
-    assert rec["restarts"] == 1
+    assert rec["restarts"] >= 1
     assert rec["cold_cache_entries_written"] > 0
     assert rec["restart_compile_cache_hit"] is True, rec
     assert rec["restart_to_next_step_s"] < 60, rec
