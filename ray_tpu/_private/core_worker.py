@@ -1975,18 +1975,20 @@ class CoreWorker:
         from ..util.tracing import inject_trace_ctx
 
         inject_trace_ctx(spec)
-        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        return_ids = spec.return_ids()
+        refs = [ObjectRef(oid, self.address) for oid in return_ids]
         # registered so borrower fetch_object sees in-flight returns as
         # pending rather than gone
         self._inflight.setdefault(spec.task_id,
                                   {"canceled": False, "worker_address": None})
-        if self._actor_lane_submit(spec, deps):
+        if self._actor_lane_submit(spec, deps, return_ids):
             return refs
         self._actor_lane_blocked.add(actor_id)
         self.io.spawn(self._submit_actor_task(spec, deps))
         return refs
 
-    def _actor_lane_submit(self, spec: TaskSpec, deps: List[ObjectID]) -> bool:
+    def _actor_lane_submit(self, spec: TaskSpec, deps: List[ObjectID],
+                           return_ids: List[ObjectID]) -> bool:
         """Route the call through the actor's fast lane. Once a lane
         exists ALL calls from this owner must ride it (ring FIFO is the
         ordering guarantee). A lane may only OPEN on the first-ever call
@@ -2016,11 +2018,11 @@ class CoreWorker:
             lane = self._actor_lanes.setdefault(
                 spec.actor_id, ActorLane(self, spec.actor_id))
         event = threading.Event()
-        for oid in spec.return_ids():
+        for oid in return_ids:
             self._lane_events[oid] = event
         if lane.submit(spec, event):
             return True
-        for oid in spec.return_ids():
+        for oid in return_ids:
             self._lane_events.pop(oid, None)
         return False
 

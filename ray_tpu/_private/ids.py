@@ -13,23 +13,26 @@ import os
 import threading
 
 _rng_lock = threading.Lock()
-# Uniqueness, not cryptography: an os.urandom syscall per ID taxes the
-# trivial-task submit path (two IDs each). A per-process random128 seed
-# + counter stream from Python's Mersenne generator is collision-safe
-# across processes (seed entropy) and within one (counter), and ~10x
-# cheaper. Re-seeded after fork so children diverge.
-_rng_state = {"pid": None, "rng": None}
+# Uniqueness, not cryptography: a 4 KiB os.urandom buffer drained from
+# the tail amortizes one syscall over ~hundreds of ids (3+ ids minted
+# per submit on the hot path). Refilled on exhaustion or fork (pid
+# check) so children diverge.
+_rng_state = {"pid": None, "buf": bytearray()}
 
 
 def _random_bytes(n: int) -> bytes:
+    """Buffered randomness: ids are minted on every submit (3+ per task),
+    so amortize one urandom read over ~hundreds of ids instead of taking
+    the RNG through getrandbits per id. Fork-safe via the pid check."""
     pid = os.getpid()
     with _rng_lock:
-        if _rng_state["pid"] != pid:
-            import random
-
+        if _rng_state["pid"] != pid or len(_rng_state["buf"]) < n:
             _rng_state["pid"] = pid
-            _rng_state["rng"] = random.Random(os.urandom(16))
-        return _rng_state["rng"].getrandbits(n * 8).to_bytes(n, "little")
+            _rng_state["buf"] = bytearray(os.urandom(4096))
+        buf = _rng_state["buf"]
+        out = bytes(buf[-n:])
+        del buf[-n:]
+        return out
 
 
 class BaseID:

@@ -42,7 +42,12 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        # cache on the instance: hot actor-call loops touch the same
+        # method attribute thousands of times (default-options methods
+        # are stateless; .options() still returns fresh instances)
+        method = ActorMethod(self, name)
+        self.__dict__[name] = method
+        return method
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:16]})"
