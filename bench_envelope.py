@@ -28,6 +28,10 @@ import sys
 import time
 
 QUICK = "--quick" in sys.argv
+# --moderate: the depths bench.py embeds (bounded wall clock inside the
+# driver's bench run); the standalone full-depth record is
+# ENVELOPE_r05.json, produced by running this script with no flag
+MODERATE = "--moderate" in sys.argv
 FAMILIES = [a for a in sys.argv[1:] if not a.startswith("--")]
 
 
@@ -63,7 +67,7 @@ def bench_queued(results, n=1_000_000):
     def nop():
         return None
 
-    n = 2_000 if QUICK else n
+    n = 2_000 if QUICK else (200_000 if MODERATE else n)
     t0 = time.perf_counter()
     refs = [nop.remote() for _ in range(n)]
     t_submit = time.perf_counter() - t0
@@ -411,6 +415,8 @@ def bench_bigobj(results, size_gb=30.0):
 
     if QUICK:
         size_gb = 0.25
+    elif MODERATE:
+        size_gb = 10.0
     nbytes = int(size_gb * (1 << 30))
     # np.empty: untouched pages read as the shared zero page, so setup
     # doesn't pay a full-size write on bandwidth-poor hosts — the put
@@ -445,6 +451,8 @@ def bench_spill(results, total_gb=12.0, obj_gb=1.0, store_gb=4.0):
 
     if QUICK:
         total_gb, obj_gb, store_gb = 1.0, 0.25, 0.5
+    elif MODERATE:
+        total_gb = 6.0
     n = int(total_gb / obj_gb)
     nbytes = int(obj_gb * (1 << 30))
     ray.init(num_cpus=2, object_store_memory=int(store_gb * (1 << 30)))
@@ -581,7 +589,9 @@ def main():
     in_session = [n for n in names if n in _IN_SESSION]
     if in_session:
         import ray_tpu as ray
-        store = (36 << 30) if "bigobj" in in_session and not QUICK else (2 << 30)
+        store = (2 << 30)
+        if "bigobj" in in_session and not QUICK:
+            store = (14 << 30) if MODERATE else (36 << 30)
         ray.init(num_cpus=4, object_store_memory=store)
         try:
             for name in in_session:
