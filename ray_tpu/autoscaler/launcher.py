@@ -1,0 +1,259 @@
+"""Cluster launcher: the `ray up` / `ray down` role (ref:
+python/ray/scripts/scripts.py:1378 `up`, autoscaler/command_runner.py
+SSHCommandRunner, autoscaler/_private/commands.py get_or_create_head_node).
+
+A cluster config (YAML or JSON) names a provider and the bootstrap
+commands; `up()` provisions + bootstraps the head, starts it, then
+brings up ``min_workers`` joined to it. All remote execution goes
+through a CommandRunner seam — the real one shells ssh/scp, tests
+inject a recorder — and provisioning goes through the same NodeProvider
+seam the autoscaler uses, so the gcloud/TPU control logic stays
+unit-testable in a zero-egress environment.
+
+Config shape (TPU-first analog of the reference's cluster YAML):
+
+    cluster_name: demo
+    provider:
+      type: manual | subprocess | tpu_queued_resources
+      # manual:            {head_ip, worker_ips: [...]}
+      # subprocess:        {}               (nodes on this host)
+      # tpu_queued_resources: {project, zone, accelerator_type,
+      #                        runtime_version}
+    auth: {ssh_user: ubuntu, ssh_private_key: ~/.ssh/key.pem}
+    head_setup_commands: [ ... shell ... ]
+    worker_setup_commands: [ ... shell ... ]
+    head_start_command: python -m ray_tpu.scripts.cli start --head --port 6380
+    min_workers: 2
+    worker_resources: {CPU: 4}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["ClusterConfig", "SSHCommandRunner", "up", "down",
+           "load_cluster_config"]
+
+
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    """YAML when pyyaml is available, JSON always (same ladder the
+    conda runtime-env spec uses)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        import yaml
+
+        out = yaml.safe_load(text)
+    except ImportError:
+        out = json.loads(text)
+    if not isinstance(out, dict):
+        raise ValueError(f"cluster config {path!r} must hold a mapping")
+    return out
+
+
+@dataclass
+class ClusterConfig:
+    cluster_name: str
+    provider: Dict[str, Any]
+    auth: Dict[str, str] = field(default_factory=dict)
+    head_setup_commands: List[str] = field(default_factory=list)
+    worker_setup_commands: List[str] = field(default_factory=list)
+    head_start_command: str = ""
+    head_port: int = 6380
+    min_workers: int = 0
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+    # interpreter used ON REMOTE HOSTS (manual/tpu providers): the local
+    # sys.executable path is meaningless over ssh. The subprocess
+    # provider (same host) uses sys.executable.
+    remote_python: str = "python3"
+    # full override of the worker join command ("{address}" substituted)
+    worker_join_command: str = ""
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "ClusterConfig":
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown cluster config keys: {sorted(unknown)}")
+        if "cluster_name" not in raw or "provider" not in raw:
+            raise ValueError("cluster config needs cluster_name + provider")
+        return cls(**raw)
+
+
+class SSHCommandRunner:
+    """Run shell on a remote host over ssh (ref: command_runner.py:7
+    SSHCommandRunner). One instance per host; tests inject a fake with
+    the same run() signature."""
+
+    def __init__(self, host: str, auth: Dict[str, str]):
+        self.host = host
+        self.user = auth.get("ssh_user", "")
+        self.key = auth.get("ssh_private_key", "")
+
+    def _ssh_base(self) -> List[str]:
+        target = f"{self.user}@{self.host}" if self.user else self.host
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+               "-o", "ConnectTimeout=10"]
+        if self.key:
+            cmd += ["-i", os.path.expanduser(self.key)]
+        return cmd + [target]
+
+    def run(self, command: str, timeout: float = 600.0) -> str:
+        proc = subprocess.run(
+            self._ssh_base() + [command], capture_output=True,
+            text=True, timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"[{self.host}] {command!r} failed "
+                f"({proc.returncode}): {proc.stderr[-1000:]}")
+        return proc.stdout
+
+
+class _LocalCommandRunner:
+    """The subprocess provider's 'remote' is this host."""
+
+    host = "localhost"
+
+    def run(self, command: str, timeout: float = 600.0) -> str:
+        proc = subprocess.run(["bash", "-c", command],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(f"[local] {command!r} failed "
+                               f"({proc.returncode}): {proc.stderr[-1000:]}")
+        return proc.stdout
+
+
+def _runner_for(cfg: ClusterConfig, host: str, runner_factory):
+    if runner_factory is not None:
+        return runner_factory(host, cfg.auth)
+    if cfg.provider.get("type") == "subprocess":
+        return _LocalCommandRunner()
+    return SSHCommandRunner(host, cfg.auth)
+
+
+def up(config, runner_factory: Optional[Callable] = None) -> Dict[str, Any]:
+    """Provision + bootstrap the cluster; returns {"address", "head",
+    "workers"} (ref: commands.py create_or_update_cluster). Idempotence
+    model: `up` on a live manual/subprocess cluster re-runs setup
+    (setup commands must be idempotent, as in the reference)."""
+    cfg = config if isinstance(config, ClusterConfig) \
+        else ClusterConfig.from_dict(config)
+    ptype = cfg.provider.get("type", "manual")
+
+    if ptype == "manual":
+        head_host = cfg.provider["head_ip"]
+        # min_workers is the single worker-count knob across providers:
+        # 0 means a head-only bring-up even when worker_ips are listed
+        worker_hosts = list(cfg.provider.get("worker_ips", ()))[
+            : cfg.min_workers]
+    elif ptype == "subprocess":
+        head_host = "127.0.0.1"
+        worker_hosts = ["127.0.0.1"] * cfg.min_workers
+    elif ptype == "tpu_queued_resources":
+        head_host = cfg.provider["head_ip"]   # head is a plain VM/host
+        worker_hosts = []                      # slices join via provider
+    else:
+        raise ValueError(f"unknown provider type {ptype!r}")
+
+    # --- head: setup commands, then start ---
+    head = _runner_for(cfg, head_host, runner_factory)
+    for command in cfg.head_setup_commands:
+        head.run(command)
+    head_python = shlex.quote(sys.executable) if ptype == "subprocess" \
+        else cfg.remote_python
+    start = cfg.head_start_command or (
+        f"{head_python} -m ray_tpu.scripts.cli start "
+        f"--head --port {cfg.head_port}")
+    # the address must match where the head REALLY listens: an explicit
+    # --port inside head_start_command wins over cfg.head_port
+    port = cfg.head_port
+    match = re.search(r"--port[= ](\d+)", start)
+    if match:
+        port = int(match.group(1))
+    head.run(start)
+    address = f"{head_host}:{port}"
+
+    # --- workers ---
+    workers: List[Any] = []
+    if ptype == "tpu_queued_resources":
+        from .providers import (TpuQueuedResourceProvider,
+                                _default_gcloud_runner)
+
+        provider = TpuQueuedResourceProvider(
+            project=cfg.provider["project"],
+            zone=cfg.provider["zone"],
+            accelerator_type=cfg.provider["accelerator_type"],
+            runtime_version=cfg.provider["runtime_version"],
+            cluster_address=address,
+            runner=cfg.provider.get("gcloud_runner")
+            or _default_gcloud_runner,
+            name_prefix=cfg.cluster_name,
+            setup_commands=cfg.worker_setup_commands,
+            remote_python=cfg.remote_python)
+        for _ in range(cfg.min_workers):
+            workers.append(provider.create_node(dict(cfg.worker_resources)))
+    else:
+        worker_python = shlex.quote(sys.executable) \
+            if ptype == "subprocess" else cfg.remote_python
+        join = cfg.worker_join_command.replace("{address}", address) \
+            if cfg.worker_join_command else (
+                f"{worker_python} -m ray_tpu.scripts.cli "
+                f"start --address {shlex.quote(address)}")
+        if not cfg.worker_join_command and cfg.worker_resources.get("CPU"):
+            join += f" --num-cpus {cfg.worker_resources['CPU']}"
+        for host in worker_hosts:
+            runner = _runner_for(cfg, host, runner_factory)
+            for command in cfg.worker_setup_commands:
+                runner.run(command)
+            runner.run(join)
+            workers.append(host)
+    return {"address": address, "head": head_host, "workers": workers}
+
+
+def down(config, runner_factory: Optional[Callable] = None) -> None:
+    """Tear the cluster down (ref: scripts.py `down` -> teardown_cluster):
+    stop every node process on workers first, then the head."""
+    cfg = config if isinstance(config, ClusterConfig) \
+        else ClusterConfig.from_dict(config)
+    ptype = cfg.provider.get("type", "manual")
+    stop_python = shlex.quote(sys.executable) if ptype == "subprocess" \
+        else cfg.remote_python
+    stop = f"{stop_python} -m ray_tpu.scripts.cli stop"
+
+    if ptype == "tpu_queued_resources":
+        from .providers import (TpuQueuedResourceProvider,
+                                _default_gcloud_runner)
+
+        provider = TpuQueuedResourceProvider(
+            project=cfg.provider["project"], zone=cfg.provider["zone"],
+            accelerator_type=cfg.provider["accelerator_type"],
+            runtime_version=cfg.provider["runtime_version"],
+            cluster_address="", name_prefix=cfg.cluster_name,
+            runner=cfg.provider.get("gcloud_runner")
+            or _default_gcloud_runner)
+        for name in provider.non_terminated_nodes():
+            provider.terminate_node(name)
+        worker_hosts: List[str] = []
+        head_host = cfg.provider["head_ip"]
+    elif ptype == "subprocess":
+        head_host = "127.0.0.1"
+        worker_hosts = []   # `cli stop` on this host stops every node
+    else:
+        head_host = cfg.provider["head_ip"]
+        worker_hosts = list(cfg.provider.get("worker_ips", ()))
+
+    for host in worker_hosts:
+        try:
+            _runner_for(cfg, host, runner_factory).run(stop)
+        except Exception:
+            pass  # worker already gone
+    _runner_for(cfg, head_host, runner_factory).run(stop)

@@ -107,7 +107,9 @@ class TpuQueuedResourceProvider(NodeProvider):
     def __init__(self, *, project: str, zone: str, accelerator_type: str,
                  runtime_version: str, cluster_address: str,
                  runner: Callable[[List[str]], str] = _default_gcloud_runner,
-                 name_prefix: str = "ray-tpu"):
+                 name_prefix: str = "ray-tpu",
+                 setup_commands: Optional[List[str]] = None,
+                 remote_python: str = "python3"):
         self.project = project
         self.zone = zone
         self.accelerator_type = accelerator_type
@@ -115,6 +117,8 @@ class TpuQueuedResourceProvider(NodeProvider):
         self.cluster_address = cluster_address
         self.runner = runner
         self.name_prefix = name_prefix
+        self.setup_commands = list(setup_commands or ())
+        self.remote_python = remote_python
         self._nodes: Dict[str, dict] = {}
 
     def _base(self, *verb: str) -> List[str]:
@@ -124,8 +128,9 @@ class TpuQueuedResourceProvider(NodeProvider):
 
     def create_node(self, resources: Dict[str, float]) -> Any:
         name = f"{self.name_prefix}-{uuid.uuid4().hex[:8]}"
-        startup = (f"python -m ray_tpu.scripts.cli start "
-                   f"--address {shlex.quote(self.cluster_address)} --block")
+        join = (f"{self.remote_python} -m ray_tpu.scripts.cli start "
+                f"--address {shlex.quote(self.cluster_address)} --block")
+        startup = "; ".join(self.setup_commands + [join])
         cmd = self._base("create", name) + [
             "--node-id", name,
             "--accelerator-type", self.accelerator_type,
@@ -147,7 +152,7 @@ class TpuQueuedResourceProvider(NodeProvider):
             for entry in json.loads(out or "[]"):
                 name = entry.get("name", "").rsplit("/", 1)[-1]
                 state = (entry.get("state", {}) or {}).get("state", "")
-                if (name.startswith(self.name_prefix)
+                if (name.startswith(self.name_prefix + "-")
                         and state not in ("SUSPENDED", "FAILED",
                                           "DELETING")):
                     live.append(name)

@@ -358,6 +358,26 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """ref: python/ray/scripts/scripts.py:1378 `up`."""
+    from ..autoscaler.launcher import load_cluster_config, up
+
+    out = up(load_cluster_config(args.config))
+    print(f"cluster up: head {out['head']}, address {out['address']}, "
+          f"{len(out['workers'])} worker(s)")
+    print(f"connect with: ray_tpu.init(address={out['address']!r}) or "
+          f"RAY_TPU_ADDRESS={out['address']}")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ..autoscaler.launcher import down, load_cluster_config
+
+    down(load_cluster_config(args.config))
+    print("cluster down")
+    return 0
+
+
 # ------------------------------------------------------------------ main
 
 def build_parser() -> argparse.ArgumentParser:
@@ -391,6 +411,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop the node started on this host")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("up", help="launch a cluster from a config "
+                                   "(the `ray up` role)")
+    sp.add_argument("config", help="cluster YAML/JSON path")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a launched cluster")
+    sp.add_argument("config", help="cluster YAML/JSON path")
+    sp.set_defaults(fn=cmd_down)
 
     sp = sub.add_parser("status", help="cluster nodes + resources")
     sp.add_argument("--address", default=None)
