@@ -17,8 +17,10 @@ Config shape (TPU-first analog of the reference's cluster YAML):
       type: manual | subprocess | tpu_queued_resources
       # manual:            {head_ip, worker_ips: [...]}
       # subprocess:        {}               (nodes on this host)
-      # tpu_queued_resources: {project, zone, accelerator_type,
-      #                        runtime_version}
+      # tpu_queued_resources: {head_ip, project, zone,
+      #                        accelerator_type, runtime_version}
+      #                        (head_ip: the head VM this launcher
+      #                        bootstraps over ssh; slices join it)
     auth: {ssh_user: ubuntu, ssh_private_key: ~/.ssh/key.pem}
     head_setup_commands: [ ... shell ... ]
     worker_setup_commands: [ ... shell ... ]
@@ -159,7 +161,11 @@ def up(config, runner_factory: Optional[Callable] = None) -> Dict[str, Any]:
         head_host = "127.0.0.1"
         worker_hosts = ["127.0.0.1"] * cfg.min_workers
     elif ptype == "tpu_queued_resources":
-        head_host = cfg.provider["head_ip"]   # head is a plain VM/host
+        if "head_ip" not in cfg.provider:
+            raise ValueError(
+                "tpu_queued_resources provider needs head_ip: the head "
+                "runs on a plain VM this launcher bootstraps over ssh")
+        head_host = cfg.provider["head_ip"]
         worker_hosts = []                      # slices join via provider
     else:
         raise ValueError(f"unknown provider type {ptype!r}")
@@ -243,6 +249,8 @@ def down(config, runner_factory: Optional[Callable] = None) -> None:
         for name in provider.non_terminated_nodes():
             provider.terminate_node(name)
         worker_hosts: List[str] = []
+        if "head_ip" not in cfg.provider:
+            raise ValueError("tpu_queued_resources provider needs head_ip")
         head_host = cfg.provider["head_ip"]
     elif ptype == "subprocess":
         head_host = "127.0.0.1"

@@ -130,7 +130,9 @@ class TpuQueuedResourceProvider(NodeProvider):
         name = f"{self.name_prefix}-{uuid.uuid4().hex[:8]}"
         join = (f"{self.remote_python} -m ray_tpu.scripts.cli start "
                 f"--address {shlex.quote(self.cluster_address)} --block")
-        startup = "; ".join(self.setup_commands + [join])
+        # && : a failed setup command must NOT let a half-bootstrapped
+        # slice join and crash user tasks at import time later
+        startup = " && ".join(self.setup_commands + [join])
         cmd = self._base("create", name) + [
             "--node-id", name,
             "--accelerator-type", self.accelerator_type,

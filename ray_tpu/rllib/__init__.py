@@ -11,8 +11,10 @@ from .impala import IMPALA, IMPALAConfig
 from .offline import (BC, BCConfig, MARWIL, MARWILConfig,
                       record_rollouts, rollout_dataset)
 from .ppo import PPO, PPOConfig, EnvRunner
+from .sac import SAC, SACConfig
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC",
+           "SACConfig", "IMPALA",
            "IMPALAConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig",
            "GRPO", "GRPOConfig", "EnvRunner", "CartPole", "make_env",
            "record_rollouts", "rollout_dataset"]

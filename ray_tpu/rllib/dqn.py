@@ -34,13 +34,17 @@ def q_forward(params, obs):
 
 
 class ReplayBuffer:
-    """Uniform ring replay (ref: rllib/utils/replay_buffers/)."""
+    """Uniform ring replay (ref: rllib/utils/replay_buffers/).
 
-    def __init__(self, capacity: int, obs_dim: int):
+    ``act_shape``/``act_dtype`` cover both action spaces: DQN stores
+    scalar int32 actions, SAC stores float32 vectors."""
+
+    def __init__(self, capacity: int, obs_dim: int,
+                 act_shape: tuple = (), act_dtype=np.int32):
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros(capacity, np.int32)
+        self.actions = np.zeros((capacity, *act_shape), act_dtype)
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.float32)
         self.size = 0

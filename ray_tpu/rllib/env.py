@@ -67,7 +67,59 @@ class CartPole:
                 {})
 
 
-_REGISTRY = {"CartPole-v1": CartPole}
+class Pendulum:
+    """Classic pendulum swing-up (the Pendulum-v1 task: torque-limited
+    continuous control; reward = -(theta² + 0.1·theta_dot² + 0.001·u²)).
+    The continuous-action counterpart to the discrete CartPole — SAC's
+    native habitat."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    observation_dim = 3
+    action_dim = 1          # continuous: u in [-MAX_TORQUE, MAX_TORQUE]
+    continuous = True
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.default_rng(seed)
+        self.theta = 0.0
+        self.theta_dot = 0.0
+        self.steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([math.cos(self.theta), math.sin(self.theta),
+                         self.theta_dot], np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> Tuple[np.ndarray, Dict]:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.theta = self.rng.uniform(-math.pi, math.pi)
+        self.theta_dot = self.rng.uniform(-1.0, 1.0)
+        self.steps = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.MAX_TORQUE, self.MAX_TORQUE))
+        th, thd = self.theta, self.theta_dot
+        norm_th = ((th + math.pi) % (2 * math.pi)) - math.pi
+        cost = norm_th ** 2 + 0.1 * thd ** 2 + 0.001 * u ** 2
+        thd = thd + (3 * self.G / (2 * self.L) * math.sin(th)
+                     + 3.0 / (self.M * self.L ** 2) * u) * self.DT
+        thd = float(np.clip(thd, -self.MAX_SPEED, self.MAX_SPEED))
+        th = th + thd * self.DT
+        self.theta, self.theta_dot = th, thd
+        self.steps += 1
+        truncated = self.steps >= self.MAX_STEPS
+        return self._obs(), -cost, False, truncated, {}
+
+
+_REGISTRY = {"CartPole-v1": CartPole, "Pendulum-v1": Pendulum}
 
 
 def make_env(name_or_fn: Any, seed: Optional[int] = None):

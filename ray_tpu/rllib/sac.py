@@ -1,0 +1,397 @@
+"""SAC on jax (ref: rllib/algorithms/sac/ — the new-API-stack shape the
+other families here share): stochastic env-runner actors feed a replay
+buffer; the learner update — twin soft Q critics, tanh-squashed
+Gaussian actor, auto-tuned entropy temperature, polyak target tracking
+— is ONE jitted program, so every gradient step of an iteration
+compiles onto the device while sampling stays on CPU actors.
+
+    algo = (SACConfig().environment("Pendulum-v1")
+            .env_runners(num_env_runners=2)
+            .training(lr=3e-4)).build()
+    for _ in range(20):
+        metrics = algo.train()
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .checkpoint import CheckpointableAlgorithm
+from .dqn import ReplayBuffer
+from .env import make_env
+
+_LOG_STD_MIN, _LOG_STD_MAX = -10.0, 2.0
+
+
+# ---------------------------------------------------------------- networks
+
+
+def _init_mlp(key, sizes):
+    import jax
+    import jax.numpy as jnp
+
+    layers = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (n_in, n_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        layers.append({
+            "w": jax.random.normal(k, (n_in, n_out), jnp.float32)
+            * (2.0 / n_in) ** 0.5,
+            "b": jnp.zeros((n_out,), jnp.float32),
+        })
+    return layers
+
+
+def _mlp(layers, x, *, final_linear: bool = True):
+    import jax.numpy as jnp
+
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(layers) - 1 or not final_linear:
+            x = jnp.tanh(x)
+    return x
+
+
+def init_sac_params(key, obs_dim: int, act_dim: int,
+                    hidden: Tuple[int, ...]):
+    import jax
+
+    ka, k1, k2 = jax.random.split(key, 3)
+    return {
+        # actor emits [mu | log_std]
+        "actor": _init_mlp(ka, (obs_dim, *hidden, 2 * act_dim)),
+        "q1": _init_mlp(k1, (obs_dim + act_dim, *hidden, 1)),
+        "q2": _init_mlp(k2, (obs_dim + act_dim, *hidden, 1)),
+        # log alpha as a learnable scalar (entropy temperature)
+        "log_alpha": 0.0,
+    }
+
+
+def actor_dist(params, obs):
+    import jax.numpy as jnp
+
+    out = _mlp(params["actor"], obs)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+    return mu, log_std
+
+
+def sample_action(params, obs, key):
+    """Reparameterized tanh-squashed Gaussian sample + its log-prob."""
+    import jax
+    import jax.numpy as jnp
+
+    mu, log_std = actor_dist(params, obs)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mu.shape)
+    pre = mu + std * eps
+    act = jnp.tanh(pre)
+    # log N(pre; mu, std) minus the tanh change-of-variables term
+    logp = (-0.5 * (((pre - mu) / std) ** 2
+                    + 2 * log_std + math.log(2 * math.pi))).sum(-1)
+    logp = logp - (2 * (math.log(2.0) - pre
+                        - jax.nn.softplus(-2 * pre))).sum(-1)
+    return act, logp
+
+
+def _q(params_q, obs, act):
+    import jax.numpy as jnp
+
+    return _mlp(params_q, jnp.concatenate([obs, act], axis=-1))[..., 0]
+
+
+# ---------------------------------------------------------------- learner
+
+_SAC_UPDATE_JIT = None
+
+
+def sac_update(params, target, opt_state, batch, key, *, lr: float,
+               gamma: float, tau: float, target_entropy: float,
+               n_updates: int):
+    global _SAC_UPDATE_JIT
+    if _SAC_UPDATE_JIT is None:
+        import jax
+
+        _SAC_UPDATE_JIT = jax.jit(
+            _sac_update_impl,
+            static_argnames=("lr", "gamma", "tau", "target_entropy",
+                             "n_updates"))
+    return _SAC_UPDATE_JIT(params, target, opt_state, batch, key, lr=lr,
+                           gamma=gamma, tau=tau,
+                           target_entropy=target_entropy,
+                           n_updates=n_updates)
+
+
+def _sac_update_impl(params, target, opt_state, batch, key, *, lr, gamma,
+                     tau, target_entropy, n_updates):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.adam(lr)
+    N = batch["obs"].shape[0]
+    mb = N // n_updates
+
+    def loss_fn(p, tgt, sl, k):
+        obs = batch["obs"][sl]
+        nxt = batch["next_obs"][sl]
+        act = batch["actions"][sl]
+        alpha = jnp.exp(p["log_alpha"])
+        k1, k2 = jax.random.split(k)
+
+        # --- critic target: soft Bellman backup through both targets
+        nact, nlogp = sample_action(p, nxt, k1)
+        tq = jnp.minimum(_q(tgt["q1"], nxt, nact),
+                         _q(tgt["q2"], nxt, nact))
+        backup = batch["rewards"][sl] + gamma * (
+            1.0 - batch["dones"][sl]) * jax.lax.stop_gradient(
+                tq - alpha * nlogp)
+        q1 = _q(p["q1"], obs, act)
+        q2 = _q(p["q2"], obs, act)
+        critic_loss = (jnp.square(q1 - backup)
+                       + jnp.square(q2 - backup)).mean()
+
+        # --- actor: maximize min-Q + entropy (critics held fixed)
+        pact, plogp = sample_action(p, obs, k2)
+        qpi = jnp.minimum(
+            _q(jax.lax.stop_gradient(p["q1"]), obs, pact),
+            _q(jax.lax.stop_gradient(p["q2"]), obs, pact))
+        actor_loss = (jax.lax.stop_gradient(alpha) * plogp - qpi).mean()
+
+        # --- temperature: drive entropy toward target_entropy
+        alpha_loss = (-jnp.exp(p["log_alpha"])
+                      * jax.lax.stop_gradient(plogp
+                                              + target_entropy)).mean()
+        total = critic_loss + actor_loss + alpha_loss
+        return total, (critic_loss, actor_loss, alpha,
+                       -plogp.mean())
+
+    def step(carry, i):
+        p, tgt, opt, k = carry
+        k, sub = jax.random.split(k)
+        sl = jax.lax.dynamic_slice_in_dim(jnp.arange(N), i * mb, mb)
+        (_, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, tgt, sl, sub)
+        updates, opt = optimizer.update(grads, opt, p)
+        p = optax.apply_updates(p, updates)
+        # polyak target tracking of the critics only
+        tgt = {
+            "q1": jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                               tgt["q1"], p["q1"]),
+            "q2": jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                               tgt["q2"], p["q2"]),
+        }
+        return (p, tgt, opt, k), aux
+
+    (params, target, opt_state, _), aux = jax.lax.scan(
+        step, (params, target, opt_state, key), jnp.arange(n_updates))
+    critic, actor, alpha, entropy = aux
+    return params, target, opt_state, {
+        "critic_loss": critic.mean(), "actor_loss": actor.mean(),
+        "alpha": alpha[-1], "entropy": entropy.mean()}
+
+
+# ---------------------------------------------------------------- sampling
+
+
+class SACEnvRunner:
+    """Stochastic-policy sampling actor over a continuous env."""
+
+    def __init__(self, env_spec, hidden: Tuple[int, ...], seed: int):
+        self.env = make_env(env_spec, seed=seed)
+        self.max_torque = getattr(self.env, "MAX_TORQUE", 1.0)
+        self.seed = seed
+        self._params = None
+        self._key = None
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._completed: List[float] = []
+
+    def set_params(self, params) -> bool:
+        import jax
+
+        self._params = params
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self.seed)
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        obs_dim = self.env.observation_dim
+        act_dim = self.env.action_dim
+        out = {"obs": np.zeros((num_steps, obs_dim), np.float32),
+               "next_obs": np.zeros((num_steps, obs_dim), np.float32),
+               "actions": np.zeros((num_steps, act_dim), np.float32),
+               "rewards": np.zeros(num_steps, np.float32),
+               "dones": np.zeros(num_steps, np.float32)}
+        for t in range(num_steps):
+            self._key, sub = jax.random.split(self._key)
+            act, _ = sample_action(self._params,
+                                   jnp.asarray(self._obs[None, :]), sub)
+            act = np.asarray(act)[0]
+            nxt, reward, terminated, truncated, _ = self.env.step(
+                act * self.max_torque)
+            out["obs"][t] = self._obs
+            out["next_obs"][t] = nxt
+            out["actions"][t] = act
+            out["rewards"][t] = reward
+            out["dones"][t] = float(terminated)
+            self._episode_return += reward
+            if terminated or truncated:
+                self._completed.append(self._episode_return)
+                self._episode_return = 0.0
+                nxt, _ = self.env.reset()
+            self._obs = nxt
+        completed, self._completed = self._completed, []
+        out["episode_returns"] = np.asarray(completed, np.float32)
+        return out
+
+
+# ---------------------------------------------------------------- algorithm
+
+
+@dataclass
+class SACConfig:
+    env: Any = "Pendulum-v1"
+    num_env_runners: int = 1
+    rollout_fragment_length: int = 200
+    train_batch_size: int = 256
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.01
+    hidden: Tuple[int, ...] = (64, 64)
+    buffer_capacity: int = 50_000
+    learning_starts: int = 400
+    updates_per_iter: int = 16
+    target_entropy: Optional[float] = None   # default: -act_dim
+    seed: int = 0
+
+    def environment(self, env) -> "SACConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "SACConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "SACConfig":
+        for key, val in kwargs.items():
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training option {key!r}")
+            setattr(self, key, val)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC(CheckpointableAlgorithm):
+    """Algorithm driver (ref: algorithms/sac/sac.py training_step):
+    sample -> replay add -> n jitted soft-actor-critic updates ->
+    broadcast."""
+
+    def __init__(self, config: SACConfig):
+        import jax
+        import optax
+
+        self.config = config
+        probe = make_env(config.env, seed=0)
+        if not getattr(probe, "continuous", False):
+            raise ValueError(
+                "SAC here targets continuous-action envs (e.g. "
+                "Pendulum-v1); use DQN/PPO/IMPALA for discrete ones")
+        self.obs_dim = probe.observation_dim
+        self.act_dim = probe.action_dim
+        self.target_entropy = (config.target_entropy
+                               if config.target_entropy is not None
+                               else -float(self.act_dim))
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_sac_params(key, self.obs_dim, self.act_dim,
+                                      config.hidden)
+        self.target = {"q1": jax.tree.map(lambda a: a, self.params["q1"]),
+                       "q2": jax.tree.map(lambda a: a, self.params["q2"])}
+        self.opt_state = optax.adam(config.lr).init(self.params)
+        self.buffer = ReplayBuffer(
+            config.buffer_capacity, self.obs_dim,
+            act_shape=(self.act_dim,), act_dtype=np.float32)
+        self.np_rng = np.random.default_rng(config.seed)
+        self._key = jax.random.PRNGKey(config.seed + 1)
+        self.iteration = 0
+
+        import ray_tpu
+
+        runner_cls = ray_tpu.remote(SACEnvRunner)
+        self.runners = [
+            runner_cls.remote(config.env, config.hidden,
+                              config.seed + 300 + i)
+            for i in range(config.num_env_runners)
+        ]
+        from .checkpoint import broadcast_suppressed
+
+        if not broadcast_suppressed():
+            self._broadcast()
+
+    def _extra_state(self):
+        import jax
+
+        return {"target": jax.tree.map(np.asarray, self.target)}
+
+    def _apply_extra_state(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        if "target" in state:
+            self.target = jax.tree.map(jnp.asarray, state["target"])
+
+    def _broadcast(self) -> None:
+        import jax
+        import ray_tpu
+
+        host = jax.tree.map(np.asarray, self.params)
+        ray_tpu.get([r.set_params.remote(host) for r in self.runners],
+                    timeout=120)
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import ray_tpu
+
+        cfg = self.config
+        frags = ray_tpu.get(
+            [r.sample.remote(cfg.rollout_fragment_length)
+             for r in self.runners], timeout=300)
+        returns: List[float] = []
+        for frag in frags:
+            returns.extend(frag.pop("episode_returns").tolist())
+            self.buffer.add_batch(frag)
+
+        metrics: Dict[str, Any] = {}
+        if self.buffer.size >= cfg.learning_starts:
+            batch = self.buffer.sample(
+                self.np_rng, cfg.train_batch_size * cfg.updates_per_iter)
+            self._key, sub = jax.random.split(self._key)
+            self.params, self.target, self.opt_state, metrics = sac_update(
+                self.params, self.target, self.opt_state, batch, sub,
+                lr=cfg.lr, gamma=cfg.gamma, tau=cfg.tau,
+                target_entropy=self.target_entropy,
+                n_updates=cfg.updates_per_iter)
+            metrics = {k: float(v) for k, v in metrics.items()}
+            self._broadcast()
+        self.iteration += 1
+        metrics.update({
+            "iteration": self.iteration,
+            "buffer_size": self.buffer.size,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else float("nan")),
+            "episodes_this_iter": len(returns),
+        })
+        return metrics
