@@ -13,8 +13,9 @@ the jitted kernels never branch on validity; it is never handed out.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +47,12 @@ def init_kv_cache(cfg, num_pages: int, page_size: int,
 
 
 class PageAllocator:
-    """Host-side free list over the cache's page pool (page 0 reserved)."""
+    """Host-side free list over the cache's page pool (page 0 reserved).
+
+    Pages are REFERENCE-COUNTED: prefix caching shares prompt pages
+    across sequences (vLLM's automatic-prefix-caching page sharing), so
+    ``free`` decrements and only a zero count returns the page to the
+    free list."""
 
     def __init__(self, num_pages: int, page_size: int):
         if num_pages < 2:
@@ -54,6 +60,7 @@ class PageAllocator:
         self.page_size = page_size
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._refs: dict = {}
 
     @property
     def free_pages(self) -> int:
@@ -71,13 +78,133 @@ class PageAllocator:
                 f"KV cache out of pages: want {n_pages}, "
                 f"free {len(self._free)}")
         out = [self._free.pop() for _ in range(n_pages)]
+        for p in out:
+            self._refs[p] = 1
         return out
+
+    def incref(self, page: int) -> None:
+        if page not in self._refs:
+            raise ValueError(f"incref on unallocated page {page}")
+        self._refs[page] += 1
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
 
     def free(self, pages: List[int]) -> None:
         for p in pages:
             if not 0 < p < self.num_pages:
                 raise ValueError(f"freeing invalid page {p}")
-        self._free.extend(pages)
+            if p not in self._refs:
+                raise ValueError(f"double free of page {p}")
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
+
+
+class PrefixCache:
+    """Content-addressed full prompt pages (ref: vLLM automatic prefix
+    caching — --enable-prefix-caching). A page's key is the hash chain
+    (parent key, the page's token ids), so a lookup walks the prompt's
+    full pages and reuses the longest cached chain; reused pages are
+    shared via the allocator's refcounts and their KV is NOT recomputed
+    (chunked prefill starts past them). The cache holds one reference
+    per cached page; eviction (LRU) releases it."""
+
+    def __init__(self, allocator: PageAllocator):
+        self._alloc = allocator
+        self._pages: Dict[Any, int] = {}      # key -> page index
+        self._lru: "OrderedDict[Any, None]" = OrderedDict()
+        self._parent: Dict[Any, Any] = {}     # key -> parent key (0=root)
+        self._children: Dict[Any, int] = {}   # cached children per key
+
+    @staticmethod
+    def page_keys(prompt, page_size: int) -> List[Any]:
+        """Keys for each FULL page of the prompt (chained)."""
+        keys: List[Any] = []
+        parent = 0
+        for start in range(0, (len(prompt) // page_size) * page_size,
+                           page_size):
+            chunk = tuple(prompt[start:start + page_size])
+            parent = hash((parent, chunk))
+            keys.append(parent)
+        return keys
+
+    def __len__(self) -> int:
+        return len(self._pages)
+
+    def lookup(self, keys: List[Any]) -> List[int]:
+        """Longest cached prefix chain: pages for keys[0..k), each
+        increffed for the caller."""
+        out: List[int] = []
+        for key in keys:
+            page = self._pages.get(key)
+            if page is None:
+                break
+            self._alloc.incref(page)
+            self._lru.move_to_end(key)
+            out.append(page)
+        return out
+
+    def insert(self, keys: List[Any], pages: List[int]) -> None:
+        """Register freshly-filled prompt pages; the cache takes one
+        reference per NEW entry (a key already present keeps the
+        existing page — identical content)."""
+        parent = 0
+        for key, page in zip(keys, pages):
+            if key in self._pages:
+                parent = key
+                continue
+            self._alloc.incref(page)
+            self._pages[key] = page
+            self._lru[key] = None
+            self._parent[key] = parent
+            if parent:
+                self._children[parent] = self._children.get(parent, 0) + 1
+            parent = key
+
+    def evictable(self) -> int:
+        """Pages only the cache holds (the reclaimable set)."""
+        return sum(1 for p in self._pages.values()
+                   if self._alloc.refcount(p) == 1)
+
+    def evict(self, n_pages: int) -> int:
+        """Release up to n_pages cache-only pages, LEAF pages first (a
+        chain's root evicted first would strand its whole tail
+        unreachable — lookups break at the first miss; vLLM evicts leaf
+        blocks first for the same reason), LRU-ordered within leaves.
+        Returns pages released."""
+        released = 0
+        progress = True
+        while released < n_pages and progress:
+            progress = False
+            for key in list(self._lru):
+                if released >= n_pages:
+                    break
+                if self._children.get(key, 0):
+                    continue   # interior node: evict its leaves first
+                page = self._pages[key]
+                if self._alloc.refcount(page) != 1:
+                    continue   # a live sequence still shares it
+                self._alloc.free([page])
+                del self._pages[key]
+                del self._lru[key]
+                parent = self._parent.pop(key, 0)
+                if parent and parent in self._children:
+                    self._children[parent] -= 1
+                    if not self._children[parent]:
+                        del self._children[parent]
+                self._children.pop(key, None)
+                released += 1
+                progress = True
+        return released
+
+    def evict_for(self, n_tokens: int) -> None:
+        """Evict until the allocator can serve n_tokens (best effort)."""
+        while not self._alloc.can_allocate(n_tokens):
+            if not self.evict(1):
+                return
 
 
 class SequenceTable:

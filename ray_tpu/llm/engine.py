@@ -33,7 +33,8 @@ import numpy as np
 
 from ..models.llama import LlamaConfig
 from ..ops import rope_frequencies
-from .cache import KVCache, PageAllocator, SequenceTable, init_kv_cache
+from .cache import (KVCache, PageAllocator, PrefixCache, SequenceTable,
+                    init_kv_cache)
 from .runner import decode_burst, prefill_bucket, prefill_sample
 from .sampling import SamplingParams
 
@@ -65,6 +66,12 @@ class EngineConfig:
     # finished RequestStates kept for inspection before FIFO eviction
     # (callers that stream from step() outputs never need them)
     finished_retention: int = 1024
+    # automatic prefix caching (vLLM --enable-prefix-caching analog):
+    # full prompt pages are content-addressed and SHARED across
+    # sequences via page refcounts; a request whose prompt prefix is
+    # cached skips that prefix's prefill compute entirely (chunked
+    # prefill starts past it). Forces chunked-prefill mode.
+    enable_prefix_caching: bool = False
 
 
 @dataclass
@@ -76,6 +83,8 @@ class RequestState:
     slot: int = -1
     ctx_len: int = 0          # 0 until prefill completes
     prefill_pos: int = 0      # chunked prefill progress (tokens written)
+    prompt_page_keys: Any = None   # prefix-cache keys (full pages)
+    cached_tokens: int = 0         # prefix tokens served from the cache
     finished: bool = False
     finish_reason: Optional[str] = None
     arrival_t: float = 0.0
@@ -122,6 +131,18 @@ class LLMEngine:
                                    self.ecfg.kv_dtype)
         self.allocator = PageAllocator(self.ecfg.num_pages,
                                        self.ecfg.page_size)
+        self.prefix_cache: Optional[PrefixCache] = None
+        if self.ecfg.enable_prefix_caching:
+            self.prefix_cache = PrefixCache(self.allocator)
+            if self.ecfg.prefill_chunk <= 0:
+                # cached-prefix requests resume mid-prompt, which is the
+                # chunked runner's contract. COPY before adjusting — the
+                # caller's config object must not mutate under it.
+                import dataclasses as _dc
+
+                self.ecfg = _dc.replace(
+                    self.ecfg,
+                    prefill_chunk=min(512, self.ecfg.max_seq_len))
         max_pages = self.allocator.pages_needed(self.ecfg.max_seq_len)
         self.seq_table = SequenceTable(self.ecfg.max_num_seqs, max_pages)
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
@@ -284,12 +305,37 @@ class LLMEngine:
         # pages for the whole sequence so far (prompt + any tokens
         # generated before a preemption) + the next generated token
         seq_len = len(state.prompt) + len(state.output)
-        if not self.allocator.can_allocate(seq_len + 1):
-            return None
+        cached_pages: List[int] = []
+        if self.prefix_cache is not None:
+            if state.prompt_page_keys is None:
+                state.prompt_page_keys = PrefixCache.page_keys(
+                    state.prompt, self.ecfg.page_size)
+            hits = self.prefix_cache.lookup(state.prompt_page_keys)
+            # at least one prompt token must run through prefill (its
+            # logits seed sampling): never cache the WHOLE prompt
+            cap = (len(state.prompt) - 1) // self.ecfg.page_size
+            while len(hits) > cap:
+                self.allocator.free([hits.pop()])
+            cached_pages = hits
+        fresh_tokens = seq_len + 1 - len(cached_pages) * self.ecfg.page_size
+        if not self.allocator.can_allocate(fresh_tokens):
+            if self.prefix_cache is not None:
+                need = self.allocator.pages_needed(fresh_tokens)
+                # only sacrifice cached prefixes when eviction can
+                # actually enable THIS admission
+                if (self.allocator.free_pages
+                        + self.prefix_cache.evictable()) >= need:
+                    self.prefix_cache.evict_for(fresh_tokens)
+            if not self.allocator.can_allocate(fresh_tokens):
+                if cached_pages:
+                    self.allocator.free(cached_pages)
+                return None
         self.waiting.popleft()
-        pages = self.allocator.allocate(
-            self.allocator.pages_needed(seq_len + 1))
+        pages = cached_pages + self.allocator.allocate(
+            self.allocator.pages_needed(fresh_tokens))
         state.slot = slot
+        state.cached_tokens = len(cached_pages) * self.ecfg.page_size
+        state.prefill_pos = state.cached_tokens
         self.slots[slot] = state
         self.seq_table.assign(slot, pages)
         return state
@@ -398,6 +444,13 @@ class LLMEngine:
         state.prefill_pos = start + n
         if state.prefill_pos < L:
             return []  # more chunks to go; decode interleaves meanwhile
+        if self.prefix_cache is not None and state.prompt_page_keys:
+            # prompt pages are now fully written: publish them for
+            # future requests sharing the prefix
+            table = self.seq_table.block_tables[state.slot]
+            self.prefix_cache.insert(
+                state.prompt_page_keys,
+                [int(p) for p in table[:len(state.prompt_page_keys)]])
         seed, temp, top_k, top_p, _greedy = self._sampling_arrays([state])
         tok = int(np.asarray(sample_logits(
             logits, seed, temp, top_k, top_p))[0])
@@ -416,6 +469,7 @@ class LLMEngine:
         state.slot = -1
         state.ctx_len = 0
         state.prefill_pos = 0  # chunked progress restarts with the pages
+        state.cached_tokens = 0
         try:
             self._prefill_queue.remove(state)
         except ValueError:
@@ -448,6 +502,8 @@ class LLMEngine:
         always fits)."""
         while int(self.seq_table.n_pages[s.slot]) * self.ecfg.page_size \
                 < upto:
+            if self.allocator.free_pages < 1 and self.prefix_cache:
+                self.prefix_cache.evict(1)   # cache before victims
             if self.allocator.free_pages >= 1:
                 self.seq_table.append_page(
                     s.slot, self.allocator.allocate(1)[0])

@@ -323,3 +323,80 @@ def test_llm_server_over_serve_http(tiny_params):
         serve.shutdown()
     finally:
         ray_tpu.shutdown()
+
+
+# --- automatic prefix caching ---
+
+def test_prefix_cache_page_keys_chain():
+    from ray_tpu.llm.cache import PrefixCache
+
+    a = PrefixCache.page_keys(list(range(40)), 16)   # 2 full pages
+    b = PrefixCache.page_keys(list(range(32)), 16)
+    assert len(a) == 2 and a[:2] == b[:2]
+    c = PrefixCache.page_keys([9] + list(range(1, 40)), 16)
+    assert c[0] != a[0] and c[1] != a[1]   # divergence poisons the chain
+
+
+def test_prefix_caching_reuses_pages_and_matches_uncached(tiny_params):
+    """Second request sharing a long prefix must (a) reuse the FIRST
+    request's page objects, (b) skip that prefix's prefill compute,
+    (c) emit byte-identical greedy tokens to an uncached engine."""
+    from ray_tpu.llm.cache import PrefixCache
+
+    prefix = [7, 3, 9, 1] * 6                 # 24 tokens = 6 pages @ 4
+    p1 = prefix + [11, 12]
+    p2 = prefix + [13, 14, 15]
+
+    plain = LLMEngine(tiny_params, CFG, EngineConfig(
+        max_num_seqs=2, page_size=4, num_pages=64, max_seq_len=64))
+    want1 = plain.generate([p1], SamplingParams(temperature=0.0,
+                                                max_tokens=6))[0]
+    want2 = plain.generate([p2], SamplingParams(temperature=0.0,
+                                                max_tokens=6))[0]
+
+    engine = LLMEngine(tiny_params, CFG, EngineConfig(
+        max_num_seqs=2, page_size=4, num_pages=64, max_seq_len=64,
+        enable_prefix_caching=True, prefill_chunk=8))
+    got1 = engine.generate([p1], SamplingParams(temperature=0.0,
+                                                max_tokens=6))[0]
+    assert got1 == want1
+    assert len(engine.prefix_cache) == 6      # p1's full pages published
+
+    rid = engine.add_request(p2, SamplingParams(temperature=0.0,
+                                                max_tokens=6))
+    outs = []
+    while engine.has_unfinished():
+        outs.extend(o.token for o in engine.step()
+                    if o.request_id == rid)
+    assert outs == want2
+    state = engine.requests[rid]
+    # 6 full prefix pages were served from the cache (cap leaves >=1
+    # prompt token to prefill)
+    assert state.cached_tokens == 24
+    # and the shared pages are refcounted, not copied
+    keys = PrefixCache.page_keys(p2, 4)
+    shared = [engine.prefix_cache._pages[k] for k in keys[:6]]
+    assert len(set(shared)) == 6
+
+
+def test_prefix_cache_eviction_reclaims_pages(tiny_params):
+    """A full cache must not wedge admission: LRU cache-only pages are
+    evicted to serve new sequences, and refcounts drain to empty."""
+    engine = LLMEngine(tiny_params, CFG, EngineConfig(
+        max_num_seqs=1, page_size=4, num_pages=17, max_seq_len=32,
+        enable_prefix_caching=True, prefill_chunk=8))
+    for i in range(4):   # distinct prompts fill the cache
+        prompt = [(i * 31 + j) % 250 + 1 for j in range(14)]
+        engine.generate([prompt], SamplingParams(temperature=0.0,
+                                                 max_tokens=4))
+    assert len(engine.prefix_cache) > 0
+    # a fresh long request still admits (evicts cache pages as needed)
+    out = engine.generate([[5] * 20], SamplingParams(
+        temperature=0.0, max_tokens=8))[0]
+    assert len(out) == 8
+    # release everything: after evicting the whole cache the allocator
+    # must hold zero refs (no leaked pages)
+    engine.prefix_cache.evict(1 << 20)
+    assert len(engine.prefix_cache) == 0
+    assert not engine.allocator._refs
+    assert engine.allocator.free_pages == 16
