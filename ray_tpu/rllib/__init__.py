@@ -8,13 +8,14 @@ from .env import CartPole, make_env
 from .dqn import DQN, DQNConfig
 from .grpo import GRPO, GRPOConfig
 from .impala import IMPALA, IMPALAConfig
+from .appo import APPO, APPOConfig
 from .offline import (BC, BCConfig, MARWIL, MARWILConfig,
                       record_rollouts, rollout_dataset)
 from .ppo import PPO, PPOConfig, EnvRunner
 from .sac import SAC, SACConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC",
-           "SACConfig", "IMPALA",
+           "SACConfig", "IMPALA", "APPO", "APPOConfig",
            "IMPALAConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig",
            "GRPO", "GRPOConfig", "EnvRunner", "CartPole", "make_env",
            "record_rollouts", "rollout_dataset"]

@@ -1,0 +1,26 @@
+"""APPO: asynchronous PPO (ref: rllib/algorithms/appo/) — the IMPALA
+actor-learner architecture (async fragments, v-trace off-policy
+correction) with PPO's clipped surrogate bounding each policy step.
+Exactly IMPALA's machinery with clip_param > 0; see impala.py for the
+jitted update."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .impala import IMPALA, IMPALAConfig
+
+__all__ = ["APPO", "APPOConfig"]
+
+
+@dataclass
+class APPOConfig(IMPALAConfig):
+    clip_param: float = 0.3
+    lr: float = 5e-4
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    """Async PPO driver — IMPALA's train loop, clipped update."""

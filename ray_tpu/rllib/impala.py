@@ -25,7 +25,7 @@ _IMPALA_UPDATE_JIT = None
 
 def impala_update(params, opt_state, batch, lr, *, gamma: float,
                   vf_coef: float, ent_coef: float, rho_bar: float,
-                  c_bar: float):
+                  c_bar: float, clip_param: float = 0.0):
     global _IMPALA_UPDATE_JIT
     if _IMPALA_UPDATE_JIT is None:
         import jax
@@ -33,15 +33,16 @@ def impala_update(params, opt_state, batch, lr, *, gamma: float,
         _IMPALA_UPDATE_JIT = jax.jit(
             _impala_update_impl,
             static_argnames=("gamma", "vf_coef", "ent_coef", "rho_bar",
-                             "c_bar"))
+                             "c_bar", "clip_param"))
     return _IMPALA_UPDATE_JIT(params, opt_state, batch, lr, gamma=gamma,
                               vf_coef=vf_coef, ent_coef=ent_coef,
-                              rho_bar=rho_bar, c_bar=c_bar)
+                              rho_bar=rho_bar, c_bar=c_bar,
+                              clip_param=clip_param)
 
 
 def _impala_update_impl(params, opt_state, batch, lr, *, gamma: float,
                         vf_coef: float, ent_coef: float, rho_bar: float,
-                        c_bar: float):
+                        c_bar: float, clip_param: float = 0.0):
     import jax
     import jax.numpy as jnp
     import optax
@@ -79,7 +80,17 @@ def _impala_update_impl(params, opt_state, batch, lr, *, gamma: float,
         vs_next = jnp.concatenate([vs[1:], v_next[-1:]])
         pg_adv = clipped_rho * (
             batch["rewards"] + gamma * nonterminal * vs_next - v)
-        pi_loss = -(jax.lax.stop_gradient(pg_adv) * logp).mean()
+        adv = jax.lax.stop_gradient(pg_adv)
+        if clip_param > 0.0:
+            # APPO (ref: rllib/algorithms/appo/): PPO's clipped
+            # surrogate on the v-trace advantages — async sampling with
+            # bounded policy steps per update
+            surr = jnp.minimum(
+                rhos * adv,
+                jnp.clip(rhos, 1.0 - clip_param, 1.0 + clip_param) * adv)
+            pi_loss = -surr.mean()
+        else:
+            pi_loss = -(adv * logp).mean()
         vf_loss = jnp.square(values - jax.lax.stop_gradient(vs)).mean()
         entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
         total = pi_loss + vf_coef * vf_loss - ent_coef * entropy
@@ -109,6 +120,9 @@ class IMPALAConfig:
     fragments_per_iter: int = 4
     hidden: Tuple[int, ...] = (64, 64)
     seed: int = 0
+    # 0 = plain v-trace policy gradient (IMPALA); >0 = PPO clipped
+    # surrogate on the v-trace advantages (APPO)
+    clip_param: float = 0.0
 
     def environment(self, env) -> "IMPALAConfig":
         self.env = env
@@ -214,7 +228,8 @@ class IMPALA(CheckpointableAlgorithm):
                 self.params, self.opt_state, batch, cfg.lr,
                 gamma=cfg.gamma, vf_coef=cfg.vf_loss_coeff,
                 ent_coef=cfg.entropy_coeff,
-                rho_bar=cfg.vtrace_rho_clip, c_bar=cfg.vtrace_c_clip)
+                rho_bar=cfg.vtrace_rho_clip, c_bar=cfg.vtrace_c_clip,
+                clip_param=cfg.clip_param)
             ep_returns.extend(frag["episode_returns"].tolist())
             # fresh weights to the runner we just drained, then relaunch
             ray_tpu.get(runner.set_params.remote(self._host_params()),

@@ -270,3 +270,25 @@ def test_sac_improves_on_pendulum(ray_cluster):
     assert algo.buffer.size > 400
     with pytest.raises(ValueError, match="continuous"):
         SACConfig().environment("CartPole-v1").build()
+
+
+def test_appo_improves_on_cartpole(ray_cluster):
+    """APPO (v-trace + PPO clip, async) must beat the random-policy
+    return (~22 on CartPole) within a short budget."""
+    from ray_tpu.rllib import APPO, APPOConfig
+
+    algo = (APPOConfig().environment("CartPole-v1")
+            .env_runners(num_env_runners=2, rollout_fragment_length=256)
+            .training(fragments_per_iter=4, lr=8e-4, seed=5)).build()
+    assert algo.config.clip_param > 0
+    best = 0.0
+    try:
+        for _ in range(22):
+            m = algo.train()
+            if m["episodes_this_iter"]:
+                best = max(best, m["episode_reward_mean"])
+            if best >= 80:
+                break
+    finally:
+        algo.stop()
+    assert best >= 80, best
