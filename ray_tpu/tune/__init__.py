@@ -10,6 +10,7 @@ from .schedulers import (
     ASHAScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -32,7 +33,7 @@ from .tuner import TuneConfig, Tuner
 __all__ = [
     "Tuner", "TuneConfig", "Result", "ResultGrid", "Trial", "TrialStatus",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining",
+    "MedianStoppingRule", "PopulationBasedTraining", "PB2",
     "uniform", "quniform", "loguniform", "qloguniform", "randint",
     "choice", "grid_search", "sample_from", "Searcher", "TPESearcher",
     "report", "get_context", "get_checkpoint", "get_trial_id",

@@ -222,3 +222,120 @@ class PopulationBasedTraining(TrialScheduler):
             elif isinstance(leaf, list):
                 _set_path(out, path, rng.choice(leaf))
         return out
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (ref: tune/schedulers/pb2.py — Parker-
+    Holder et al. 2020). PBT's exploit mechanics, but EXPLORE is a
+    GP-bandit: observed (hyperparams -> reward change) pairs fit a tiny
+    RBF Gaussian process, and the clone's new continuous hyperparams
+    maximize UCB over the search bounds instead of random 0.8x/1.2x
+    scaling — far more sample-efficient at small population sizes (the
+    paper's point). Non-continuous mutation leaves (choice lists) keep
+    PBT behavior. Pure numpy (the reference needs GPy; nothing extra
+    here)."""
+
+    UCB_KAPPA = 1.5
+    MAX_OBS = 64          # GP fit cost is O(n^3); keep the window recent
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._cont_paths: List[tuple] = [
+            path for path, leaf in _walk(self.mutations)
+            if isinstance(leaf, Domain) and hasattr(leaf, "lower")]
+        self._domains = {path: leaf for path, leaf in _walk(self.mutations)}
+        self._obs_x: List[List[float]] = []
+        self._obs_y: List[float] = []
+        self._last_metric: Dict[str, float] = {}
+
+    # ---- observation stream ----
+
+    def on_result(self, trials, trial, result) -> str:
+        if self.metric in result:
+            cur = float(result[self.metric])
+            prev = self._last_metric.get(trial.trial_id)
+            if prev is not None:
+                delta = cur - prev if self.mode == "max" else prev - cur
+                x = self._encode(trial.config)
+                if x is not None:
+                    self._obs_x.append(x)
+                    self._obs_y.append(delta)
+                    if len(self._obs_x) > self.MAX_OBS:
+                        self._obs_x.pop(0)
+                        self._obs_y.pop(0)
+            self._last_metric[trial.trial_id] = cur
+        return super().on_result(trials, trial, result)
+
+    # ---- GP-UCB explore ----
+
+    def _encode(self, config) -> Optional[List[float]]:
+        """Mutation hyperparams -> [0,1]^d (log-scaled where the domain
+        is)."""
+        out = []
+        for path in self._cont_paths:
+            node = config
+            try:
+                for key in path:
+                    node = node[key]
+            except (KeyError, TypeError):
+                return None
+            dom = self._domains[path]
+            lo, hi = float(dom.lower), float(dom.upper)
+            if getattr(dom, "log", False):
+                out.append((math.log(node) - math.log(lo))
+                           / (math.log(hi) - math.log(lo)))
+            else:
+                out.append((float(node) - lo) / (hi - lo))
+        return out
+
+    def _decode(self, x: List[float]):
+        vals = {}
+        for u, path in zip(x, self._cont_paths):
+            dom = self._domains[path]
+            lo, hi = float(dom.lower), float(dom.upper)
+            if getattr(dom, "log", False):
+                val = math.exp(math.log(lo)
+                               + u * (math.log(hi) - math.log(lo)))
+            else:
+                val = lo + u * (hi - lo)
+            if getattr(dom, "q", None):
+                val = round(val / dom.q) * dom.q
+            vals[path] = min(hi, max(lo, val))
+        return vals
+
+    def _gp_ucb_candidate(self) -> Optional[List[float]]:
+        import numpy as np
+
+        d = len(self._cont_paths)
+        if d == 0 or len(self._obs_x) < max(3, d):
+            return None
+        X = np.asarray(self._obs_x, np.float64)
+        y = np.asarray(self._obs_y, np.float64)
+        y_std = y.std() or 1.0
+        y_n = (y - y.mean()) / y_std
+        length, noise = 0.3, 1e-2
+        sq = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-sq / (2 * length ** 2)) + noise * np.eye(len(X))
+        try:
+            alpha = np.linalg.solve(K, y_n)
+            K_inv = np.linalg.inv(K)
+        except np.linalg.LinAlgError:
+            return None
+        cand = np.random.default_rng(
+            self.rng.randrange(1 << 30)).random((256, d))
+        sq_c = ((cand[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        k_star = np.exp(-sq_c / (2 * length ** 2))
+        mu = k_star @ alpha
+        var = np.maximum(1e-9, 1.0 - (k_star @ K_inv * k_star).sum(-1))
+        best = int(np.argmax(mu + self.UCB_KAPPA * np.sqrt(var)))
+        return cand[best].tolist()
+
+    def mutate_config(self, config, rng=None):
+        out = super().mutate_config(config, rng)   # PBT for every leaf
+        x = self._gp_ucb_candidate()
+        if x is not None:
+            # continuous leaves: GP-UCB choice overrides the random
+            # perturbation
+            for path, val in self._decode(x).items():
+                _set_path(out, path, val)
+        return out
