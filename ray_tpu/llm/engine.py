@@ -66,6 +66,13 @@ class EngineConfig:
     # finished RequestStates kept for inspection before FIFO eviction
     # (callers that stream from step() outputs never need them)
     finished_retention: int = 1024
+    # multi-LoRA serving (vLLM --enable-lora analog, S-LoRA batched
+    # adapters — llm/lora.py): rank > 0 builds a static adapter pool;
+    # requests carry model_id and every slot in a decode batch can wear
+    # a different adapter. Incompatible with prefix caching (cached
+    # pages would mix adapters) and chunked prefill for now.
+    lora_rank: int = 0
+    max_loras: int = 8
     # automatic prefix caching (vLLM --enable-prefix-caching analog):
     # full prompt pages are content-addressed and SHARED across
     # sequences via page refcounts; a request whose prompt prefix is
@@ -85,6 +92,7 @@ class RequestState:
     prefill_pos: int = 0      # chunked prefill progress (tokens written)
     prompt_page_keys: Any = None   # prefix-cache keys (full pages)
     cached_tokens: int = 0         # prefix tokens served from the cache
+    model_id: Optional[str] = None # LoRA adapter name (None = base)
     finished: bool = False
     finish_reason: Optional[str] = None
     arrival_t: float = 0.0
@@ -131,6 +139,21 @@ class LLMEngine:
                                    self.ecfg.kv_dtype)
         self.allocator = PageAllocator(self.ecfg.num_pages,
                                        self.ecfg.page_size)
+        self.lora_pool = None
+        if self.ecfg.lora_rank > 0:
+            from .lora import LoRAPool
+
+            if self.ecfg.enable_prefix_caching:
+                raise ValueError(
+                    "lora_rank and enable_prefix_caching are mutually "
+                    "exclusive (cached pages would mix adapters)")
+            if self.ecfg.prefill_chunk > 0:
+                raise ValueError(
+                    "lora_rank requires whole-prompt prefill "
+                    "(prefill_chunk=0) for now")
+            self.lora_pool = LoRAPool(cfg, self.ecfg.lora_rank,
+                                      self.ecfg.max_loras,
+                                      dtype=cfg.dtype)
         self.prefix_cache: Optional[PrefixCache] = None
         if self.ecfg.enable_prefix_caching:
             self.prefix_cache = PrefixCache(self.allocator)
@@ -168,9 +191,15 @@ class LLMEngine:
 
     def add_request(self, prompt_tokens: List[int],
                     params: Optional[SamplingParams] = None,
-                    request_id: Optional[str] = None) -> str:
+                    request_id: Optional[str] = None,
+                    model_id: Optional[str] = None) -> str:
         if not prompt_tokens:
             raise ValueError("empty prompt")
+        if model_id is not None:
+            if self.lora_pool is None:
+                raise ValueError("model_id requires EngineConfig."
+                                 "lora_rank > 0")
+            self.lora_pool.slot_of(model_id)   # validate at submission
         if len(prompt_tokens) >= self.ecfg.max_seq_len:
             raise ValueError(
                 f"prompt length {len(prompt_tokens)} >= max_seq_len "
@@ -178,7 +207,8 @@ class LLMEngine:
         rid = request_id or f"req-{next(self._id)}"
         state = RequestState(rid, list(prompt_tokens),
                              params or SamplingParams(),
-                             arrival_t=time.perf_counter())
+                             arrival_t=time.perf_counter(),
+                             model_id=model_id)
         self.waiting.append(state)
         self.requests[rid] = state
         return rid
@@ -409,13 +439,17 @@ class LLMEngine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :L] = seq
         seed, temp, top_k, top_p, greedy = self._sampling_arrays([state])
+        lora = None
+        if self.lora_pool is not None:
+            lora = self.lora_pool.select(
+                [self.lora_pool.slot_of(state.model_id)])
         toks, ck, cv = prefill_sample(
             self.params, self.cache.k, self.cache.v,
             jnp.asarray(tokens), jnp.asarray([L], jnp.int32),
             jnp.asarray(self.seq_table.block_tables[
                 state.slot:state.slot + 1]),
-            self.cos, self.sin, seed, temp, top_k, top_p, cfg=self.cfg,
-            greedy=greedy)
+            self.cos, self.sin, seed, temp, top_k, top_p, lora,
+            cfg=self.cfg, greedy=greedy)
         self.cache = KVCache(ck, cv)
         state.ctx_len = L
         tok = int(np.asarray(toks)[0])
@@ -538,6 +572,12 @@ class LLMEngine:
             active[s.slot] = True
         seed, temp, top_k, top_p, greedy = self._sampling_arrays(
             self.slots, advance=K)
+        lora = None
+        if self.lora_pool is not None:
+            ids = [0] * self.ecfg.max_num_seqs
+            for s2 in active_states:
+                ids[s2.slot] = self.lora_pool.slot_of(s2.model_id)
+            lora = self.lora_pool.select(ids)
         span = self._active_span()
         use_paged = self._paged_kernel or (
             self._paged_min_pages > 0 and span >= self._paged_min_pages)
@@ -546,7 +586,7 @@ class LLMEngine:
             jnp.asarray(tokens), jnp.asarray(positions),
             self._bt(span),
             jnp.asarray(active), self.cos, self.sin,
-            seed, temp, top_k, top_p, cfg=self.cfg, n_steps=K,
+            seed, temp, top_k, top_p, lora, cfg=self.cfg, n_steps=K,
             paged_kernel=use_paged, greedy=greedy)
         self.cache = KVCache(ck, cv)
         sampled = np.asarray(toks)  # [K, B]
@@ -592,6 +632,34 @@ class LLMEngine:
             stale = self.requests.get(old)
             if stale is not None and stale.finished:
                 del self.requests[old]
+
+    # --- LoRA management (vLLM add_lora/remove_lora analog) ---
+
+    def add_lora(self, name: str, adapter=None, *, seed: int = 0) -> None:
+        """Load an adapter into the pool (``adapter`` defaults to a
+        fresh zero-delta init at the engine's rank)."""
+        if self.lora_pool is None:
+            raise ValueError("engine built without lora_rank")
+        if adapter is None:
+            from .lora import init_lora_adapter
+
+            adapter = init_lora_adapter(
+                jax.random.PRNGKey(seed), self.cfg,
+                self.ecfg.lora_rank, dtype=self.cfg.dtype)
+        self.lora_pool.add(name, adapter)
+
+    def remove_lora(self, name: str) -> None:
+        if self.lora_pool is None:
+            raise ValueError("engine built without lora_rank")
+        users = [s.request_id for s in self.requests.values()
+                 if s.model_id == name and not s.finished]
+        if users:
+            # removal mid-flight would KeyError inside a later step(),
+            # killing the whole batch including base-model requests
+            raise RuntimeError(
+                f"adapter {name!r} is in use by {len(users)} live "
+                f"request(s); drain or abort them first")
+        self.lora_pool.remove(name)
 
     # --- metrics ---
 

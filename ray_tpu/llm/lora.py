@@ -1,0 +1,145 @@
+"""Multi-LoRA serving: per-request low-rank adapters in ONE batched
+engine (ref: the reference inherits LoRA serving from vLLM —
+--enable-lora + per-request lora_request; its own multiplex layer is
+adapter-agnostic. The batched-adapter design here is the S-LoRA /
+punica shape, TPU-first).
+
+Adapters live in a STACKED device pool — one tensor per projection:
+``a_q [P, L, d, r]``, ``b_q [P, L, r, h*hd]`` (same for wv) — so a
+decode batch where every slot wears a different adapter is one gather
+(pool[ids]) plus two skinny einsums per projection, all inside the same
+compiled program; slot 0 of the pool is the ZERO adapter (requests
+without a model_id ride it and get exactly the base model). Pool size
+is static (max_loras), so adapter add/swap never retraces."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LoRAPool", "init_lora_adapter", "lora_delta"]
+
+
+def init_lora_adapter(key, cfg, rank: int, *, scale: float = 1.0,
+                      dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """One adapter's weights (standard init: A ~ N(0, 1/r), B = 0 — a
+    fresh adapter is an exact no-op until trained)."""
+    L, d, hd = cfg.n_layers, cfg.dim, cfg.head_dim
+    h, hkv = cfg.n_heads, cfg.n_kv_heads
+    ka, kv = jax.random.split(key)
+    return {
+        "a_q": (jax.random.normal(ka, (L, d, rank), jnp.float32)
+                * (rank ** -0.5)).astype(dtype),
+        "b_q": jnp.zeros((L, rank, h * hd), dtype),
+        "a_v": (jax.random.normal(kv, (L, d, rank), jnp.float32)
+                * (rank ** -0.5)).astype(dtype),
+        "b_v": jnp.zeros((L, rank, hkv * hd), dtype),
+        "scale": jnp.float32(scale),
+    }
+
+
+def lora_delta(h, a_sel, b_sel, scale_sel, out_heads: int, head_dim: int):
+    """Per-slot low-rank delta: h [B, S, d]; a_sel [B, d, r];
+    b_sel [B, r, out]; scale_sel [B] -> [B, S, heads, head_dim]."""
+    lo = jnp.einsum("bsd,bdr->bsr", h, a_sel)
+    delta = jnp.einsum("bsr,bro->bso", lo, b_sel)
+    delta = delta * scale_sel[:, None, None].astype(delta.dtype)
+    B, S = h.shape[:2]
+    return delta.reshape(B, S, out_heads, head_dim)
+
+
+class LoRAPool:
+    """Host-side registry + device-side stacked pool.
+
+    Slot 0 is permanently the zero adapter. ``add`` uploads an adapter
+    into a free slot; ``remove`` frees it (the pool tensor keeps its
+    static shape — the slot is just zeroed lazily on reuse)."""
+
+    def __init__(self, cfg, rank: int, max_loras: int,
+                 dtype=jnp.bfloat16):
+        if max_loras < 1:
+            raise ValueError("max_loras must be >= 1")
+        L, d, hd = cfg.n_layers, cfg.dim, cfg.head_dim
+        h, hkv = cfg.n_heads, cfg.n_kv_heads
+        P = max_loras + 1              # + the zero slot
+        self.rank, self.max_loras = rank, max_loras
+        self.cfg = cfg
+        self.pool = {
+            "a_q": jnp.zeros((P, L, d, rank), dtype),
+            "b_q": jnp.zeros((P, L, rank, h * hd), dtype),
+            "a_v": jnp.zeros((P, L, d, rank), dtype),
+            "b_v": jnp.zeros((P, L, rank, hkv * hd), dtype),
+            "scale": jnp.zeros((P,), jnp.float32),
+        }
+        self._slots: Dict[str, int] = {}
+        self._free = list(range(P - 1, 0, -1))
+        self._select_cache: Dict[tuple, Dict[str, Any]] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._slots
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def slot_of(self, name: Optional[str]) -> int:
+        if name is None:
+            return 0
+        if name not in self._slots:
+            raise KeyError(f"unknown LoRA adapter {name!r}; add_lora it "
+                           f"first (loaded: {sorted(self._slots)})")
+        return self._slots[name]
+
+    def add(self, name: str, adapter: Dict[str, Any]) -> int:
+        if name in self._slots:
+            raise ValueError(f"adapter {name!r} already loaded")
+        if not self._free:
+            raise RuntimeError(
+                f"LoRA pool full ({self.max_loras}); remove one first")
+        slot = self._free.pop()
+        for field in ("a_q", "b_q", "a_v", "b_v"):
+            leaf = jnp.asarray(adapter[field],
+                               self.pool[field].dtype)
+            if leaf.shape != self.pool[field].shape[1:]:
+                raise ValueError(
+                    f"adapter {field} shape {leaf.shape} != pool "
+                    f"{self.pool[field].shape[1:]}")
+            self.pool[field] = self.pool[field].at[slot].set(leaf)
+        self.pool["scale"] = self.pool["scale"].at[slot].set(
+            jnp.float32(adapter.get("scale", 1.0)))
+        self._select_cache.clear()
+        self._slots[name] = slot
+        return slot
+
+    def remove(self, name: str) -> None:
+        slot = self._slots.pop(name)
+        # zero the scale: the slot's stale weights multiply to nothing,
+        # so reuse can lazily overwrite without an eager wipe
+        self.pool["scale"] = self.pool["scale"].at[slot].set(0.0)
+        self._select_cache.clear()
+        self._free.append(slot)
+
+    def select(self, ids) -> Dict[str, Any]:
+        """Per-slot adapter tensors for a batch: ids [B] ->
+        {a_q [B, L, d, r], ...} (one gather per projection). Cached by
+        the id tuple — steady-state decode re-selects the SAME batch
+        assignment every burst and must not pay the gather again; any
+        pool mutation (add/remove) invalidates."""
+        key = tuple(int(i) for i in ids)
+        cached = self._select_cache.get(key)
+        if cached is not None:
+            return cached
+        idx = jnp.asarray(key, jnp.int32)
+        out = {
+            "a_q": self.pool["a_q"][idx],
+            "b_q": self.pool["b_q"][idx],
+            "a_v": self.pool["a_v"][idx],
+            "b_v": self.pool["b_v"][idx],
+            "scale": self.pool["scale"][idx],
+        }
+        if len(self._select_cache) > 64:
+            self._select_cache.clear()
+        self._select_cache[key] = out
+        return out

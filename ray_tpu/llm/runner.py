@@ -81,23 +81,36 @@ def _write_pages(cache_layer, new, block_tables, positions, page_size):
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k",
                                                              "cache_v"))
 def prefill(params, cache_k, cache_v, tokens, prompt_lens, block_tables,
-            cos, sin, *, cfg: LlamaConfig):
+            cos, sin, lora=None, *, cfg: LlamaConfig):
     """Process full prompts, fill their pages, return last-token logits.
 
     tokens: [B, S] right-padded; prompt_lens: [B]; block_tables: [B, Pmax].
+    ``lora``: per-slot batched adapters from LoRAPool.select(ids) —
+    low-rank deltas on wq/wv (llm/lora.py), empty/None = base model.
     Returns (logits [B, vocab], cache_k, cache_v).
     """
+    from .lora import lora_delta
+
     B, S = tokens.shape
     x = embed_lookup(params["embed"], tokens, cfg.dtype)
     pos_grid = jnp.arange(S)[None, :].repeat(B, 0)
     write_pos = jnp.where(pos_grid < prompt_lens[:, None], pos_grid, -1)
+    # adapters ride the layer scan as xs: [B, L, ...] -> [L, B, ...]
+    lora_xs = {} if not lora else {
+        k2: jnp.swapaxes(v2, 0, 1) for k2, v2 in lora.items()
+        if k2 != "scale"}
 
     def layer(x, inputs):
-        lp, ck, cv = inputs
+        lp, ck, cv, lr = inputs
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = weight_einsum("bsd,dhk->bshk", h, lp["wq"])
         k = weight_einsum("bsd,dhk->bshk", h, lp["wk"])
         v = weight_einsum("bsd,dhk->bshk", h, lp["wv"])
+        if lr:
+            q = q + lora_delta(h, lr["a_q"], lr["b_q"], lora["scale"],
+                               cfg.n_heads, cfg.head_dim)
+            v = v + lora_delta(h, lr["a_v"], lr["b_v"], lora["scale"],
+                               cfg.n_kv_heads, cfg.head_dim)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
         ck = _write_pages(ck, k, block_tables, write_pos, ck.shape[1])
@@ -111,7 +124,7 @@ def prefill(params, cache_k, cache_v, tokens, prompt_lens, block_tables,
         return x, (ck, cv)
 
     x, (cache_k, cache_v) = jax.lax.scan(
-        layer, x, (params["layers"], cache_k, cache_v))
+        layer, x, (params["layers"], cache_k, cache_v, lora_xs))
     x_last = jnp.take_along_axis(
         x, jnp.maximum(prompt_lens - 1, 0)[:, None, None], axis=1)[:, 0]
     x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
@@ -220,7 +233,8 @@ def sample_logits(logits, seed, temperature, top_k, top_p):
          donate_argnames=("cache_k", "cache_v"))
 def prefill_sample(params, cache_k, cache_v, tokens, prompt_lens,
                    block_tables, cos, sin, seed, temperature, top_k,
-                   top_p, *, cfg: LlamaConfig, greedy: bool = False):
+                   top_p, lora=None, *, cfg: LlamaConfig,
+                   greedy: bool = False):
     """``greedy=True`` (every request temperature==0) compiles an
     argmax-only epilogue — bit-identical results for greedy requests,
     and a materially simpler program: the top_k/sort/categorical
@@ -232,7 +246,7 @@ def prefill_sample(params, cache_k, cache_v, tokens, prompt_lens,
 
     logits, cache_k, cache_v = prefill.__wrapped__(
         params, cache_k, cache_v, tokens, prompt_lens, block_tables,
-        cos, sin, cfg=cfg)
+        cos, sin, lora, cfg=cfg)
     if greedy:
         toks = jnp.argmax(logits, axis=-1)
     else:
@@ -246,8 +260,9 @@ def prefill_sample(params, cache_k, cache_v, tokens, prompt_lens,
          donate_argnames=("cache_k", "cache_v"))
 def decode_burst(params, cache_k, cache_v, tokens, positions,
                  block_tables, active, cos, sin, seed, temperature,
-                 top_k, top_p, *, cfg: LlamaConfig, n_steps: int,
-                 paged_kernel: bool = None, greedy: bool = False):
+                 top_k, top_p, lora=None, *, cfg: LlamaConfig,
+                 n_steps: int, paged_kernel: bool = None,
+                 greedy: bool = False):
     """n_steps fused decode+sample steps, sampled tokens fed back
     ON-DEVICE (multi-step scheduling, vLLM's --num-scheduler-steps
     analog). One host round trip yields n_steps tokens per slot — the
@@ -269,6 +284,7 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
     from .sampling import sample_from_logits
 
     from .._private.config import global_config
+    from .lora import lora_delta
 
     # static jit arg (None -> config default) so flag flips retrace
     use_paged_kernel = (global_config().llm_paged_kernel
@@ -292,6 +308,9 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
             L, B, Sold, kvh, hd)
     scratch_k = jnp.zeros((L, B, K, kvh, hd), cache_k.dtype)
     scratch_v = jnp.zeros((L, B, K, kvh, hd), cache_v.dtype)
+    lora_xs = {} if not lora else {
+        k2: jnp.swapaxes(v2, 0, 1) for k2, v2 in lora.items()
+        if k2 != "scale"}
     old_mask = jnp.arange(Sold)[None, :] < positions[:, None]  # [B, Sold]
 
     def step(carry, i):
@@ -327,11 +346,16 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
                 page_size=page_size).astype(jnp.float32)
 
         def layer(x, inputs):
-            lp, ok, ov, nk, nv = inputs
+            lp, ok, ov, nk, nv, lr = inputs
             h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
             q = weight_einsum("bsd,dhk->bshk", h, lp["wq"])
             k = weight_einsum("bsd,dhk->bshk", h, lp["wk"])
             v = weight_einsum("bsd,dhk->bshk", h, lp["wv"])
+            if lr:
+                q = q + lora_delta(h, lr["a_q"], lr["b_q"],
+                                   lora["scale"], cfg.n_heads, hd)
+                v = v + lora_delta(h, lr["a_v"], lr["b_v"],
+                                   lora["scale"], kvh, hd)
             q = apply_rotary(q, cos, sin, positions=pos_i[:, None])[:, 0]
             k = apply_rotary(k, cos, sin, positions=pos_i[:, None])[:, 0]
             nk = jax.lax.dynamic_update_index_in_dim(
@@ -357,15 +381,18 @@ def decode_burst(params, cache_k, cache_v, tokens, positions,
             sks, svs = [], []
             for li in range(L):
                 lp_l = jax.tree.map(lambda a: a[li], params["layers"])
+                lr_l = {k2: v2[li] for k2, v2 in lora_xs.items()}
                 x, (nk_l, nv_l) = layer(
-                    x, (lp_l, cache_k[li], cache_v[li], sk[li], sv[li]))
+                    x, (lp_l, cache_k[li], cache_v[li], sk[li], sv[li],
+                        lr_l))
                 sks.append(nk_l)
                 svs.append(nv_l)
             sk = jnp.stack(sks)
             sv = jnp.stack(svs)
         else:
             x, (sk, sv) = jax.lax.scan(
-                layer, x, (params["layers"], old_k, old_v, sk, sv))
+                layer, x, (params["layers"], old_k, old_v, sk, sv,
+                           lora_xs))
         h = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
         logits = _lm_logits(h, params, cfg)
         if greedy:   # see prefill_sample: argmax-only epilogue

@@ -239,10 +239,15 @@ class PB2(PopulationBasedTraining):
     MAX_OBS = 64          # GP fit cost is O(n^3); keep the window recent
 
     def __init__(self, *args, **kwargs):
+        from .search import Float
+
         super().__init__(*args, **kwargs)
+        # GP-modeled dims are the FLOAT domains only: Integer leaves
+        # would receive un-rounded, possibly upper-bound-exclusive
+        # floats from _decode — they keep PBT perturbation instead
         self._cont_paths: List[tuple] = [
             path for path, leaf in _walk(self.mutations)
-            if isinstance(leaf, Domain) and hasattr(leaf, "lower")]
+            if isinstance(leaf, Float)]
         self._domains = {path: leaf for path, leaf in _walk(self.mutations)}
         self._obs_x: List[List[float]] = []
         self._obs_y: List[float] = []
@@ -264,7 +269,13 @@ class PB2(PopulationBasedTraining):
                         self._obs_x.pop(0)
                         self._obs_y.pop(0)
             self._last_metric[trial.trial_id] = cur
-        return super().on_result(trials, trial, result)
+        decision = super().on_result(trials, trial, result)
+        if decision == self.EXPLOIT:
+            # the clone resumes from the DONOR's checkpoint: its next
+            # metric jump is inheritance, not this config's doing —
+            # recording that delta would poison the GP
+            self._last_metric.pop(trial.trial_id, None)
+        return decision
 
     # ---- GP-UCB explore ----
 
