@@ -32,9 +32,11 @@ class LLMServer:
     def __init__(self, model: str = "tiny", *, init: str = "random",
                  params_path: Optional[str] = None,
                  engine_config: Optional[dict] = None,
-                 tokenizer: Optional[str] = None, seed: int = 0):
+                 tokenizer: Optional[str] = None, seed: int = 0,
+                 quantize: Optional[str] = None):
         import jax
 
+        self.model_name = model
         if model in LLAMA_CONFIGS:
             cfg = LLAMA_CONFIGS[model]
         elif os.path.isdir(model):
@@ -53,7 +55,10 @@ class LLMServer:
                     f"init='hf' needs an HF checkpoint directory; "
                     f"{path!r} is not one (pass it as `model` or "
                     f"`params_path`)")
-            params, cfg = load_hf_checkpoint(path)
+            # quantize="int8": host-side per-channel int8 before the
+            # device sees anything — how Llama-3-8B serves on one 16 GB
+            # chip (ops/quant.py)
+            params, cfg = load_hf_checkpoint(path, quantize=quantize)
             params = jax.device_put(params)
             if tokenizer is None and os.path.exists(
                     os.path.join(path, "tokenizer_config.json")):
@@ -61,10 +66,18 @@ class LLMServer:
         elif params_path:
             import pickle
 
+            if quantize is not None:
+                raise ValueError(
+                    "quantize applies to HF-checkpoint loading only "
+                    "(init='hf' / a checkpoint-dir model)")
             with open(params_path, "rb") as f:
                 params = pickle.load(f)
             params = jax.device_put(params)
         elif init == "random":
+            if quantize is not None:
+                raise ValueError(
+                    "quantize applies to HF-checkpoint loading only "
+                    "(init='hf' / a checkpoint-dir model)")
             params = init_params(jax.random.PRNGKey(seed), cfg)
         else:
             raise ValueError(f"unknown init {init!r}")
@@ -103,8 +116,10 @@ class LLMServer:
                 await asyncio.sleep(0.002)
 
     async def _submit(self, prompt_ids: List[int],
-                      params: SamplingParams) -> asyncio.Queue:
-        rid = self.engine.add_request(prompt_ids, params)
+                      params: SamplingParams,
+                      model_id: Optional[str] = None) -> asyncio.Queue:
+        rid = self.engine.add_request(prompt_ids, params,
+                                      model_id=model_id)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         self._ensure_pump()
@@ -124,7 +139,25 @@ class LLMServer:
             top_p=float(payload.get("top_p", 1.0)),
             max_tokens=int(payload.get("max_tokens", 64)),
             stop_token_ids=tuple(payload.get("stop_token_ids", ())))
-        return prompt_ids, params
+        # OpenAI-style per-request "model": the server's base-model
+        # name rides the base weights; any OTHER name must be a LOADED
+        # LoRA adapter — an unknown name is a client error, not a
+        # silent base-model fallback
+        model_id = payload.get("model")
+        if model_id is not None:
+            if not isinstance(model_id, str):
+                raise ValueError("'model' must be a string")
+            if model_id in (self.model_name, "base", ""):
+                model_id = None
+            elif self.engine.lora_pool is None \
+                    or model_id not in self.engine.lora_pool:
+                loaded = (sorted(self.engine.lora_pool._slots)
+                          if self.engine.lora_pool is not None else [])
+                raise ValueError(
+                    f"unknown model {model_id!r}: not this server's "
+                    f"base model ({self.model_name!r}) or a loaded "
+                    f"LoRA adapter ({loaded})")
+        return prompt_ids, params, model_id
 
     def _detok(self, token_ids: List[int]) -> Optional[str]:
         if self.tokenizer is None:
@@ -143,8 +176,8 @@ class LLMServer:
         """OpenAI-completions-shaped endpoint (ref: ray.llm's OpenAI
         router). ``stream=True`` returns an async generator serve turns
         into chunked HTTP (SSE-style ``data:`` lines)."""
-        prompt_ids, params = self._parse(payload)
-        queue = await self._submit(prompt_ids, params)
+        prompt_ids, params, model_id = self._parse(payload)
+        queue = await self._submit(prompt_ids, params, model_id)
         if payload.get("stream"):
             return self._stream_from(queue)
         tokens: List[int] = []
