@@ -1864,7 +1864,8 @@ class CoreWorker:
         except BaseException as e:  # noqa: BLE001
             try:
                 await self.gcs.call("actor_failed", {
-                    "actor_id": spec.actor_id, "cause": f"creation failed: {e}",
+                    "actor_id": spec.actor_id,
+                    "cause": f"creation failed: {type(e).__name__}: {e}",
                 })
             except Exception:
                 pass

@@ -390,6 +390,7 @@ class EventLoopThread:
     def __init__(self, name: str = "ray_tpu_io"):
         self.loop = asyncio.new_event_loop()
         self._stopping = False
+        self._spawned: set = set()   # strong refs to in-flight spawns
         self.thread = threading.Thread(target=self._run, name=name, daemon=True)
         self.thread.start()
 
@@ -410,7 +411,16 @@ class EventLoopThread:
         if self._stopping or self.loop.is_closed():
             coro.close()
             return None
-        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        # RETAIN the future until done: the event loop keeps only WEAK
+        # task references, and fire-and-forget callers drop this future
+        # — a suspended task can then be garbage-collected mid-await,
+        # surfacing as a spurious GeneratorExit inside the coroutine
+        # (observed: pipelined actor creations dying with
+        # "creation failed: GeneratorExit" under GC pressure).
+        self._spawned.add(fut)
+        fut.add_done_callback(self._spawned.discard)
+        return fut
 
     def stop(self):
         self._stopping = True
