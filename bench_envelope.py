@@ -362,7 +362,12 @@ def bench_broadcast(results, size_gb=1.0, nodes=4):
 
         @ray.remote
         def touch(arr):
-            return int(arr[0]) + int(arr[-1])
+            # completion timestamp: the spread max-min across nodes is
+            # the pipeline fill — with cut-through relay every node
+            # finishes a small fixed lag behind the origin stream, so
+            # the spread stays near zero regardless of fan-out depth
+            # (store-and-forward trees pay a full object copy per hop)
+            return int(arr[0]) + int(arr[-1]), time.time()
 
         data = np.empty(nbytes, dtype=np.uint8)
         data[0] = 1
@@ -374,10 +379,12 @@ def bench_broadcast(results, size_gb=1.0, nodes=4):
             touch.options(resources={f"slot{i}": 1.0}).remote(ref)
             for i in range(nodes - 1)], timeout=600)
         t_bcast = time.perf_counter() - t0
-        assert outs == [2] * (nodes - 1)
+        assert [o[0] for o in outs] == [2] * (nodes - 1)
+        done_ts = [o[1] for o in outs]
         results.append(emit(
             "envelope_broadcast", object_gb=round(size_gb, 2), nodes=nodes,
             broadcast_s=t_bcast,
+            broadcast_pipeline_fill_s=max(done_ts) - min(done_ts),
             aggregate_gb_per_s=(nodes - 1) * size_gb / t_bcast))
     finally:
         ray.shutdown()
@@ -457,6 +464,12 @@ def bench_spill(results, total_gb=12.0, obj_gb=1.0, store_gb=4.0):
     nbytes = int(obj_gb * (1 << 30))
     ray.init(num_cpus=2, object_store_memory=int(store_gb * (1 << 30)))
     try:
+        # per-stage I/O counters (pure spill-write / restore-read time,
+        # excluding admission waits): puts and gets run in THIS process,
+        # so the driver's own store counters cover the whole run
+        from ray_tpu._private.object_store import IO_STATS
+
+        s0 = dict(IO_STATS)
         t0 = time.perf_counter()
         refs = []
         for i in range(n):
@@ -465,6 +478,7 @@ def bench_spill(results, total_gb=12.0, obj_gb=1.0, store_gb=4.0):
             refs.append(ray.put(a))
             del a
         t_put = time.perf_counter() - t0
+        s1 = dict(IO_STATS)
         gc.collect()
         t0 = time.perf_counter()
         ok = 0
@@ -475,10 +489,19 @@ def bench_spill(results, total_gb=12.0, obj_gb=1.0, store_gb=4.0):
             del out
             gc.collect()
         t_get = time.perf_counter() - t0
+        s2 = dict(IO_STATS)
+
+        def stage_rate(a, b, kind):
+            nbytes_moved = b[kind + "_bytes"] - a[kind + "_bytes"]
+            secs = b[kind + "_s"] - a[kind + "_s"]
+            return (nbytes_moved / (1 << 30)) / secs if secs > 0 else 0.0
+
         results.append(emit(
             "envelope_spill", total_gb=total_gb, store_gb=store_gb,
             objects=n, put_gb_per_s=total_gb / t_put,
-            restore_get_gb_per_s=total_gb / t_get))
+            restore_get_gb_per_s=total_gb / t_get,
+            spill_write_io_gb_per_s=stage_rate(s0, s2, "spill"),
+            restore_read_io_gb_per_s=stage_rate(s1, s2, "restore")))
     finally:
         ray.shutdown()
 

@@ -61,7 +61,7 @@ PY
     exit 0
 fi
 
-echo "== [1/3] native build =="
+echo "== [1/4] native build =="
 rm -rf ray_tpu/_native/build
 python - <<'PY'
 from ray_tpu._native import get_lib, native_unavailable_reason
@@ -69,14 +69,23 @@ assert get_lib() is not None, native_unavailable_reason()
 print("native lib built + loaded")
 PY
 
-echo "== [2/3] test suite =="
+echo "== [2/4] data-plane smoke: transfer + spilling =="
+# the bulk data plane (cut-through relay watermark, parallel spill I/O)
+# gets its own early, explicit lane: a broken transfer/spill path fails
+# the round in minutes instead of surfacing mid-suite
+JAX_PLATFORMS=cpu \
+RAY_TPU_TEST_TIMEOUT_S="${RAY_TPU_TEST_TIMEOUT_S:-180}" \
+timeout "${CI_SMOKE_TIMEOUT_S:-600}" \
+    python -m pytest tests/test_object_transfer.py tests/test_spilling.py -q
+
+echo "== [3/4] test suite =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 JAX_PLATFORMS=cpu \
 RAY_TPU_TEST_TIMEOUT_S="${RAY_TPU_TEST_TIMEOUT_S:-180}" \
 timeout "${CI_SUITE_TIMEOUT_S:-3000}" \
     python -m pytest tests/ -q
 
-echo "== [3/3] multichip dry-run =="
+echo "== [4/4] multichip dry-run =="
 timeout "${CI_DRYRUN_TIMEOUT_S:-1200}" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 

@@ -50,6 +50,14 @@ class Config:
     object_spilling_dir: str = ""             # "" = TEMP_ROOT/spill/<store>
     object_spilling_threshold: float = 0.8
     object_store_eviction_fraction: float = 0.1
+    # spill/restore I/O plane (object_store.py): chunked multi-worker
+    # copies straight between spill files and the shm mapping (preadv/
+    # sendfile, no intermediate bytes). Workers size the shared I/O
+    # pool; restores additionally admit through a bytes-in-flight gate
+    # that shares object_transfer_max_inflight_bytes with PullManager
+    # so concurrent restores can't blow the store.
+    object_spill_io_workers: int = 4
+    object_spill_io_chunk_bytes: int = 8 * 1024**2
     # --- memory pressure (ref: memory_monitor.h:52 + killing policies) ---
     memory_monitor_refresh_ms: int = 500      # 0 disables the monitor
     memory_usage_threshold: float = 0.95      # host RSS fraction to act at

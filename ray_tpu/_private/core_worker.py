@@ -2104,7 +2104,13 @@ class CoreWorker:
                     await client.call("kill_self", {}, timeout=2)
                 except Exception:
                     pass
-        self.io.run(_kill())
+        if threading.current_thread() is self.io.thread:
+            # kill() can be reached from a destructor GC runs on the io
+            # loop thread itself (e.g. a dataset coordinator handle);
+            # blocking there would deadlock the loop — fire and forget
+            self.io.spawn(_kill())
+        else:
+            self.io.run(_kill())
 
     def get_named_actor(self, name: str, namespace: str = "") -> ActorID:
         info = self.io.run(self.gcs.call("get_actor", {"name": name, "namespace": namespace}))

@@ -196,11 +196,22 @@ class GcsServer:
         period = max(0.05, cfg.health_check_period_ms / 1000.0)
         timeout = max(0.05, cfg.health_check_timeout_ms / 1000.0)
         misses: Dict[NodeID, int] = {}
+        inflight: Dict[NodeID, asyncio.Task] = {}
         while True:
             await asyncio.sleep(period)
+            for node_id in [n for n in inflight if n not in self.nodes]:
+                inflight.pop(node_id).cancel()
             for node_id, info in list(self.nodes.items()):
                 if not info.alive:
                     misses.pop(node_id, None)
+                    continue
+                prev = inflight.get(node_id)
+                if prev is not None and not prev.done():
+                    # at most ONE probe in flight per node: when this
+                    # loop stalls (~5 s GC pause, saturated loop), the
+                    # backlog of rounds must not fire as a burst of
+                    # already-timed-out probes that alone cross the
+                    # failure threshold and declare a live raylet dead
                     continue
 
                 async def _probe(node_id=node_id, info=info):
@@ -233,7 +244,7 @@ class GcsServer:
 
                 # probes run concurrently so one wedged node cannot
                 # stretch the round for the others
-                asyncio.ensure_future(_probe())
+                inflight[node_id] = asyncio.ensure_future(_probe())
 
     async def _storage_failure_detector(self):
         """Ping the external store; a sustained outage is fatal for the

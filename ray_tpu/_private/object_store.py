@@ -19,21 +19,226 @@ Eviction is LRU over sealed, unpinned objects (ref: plasma/eviction_policy.h).
 
 from __future__ import annotations
 
+import asyncio
 import mmap
 import os
 import threading
 import time
 from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .ids import ObjectID
 
 _SHM_ROOT = "/dev/shm"
 
+# process-wide spill/restore I/O counters (pure I/O time, excluding
+# admission waits) — bench_envelope reads these for per-stage throughput
+IO_STATS = {"spill_bytes": 0, "spill_s": 0.0,
+            "restore_bytes": 0, "restore_s": 0.0}
+_IO_STATS_LOCK = threading.Lock()
+
+
+def _bump_io_stats(kind: str, nbytes: int, seconds: float) -> None:
+    with _IO_STATS_LOCK:
+        IO_STATS[kind + "_bytes"] += nbytes
+        IO_STATS[kind + "_s"] += seconds
+
 
 class ObjectStoreFullError(RuntimeError):
     pass
+
+
+class InProgress:
+    """Streaming-creation handle: the cut-through watermark.
+
+    Registered per process while an object is being received (transfer
+    plane) or restored (spill); ``watermark`` is the count of contiguous
+    bytes already written at the front of ``buf``. Readers — the
+    TransferServer relaying a broadcast, a peer's RPC chunk pull — wait
+    for the watermark to pass their range and then serve straight from
+    the unsealed mapping, so an interior broadcast-tree node forwards
+    chunks as they arrive instead of store-and-forwarding the whole
+    object (tree depth stops multiplying latency).
+
+    Writers may advance from any thread (spill restore runs in I/O
+    worker threads); waiters are asyncio futures woken through their own
+    loop. ``finish(failed=True)`` (abort, reclaimed seal) wakes everyone
+    so a dead upstream fails children fast instead of stranding them."""
+
+    __slots__ = ("oid", "size", "buf", "watermark", "done", "failed",
+                 "_lock", "_waiters")
+
+    def __init__(self, oid: ObjectID, size: int, buf: memoryview):
+        self.oid = oid
+        self.size = size
+        self.buf = buf
+        self.watermark = 0
+        self.done = False
+        self.failed = False
+        self._lock = threading.Lock()
+        self._waiters: List[tuple] = []
+
+    def advance(self, watermark: int) -> None:
+        with self._lock:
+            if self.done or watermark <= self.watermark:
+                return
+            self.watermark = watermark
+            ready = [w for w in self._waiters if w[0] <= watermark]
+            self._waiters = [w for w in self._waiters if w[0] > watermark]
+        for _, loop, fut in ready:
+            self._wake(loop, fut)
+
+    def finish(self, failed: bool) -> None:
+        with self._lock:
+            if self.done:
+                return
+            self.done = True
+            self.failed = failed
+            if not failed:
+                self.watermark = self.size
+            ready, self._waiters = self._waiters, []
+        for _, loop, fut in ready:
+            self._wake(loop, fut)
+
+    @staticmethod
+    def _wake(loop, fut) -> None:
+        def _set():
+            if not fut.done():
+                fut.set_result(None)
+        try:
+            loop.call_soon_threadsafe(_set)
+        except RuntimeError:
+            pass  # waiter's loop already closed
+
+    async def wait_for(self, threshold: int, timeout: float) -> bool:
+        """True once watermark >= threshold (seal counts); False when the
+        creation failed or the watermark stalls past `timeout`."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self.watermark >= threshold:
+                    return True
+                if self.done:
+                    return False
+                loop = asyncio.get_event_loop()
+                fut = loop.create_future()
+                entry = (threshold, loop, fut)
+                self._waiters.append(entry)
+            try:
+                await asyncio.wait_for(
+                    fut, max(0.0, deadline - time.monotonic()))
+            except asyncio.TimeoutError:
+                with self._lock:
+                    try:
+                        self._waiters.remove(entry)
+                    except ValueError:
+                        pass
+                return self.watermark >= threshold
+
+
+class _RestoreGate:
+    """Bytes-in-flight admission for spill restores: the thread-side
+    sibling of PullManager.acquire_bytes (same semantics — the sole
+    in-flight restore always admits so one over-budget object can't
+    wedge; otherwise wait for releases), sharing the same configured
+    budget (``object_transfer_max_inflight_bytes``) so concurrent
+    restores can't blow the store past what pulls may."""
+
+    def __init__(self, budget: int):
+        self.budget = budget
+        self._inflight = 0
+        self._count = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, nbytes: int) -> None:
+        with self._cond:
+            while self._count and self._inflight + nbytes > self.budget:
+                self._cond.wait(timeout=1.0)
+            self._inflight += nbytes
+            self._count += 1
+
+    def release(self, nbytes: int) -> None:
+        with self._cond:
+            self._inflight -= nbytes
+            self._count -= 1
+            self._cond.notify_all()
+
+
+_restore_gate: Optional[_RestoreGate] = None
+_spill_pool: Optional[ThreadPoolExecutor] = None
+_pool_lock = threading.Lock()
+
+
+def _get_restore_gate() -> _RestoreGate:
+    global _restore_gate
+    if _restore_gate is None:
+        from .config import global_config
+        with _pool_lock:
+            if _restore_gate is None:
+                _restore_gate = _RestoreGate(
+                    global_config().object_transfer_max_inflight_bytes)
+    return _restore_gate
+
+
+def _get_spill_pool() -> ThreadPoolExecutor:
+    global _spill_pool
+    if _spill_pool is None:
+        from .config import global_config
+        with _pool_lock:
+            if _spill_pool is None:
+                _spill_pool = ThreadPoolExecutor(
+                    max_workers=max(
+                        1, global_config().object_spill_io_workers),
+                    thread_name_prefix="rtpu-spill-io")
+    return _spill_pool
+
+
+def _parallel_io(size: int, chunk: int, run_chunk, on_frontier=None) -> None:
+    """Fan `size` bytes of positional I/O over the spill pool in `chunk`
+    pieces. `run_chunk(offset, end)` moves one piece (any worker thread);
+    `on_frontier(nbytes)` fires as the CONTIGUOUS completed prefix grows
+    (the restore watermark). Worker exceptions propagate to the caller."""
+    n_chunks = max(1, (size + chunk - 1) // chunk)
+    from .config import global_config
+    workers = max(1, min(global_config().object_spill_io_workers, n_chunks))
+    if workers == 1 or n_chunks == 1:
+        off = 0
+        while off < size:
+            end = min(off + chunk, size)
+            run_chunk(off, end)
+            off = end
+            if on_frontier is not None:
+                on_frontier(off)
+        return
+    lock = threading.Lock()
+    state = {"next": 0, "frontier": 0}
+    done = bytearray(n_chunks)
+
+    def work():
+        while True:
+            with lock:
+                i = state["next"]
+                if i >= n_chunks:
+                    return
+                state["next"] = i + 1
+            off = i * chunk
+            run_chunk(off, min(off + chunk, size))
+            with lock:
+                done[i] = 1
+                f = state["frontier"]
+                while f < n_chunks and done[f]:
+                    f += 1
+                state["frontier"] = f
+                frontier_bytes = size if f >= n_chunks else f * chunk
+            if on_frontier is not None:
+                on_frontier(frontier_bytes)
+
+    pool = _get_spill_pool()
+    futs = [pool.submit(work) for _ in range(workers)]
+    for fut in futs:
+        fut.result()
 
 
 @dataclass
@@ -85,6 +290,11 @@ class SharedObjectStore:
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._lock = threading.Lock()
         self._used = 0
+        # streaming creations (cut-through watermark), per process
+        self._inprogress: Dict[ObjectID, InProgress] = {}
+        # per-oid single-flight gate for spill restores (threads get()ing
+        # the same spilled object wait for the winner's seal)
+        self._restoring: Dict[ObjectID, threading.Event] = {}
         # fallback-path eviction staging (flushed outside self._lock)
         self._pending_spill_flush: list = []
         # Native index (C++ shared table, ray_tpu/_native): makes seal
@@ -147,12 +357,74 @@ class SharedObjectStore:
         staged = os.path.join(self.dir, oid.hex() + ".spilling")
         if not os.path.exists(staged):
             return
-        import shutil
-
+        dest = os.path.join(self.spill_dir, oid.hex())
         try:
-            shutil.move(staged, os.path.join(self.spill_dir, oid.hex()))
+            # same filesystem: O(1), nothing to parallelize
+            os.rename(staged, dest)
+            return
+        except FileNotFoundError:
+            return
+        except OSError:
+            pass  # EXDEV — tmpfs store dir vs on-disk spill dir
+        try:
+            t0 = time.monotonic()
+            size = self._parallel_copy_file(staged, dest)
+            _bump_io_stats("spill", size, time.monotonic() - t0)
+            os.unlink(staged)
         except (FileNotFoundError, OSError):
-            pass
+            try:
+                os.unlink(dest + ".part")
+            except OSError:
+                pass
+
+    def _parallel_copy_file(self, src: str, dest: str) -> int:
+        """Cross-fs spill write: chunked multi-worker sendfile (pread/
+        pwrite fallback) into dest+'.part', renamed into place only when
+        complete — a crashed evictor must not leave a short file that
+        looks like a finished spill. Returns bytes copied."""
+        from .config import global_config
+
+        chunk = max(64 << 10, global_config().object_spill_io_chunk_bytes)
+        sfd = os.open(src, os.O_RDONLY)
+        try:
+            size = os.fstat(sfd).st_size
+            part = dest + ".part"
+            out0 = os.open(part, os.O_CREAT | os.O_WRONLY | os.O_TRUNC,
+                           0o600)
+            try:
+                if size:
+                    os.ftruncate(out0, size)
+
+                def copy_range(off, end):
+                    # per-worker out fd: sendfile writes at the fd's own
+                    # offset, shared fds would race on it
+                    ofd = os.open(part, os.O_WRONLY)
+                    try:
+                        os.lseek(ofd, off, os.SEEK_SET)
+                        pos = off
+                        while pos < end:
+                            try:
+                                n = os.sendfile(ofd, sfd, pos, end - pos)
+                            except OSError:
+                                scratch = bytearray(
+                                    min(chunk, end - pos))
+                                n = os.preadv(sfd, [scratch], pos)
+                                if n:
+                                    os.pwrite(ofd, scratch[:n], pos)
+                                    os.lseek(ofd, pos + n, os.SEEK_SET)
+                            if n == 0:
+                                raise OSError("spill source truncated")
+                            pos += n
+                    finally:
+                        os.close(ofd)
+
+                _parallel_io(size, chunk, copy_range)
+            finally:
+                os.close(out0)
+            os.rename(part, dest)
+            return size
+        finally:
+            os.close(sfd)
 
     def _flush_pending_spills(self) -> None:
         """Fallback-path staging flush, outside self._lock."""
@@ -203,6 +475,36 @@ class SharedObjectStore:
         buf[:] = data
         self.seal(oid)
 
+    def create_streaming(self, oid: ObjectID,
+                         size: int) -> Tuple[memoryview, InProgress]:
+        """create() plus a registered InProgress watermark handle, so
+        readers in this process (TransferServer relay, RPC chunk serving)
+        can stream already-received contiguous bytes before seal. seal()
+        finishes the handle ok; abort() (or a reclaimed seal) fails it,
+        waking blocked range readers with failure."""
+        buf = self.create(oid, size)
+        with self._lock:
+            e = self._entries.get(oid)
+            view = memoryview(e.mm)[:size] if (e is not None
+                                               and e.mm is not None) else buf
+        entry = InProgress(oid, size, view)
+        with self._lock:
+            # a concurrent streaming creation of the same oid keeps the
+            # first registration (both write identical content; the
+            # first seal/abort for the oid finishes it)
+            self._inprogress.setdefault(oid, entry)
+        return buf, entry
+
+    def inprogress(self, oid: ObjectID) -> Optional[InProgress]:
+        with self._lock:
+            return self._inprogress.get(oid)
+
+    def _finish_inprogress(self, oid: ObjectID, failed: bool) -> None:
+        with self._lock:
+            entry = self._inprogress.pop(oid, None)
+        if entry is not None:
+            entry.finish(failed)
+
     def seal(self, oid: ObjectID) -> None:
         with self._lock:
             entry = self._entries[oid]
@@ -228,11 +530,14 @@ class SharedObjectStore:
                     os.unlink(entry.path)
                 except FileNotFoundError:
                     pass
+                self._finish_inprogress(oid, failed=True)
                 raise ObjectStoreFullError(
                     f"object {oid.hex()} lost at seal: index reservation "
                     f"was reclaimed (rc={rc}); re-put the object")
+        self._finish_inprogress(oid, failed=False)
 
     def abort(self, oid: ObjectID) -> None:
+        self._finish_inprogress(oid, failed=True)
         with self._lock:
             entry = self._entries.pop(oid, None)
             if entry is None:
@@ -243,7 +548,10 @@ class SharedObjectStore:
             else:
                 self._used -= entry.size
             if entry.mm is not None:
-                entry.mm.close()
+                try:
+                    entry.mm.close()
+                except BufferError:
+                    pass  # relay readers hold views; unlink still reclaims
             paths = [entry.tmp_path] if entry.tmp_path else []
             # only the reservation owner may take down the sealed file
             if entry.owns_reservation:
@@ -258,6 +566,24 @@ class SharedObjectStore:
     def get(self, oid: ObjectID) -> Optional[memoryview]:
         """Map a sealed object; zero-copy view. None if absent/unsealed.
         Objects spilled to disk are transparently restored first."""
+        view = self._get_once(oid)
+        if view is not None:
+            return view
+        # under capacity thrash a concurrent restore's eviction pressure
+        # can re-spill the object between our lookup and mapping (or the
+        # index still shows another thread's not-yet-sealed restore);
+        # while any evidence of the object survives, retry — None must
+        # mean ABSENT, not "lost a race"
+        for attempt in range(64):
+            if not self.contains(oid):
+                return None
+            time.sleep(min(0.05, 0.001 * (attempt + 1)))
+            view = self._get_once(oid)
+            if view is not None:
+                return view
+        return None
+
+    def _get_once(self, oid: ObjectID) -> Optional[memoryview]:
         if self._idx is not None:
             # index is the authority (and the lookup is the LRU touch):
             # a locally-cached mmap whose entry another process evicted
@@ -332,32 +658,98 @@ class SharedObjectStore:
         return os.path.join(self.spill_dir, oid.hex())
 
     def _restore_from_spill(self, oid: ObjectID) -> bool:
-        """Copy a spilled object back into the store (which may cascade
-        further spills) and drop the disk copy. Concurrent restores of
-        one object are benign: create() tolerates an existing
-        reservation and seal renames atomically. Also serves objects
-        still sitting in the same-fs ".spilling" staging name (the
-        evictor flushes those to the spill dir outside the index lock —
-        a reader can land in that window, or after an evictor crash)."""
+        """Restore a spilled object: chunked multi-worker preadv straight
+        from the spill file into the unsealed shm mapping (no
+        intermediate bytes — the old whole-file read paid a full extra
+        copy and ran on one thread), then drop the disk copy. Admission
+        rides the restore byte gate (PullManager-budget sibling) so
+        concurrent restores can't blow the store. The contiguous-read
+        frontier advances the InProgress watermark, so transfer-plane
+        pullers of a RESTORING object stream behind the restore instead
+        of waiting for its seal. Restores are single-flight per object
+        per process (threads racing get() wait for the winner's seal).
+        Also serves objects still sitting in the
+        same-fs ".spilling" staging name (the evictor flushes those to
+        the spill dir outside the index lock — a reader can land in that
+        window, or after an evictor crash)."""
         path = self._spill_path(oid)
         if path is None:
             return False
-        data = None
+        # one restore per object per process: two threads get()ing the
+        # same spilled object would both create() the same tmp path
+        # (same pid -> same name) and O_TRUNC it under the other's live
+        # mapping; losers wait for the winner and re-serve its result
+        with self._lock:
+            ev = self._restoring.get(oid)
+            waiter = ev is not None
+            if not waiter:
+                ev = threading.Event()
+                self._restoring[oid] = ev
+        if waiter:
+            ev.wait(timeout=600.0)
+            return True  # winner sealed it (or get() finds it absent)
+        try:
+            return self._do_restore_from_spill(oid, path)
+        finally:
+            with self._lock:
+                self._restoring.pop(oid, None)
+            ev.set()
+
+    def _do_restore_from_spill(self, oid: ObjectID, path: str) -> bool:
+        sfd = -1
         for candidate in (path, os.path.join(self.dir,
                                              oid.hex() + ".spilling")):
             try:
-                with open(candidate, "rb") as f:
-                    data = f.read()
+                sfd = os.open(candidate, os.O_RDONLY)
                 path = candidate
                 break
-            except (FileNotFoundError, OSError):
+            except OSError:
                 continue
-        if data is None:
+        if sfd < 0:
             return False
+        gate = _get_restore_gate()
+        acquired = 0
         try:
-            self.put(oid, data)
-        except (ObjectStoreFullError, OSError):
+            size = os.fstat(sfd).st_size
+            gate.acquire(size)
+            acquired = size
+            try:
+                buf, entry = self.create_streaming(oid, size)
+            except (ObjectStoreFullError, OSError):
+                return False
+            from .config import global_config
+
+            chunk = max(64 << 10,
+                        global_config().object_spill_io_chunk_bytes)
+
+            def read_range(off, end):
+                pos = off
+                while pos < end:
+                    n = os.preadv(sfd, [buf[pos:end]], pos)
+                    if n == 0:
+                        raise OSError("spill file truncated mid-restore")
+                    pos += n
+
+            t0 = time.monotonic()
+            try:
+                _parallel_io(size, chunk, read_range,
+                             on_frontier=entry.advance)
+            except BaseException:
+                buf.release()
+                self.abort(oid)
+                raise
+            _bump_io_stats("restore", size, time.monotonic() - t0)
+            buf.release()
+            try:
+                self.seal(oid)
+            except (ObjectStoreFullError, OSError):
+                return False
+        except OSError:
             return False
+        finally:
+            if acquired:
+                gate.release(acquired)
+            os.close(sfd)
         try:
             os.unlink(path)
         except FileNotFoundError:

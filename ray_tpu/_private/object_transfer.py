@@ -41,6 +41,11 @@ _ABSENT = (1 << 64) - 1
 # chunk, but bounded — an unbounded read against a half-open peer would
 # wedge the pull AND its PullManager byte reservation forever
 _IO_TIMEOUT_S = 60.0
+# cut-through relay: how long a range request on an in-progress object
+# may block for the watermark to pass it before reporting absent. Must
+# stay below _IO_TIMEOUT_S or a stalled upstream would trip the CHILD's
+# transport deadline (a retry storm) instead of a clean absent-fallback.
+_RELAY_WAIT_S = 45.0
 
 
 def _parse_addr(address: str):
@@ -156,8 +161,15 @@ class TransferServer:
                 oid = ObjectID(req["oid"])
                 view = self.store.get(oid)
                 if view is None:
-                    await loop.sock_sendall(conn, _RESP.pack(_ABSENT, 0))
-                    continue
+                    if await self._serve_inprogress(loop, conn, oid, req):
+                        continue
+                    # the creation may have sealed (registry cleared)
+                    # between the miss and the in-progress check
+                    view = self.store.get(oid)
+                    if view is None:
+                        await loop.sock_sendall(conn,
+                                                _RESP.pack(_ABSENT, 0))
+                        continue
                 total = len(view)
                 offset = min(req["offset"], total)
                 length = min(req["len"], total - offset)
@@ -171,6 +183,34 @@ class TransferServer:
             pass
         finally:
             conn.close()
+
+    async def _serve_inprogress(self, loop, conn, oid: ObjectID,
+                                req) -> bool:
+        """Cut-through relay: serve a range of an object this node is
+        still RECEIVING (or restoring from spill). The request blocks
+        until the creation's contiguous watermark passes the range, then
+        sends straight from the unsealed mapping — an interior
+        broadcast-tree node forwards chunks as they arrive, so tree
+        depth adds only pipeline fill, not whole-object store-and-
+        forward hops. A failed/stalled upstream answers absent, failing
+        children fast onto another holder. Returns False when no
+        in-progress creation exists (caller answers absent)."""
+        getter = getattr(self.store, "inprogress", None)
+        entry = getter(oid) if getter is not None else None
+        if entry is None:
+            return False
+        total = entry.size
+        offset = min(req["offset"], total)
+        length = min(req["len"], total - offset)
+        if length and not await entry.wait_for(offset + length,
+                                               _RELAY_WAIT_S):
+            await loop.sock_sendall(conn, _RESP.pack(_ABSENT, 0))
+            return True
+        await loop.sock_sendall(conn, _RESP.pack(total, length))
+        if length:
+            await loop.sock_sendall(conn,
+                                    entry.buf[offset:offset + length])
+        return True
 
 
 class _Stream:
@@ -225,13 +265,16 @@ class _Stream:
 async def fetch_object(address: str, oid: ObjectID, create_buf,
                        *, streams: int, chunk_bytes: int,
                        seal: Callable, abort: Callable,
-                       admit_bytes=None) -> Optional[int]:
+                       admit_bytes=None, on_progress=None) -> Optional[int]:
     """Pull one object from `address` with up to `streams` parallel
     connections. `create_buf(size) -> memoryview` allocates the
     destination once the size is known; `admit_bytes(size)` (async,
-    optional) runs first — the PullManager's byte-budget gate. Returns
-    the object size, or None when the holder no longer has it. Raises on
-    transport failure (the caller owns retry/fallback policy)."""
+    optional) runs first — the PullManager's byte-budget gate.
+    `on_progress(watermark)` (optional) fires as the CONTIGUOUS received
+    prefix grows — the cut-through watermark a relaying node publishes
+    so its own pullers can stream behind this pull. Returns the object
+    size, or None when the holder no longer has it. Raises on transport
+    failure (the caller owns retry/fallback policy)."""
     first = _Stream(address)
     await first.connect()
     buf = None
@@ -250,6 +293,8 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
         buf = create_buf(total)
         buf[:got] = probe[:got]
         del probe
+        if on_progress is not None:
+            on_progress(got)
         if got >= total:
             buf.release()
             buf = None
@@ -261,6 +306,20 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
         offsets = list(range(got, total, chunk_bytes))
         n_streams = max(1, min(streams, len(offsets)))
         next_i = 0
+        # contiguous-prefix tracking for the relay watermark: chunk i is
+        # "done" once its bytes sit in buf; the frontier is the first
+        # incomplete chunk (single event loop — no lock needed)
+        done_chunks = bytearray(len(offsets))
+        frontier = 0
+
+        def _chunk_done(i: int) -> None:
+            nonlocal frontier
+            done_chunks[i] = 1
+            while frontier < len(offsets) and done_chunks[frontier]:
+                frontier += 1
+            if on_progress is not None:
+                on_progress(total if frontier >= len(offsets)
+                            else offsets[frontier])
 
         async def run_stream(stream: Optional[_Stream]):
             nonlocal next_i
@@ -304,6 +363,7 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
                         raise ConnectionError(
                             "holder dropped object mid-transfer")
                     retries = 0
+                    _chunk_done(i)
                     break
 
         tasks = [asyncio.ensure_future(run_stream(first))]

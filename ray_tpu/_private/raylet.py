@@ -240,6 +240,11 @@ class Raylet:
         # broadcast-tree sender slots: oid -> {puller_hex: grant expiry}
         self._transfer_tokens: Dict[ObjectID, Dict[str, float]] = {}
         self._transfer_token_high: Dict[ObjectID, int] = {}  # high-water
+        # grants per control connection, released the moment the puller's
+        # connection drops (a crashed puller must not pin a sender slot
+        # for the wall-clock TTL) — the TTL stays as the backstop
+        self._token_conn_grants: Dict[object, set] = {}
+        self._token_conn_watchers: Dict[object, asyncio.Task] = {}
         self._pull_sources: Dict[ObjectID, NodeID] = {}   # observability
         # cluster view (for spillback) — node_id -> (address, available)
         self._remote_nodes: Dict[NodeID, Tuple[str, ResourceSet]] = {}
@@ -420,6 +425,9 @@ class Raylet:
                 pass
 
     async def stop(self):
+        for task in list(self._token_conn_watchers.values()):
+            task.cancel()
+        self._token_conn_watchers.clear()
         for worker in self._workers.values():
             if worker.conn is not None:
                 await worker.conn.push("shutdown", {})
@@ -1546,8 +1554,34 @@ class Raylet:
             grants[puller] = now + self._TRANSFER_TOKEN_TTL_S
             high = self._transfer_token_high.get(oid, 0)
             self._transfer_token_high[oid] = max(high, len(grants))
+            self._track_token_conn(conn, oid, puller)
             return True
         return False
+
+    def _track_token_conn(self, conn, oid: ObjectID, puller: str) -> None:
+        """Tie a sender-slot grant to the puller's control connection:
+        when the connection closes (crash, shutdown) the grant is
+        released immediately instead of pinning one of the default 2
+        slots until the 120 s TTL sweep."""
+        if conn is None or not hasattr(conn, "closed"):
+            return
+        self._token_conn_grants.setdefault(conn, set()).add((oid, puller))
+        if conn not in self._token_conn_watchers:
+            self._token_conn_watchers[conn] = asyncio.ensure_future(
+                self._watch_token_conn(conn))
+
+    async def _watch_token_conn(self, conn) -> None:
+        try:
+            await conn.closed.wait()
+        except asyncio.CancelledError:
+            return
+        for oid, puller in self._token_conn_grants.pop(conn, ()):
+            grants = self._transfer_tokens.get(oid)
+            if grants is not None:
+                grants.pop(puller, None)
+                if not grants:
+                    self._transfer_tokens.pop(oid, None)
+        self._token_conn_watchers.pop(conn, None)
 
     async def handle_transfer_token_release(self, payload, conn):
         grants = self._transfer_tokens.get(payload["object_id"])
@@ -1555,6 +1589,9 @@ class Raylet:
             grants.pop(payload["node_id"], None)
             if not grants:
                 self._transfer_tokens.pop(payload["object_id"], None)
+        tracked = self._token_conn_grants.get(conn)
+        if tracked is not None:
+            tracked.discard((payload["object_id"], payload["node_id"]))
         return True
 
     async def _fetch_via(self, oid: ObjectID, address: str,
@@ -1570,16 +1607,33 @@ class Raylet:
 
             if self.store.contains(oid):
                 return self._sealed.get(oid, 0)
+            holder = {}
+
+            def _create(size: int):
+                buf, entry = self.store.create_streaming(oid, size)
+                holder["entry"] = entry
+                # cut-through relay: advertise this IN-PROGRESS copy in
+                # the directory now — downstream pullers stream behind
+                # our watermark instead of waiting for our seal, so a
+                # broadcast tree pipelines across its depth (retracted
+                # below if the pull dies)
+                asyncio.ensure_future(self._report_location(oid))
+                return buf
+
             try:
                 return await fetch_object(
-                    xfer_address, oid,
-                    lambda size: self.store.create(oid, size),
+                    xfer_address, oid, _create,
                     streams=self.cfg.object_transfer_streams,
                     chunk_bytes=self.cfg.object_transfer_chunk_bytes,
                     seal=lambda: self.store.seal(oid),
                     abort=lambda: self.store.abort(oid),
-                    admit_bytes=lambda n: self.pulls.acquire_bytes(oid, n))
+                    admit_bytes=lambda n: self.pulls.acquire_bytes(oid, n),
+                    on_progress=lambda wm: holder["entry"].advance(wm))
             except Exception:
+                if "entry" in holder:
+                    # the early advertisement is stale — retract it
+                    # BEFORE the RPC fallback can re-add it on success
+                    await self._drop_location(oid)
                 pass  # plane unreachable/dropped: fall through to RPC
             finally:
                 self.pulls.release_bytes(oid)
@@ -1629,12 +1683,24 @@ class Raylet:
 
     async def handle_pull_object(self, payload, conn):
         """Serve one chunk of a sealed local object to a peer raylet
-        (ref: push_manager.h:32 — chunked sends on the control transport)."""
+        (ref: push_manager.h:32 — chunked sends on the control transport).
+        An object still being received/restored here serves from behind
+        its watermark (bounded wait), so the RPC fallback path cuts
+        through in-progress creations the same way the transfer plane
+        does."""
         oid = payload["object_id"]
+        offset, length = payload["offset"], payload["length"]
         view = self.store.get(oid)
         if view is None:
+            entry = self.store.inprogress(oid)
+            if entry is not None:
+                total = entry.size
+                off = min(offset, total)
+                ln = min(length, total - off)
+                if not ln or await entry.wait_for(off + ln, 30.0):
+                    return {"size": total,
+                            "data": bytes(entry.buf[off:off + ln])}
             return None
-        offset, length = payload["offset"], payload["length"]
         return {"size": len(view), "data": bytes(view[offset: offset + length])}
 
     async def handle_wait_objects(self, payload, conn):

@@ -399,6 +399,14 @@ class EventLoopThread:
         self.loop.run_forever()
 
     def run(self, coro, timeout: Optional[float] = None):
+        if threading.current_thread() is self.thread:
+            # blocking on our own loop can never complete — this happens
+            # when a destructor runs during GC *inside* the loop thread
+            # and calls a sync API; raise so the caller can degrade to
+            # spawn() instead of wedging the whole loop forever
+            coro.close()
+            raise RuntimeError(
+                "EventLoopThread.run() called from its own loop thread")
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 

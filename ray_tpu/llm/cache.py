@@ -13,6 +13,8 @@ the jitted kernels never branch on validity; it is never handed out.
 
 from __future__ import annotations
 
+import hashlib
+import struct
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
@@ -121,13 +123,24 @@ class PrefixCache:
 
     @staticmethod
     def page_keys(prompt, page_size: int) -> List[Any]:
-        """Keys for each FULL page of the prompt (chained)."""
+        """Keys for each FULL page of the prompt (chained).
+
+        SHA-256 over (parent digest + the page's token bytes), NOT the
+        builtin hash(): these keys route one request's cached KV pages
+        to other prompts, so a 64-bit (and PYTHONHASHSEED-dependent)
+        hash collision silently serves a DIFFERENT prompt's KV — the
+        same class of cross-request leak as vLLM's prefix-cache hash
+        fix. Tokens pack as fixed-width int64 so no two token sequences
+        share an encoding."""
         keys: List[Any] = []
-        parent = 0
+        parent = b""
         for start in range(0, (len(prompt) // page_size) * page_size,
                            page_size):
-            chunk = tuple(prompt[start:start + page_size])
-            parent = hash((parent, chunk))
+            chunk = prompt[start:start + page_size]
+            h = hashlib.sha256(parent)
+            h.update(struct.pack(f"<{len(chunk)}q",
+                                 *(int(t) for t in chunk)))
+            parent = h.digest()
             keys.append(parent)
         return keys
 

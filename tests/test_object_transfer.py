@@ -111,6 +111,185 @@ def test_fetch_aborts_on_dropped_stream(two_stores, tmp_path):
     assert dst.get(oid) is None, "partial transfer must be aborted"
 
 
+def test_inprogress_range_blocks_until_watermark(two_stores, tmp_path):
+    """Cut-through relay: a range request against an object this node is
+    still RECEIVING blocks until the contiguous watermark passes the
+    range, then serves the bytes straight from the unsealed mapping."""
+    from ray_tpu._private.object_transfer import _Stream
+
+    src, _ = two_stores
+    oid = ObjectID.from_random()
+    payload = np.random.default_rng(7).integers(
+        0, 256, 1 << 20, dtype=np.uint8).astype(np.uint8).tobytes()
+    half = len(payload) // 2
+
+    async def go():
+        server = TransferServer(src, str(tmp_path / "wm.sock"))
+        address = await server.start()
+        buf, entry = src.create_streaming(oid, len(payload))
+        stream = _Stream(address)
+        try:
+            await stream.connect()
+            out = bytearray(256 << 10)
+            task = asyncio.ensure_future(
+                stream.fetch_range(oid, 0, len(out), memoryview(out)))
+            await asyncio.sleep(0.1)
+            assert not task.done(), "range past the watermark must block"
+            buf[:half] = payload[:half]
+            entry.advance(half)
+            total, n = await asyncio.wait_for(task, 5)
+            assert (total, n) == (len(payload), len(out))
+            assert bytes(out) == payload[:len(out)]
+            # a range wholly past the watermark stays blocked until seal
+            out2 = bytearray(len(payload) - half)
+            task2 = asyncio.ensure_future(
+                stream.fetch_range(oid, half, len(out2), memoryview(out2)))
+            await asyncio.sleep(0.05)
+            assert not task2.done()
+            buf[half:] = payload[half:]
+            buf.release()
+            src.seal(oid)
+            total, n = await asyncio.wait_for(task2, 5)
+            assert (total, n) == (len(payload), len(out2))
+            assert bytes(out2) == payload[half:]
+        finally:
+            stream.close()
+            await server.stop()
+
+    _run(go())
+    view = src.get(oid)
+    assert view is not None and bytes(view) == payload
+
+
+def test_inprogress_holder_crash_fails_children(two_stores, tmp_path):
+    """A holder whose own in-progress creation dies (abort) must answer
+    its blocked relay readers with absent — the child pull fails fast
+    and cleanly (no partial object left in the child store)."""
+    src, dst = two_stores
+    oid = ObjectID.from_random()
+    size = 16 << 20
+    data = np.arange(size, dtype=np.uint8).tobytes()
+
+    async def go():
+        server = TransferServer(src, str(tmp_path / "crash.sock"))
+        address = await server.start()
+        buf, entry = src.create_streaming(oid, size)
+        buf[: 4 << 20] = data[: 4 << 20]
+        entry.advance(4 << 20)
+
+        async def crash_soon():
+            await asyncio.sleep(0.3)
+            buf.release()
+            src.abort(oid)   # upstream died mid-stream
+
+        crash = asyncio.ensure_future(crash_soon())
+        try:
+            with pytest.raises(ConnectionError):
+                # first 4 MB serve immediately off the watermark; the
+                # chunk at 4 MB blocks until the abort fails it
+                await fetch_object(
+                    address, oid, lambda n: dst.create(oid, n),
+                    streams=2, chunk_bytes=1 << 20,
+                    seal=lambda: dst.seal(oid),
+                    abort=lambda: dst.abort(oid))
+        finally:
+            await crash
+            await server.stop()
+
+    _run(go())
+    assert dst.get(oid) is None, "partial child copy must be aborted"
+
+
+def test_cut_through_relay_chain(two_stores, tmp_path):
+    """A -> B -> C chain: C pulls from B while B is still receiving from
+    A. C must start (and finish) off B's in-progress copy — interior
+    tree nodes forward chunks as they arrive instead of
+    store-and-forwarding the sealed object."""
+    from ray_tpu._private.object_store import SharedObjectStore
+
+    src, dst = two_stores
+    mid = SharedObjectStore(f"xfer_mid_{os.getpid()}", 1 << 28)
+    oid = ObjectID.from_random()
+    payload = np.random.default_rng(3).integers(
+        0, 256, 8 << 20, dtype=np.uint8).astype(np.uint8).tobytes()
+    src.put(oid, payload)
+    started_unsealed = []
+
+    async def go():
+        server_a = TransferServer(src, str(tmp_path / "a.sock"))
+        server_b = TransferServer(mid, str(tmp_path / "b.sock"))
+        addr_a = await server_a.start()
+        addr_b = await server_b.start()
+        holder = {}
+
+        def mid_create(n):
+            buf, entry = mid.create_streaming(oid, n)
+            holder["entry"] = entry
+            return buf
+
+        async def b_pull():
+            size = await fetch_object(
+                addr_a, oid, mid_create, streams=2, chunk_bytes=256 << 10,
+                seal=lambda: mid.seal(oid), abort=lambda: mid.abort(oid),
+                on_progress=lambda wm: holder["entry"].advance(wm))
+            assert size == len(payload)
+
+        async def c_pull():
+            while mid.inprogress(oid) is None:
+                await asyncio.sleep(0)
+            started_unsealed.append(mid.get(oid) is None)
+            size = await fetch_object(
+                addr_b, oid, lambda n: dst.create(oid, n),
+                streams=2, chunk_bytes=256 << 10,
+                seal=lambda: dst.seal(oid), abort=lambda: dst.abort(oid))
+            assert size == len(payload)
+
+        try:
+            await asyncio.gather(b_pull(), c_pull())
+        finally:
+            await server_a.stop()
+            await server_b.stop()
+
+    try:
+        _run(go())
+        assert started_unsealed == [True], \
+            "C must have started while B's copy was still in progress"
+        view = dst.get(oid)
+        assert view is not None and bytes(view) == payload
+    finally:
+        mid.destroy()
+
+
+def test_fetch_on_progress_reports_contiguous_watermark(
+        two_stores, tmp_path):
+    """on_progress must report a monotonically increasing CONTIGUOUS
+    prefix (never a hole) and end exactly at the object size."""
+    src, dst = two_stores
+    oid = ObjectID.from_random()
+    payload = os.urandom(40 << 20)   # 5 chunks @ 8M over 3 streams
+    src.put(oid, payload)
+    marks = []
+
+    async def go():
+        server = TransferServer(src, str(tmp_path / "prog.sock"))
+        address = await server.start()
+        try:
+            size = await fetch_object(
+                address, oid, lambda n: dst.create(oid, n),
+                streams=3, chunk_bytes=8 << 20,
+                seal=lambda: dst.seal(oid), abort=lambda: dst.abort(oid),
+                on_progress=marks.append)
+            assert size == len(payload)
+        finally:
+            await server.stop()
+
+    _run(go())
+    assert marks and marks[-1] == len(payload)
+    assert all(b >= a for a, b in zip(marks, marks[1:])), marks
+    view = dst.get(oid)
+    assert view is not None and bytes(view) == payload
+
+
 def test_pull_manager_concurrency_and_priority():
     """Concurrency gate admits highest class first and honors priority
     upgrades of already-queued pulls."""
