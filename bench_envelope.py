@@ -580,6 +580,79 @@ def bench_syncer(results, nodes=64, reports=8000):
         hub_fanout_msgs_per_s=rate * nodes))
 
 
+# --------------------------------------------------------------- shuffle
+def bench_shuffle(results, blocks=16, rows_per_block=50_000,
+                  payload_width=16):
+    """Push-based shuffle exchange (data/shuffle.py): rows/s for sort /
+    repartition / random_shuffle at N blocks x M rows, plus the largest
+    payload any single driver-side get() materialized during the
+    exchange — the O(one block) driver-residency envelope. Own session:
+    the dataset should dwarf inline thresholds but fit the store."""
+    import numpy as np
+
+    import ray_tpu as ray
+    import ray_tpu.data as rdata
+    from ray_tpu.util.metrics import snapshot_local
+
+    if QUICK:
+        blocks, rows_per_block = 4, 4_000
+    elif MODERATE:
+        blocks, rows_per_block = 8, 20_000
+    n = blocks * rows_per_block
+
+    def make_ds():
+        def widen(b):
+            ids = np.asarray(b["id"])
+            return {"id": ids,
+                    "key": (ids * 2654435761) % 1_000_003,
+                    "payload": np.tile(ids.astype(np.float64),
+                                       (payload_width, 1)).T.copy()}
+
+        return rdata.range(n, parallelism=blocks).map_batches(widen)
+
+    ops = {
+        "sort": lambda ds: ds.sort("key"),
+        "repartition": lambda ds: ds.repartition(max(2, blocks // 2)),
+        "random_shuffle": lambda ds: ds.random_shuffle(seed=7),
+    }
+    ray.init(num_cpus=4)
+    try:
+        import cloudpickle
+
+        for op, build in ops.items():
+            peak = {"v": 0}
+            orig_get = ray.get
+
+            def metered(refs, **kwargs):
+                out = orig_get(refs, **kwargs)
+                for v in (out if isinstance(out, list) else [out]):
+                    try:
+                        peak["v"] = max(peak["v"],
+                                        len(cloudpickle.dumps(v)))
+                    except Exception:
+                        pass
+                return out
+
+            ray.get = metered
+            try:
+                t0 = time.perf_counter()
+                out_refs = list(build(make_ds()).iter_block_refs())
+                dt = time.perf_counter() - t0
+            finally:
+                ray.get = orig_get
+            snap = snapshot_local("data_shuffle")
+            results.append(emit(
+                "envelope_shuffle", op=op, blocks=blocks, rows=n,
+                s=round(dt, 3), rows_per_s=int(n / dt),
+                out_blocks=len(out_refs),
+                peak_driver_get_bytes=peak["v"],
+                bytes_pushed=int(snap.get(
+                    f"data_shuffle_bytes_pushed_total{{op={op}}}", 0)),
+                driver_rss_mb=_rss_mb()))
+    finally:
+        ray.shutdown()
+
+
 # in-session families in dict order = default run order: "actors" LAST
 # among them so its creations contend with the task-event backlog the
 # earlier families leave (the regime the r4 bench dodged)
@@ -594,6 +667,7 @@ ALL = {
     "broadcast": bench_broadcast,
     "gang": bench_gang_restart,
     "spill": bench_spill,
+    "shuffle": bench_shuffle,
 }
 
 # families that run inside a ray.init'd single-node session; "actors"

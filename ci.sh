@@ -69,14 +69,16 @@ assert get_lib() is not None, native_unavailable_reason()
 print("native lib built + loaded")
 PY
 
-echo "== [2/4] data-plane smoke: transfer + spilling =="
-# the bulk data plane (cut-through relay watermark, parallel spill I/O)
-# gets its own early, explicit lane: a broken transfer/spill path fails
-# the round in minutes instead of surfacing mid-suite
+echo "== [2/4] data-plane smoke: transfer + spilling + shuffle =="
+# the bulk data plane (cut-through relay watermark, parallel spill I/O,
+# push-based shuffle exchange) gets its own early, explicit lane: a
+# broken transfer/spill/shuffle path fails the round in minutes instead
+# of surfacing mid-suite
 JAX_PLATFORMS=cpu \
 RAY_TPU_TEST_TIMEOUT_S="${RAY_TPU_TEST_TIMEOUT_S:-180}" \
 timeout "${CI_SMOKE_TIMEOUT_S:-600}" \
-    python -m pytest tests/test_object_transfer.py tests/test_spilling.py -q
+    python -m pytest tests/test_object_transfer.py tests/test_spilling.py \
+        tests/test_data_shuffle.py -q
 
 echo "== [3/4] test suite =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \

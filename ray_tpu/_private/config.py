@@ -58,6 +58,16 @@ class Config:
     # so concurrent restores can't blow the store.
     object_spill_io_workers: int = 4
     object_spill_io_chunk_bytes: int = 8 * 1024**2
+    # --- data shuffle (data/shuffle.py map/merge exchange) ---
+    # partitions per exchange; 0 = auto (sort: max(input blocks,
+    # total/fragment_target); random_shuffle: total/fragment_target,
+    # layout-independent so a fixed seed is reproducible across block
+    # layouts; groupby: fixed small default so maps pipeline)
+    shuffle_num_partitions: int = 0
+    # auto-partitioning aims each merged output block at this size
+    shuffle_fragment_target_bytes: int = 16 * 1024**2
+    # merge-task submission window (per-partition merges in flight)
+    shuffle_merge_parallelism: int = 8
     # --- memory pressure (ref: memory_monitor.h:52 + killing policies) ---
     memory_monitor_refresh_ms: int = 500      # 0 disables the monitor
     memory_usage_threshold: float = 0.95      # host RSS fraction to act at

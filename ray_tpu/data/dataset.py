@@ -180,71 +180,49 @@ class Dataset:
                                        {"num_cpus": 1}))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Shuffle: global block-order permutation + per-block row
-        permutation with distinct seeds (an all-to-all barrier stage, ref:
-        dataset.py:1463; full cross-block row exchange is a later round)."""
+        """True row-level shuffle via the push-based map/merge exchange
+        (shuffle.py; ref: dataset.py:1463 random_shuffle): rows scatter
+        across partitions keyed on (seed, global row index) and each
+        merge applies a seeded permutation — so a fixed ``seed`` yields
+        the identical row sequence on every run and for ANY input block
+        layout. Rows never pass through the driver."""
+        from .shuffle import ShuffleSpec
+
         return self._append(_LogicalOp(
-            "shuffle", "random_shuffle", {"seed": seed}, {"num_cpus": 1}))
+            "shuffle_exchange", "random_shuffle",
+            {"spec": ShuffleSpec(kind="random_shuffle",
+                                 name="random_shuffle", seed=seed)}))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         """Re-slice the stream into exactly ``num_blocks`` near-equal
-        blocks (all-to-all exchange; ref: dataset.py:1366)."""
-
-        def exchange(refs):
-            from .. import get, put
-            from .block import (block_num_rows, concat_blocks, slice_block)
-
-            blocks = [get(r) for r in refs]
-            blocks = [b for b in blocks if block_num_rows(b) > 0]
-            if not blocks:
-                return []
-            whole = concat_blocks(blocks)
-            total = block_num_rows(whole)
-            out = []
-            for i in range(num_blocks):
-                start = i * total // num_blocks
-                end = (i + 1) * total // num_blocks
-                out.append(put(slice_block(whole, start, end)))
-            return out
+        blocks, preserving row order (ref: dataset.py:1366). Runs as a
+        distributed exchange — map tasks slice each block by contiguous
+        global row range, per-partition merges concat the slices — so
+        the dataset is never gathered in driver memory."""
+        if num_blocks < 1:
+            raise ValueError("repartition() needs num_blocks >= 1")
+        from .shuffle import ShuffleSpec
 
         return self._append(_LogicalOp(
-            "all_to_all", f"repartition({num_blocks})", {"fn": exchange}))
+            "shuffle_exchange", f"repartition({num_blocks})",
+            {"spec": ShuffleSpec(kind="repartition",
+                                 name=f"repartition({num_blocks})",
+                                 num_partitions=num_blocks)}))
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
-        """Global sort by a column/row key (all-to-all; ref:
-        dataset.py sort → sort exchange). Block count is preserved."""
-
-        def exchange(refs):
-            import numpy as np
-
-            from .. import get, put
-            from .block import (block_num_rows, concat_blocks, is_columnar,
-                                slice_block)
-
-            blocks = [get(r) for r in refs]
-            blocks = [b for b in blocks if block_num_rows(b) > 0]
-            if not blocks:
-                return []
-            whole = concat_blocks(blocks)
-            if is_columnar(whole):
-                order = np.argsort(np.asarray(whole[key]), kind="stable")
-                if descending:
-                    order = order[::-1]
-                whole = {k: np.asarray(v)[order] for k, v in whole.items()}
-            else:
-                whole = sorted(whole, key=lambda r: r[key],
-                               reverse=descending)
-            total = block_num_rows(whole)
-            n_out = max(1, len(blocks))
-            out = []
-            for i in range(n_out):
-                start = i * total // n_out
-                end = (i + 1) * total // n_out
-                out.append(put(slice_block(whole, start, end)))
-            return out
+        """Global stable sort by a key column (ref: dataset.py sort →
+        sort exchange): a sampling pass estimates range boundaries, map
+        tasks range-partition + pre-sort fragments, and per-partition
+        merge tasks k-way-merge them into globally ordered output
+        blocks. Equal keys keep their original relative order in both
+        directions (descending uses a reversed-stable argsort rather
+        than reversing the ascending order, which would flip ties)."""
+        from .shuffle import ShuffleSpec
 
         return self._append(_LogicalOp(
-            "all_to_all", f"sort({key})", {"fn": exchange}))
+            "shuffle_exchange", f"sort({key})",
+            {"spec": ShuffleSpec(kind="sort", name=f"sort({key})",
+                                 key=key, descending=descending)}))
 
     def groupby(self, key: str) -> "GroupedData":
         """Group rows by a key column (ref: dataset.py:2188 → GroupedData

@@ -369,33 +369,24 @@ class MapStage(_Stage):
                     self.stats.blocks_out += 1
 
 
-class ShuffleStage(_Stage):
-    """random_shuffle: an all-to-all barrier — gather every input block,
-    permute BLOCK order globally, and re-permute rows within each block
-    with a distinct per-block seed (ref: dataset.py:1463 random_shuffle's
-    exchange; full row-level cross-block exchange is a later round)."""
+class ShuffleExchangeStage(_Stage):
+    """Push-based map/merge all-to-all exchange (shuffle.py): map tasks
+    partition each input block into P fragments sealed on their local
+    store; spread-scheduled per-partition merge tasks pull their
+    fragments through the bulk transfer plane and emit the merged
+    output blocks. The driver holds only refs and O(P) metadata — rows
+    never land in driver memory — and fragments spill/restore through
+    the parallel spill I/O plane when the working set outgrows the
+    store (ref: _internal/planner/exchange/ physical operators;
+    Exoshuffle 2023 + Magnet VLDB'20 push-based merging). Input refs
+    stream straight from in_q into the exchange, so probe tasks and
+    hash-partitioned map fragments overlap upstream production."""
 
-    def __init__(self, in_q, out_q, seed, ray_remote_args: dict):
-        super().__init__("random_shuffle", out_q, in_q)
-        self.seed = seed
-        self.ray_remote_args = ray_remote_args
+    def __init__(self, name: str, in_q, out_q, spec):
+        super().__init__(name, out_q, in_q)
+        self.spec = spec
 
-    def _run(self):
-        import numpy as np
-
-        from .. import remote
-
-        @remote(**self.ray_remote_args)
-        def _shuffle_block(block, block_seed):
-            from .block import block_num_rows, is_columnar
-
-            rng = np.random.default_rng(block_seed)
-            perm = rng.permutation(block_num_rows(block))
-            if is_columnar(block):
-                return {k: np.asarray(v)[perm] for k, v in block.items()}
-            return [block[i] for i in perm]
-
-        refs = []
+    def _iter_inputs(self):
         while True:
             try:
                 item = self.in_q.get(timeout=0.5)
@@ -404,22 +395,26 @@ class ShuffleStage(_Stage):
                     return
                 continue
             if item is _SENTINEL:
-                break
-            refs.append(item)
-        rng = np.random.default_rng(self.seed)
-        order = rng.permutation(len(refs))
-        seeds = rng.integers(0, 2**31, size=len(refs))
-        for i in order:
-            if not self._put_out(_shuffle_block.remote(refs[i], int(seeds[i]))):
                 return
-            self.stats.tasks_submitted += 1
+            yield item
+
+    def _run(self):
+        from .shuffle import run_exchange
+
+        for ref in run_exchange(self.spec, self._iter_inputs(),
+                                stats=self.stats,
+                                stop_event=self.stop_event):
+            if not self._put_out(ref):
+                return
             self.stats.blocks_out += 1
 
 
 class AllToAllStage(_Stage):
     """Generic barrier stage: gather every upstream block ref, hand the
-    full list to ``fn(refs) -> iterable of refs`` (ref: the all-to-all
-    physical operators — repartition/sort/aggregate exchanges)."""
+    full list to ``fn(refs) -> iterable of refs``. The built-in
+    all-to-all ops (sort/repartition/random_shuffle/groupby) run on
+    ShuffleExchangeStage; this remains the escape hatch for
+    user-supplied exchange functions."""
 
     def __init__(self, name: str, in_q, out_q, fn: Callable):
         super().__init__(name, out_q, in_q)
@@ -614,9 +609,9 @@ def build_executor(plan, parallelism: int) -> StreamingExecutor:
         if op.kind == "map_block":
             stages.append(MapStage(op.name, q, next_q, op.args["block_fn"],
                                    op.remote_args, op.budget))
-        elif op.kind == "shuffle":
-            stages.append(ShuffleStage(q, next_q, op.args.get("seed"),
-                                       op.remote_args))
+        elif op.kind == "shuffle_exchange":
+            stages.append(ShuffleExchangeStage(op.name, q, next_q,
+                                               op.args["spec"]))
         elif op.kind == "all_to_all":
             stages.append(AllToAllStage(op.name, q, next_q,
                                         op.args["fn"]))

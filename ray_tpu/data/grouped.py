@@ -1,14 +1,18 @@
 """Grouped aggregations (ref: python/ray/data/grouped_data.py —
 GroupedData.count/sum/mean/min/max/map_groups over a groupby key).
 
-The exchange is a single barrier stage: rows partition by key on the
-driver-side reducer task; per-group aggregates come back as one columnar
-block sorted by key (matching the reference's sorted-groupby output).
+The exchange is the push-based map/merge shuffle (shuffle.py): map
+tasks hash-partition by group key and run map-side combiners, so only
+accumulator-sized partials — never rows — cross the wire; per-partition
+merge tasks combine partials and finalize one columnar block each,
+sorted by key within the partition. For ``map_groups`` the rows of each
+group do travel, but straight between workers through the object plane;
+the driver only ever holds refs.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from typing import Callable
 
 
 class GroupedData:
@@ -18,140 +22,63 @@ class GroupedData:
 
     def aggregate(self, *aggs):
         """Generic user aggregations (ref: grouped_data.py:49
-        ``aggregate(*AggregateFn)``). Per-block accumulation runs as one
-        remote task per block — only accumulator-sized partials (not
-        rows) cross the exchange — then partials merge per group and
-        finalize into one sorted columnar block."""
+        ``aggregate(*AggregateFn)``): one output block per shuffle
+        partition, each ``{key, agg.name...}`` columnar and sorted by
+        key within the partition (keys are hash-partitioned, so global
+        output order is not sorted across blocks)."""
         from .dataset import _LogicalOp
+        from .shuffle import ShuffleSpec
 
-        key = self._key
         aggs = list(aggs)
         if not aggs:
             raise ValueError("aggregate() needs at least one AggregateFn")
-
-        def exchange(refs):
-            import numpy as np
-
-            from .. import get, put, remote
-            from .block import rows_of
-
-            def block_partials(block):
-                """{group: [accumulator per agg]} for one block."""
-                by_key = {}
-                for row in rows_of(block):
-                    k = row[key]
-                    k = k.item() if hasattr(k, "item") else k
-                    by_key.setdefault(k, []).append(row)
-                return {
-                    k: [agg.accumulate_block(agg.init(k), rows)
-                        for agg in aggs]
-                    for k, rows in by_key.items()}
-
-            task = remote(num_cpus=1)(block_partials)
-            partials = get([task.remote(ref) for ref in refs])
-            merged = {}
-            for part in partials:
-                for k, accs in part.items():
-                    cur = merged.get(k)
-                    merged[k] = accs if cur is None else [
-                        agg.merge(a, b)
-                        for agg, a, b in zip(aggs, cur, accs)]
-            keys_sorted = sorted(merged)
-            block = {key: np.asarray(keys_sorted)}
-            for i, agg in enumerate(aggs):
-                block[agg.name] = np.asarray(
-                    [agg.finalize(merged[k][i]) for k in keys_sorted])
-            return [put(block)]
-
         names = ",".join(agg.name for agg in aggs)
+        name = f"groupby({self._key}).aggregate({names})"
         return self._ds._append(_LogicalOp(
-            "all_to_all", f"groupby({key}).aggregate({names})",
-            {"fn": exchange}))
-
-    def _aggregate(self, name: str,
-                   agg_fn: Callable, value_key: Optional[str]):
-        from .dataset import Dataset, _LogicalOp
-
-        key = self._key
-
-        def exchange(refs):
-            import numpy as np
-
-            from .. import get, put
-            from .block import rows_of
-
-            groups: Dict[Any, List[Any]] = {}
-            for ref in refs:
-                for row in rows_of(get(ref)):
-                    k = row[key]
-                    k = k.item() if hasattr(k, "item") else k
-                    groups.setdefault(k, []).append(row)
-            keys_sorted = sorted(groups)
-            col_name = (f"{name}({value_key})" if value_key else "count()")
-            values = []
-            for k in keys_sorted:
-                rows = groups[k]
-                if value_key is None:
-                    values.append(len(rows))
-                else:
-                    values.append(agg_fn(
-                        np.asarray([row[value_key] for row in rows])))
-            block = {key: np.asarray(keys_sorted),
-                     col_name: np.asarray(values)}
-            return [put(block)]
-
-        return self._ds._append(_LogicalOp(
-            "all_to_all", f"groupby({key}).{name}", {"fn": exchange}))
+            "shuffle_exchange", name,
+            {"spec": ShuffleSpec(kind="groupby_agg", name=name,
+                                 key=self._key, aggs=aggs)}))
 
     def count(self):
-        return self._aggregate("count", None, None)
+        from .aggregate import Count
+
+        return self.aggregate(Count())
 
     def sum(self, value_key: str):
-        import numpy as np
+        from .aggregate import Sum
 
-        return self._aggregate("sum", np.sum, value_key)
+        return self.aggregate(Sum(value_key))
 
     def mean(self, value_key: str):
-        import numpy as np
+        from .aggregate import Mean
 
-        return self._aggregate("mean", np.mean, value_key)
+        return self.aggregate(Mean(value_key))
 
     def min(self, value_key: str):
-        import numpy as np
+        from .aggregate import Min
 
-        return self._aggregate("min", np.min, value_key)
+        return self.aggregate(Min(value_key))
 
     def max(self, value_key: str):
-        import numpy as np
+        from .aggregate import Max
 
-        return self._aggregate("max", np.max, value_key)
+        return self.aggregate(Max(value_key))
 
     def std(self, value_key: str):
-        import numpy as np
+        from .aggregate import Std
 
-        return self._aggregate("std", np.std, value_key)
+        return self.aggregate(Std(value_key))
 
     def map_groups(self, fn: Callable):
-        """Apply ``fn(rows) -> rows`` per group (ref: map_groups)."""
-        from .dataset import Dataset, _LogicalOp
+        """Apply ``fn(rows) -> rows`` per group (ref: map_groups). Rows
+        hash-partition by group key across merge workers; each merge
+        applies ``fn`` to its complete groups (a group never splits
+        across partitions) and emits one block of the results."""
+        from .dataset import _LogicalOp
+        from .shuffle import ShuffleSpec
 
-        key = self._key
-
-        def exchange(refs):
-            from .. import get, put
-            from .block import rows_of
-
-            groups: Dict[Any, List[Any]] = {}
-            for ref in refs:
-                for row in rows_of(get(ref)):
-                    k = row[key]
-                    k = k.item() if hasattr(k, "item") else k
-                    groups.setdefault(k, []).append(row)
-            out = []
-            for k in sorted(groups):
-                result = fn(groups[k])
-                out.append(put(list(result)))
-            return out
-
+        name = f"groupby({self._key}).map_groups"
         return self._ds._append(_LogicalOp(
-            "all_to_all", f"groupby({key}).map_groups", {"fn": exchange}))
+            "shuffle_exchange", name,
+            {"spec": ShuffleSpec(kind="groupby_map", name=name,
+                                 key=self._key, fn=fn)}))

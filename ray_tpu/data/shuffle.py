@@ -1,0 +1,706 @@
+"""Push-based distributed shuffle: the Data plane's all-to-all exchange.
+
+Two-stage map/merge exchange run entirely inside the distributed object
+store (ref: Exoshuffle — Luan et al. 2023, shuffle built on the task +
+object-store substrate; Magnet — Shen et al., VLDB 2020, push-based
+partition merging; code analog: ray/data/_internal/planner/exchange/):
+
+  * **map** tasks partition one input block into P partition fragments
+    and return them as separate task returns (``num_returns = P + 1``,
+    the +1 a small metadata dict), so every fragment seals on the map
+    worker's *local* store — that is the push;
+  * per-partition **merge** tasks (spread-scheduled across nodes) take
+    their P_i fragment refs as task dependencies and pull them through
+    the bulk transfer plane — the cut-through relay + parallel spill
+    restore path — emitting one merged output block per partition:
+    concat for ``repartition`` (contiguous global row ranges, order
+    preserving), k-way sorted merge for ``sort``, hash-merge + aggregate
+    combiners for ``groupby`` (only accumulator-sized partials cross the
+    wire), and a seeded row-level scatter for ``random_shuffle``.
+
+The driver only ever holds ObjectRefs and O(P) metadata — row counts,
+sampled range boundaries, fragment byte sizes. Rows never materialize in
+driver memory; when the working set outgrows the store, fragments spill
+and restore through the N11 parallel spill I/O plane and the exchange
+records a WARNING cluster event marking the out-of-core transition.
+
+Pipelining: hash-partitioned exchanges (groupby) know P up front, so map
+fragments start pushing while upstream read/map tasks are still
+producing; range/scatter exchanges overlap their sampling / row-count
+probe tasks with upstream production the same way. Merge tasks are
+submitted in a ``shuffle_merge_parallelism`` window *before* earlier
+merges finish, so fragment pulls overlap map execution.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import zlib
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .block import (block_num_rows, block_size_bytes, concat_blocks,
+                    is_arrow, is_columnar, rows_of, slice_block,
+                    to_columnar)
+
+# reserved column carrying the global row index through a random_shuffle
+# exchange (stripped from merge output)
+_GIDX = "__shuffle_gidx__"
+# evenly-spaced key samples per input block for range partitioning
+_SAMPLES_PER_BLOCK = 64
+# hash exchanges (groupby) use a fixed small default partition count so
+# map tasks can dispatch before the input cardinality is known — the
+# property that lets fragment pushes pipeline with upstream production
+_GROUPBY_DEFAULT_PARTITIONS = 8
+# ceiling for auto-derived partition counts (bounds num_returns fan-out)
+_MAX_AUTO_PARTITIONS = 512
+
+
+# ---------------------------------------------------------------------------
+# spec
+
+
+@dataclass
+class ShuffleSpec:
+    """Driver-side description of one exchange, shipped to map/merge
+    tasks inside their (cloudpickled) payload arg."""
+
+    kind: str                    # sort | repartition | random_shuffle |
+    #                              groupby_agg | groupby_map
+    name: str = ""
+    key: Optional[str] = None    # sort / groupby key column
+    descending: bool = False
+    seed: Optional[int] = None   # random_shuffle
+    num_partitions: int = 0      # 0 = auto; repartition pins it
+    aggs: Optional[List[Any]] = None       # groupby_agg AggregateFns
+    fn: Optional[Callable] = None          # groupby_map group function
+
+
+# ---------------------------------------------------------------------------
+# metrics (created lazily so importing this module never starts the
+# metrics flusher thread in processes that never shuffle)
+
+_metrics_lock = threading.Lock()
+_metrics: Optional[Dict[str, Any]] = None
+
+
+def _shuffle_metrics() -> Dict[str, Any]:
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            from ..util.metrics import Counter
+
+            _metrics = {
+                "exchanges": Counter(
+                    "data_shuffle_exchanges_total",
+                    "shuffle exchanges run", ("op",)),
+                "bytes_pushed": Counter(
+                    "data_shuffle_bytes_pushed_total",
+                    "fragment bytes pushed map->merge", ("op",)),
+                "fragments": Counter(
+                    "data_shuffle_fragments_total",
+                    "non-empty partition fragments produced", ("op",)),
+                "merge_tasks": Counter(
+                    "data_shuffle_merge_tasks_total",
+                    "per-partition merge tasks run", ("op",)),
+                "spill_bytes": Counter(
+                    "data_shuffle_spill_bytes_total",
+                    "store spill observed during exchanges", ("op",)),
+            }
+        return _metrics
+
+
+# ---------------------------------------------------------------------------
+# deterministic hashing / stable ordering primitives
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 — a deterministic,
+    well-mixed hash (Python's ``hash()`` is salted per process, useless
+    for cross-worker partitioning)."""
+    x = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x
+
+
+def _hash_scalar(value: Any) -> int:
+    """Deterministic 64-bit hash of one group key (must agree with the
+    vectorized column path for the same logical value)."""
+    if hasattr(value, "item"):
+        value = value.item()
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, int):
+        raw = np.uint64(np.int64(value).view(np.uint64))
+    elif isinstance(value, float):
+        raw = np.uint64(np.float64(value + 0.0).view(np.uint64))
+    else:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        raw = np.uint64(zlib.crc32(data))
+    return int(_mix64(np.asarray([raw]))[0])
+
+
+def _hash_column(col: np.ndarray) -> np.ndarray:
+    col = np.asarray(col)
+    if col.dtype.kind in "iub":
+        raw = col.astype(np.int64).view(np.uint64)
+    elif col.dtype.kind == "f":
+        # + 0.0 folds -0.0 into +0.0 so equal floats hash equal
+        raw = (col.astype(np.float64) + 0.0).view(np.uint64)
+    else:
+        return np.asarray([_hash_scalar(v) for v in col], np.uint64)
+    return _mix64(raw)
+
+
+def stable_argsort(keys: np.ndarray, descending: bool = False) -> np.ndarray:
+    """Stable argsort in either direction. The naive descending form —
+    ``np.argsort(keys, kind="stable")[::-1]`` — reverses tie order too;
+    stably sorting the *reversed* array and mapping indices back keeps
+    equal keys in original order for every dtype (negation would break
+    unsigned ints and strings)."""
+    keys = np.asarray(keys)
+    if not descending:
+        return np.argsort(keys, kind="stable")
+    n = len(keys)
+    rev = np.argsort(keys[::-1], kind="stable")
+    return (n - 1 - rev)[::-1]
+
+
+def _take(block_cols: Dict[str, np.ndarray], idx: np.ndarray) -> Dict:
+    return {k: np.asarray(v)[idx] for k, v in block_cols.items()}
+
+
+# ---------------------------------------------------------------------------
+# per-task environment metadata (spill / store-pressure observation)
+
+
+def _task_env() -> Dict[str, Any]:
+    """Cumulative spill counter + store-pressure flag for THIS worker
+    process; the driver diffs per-pid snapshots across all exchange
+    tasks to estimate how much spill the exchange itself drove."""
+    out: Dict[str, Any] = {"pid": os.getpid(), "spill": 0, "hot": False}
+    try:
+        from .._private.object_store import IO_STATS
+
+        out["spill"] = int(IO_STATS.get("spill_bytes", 0))
+    except Exception:
+        pass
+    try:
+        from .._private.config import global_config
+        from .._worker_api import _core
+
+        if _core is not None and getattr(_core, "store", None) is not None:
+            capacity = _core.store.capacity or 1
+            frac = _core.store.used_bytes() / capacity
+            out["hot"] = frac >= global_config().object_spilling_threshold
+    except Exception:
+        pass
+    return out
+
+
+def _payload_bytes(obj: Any) -> int:
+    try:
+        return int(block_size_bytes(obj))
+    except Exception:
+        try:
+            import cloudpickle
+
+            return len(cloudpickle.dumps(obj))
+        except Exception:
+            return 0
+
+
+# ---------------------------------------------------------------------------
+# probe tasks (pipelined with upstream production)
+
+
+def _exchange_meta_task(block):
+    """Tiny (rows, bytes) probe — the only thing the driver get()s per
+    input block besides merge metadata."""
+    return block_num_rows(block), _payload_bytes(block)
+
+
+def _exchange_sample_task(block, key, k):
+    """(rows, bytes, sampled keys): up to k evenly-spaced key values for
+    range-boundary estimation (ref: exchange/sort sample stage)."""
+    n = block_num_rows(block)
+    nb = _payload_bytes(block)
+    if n == 0:
+        return n, nb, np.asarray([])
+    keys = np.asarray(to_columnar(block)[key])
+    idx = np.linspace(0, n - 1, num=min(int(k), n)).astype(np.int64)
+    return n, nb, keys[idx]
+
+
+# ---------------------------------------------------------------------------
+# map side: block -> P fragments
+
+
+def _empty_like(block) -> Any:
+    if is_columnar(block) or is_arrow(block):
+        return slice_block(block, 0, 0)
+    return []
+
+
+def _partition_sort(block, spec: ShuffleSpec, ctx: Dict) -> List[Any]:
+    P = ctx["P"]
+    boundaries = np.asarray(ctx["boundaries"])
+    if is_columnar(block):
+        cols = to_columnar(block)
+        keys = np.asarray(cols[spec.key])
+        if len(boundaries):
+            part = np.searchsorted(boundaries, keys, side="right")
+        else:
+            part = np.zeros(len(keys), dtype=np.int64)
+        if spec.descending:
+            part = (P - 1) - part
+        frags = []
+        for p in range(P):
+            idx = np.nonzero(part == p)[0]
+            if not len(idx):
+                frags.append({k: np.asarray(v)[:0] for k, v in cols.items()})
+                continue
+            frag = _take(cols, idx)
+            # pre-sort each fragment so merges are k-way merges of runs
+            order = stable_argsort(frag[spec.key], spec.descending)
+            frags.append(_take(frag, order))
+        return frags
+    rows = list(rows_of(block))
+    buckets: List[List[Any]] = [[] for _ in range(P)]
+    for row in rows:
+        k = row[spec.key]
+        p = int(np.searchsorted(boundaries, np.asarray(k), side="right")) \
+            if len(boundaries) else 0
+        buckets[(P - 1) - p if spec.descending else p].append(row)
+    return [sorted(b, key=lambda r: r[spec.key], reverse=spec.descending)
+            for b in buckets]
+
+
+def _partition_repartition(block, spec: ShuffleSpec, ctx: Dict) -> List[Any]:
+    """Contiguous global row ranges: partition p owns global rows
+    [p*total//P, (p+1)*total//P); this block covers [offset, offset+n)."""
+    P, total, offset = ctx["P"], ctx["total"], ctx["offset"]
+    n = block_num_rows(block)
+    frags = []
+    for p in range(P):
+        lo = (p * total) // P
+        hi = ((p + 1) * total) // P
+        start = min(max(lo - offset, 0), n)
+        end = min(max(hi - offset, 0), n)
+        frags.append(slice_block(block, start, end) if end > start
+                     else _empty_like(block))
+    return frags
+
+
+def _partition_random(block, spec: ShuffleSpec, ctx: Dict) -> List[Any]:
+    """Seeded row-level scatter. partition(row) depends only on (seed,
+    global row index), and the merge re-sorts by global index before
+    applying its seeded permutation — so the output is identical for any
+    block layout of the same logical dataset."""
+    P, offset, seed = ctx["P"], ctx["offset"], ctx["seed"]
+    n = block_num_rows(block)
+    gidx = np.arange(offset, offset + n, dtype=np.uint64)
+    part = _mix64(gidx ^ _mix64(np.asarray([seed], np.uint64))[0]) \
+        % np.uint64(P)
+    if is_columnar(block):
+        cols = dict(to_columnar(block))
+        cols[_GIDX] = gidx
+        return [_take(cols, np.nonzero(part == p)[0]) for p in range(P)]
+    rows = list(rows_of(block))
+    buckets: List[List[Any]] = [[] for _ in range(P)]
+    for i, row in enumerate(rows):
+        buckets[int(part[i])].append((int(gidx[i]), row))
+    return buckets
+
+
+def _group_rows(block, key: str, P: int) -> List[Dict[Any, List[Any]]]:
+    """Hash-partitioned {group key: rows} maps, one per partition."""
+    parts: List[Dict[Any, List[Any]]] = [{} for _ in range(P)]
+    if is_columnar(block):
+        cols = to_columnar(block)
+        hashes = _hash_column(np.asarray(cols[key]))
+        part = (hashes % np.uint64(P)).astype(np.int64)
+        for i, row in enumerate(rows_of(cols)):
+            k = row[key]
+            k = k.item() if hasattr(k, "item") else k
+            parts[part[i]].setdefault(k, []).append(row)
+        return parts
+    for row in rows_of(block):
+        k = row[key]
+        k = k.item() if hasattr(k, "item") else k
+        parts[_hash_scalar(k) % P].setdefault(k, []).append(row)
+    return parts
+
+
+def _partition_groupby_agg(block, spec: ShuffleSpec, ctx: Dict) -> List[Any]:
+    """Map-side combiners: each fragment is {group: [accumulator per
+    agg]} — rows never cross the exchange for aggregations."""
+    aggs = spec.aggs
+    frags = []
+    for groups in _group_rows(block, spec.key, ctx["P"]):
+        frags.append({
+            k: [agg.accumulate_block(agg.init(k), rows) for agg in aggs]
+            for k, rows in groups.items()})
+    return frags
+
+
+def _partition_groupby_map(block, spec: ShuffleSpec, ctx: Dict) -> List[Any]:
+    return _group_rows(block, spec.key, ctx["P"])
+
+
+_PARTITIONERS = {
+    "sort": _partition_sort,
+    "repartition": _partition_repartition,
+    "random_shuffle": _partition_random,
+    "groupby_agg": _partition_groupby_agg,
+    "groupby_map": _partition_groupby_map,
+}
+
+
+def _shuffle_map_task(block, payload):
+    """One map task: partition ``block`` into P fragments; returns
+    ``(*fragments, meta)`` so each fragment seals as its own object on
+    this worker's local store (num_returns = P + 1)."""
+    spec, ctx = payload
+    env0 = _task_env()
+    frags = _PARTITIONERS[spec.kind](block, spec, ctx)
+    meta = {
+        "bytes": [_payload_bytes(f) for f in frags],
+        "frags": sum(1 for f in frags if _frag_len(f)),
+        "env0": env0, "env1": _task_env(),
+    }
+    return tuple(frags) + (meta,)
+
+
+def _frag_len(frag) -> int:
+    try:
+        return block_num_rows(frag)
+    except Exception:
+        return len(frag)
+
+
+# ---------------------------------------------------------------------------
+# merge side: fragments -> one output block
+
+
+def _merge_two_runs(a: Dict, b: Dict, key: str) -> Dict:
+    """Stable merge of two sorted columnar runs via the searchsorted
+    interleave: a-rows land before equal b-rows (side=left/right pair),
+    so composing pairwise merges in map order stays globally stable."""
+    ak, bk = np.asarray(a[key]), np.asarray(b[key])
+    a_pos = np.arange(ak.size) + np.searchsorted(bk, ak, side="left")
+    b_pos = np.arange(bk.size) + np.searchsorted(ak, bk, side="right")
+    n = ak.size + bk.size
+    out: Dict[str, np.ndarray] = {}
+    for col in a.keys():
+        av, bv = np.asarray(a[col]), np.asarray(b[col])
+        dtype = av.dtype if av.dtype == bv.dtype \
+            else np.result_type(av, bv)
+        merged = np.empty((n,) + av.shape[1:], dtype=dtype)
+        merged[a_pos] = av
+        merged[b_pos] = bv
+        out[col] = merged
+    return out
+
+
+def _merge_sorted_columnar(runs: List[Dict], key: str,
+                           descending: bool) -> Dict:
+    if descending:
+        # searchsorted needs ascending runs; a descending merge instead
+        # concats in map order + one stable descending argsort — still
+        # stable because concat order IS original row order
+        whole = concat_blocks(runs)
+        if not block_num_rows(whole):
+            return runs[0]
+        order = stable_argsort(np.asarray(whole[key]), descending=True)
+        return _take(whole, order)
+    while len(runs) > 1:
+        nxt = [_merge_two_runs(runs[i], runs[i + 1], key)
+               for i in range(0, len(runs) - 1, 2)]
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+    return runs[0]
+
+
+def _merge_sort(frags: List[Any], spec: ShuffleSpec, ctx: Dict):
+    import heapq
+
+    live = [f for f in frags if _frag_len(f)]
+    if not live:
+        return concat_blocks([])
+    if all(is_columnar(f) for f in live):
+        return _merge_sorted_columnar(live, spec.key, spec.descending)
+    rows_runs = [list(rows_of(f)) for f in live]
+    return list(heapq.merge(*rows_runs, key=lambda r: r[spec.key],
+                            reverse=spec.descending))
+
+
+def _merge_random(frags: List[Any], spec: ShuffleSpec, ctx: Dict):
+    seed, part = ctx["seed"], ctx["part"]
+    rng = np.random.default_rng([seed, part])
+    cols = [f for f in frags if isinstance(f, dict) and block_num_rows(f)]
+    lists = [f for f in frags if isinstance(f, list) and f]
+    for lf in lists:
+        body = dict(to_columnar([r for _, r in lf]))
+        body[_GIDX] = np.asarray([g for g, _ in lf], np.uint64)
+        cols.append(body)
+    if not cols:
+        return []
+    whole = concat_blocks(cols)
+    # sort by global index first: makes the input to the permutation a
+    # pure function of the logical dataset, not of block layout
+    order = np.argsort(np.asarray(whole[_GIDX]), kind="stable")
+    perm = rng.permutation(len(order))
+    take = order[perm]
+    if lists and len(cols) == len(lists):
+        rows = sorted((r for lf in lists for r in lf), key=lambda t: t[0])
+        return [rows[i][1] for i in perm]
+    return {k: np.asarray(v)[take] for k, v in whole.items() if k != _GIDX}
+
+
+def _merge_groupby_agg(frags: List[Any], spec: ShuffleSpec, ctx: Dict):
+    aggs = spec.aggs
+    merged: Dict[Any, List[Any]] = {}
+    for part in frags:
+        for k, accs in part.items():
+            cur = merged.get(k)
+            merged[k] = accs if cur is None else [
+                agg.merge(a, b) for agg, a, b in zip(aggs, cur, accs)]
+    keys_sorted = sorted(merged)
+    block = {spec.key: np.asarray(keys_sorted)}
+    for i, agg in enumerate(aggs):
+        block[agg.name] = np.asarray(
+            [agg.finalize(merged[k][i]) for k in keys_sorted])
+    return block
+
+
+def _merge_groupby_map(frags: List[Any], spec: ShuffleSpec, ctx: Dict):
+    groups: Dict[Any, List[Any]] = {}
+    for part in frags:
+        for k, rows in part.items():
+            groups.setdefault(k, []).extend(rows)
+    out: List[Any] = []
+    for k in sorted(groups):
+        out.extend(spec.fn(groups[k]))
+    return out
+
+
+_MERGERS = {
+    "sort": _merge_sort,
+    "repartition": lambda frags, spec, ctx: concat_blocks(list(frags)),
+    "random_shuffle": _merge_random,
+    "groupby_agg": _merge_groupby_agg,
+    "groupby_map": _merge_groupby_map,
+}
+
+
+def _shuffle_merge_task(payload, *frags):
+    """One per-partition merge: pulls its fragments (task deps resolved
+    through the bulk transfer plane) and emits (merged block, meta)."""
+    spec, ctx = payload
+    env0 = _task_env()
+    block = _MERGERS[spec.kind](list(frags), spec, ctx)
+    meta = {"rows": _frag_len(block), "bytes": _payload_bytes(block),
+            "env0": env0, "env1": _task_env()}
+    return block, meta
+
+
+# ---------------------------------------------------------------------------
+# driver-side coordinator
+
+
+def _resolve_partitions(spec: ShuffleSpec, cfg, n_blocks: int,
+                        total_bytes: int) -> int:
+    if spec.num_partitions > 0:         # repartition pins P explicitly
+        return spec.num_partitions
+    if cfg.shuffle_num_partitions > 0:
+        return int(cfg.shuffle_num_partitions)
+    target = max(1, int(cfg.shuffle_fragment_target_bytes))
+    by_bytes = min(_MAX_AUTO_PARTITIONS, -(-int(total_bytes) // target))
+    if spec.kind == "random_shuffle":
+        # layout-independent on purpose: P must not depend on the block
+        # count or a fixed seed would shuffle differently per layout
+        return max(1, by_bytes)
+    return max(1, n_blocks, by_bytes)
+
+
+def _spill_estimate(metas: List[Dict]) -> tuple:
+    """(spill byte delta, store-went-hot flag) across every worker pid
+    that ran an exchange task, from their env0/env1 snapshots."""
+    per_pid: Dict[int, List[int]] = {}
+    hot = False
+    for m in metas:
+        for env in (m.get("env0"), m.get("env1")):
+            if not env:
+                continue
+            per_pid.setdefault(env["pid"], []).append(env["spill"])
+            hot = hot or bool(env.get("hot"))
+    delta = sum(max(v) - min(v) for v in per_pid.values())
+    return delta, hot
+
+
+def run_exchange(spec: ShuffleSpec, inputs: Iterable,
+                 stats=None, stop_event: Optional[threading.Event] = None):
+    """Drive one exchange: generator of merged output block refs, in
+    partition order. ``inputs`` may be a live iterator — probe and
+    hash-partitioned map tasks dispatch as refs arrive, overlapping with
+    upstream production."""
+    from .. import get, remote, wait
+    from .._private.config import global_config
+    from ..util.scheduling_strategies import SpreadSchedulingStrategy
+    from .executor import _store_backpressure_wait
+
+    cfg = global_config()
+    stop = stop_event if stop_event is not None else threading.Event()
+    met = _shuffle_metrics()
+
+    def _submitted(n: int = 1):
+        if stats is not None:
+            stats.tasks_submitted += n
+
+    seed = spec.seed
+    if spec.kind == "random_shuffle":
+        if seed is None:
+            seed = int.from_bytes(os.urandom(8), "little")
+        seed = int(seed) & (2**63 - 1)
+
+    meta_fn = remote(num_cpus=0.25)(_exchange_meta_task)
+    sample_fn = remote(num_cpus=0.25)(_exchange_sample_task)
+    map_fn = remote(num_cpus=1)(_shuffle_map_task)
+    merge_fn = remote(num_cpus=1,
+                      scheduling_strategy=SpreadSchedulingStrategy())(
+        _shuffle_merge_task)
+
+    P: Optional[int] = None
+    if spec.kind in ("groupby_agg", "groupby_map"):
+        P = int(cfg.shuffle_num_partitions) or _GROUPBY_DEFAULT_PARTITIONS
+
+    input_refs: List[Any] = []
+    probe_refs: List[Any] = []
+    map_rets: List[List[Any]] = []
+    for ref in inputs:
+        if stop.is_set():
+            return
+        input_refs.append(ref)
+        if spec.kind == "sort":
+            probe_refs.append(
+                sample_fn.remote(ref, spec.key, _SAMPLES_PER_BLOCK))
+            _submitted()
+        elif spec.kind in ("repartition", "random_shuffle"):
+            probe_refs.append(meta_fn.remote(ref))
+            _submitted()
+        else:
+            # hash partitioning: P known up front — push fragments while
+            # upstream is still producing blocks
+            _store_backpressure_wait(stop)
+            map_rets.append(map_fn.options(num_returns=P + 1).remote(
+                ref, (spec, {"P": P})))
+            _submitted()
+    n_blocks = len(input_refs)
+    if n_blocks == 0 or stop.is_set():
+        return
+
+    if probe_refs:
+        # O(n_blocks) tuples of counts/samples — the only driver-side
+        # get() over the input side of the exchange
+        metas = get(probe_refs)
+        nrows = [int(m[0]) for m in metas]
+        total_rows = sum(nrows)
+        total_bytes = sum(int(m[1]) for m in metas)
+        P = _resolve_partitions(spec, cfg, n_blocks, total_bytes)
+        offsets = [0]
+        for n in nrows:
+            offsets.append(offsets[-1] + n)
+        boundaries = np.asarray([])
+        if spec.kind == "sort" and P > 1:
+            sampled = [np.asarray(m[2]) for m in metas if len(m[2])]
+            if sampled:
+                samples = np.sort(np.concatenate(sampled))
+                boundaries = samples[
+                    [(len(samples) * p) // P for p in range(1, P)]]
+        for i, ref in enumerate(input_refs):
+            if stop.is_set():
+                return
+            _store_backpressure_wait(stop)
+            ctx: Dict[str, Any] = {"P": P}
+            if spec.kind == "sort":
+                ctx["boundaries"] = boundaries
+            else:
+                ctx.update(offset=offsets[i], total=total_rows, seed=seed)
+            map_rets.append(map_fn.options(num_returns=P + 1).remote(
+                ref, (spec, ctx)))
+            _submitted()
+
+    met["exchanges"].inc(tags={"op": spec.kind})
+
+    # merge window: submit merges before earlier ones finish so their
+    # fragment pulls overlap map execution; yield in partition order by
+    # waiting on the head merge's (tiny) meta return
+    window = max(1, int(cfg.shuffle_merge_parallelism))
+    pending: "collections.deque" = collections.deque()
+    merge_metas: List[Dict] = []
+    next_p = 0
+
+    def _submit_merge():
+        nonlocal next_p
+        p = next_p
+        next_p += 1
+        frag_refs = [map_rets[i][p] for i in range(n_blocks)]
+        rets = merge_fn.options(num_returns=2).remote(
+            (spec, {"part": p, "P": P, "seed": seed}), *frag_refs)
+        _submitted()
+        met["merge_tasks"].inc(tags={"op": spec.kind})
+        pending.append((rets[0], rets[1]))
+
+    while next_p < P and len(pending) < window:
+        _submit_merge()
+    while pending:
+        if stop.is_set():
+            return
+        block_ref, meta_ref = pending[0]
+        ready, _ = wait([meta_ref], num_returns=1, timeout=0.2)
+        if not ready:
+            continue
+        pending.popleft()
+        merge_metas.append(get(meta_ref))
+        if next_p < P:
+            _submit_merge()
+        yield block_ref
+
+    # metrics + out-of-core event, from O(P + n_blocks) metadata only
+    try:
+        map_metas = get([rets[P] for rets in map_rets]) if map_rets else []
+    except Exception:
+        map_metas = []
+    pushed = sum(sum(m["bytes"]) for m in map_metas)
+    frag_count = sum(m["frags"] for m in map_metas)
+    if pushed:
+        met["bytes_pushed"].inc(pushed, tags={"op": spec.kind})
+    if frag_count:
+        met["fragments"].inc(frag_count, tags={"op": spec.kind})
+    spill_delta, hot = _spill_estimate(map_metas + merge_metas)
+    if spill_delta:
+        met["spill_bytes"].inc(spill_delta, tags={"op": spec.kind})
+    if spill_delta or hot:
+        try:
+            from ..util.state import record_event
+
+            record_event(
+                f"shuffle {spec.name or spec.kind} fell back to spill "
+                f"(out-of-core exchange)",
+                severity="WARNING", source="DATA", op=spec.kind,
+                partitions=int(P), input_blocks=n_blocks,
+                spill_bytes=int(spill_delta),
+                bytes_pushed=int(pushed), fragments=int(frag_count))
+        except Exception:
+            pass

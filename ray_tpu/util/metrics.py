@@ -117,6 +117,25 @@ class Histogram(_Metric):
             self._values[_tag_key({**merged, "__stat__": "count"})] += 1
 
 
+def snapshot_local(prefix: str = "") -> Dict[str, float]:
+    """Current values of every metric registered in THIS process, without
+    a GCS round trip: ``{"name" | "name{k=v,...}": value}``. The local
+    introspection hook tests and benches use to read counters that the
+    flusher would otherwise only surface through the state API."""
+    with _registry_lock:
+        metrics = list(_registry)
+    out: Dict[str, float] = {}
+    for metric in metrics:
+        for rec in metric._snapshot():
+            if prefix and not rec["name"].startswith(prefix):
+                continue
+            tags = rec["tags"]
+            key = rec["name"] if not tags else rec["name"] + "{" + ",".join(
+                f"{k}={v}" for k, v in sorted(tags.items())) + "}"
+            out[key] = out.get(key, 0.0) + rec["value"]
+    return out
+
+
 def _flush_once() -> bool:
     from .. import _worker_api
 
