@@ -80,14 +80,23 @@ timeout "${CI_SMOKE_TIMEOUT_S:-600}" \
     python -m pytest tests/test_object_transfer.py tests/test_spilling.py \
         tests/test_data_shuffle.py -q
 
-echo "== [3/4] test suite =="
+echo "== [3/5] observability smoke: lifecycle + timeline + serve metrics =="
+# the flight recorder (task state transitions, Perfetto export, serving
+# histograms) gets a live end-to-end check: a silent telemetry
+# regression would otherwise only show up as weaker dashboards, not a
+# test failure
+JAX_PLATFORMS=cpu \
+timeout "${CI_OBS_TIMEOUT_S:-300}" \
+    python -m ray_tpu.scripts.obs_smoke
+
+echo "== [4/5] test suite =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 JAX_PLATFORMS=cpu \
 RAY_TPU_TEST_TIMEOUT_S="${RAY_TPU_TEST_TIMEOUT_S:-180}" \
 timeout "${CI_SUITE_TIMEOUT_S:-3000}" \
     python -m pytest tests/ -q
 
-echo "== [4/4] multichip dry-run =="
+echo "== [5/5] multichip dry-run =="
 timeout "${CI_DRYRUN_TIMEOUT_S:-1200}" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 

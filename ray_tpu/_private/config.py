@@ -138,6 +138,10 @@ class Config:
     # --- logging / metrics ---
     event_log_enabled: bool = True
     metrics_report_interval_ms: int = 2000
+    # raylet clock-sync period against the GCS clock (NTP-style offset
+    # piggybacked on ping; raylet.py _clock_sync_loop). 0 disables —
+    # timelines then merge raw per-node wall clocks.
+    clock_sync_interval_s: float = 30.0
     # --- device plane ---
     # Serving decode attention: stream KV pages through the Pallas
     # paged-attention kernel (ops/paged_attention.py) instead of the

@@ -527,6 +527,17 @@ class CoreWorker:
         event = {"task_id": task_id}
         event.update(fields)
         with self._task_events_lock:
+            # lifecycle transitions coalesce into the tail event when it
+            # is for the same task (one merged GCS record update instead
+            # of N) — everything else appends
+            if ("transitions" in event and self._task_events
+                    and self._task_events[-1]["task_id"] == task_id):
+                tail = self._task_events[-1]
+                tail.setdefault("transitions", []).extend(
+                    event.pop("transitions"))
+                tail.update({k: v for k, v in event.items()
+                             if k != "task_id"})
+                return
             # bounded buffer: a submit burst must not build an unbounded
             # flush payload that then monopolizes the GCS loop (observed
             # r4: flush backlog starving actor creations). Oldest events
@@ -541,6 +552,18 @@ class CoreWorker:
                 self._task_event_flusher_armed = True
         if arm:
             self.io.spawn(self._task_event_flusher())
+
+    def _record_transition(self, task_id: TaskID, to_state: str,
+                           ts: Optional[float] = None, **fields) -> None:
+        """Append one lifecycle transition {state, ts, node_id} to the
+        task's state_transitions list in the GCS task table (the flight
+        recorder's unit record). Extra fields ride the same event as
+        last-writer-wins record fields (e.g. state/node_id/worker_id —
+        hence the positional name: ``state=`` means the record field)."""
+        entry = {"state": to_state,
+                 "ts": time.time() if ts is None else ts,
+                 "node_id": self.node_id.hex()}
+        self._record_task_event(task_id, transitions=[entry], **fields)
 
     _TASK_EVENT_FLUSH_MAX = 2000     # events per report RPC
     _TASK_EVENT_BUFFER_MAX = 100_000
@@ -1230,8 +1253,10 @@ class CoreWorker:
         self._inflight[spec.task_id] = {"canceled": False, "worker_address": None}
         if self.cfg.lineage_pinning_enabled and not streaming:
             self._lineage[spec.task_id] = spec
-        self._record_task_event(spec.task_id, name=spec.function.repr_name,
-                                state="SUBMITTED", start_time=time.time())
+        submit_t = time.time()
+        self._record_transition(spec.task_id, "SUBMITTED", ts=submit_t,
+                                name=spec.function.repr_name,
+                                state="SUBMITTED", start_time=submit_t)
         if streaming:
             self._streams[spec.task_id] = _StreamState()
             self.io.spawn(self._submit_normal(spec, deps))
@@ -1285,21 +1310,23 @@ class CoreWorker:
             if last_error is not None:
                 self._store_error(spec, exc.WorkerCrashedError(
                     f"task {spec.function.repr_name} failed after {attempts} attempts: {last_error}"))
-                self._record_task_event(spec.task_id, state="FAILED",
+                self._record_transition(spec.task_id, "FAILED",
+                                        state="FAILED",
                                         end_time=time.time(),
                                         error=str(last_error))
             else:
                 # a task whose body raised is FAILED in the state API even
                 # though submission completed cleanly (its returns hold the
                 # serialized error)
-                self._record_task_event(
-                    spec.task_id,
-                    state="FAILED" if app_errored else "FINISHED",
+                terminal = "FAILED" if app_errored else "FINISHED"
+                self._record_transition(
+                    spec.task_id, terminal,
+                    state=terminal,
                     end_time=time.time(),
                     error="application error" if app_errored else None)
         except BaseException as e:  # noqa: BLE001
             self._store_error(spec, e)
-            self._record_task_event(spec.task_id, state="FAILED",
+            self._record_transition(spec.task_id, "FAILED", state="FAILED",
                                     end_time=time.time(), error=str(e))
         finally:
             self._inflight.pop(spec.task_id, None)
@@ -1329,6 +1356,7 @@ class CoreWorker:
     async def _run_on_leased_worker(self, spec: TaskSpec, info: Optional[dict] = None):
         sched_class = spec.scheduling_class()
         pool = self._lease_pools.setdefault(sched_class, _LeasePool())
+        self._record_transition(spec.task_id, "PENDING_NODE_ASSIGNMENT")
         grant = await self._acquire_lease(pool, spec)
         keep = False
         try:
@@ -1340,6 +1368,12 @@ class CoreWorker:
                 info["worker_address"] = grant["worker_address"]
             if grant.get("chip_ids"):
                 spec.chip_ids = grant["chip_ids"]
+            gnode_id = grant.get("node_id")
+            gworker = grant.get("worker_id")
+            self._record_transition(
+                spec.task_id, "SUBMITTED_TO_WORKER",
+                node_id=gnode_id.hex() if gnode_id else "",
+                worker_id=gworker.hex() if gworker else "")
             client = await self._client_for(grant["worker_address"])
             reply = await client.call("push_task", cloudpickle.dumps(spec))
             gnode = grant.get("node_id")

@@ -188,8 +188,9 @@ class _Lane:
             spec, event = entry
             try:
                 errored = self.core._handle_task_reply(spec, reply)
-                self.core._record_task_event(
-                    spec.task_id, state="FAILED" if errored else "FINISHED",
+                terminal = "FAILED" if errored else "FINISHED"
+                self.core._record_transition(
+                    spec.task_id, terminal, state=terminal,
                     end_time=time.time(),
                     error="application error" if errored else None)
             finally:

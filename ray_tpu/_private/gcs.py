@@ -56,6 +56,10 @@ class NodeInfo:
     # predates the transfer plane, pulls fall back to control-RPC chunks
     # (wire schema rule: appended field, decode fills the default)
     transfer_address: str = ""
+    # NTP-style estimate of (GCS clock - this node's clock), seconds,
+    # reported by the raylet's clock-sync loop; timestamps from this
+    # node compose cluster-wide as local_ts + clock_offset
+    clock_offset: float = 0.0
 
 
 @dataclass
@@ -1046,18 +1050,41 @@ class GcsServer:
         return list(out.values())
 
     # ---- task events (ref: gcs_task_manager.h — the state API backend) ----
+    _TERMINAL_STATES = ("FINISHED", "FAILED")
+
+    def _evict_task_event(self) -> None:
+        """Make room for one record: prefer the oldest TERMINAL record —
+        evicting a still-RUNNING task's record would lose live state the
+        moment the table fills with completed history."""
+        victim = None
+        for key, rec in self.task_events.items():
+            if rec.get("state") in self._TERMINAL_STATES:
+                victim = key
+                break
+        if victim is None:
+            victim = next(iter(self.task_events))
+        self.task_events.pop(victim)
+
     async def handle_report_task_events(self, payload, conn):
         for event in payload["events"]:
             task_id = event["task_id"]
             record = self.task_events.get(task_id)
             if record is None:
                 if len(self.task_events) >= self.MAX_TASK_EVENTS:
-                    self.task_events.pop(next(iter(self.task_events)))
+                    self._evict_task_event()
                 record = self.task_events[task_id] = {
                     "task_id": task_id, "name": "", "state": "",
                     "start_time": None, "end_time": None, "error": "",
+                    "state_transitions": [],
                 }
-            record.update({k: v for k, v in event.items() if v is not None})
+            # lifecycle transitions accumulate (append-merge); every
+            # other field is last-writer-wins as before
+            transitions = event.get("transitions")
+            record.update({k: v for k, v in event.items()
+                           if v is not None and k != "transitions"})
+            if transitions:
+                record.setdefault("state_transitions",
+                                  []).extend(transitions)
         return True
 
     async def handle_list_task_events(self, payload, conn):
@@ -1066,6 +1093,18 @@ class GcsServer:
     # ---- health / introspection ----
     async def handle_ping(self, payload, conn):
         return {"time": time.time()}
+
+    async def handle_report_clock_offset(self, payload, conn):
+        """Store a node's smoothed clock offset (raylet clock-sync loop;
+        NTP-style offset = GCS time - node-local midpoint)."""
+        node_id = payload["node_id"]
+        if isinstance(node_id, str):
+            node_id = NodeID.from_hex(node_id)
+        info = self.nodes.get(node_id)
+        if info is None:
+            return False
+        info.clock_offset = float(payload["offset"])
+        return True
 
     async def handle_cluster_status(self, payload, conn):
         return {

@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from .ids import ObjectID
+from ..util.tracing import record_lane_event
 
 _SHM_ROOT = "/dev/shm"
 
@@ -359,17 +360,27 @@ class SharedObjectStore:
             return
         dest = os.path.join(self.spill_dir, oid.hex())
         try:
+            try:
+                size = os.path.getsize(staged)
+            except OSError:
+                size = 0
+            wall0 = time.time()
             # same filesystem: O(1), nothing to parallelize
             os.rename(staged, dest)
+            record_lane_event("spill", f"spill {oid.hex()[:12]}",
+                              wall0, time.time(), bytes=size)
             return
         except FileNotFoundError:
             return
         except OSError:
             pass  # EXDEV — tmpfs store dir vs on-disk spill dir
         try:
+            wall0 = time.time()
             t0 = time.monotonic()
             size = self._parallel_copy_file(staged, dest)
             _bump_io_stats("spill", size, time.monotonic() - t0)
+            record_lane_event("spill", f"spill {oid.hex()[:12]}",
+                              wall0, time.time(), bytes=size)
             os.unlink(staged)
         except (FileNotFoundError, OSError):
             try:
@@ -730,6 +741,7 @@ class SharedObjectStore:
                         raise OSError("spill file truncated mid-restore")
                     pos += n
 
+            wall0 = time.time()
             t0 = time.monotonic()
             try:
                 _parallel_io(size, chunk, read_range,
@@ -739,6 +751,8 @@ class SharedObjectStore:
                 self.abort(oid)
                 raise
             _bump_io_stats("restore", size, time.monotonic() - t0)
+            record_lane_event("restore", f"restore {oid.hex()[:12]}",
+                              wall0, time.time(), bytes=size)
             buf.release()
             try:
                 self.seal(oid)

@@ -30,9 +30,11 @@ import asyncio
 import os
 import socket
 import struct
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .ids import ObjectID
+from ..util.tracing import record_lane_event
 
 _REQ_LEN = struct.Struct("<I")
 _RESP = struct.Struct("<QQ")   # (total object size, this payload length)
@@ -275,6 +277,7 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
     so its own pullers can stream behind this pull. Returns the object
     size, or None when the holder no longer has it. Raises on transport
     failure (the caller owns retry/fallback policy)."""
+    pull_t0 = time.time()
     first = _Stream(address)
     await first.connect()
     buf = None
@@ -299,6 +302,9 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
             buf.release()
             buf = None
             seal()
+            record_lane_event("transfer", f"pull {oid.hex()[:12]}",
+                              pull_t0, time.time(),
+                              bytes=total, source=address)
             return total
         # fan the remaining range over parallel streams: stream i takes
         # chunks i, i+K, i+2K... — ranges interleave so every stream
@@ -373,6 +379,9 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
         buf.release()
         buf = None
         seal()
+        record_lane_event("transfer", f"pull {oid.hex()[:12]}",
+                          pull_t0, time.time(),
+                          bytes=total, source=address, streams=n_streams)
         return total
     except BaseException:
         # sibling streams must stop WRITING and drop their buffer views

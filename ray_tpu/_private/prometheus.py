@@ -85,23 +85,50 @@ def render(entries: Iterable[Dict[str, Any]]) -> str:
         ptype = {"counter": "counter", "gauge": "gauge",
                  "histogram": "histogram"}.get(kind, "untyped")
         lines.append(f"# TYPE {pname} {ptype}")
-        for e in items:
-            tags = dict(e.get("tags") or {})
-            if kind == "histogram":
+        if kind == "histogram":
+            # Prometheus requires buckets in ascending `le` order with
+            # +Inf last, per series; the table hands rows back in
+            # insertion order, which interleaves series and sorts "10"
+            # before "2" lexically. Partition then sort numerically.
+            buckets, sums, counts, strays = [], [], [], []
+            for e in items:
+                tags = dict(e.get("tags") or {})
                 stat = tags.pop("__stat__", None)
                 if stat == "sum":
-                    lines.append(f"{pname}_sum{_fmt_labels(tags)} "
-                                 f"{_fmt_value(e['value'])}")
+                    sums.append((tags, e["value"]))
                 elif stat == "count":
-                    lines.append(f"{pname}_count{_fmt_labels(tags)} "
-                                 f"{_fmt_value(e['value'])}")
+                    counts.append((tags, e["value"]))
                 elif "le" in tags:
-                    lines.append(f"{pname}_bucket{_fmt_labels(tags)} "
-                                 f"{_fmt_value(e['value'])}")
-                else:  # stray histogram row: emit as untyped sample
-                    lines.append(f"{pname}{_fmt_labels(tags)} "
-                                 f"{_fmt_value(e['value'])}")
-            else:
+                    buckets.append((tags, e["value"]))
+                else:
+                    strays.append((tags, e["value"]))
+
+            def _le_key(pair):
+                tags, _ = pair
+                le = tags["le"]
+                series = sorted((k, v) for k, v in tags.items()
+                                if k != "le")
+                try:
+                    bound = float("inf") if le == "+Inf" else float(le)
+                except ValueError:
+                    bound = float("inf")
+                return (series, bound)
+
+            for tags, value in sorted(buckets, key=_le_key):
+                lines.append(f"{pname}_bucket{_fmt_labels(tags)} "
+                             f"{_fmt_value(value)}")
+            for tags, value in sums:
+                lines.append(f"{pname}_sum{_fmt_labels(tags)} "
+                             f"{_fmt_value(value)}")
+            for tags, value in counts:
+                lines.append(f"{pname}_count{_fmt_labels(tags)} "
+                             f"{_fmt_value(value)}")
+            for tags, value in strays:  # emit as untyped samples
+                lines.append(f"{pname}{_fmt_labels(tags)} "
+                             f"{_fmt_value(value)}")
+        else:
+            for e in items:
+                tags = dict(e.get("tags") or {})
                 lines.append(f"{pname}{_fmt_labels(tags)} "
                              f"{_fmt_value(e['value'])}")
     return "\n".join(lines) + ("\n" if lines else "")

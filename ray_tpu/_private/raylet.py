@@ -270,6 +270,9 @@ class Raylet:
         # TPU_VISIBLE_CHIPS isolation + GPU fractional semantics)
         self._chip_used: List[float] = \
             [0.0] * int(self.resources.total.get("TPU", 0))
+        # smoothed NTP-style estimate of (GCS clock - local clock);
+        # None until the first clock-sync round completes
+        self._clock_offset: Optional[float] = None
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -336,6 +339,43 @@ class Raylet:
                 self._spawn_worker()
         if self.cfg.memory_monitor_refresh_ms > 0:
             asyncio.ensure_future(self._memory_monitor_loop())
+        if self.cfg.clock_sync_interval_s > 0:
+            asyncio.ensure_future(self._clock_sync_loop())
+
+    async def _clock_sync_loop(self):
+        """Estimate this node's clock offset against the GCS clock by
+        piggybacking on the ping RPC (NTP-style: offset = remote_time -
+        local round-trip midpoint), EMA-smoothed so one congested RTT
+        doesn't yank the whole node's timeline. The GCS stores it on the
+        node table; timeline assembly applies it so per-node timestamps
+        compose cluster-wide (corrected = local_ts + offset)."""
+        period = self.cfg.clock_sync_interval_s
+        # first few rounds run quickly so a fresh node's timestamps are
+        # correctable almost immediately, then settle to the period
+        warmup = 3
+        while True:
+            try:
+                t0 = time.time()
+                reply = await self.gcs.call("ping", {}, timeout=5)
+                t1 = time.time()
+                sample = reply["time"] - (t0 + t1) / 2.0
+                if self._clock_offset is None:
+                    self._clock_offset = sample
+                else:
+                    self._clock_offset = (0.8 * self._clock_offset
+                                          + 0.2 * sample)
+                await self.gcs.call("report_clock_offset", {
+                    "node_id": self.node_id,
+                    "offset": self._clock_offset,
+                    "rtt": t1 - t0,
+                })
+            except Exception:
+                pass  # next round reconnects/retries
+            if warmup > 0:
+                warmup -= 1
+                await asyncio.sleep(min(1.0, period))
+            else:
+                await asyncio.sleep(period)
 
     async def _on_gcs_reconnect(self):
         """A restarted GCS lost every per-connection subscription (and,
@@ -360,6 +400,12 @@ class Raylet:
                 {"channels": (["node", "object"] if self.syncer is not None
                               else ["resources", "node", "object"])})
             await self._report_resources()
+            if self._clock_offset is not None:
+                # a cold-journal GCS restart lost the node table entry's
+                # offset: re-seed it so timelines stay correctable
+                await self.gcs.call("report_clock_offset", {
+                    "node_id": self.node_id,
+                    "offset": self._clock_offset, "rtt": 0.0})
         except Exception:
             pass  # next retrying call reconnects and refires this hook
 

@@ -245,6 +245,10 @@ class TaskExecutor:
     # -------------------------------------------------------- result sealing
     def _ok_reply(self, spec: TaskSpec, values: Any) -> dict:
         results, sealed = self._seal_results(spec, values)
+        if not spec.is_actor_task():
+            # actor calls don't flow through the task table (no SUBMITTED
+            # record exists for them) — don't create orphan records
+            self.core._record_transition(spec.task_id, "OUTPUT_SEALED")
         return {"results": results, "sealed": sealed, "error": None}
 
     def _seal_results(self, spec: TaskSpec, values: Any) -> tuple:
@@ -321,9 +325,11 @@ class TaskExecutor:
         try:
             self._ensure_runtime_env(spec)
             func = self.core.load_function(spec.function.blob_id)
+            self.core._record_transition(spec.task_id, "PENDING_ARGS_FETCH")
             args, kwargs = self._resolve_args(spec)
             self.core.set_task_context(spec.task_id)
             self._register_running(spec.task_id)
+            self.core._record_transition(spec.task_id, "RUNNING")
             try:
                 with _maybe_span(spec):
                     if spec.runtime_env and spec.runtime_env.get(
@@ -387,9 +393,12 @@ class TaskExecutor:
             try:
                 self._ensure_runtime_env(spec)
                 func = self.core.load_function(spec.function.blob_id)
+                self.core._record_transition(spec.task_id,
+                                             "PENDING_ARGS_FETCH")
                 args, kwargs = self._resolve_args(spec)
                 self.core.set_task_context(spec.task_id)
                 self._register_running(spec.task_id)
+                self.core._record_transition(spec.task_id, "RUNNING")
                 try:
                     out = func(*args, **kwargs)
                     items = out if inspect.isgenerator(out) else iter([out])
@@ -601,6 +610,11 @@ async def _amain():
 
     async def handle_push_task(payload, conn):
         spec: TaskSpec = cloudpickle.loads(payload)
+        if not spec.actor_creation and not spec.is_actor_task():
+            # worker-start mark: transitions-only (never the top-level
+            # `state` field — a flush race with the owner's terminal
+            # event must not clobber FINISHED/FAILED)
+            core._record_transition(spec.task_id, "WORKER_STARTED")
         if spec.actor_creation:
             core.job_id = spec.job_id
             core.current_task_id = spec.task_id

@@ -176,8 +176,21 @@ def shutdown() -> None:
     """Tear the runtime down. Best-effort and idempotent (ref: ray.shutdown):
     globals are cleared FIRST so a failure mid-teardown can never strand a
     half-dead core that makes the next init() refuse to run."""
+    import sys
+
     global _node, _core
     with _lock:
+        if _core is not None:
+            # reap live streaming_split coordinators NOW, while the RPC
+            # plane is still up — leaving them to __del__ at interpreter
+            # exit used to hang the process (the finalizer's kill() hit
+            # auto-init, which cannot start threads during finalization)
+            dataset_mod = sys.modules.get("ray_tpu.data.dataset")
+            if dataset_mod is not None:
+                try:
+                    dataset_mod._reap_split_groups()
+                except Exception:
+                    pass
         core, node = _core, _node
         _core = None
         _node = None

@@ -56,6 +56,7 @@ _UI_HTML = """<!doctype html>
  <section><h2>Task timeline</h2>
   <div style="margin-bottom:6px"><a href="/api/timeline" download="timeline.json">
    download chrome-trace JSON</a> (open in Perfetto)</div>
+  <div id="phases" style="margin-bottom:8px"></div>
   <div id="timeline"></div></section>
  <section><h2>Worker logs</h2>
   <select id="lognode"></select> <select id="logfile"></select>
@@ -107,13 +108,27 @@ async function refresh(){try{
   'updated '+new Date().toLocaleTimeString();
 }catch(e){document.getElementById('status').textContent='error: '+e;}}
 async function refreshTimeline(){try{
- const tl=await j('/api/timeline');
+ const s=await j('/api/summary');
+ const ph=s.phases||{};
+ document.getElementById('phases').innerHTML=table([{
+  tasks:s.tasks_with_transitions||0,
+  wall_s:(s.wall_time_s||0).toFixed(3),
+  scheduling_s:(ph.scheduling||0).toFixed(3),
+  dep_fetch_s:(ph.dep_fetch||0).toFixed(3),
+  execution_s:(ph.execution||0).toFixed(3),
+  transfer_s:(ph.transfer||0).toFixed(3)}]);
+ let tl=await j('/api/timeline');
+ // duration slices only: metadata (ph M) and flow (s/f) records carry
+ // no ts/dur and would render as NaN rows
+ tl=tl.filter(e=>e.ph==='X');
  tl.sort((a,b)=>b.ts-a.ts);
- document.getElementById('timeline').innerHTML=table(tl.slice(0,40).map(e=>({
+ document.getElementById('timeline').innerHTML=table(tl.slice(0,60).map(e=>({
   task:e.name,start:new Date(e.ts/1000).toLocaleTimeString(),
-  dur_ms:(e.dur/1000).toFixed(1),state:e.args&&e.args.state||'',
+  dur_ms:(e.dur/1000).toFixed(1),
+  node:e.args&&e.args.node||'',worker:e.args&&e.args.worker||'',
+  phase:e.args&&e.args.phase||'',state:e.args&&e.args.state||'',
   error:e.args&&e.args.error||''})),
-  ['task','start','dur_ms','state','error']);
+  ['task','start','dur_ms','node','worker','phase','state','error']);
 }catch(e){}}
 async function refreshLogs(){try{
  const nodes=await j('/api/nodes');
@@ -197,6 +212,9 @@ def _routes():
 
         return _json(tracing.timeline())
 
+    async def api_summary(_req):
+        return _json(state_api.summarize_tasks(breakdown=True))
+
     async def api_logs(req):
         node = req.query.get("node_id") or None
         return _json(state_api.list_logs(node))
@@ -230,6 +248,7 @@ def _routes():
     app.router.add_get("/api/events", api_events)
     app.router.add_get("/api/cluster_status", api_cluster_status)
     app.router.add_get("/api/timeline", api_timeline)
+    app.router.add_get("/api/summary", api_summary)
     app.router.add_get("/api/logs", api_logs)
     app.router.add_get("/api/logs/tail", api_log_tail)
     return app

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
@@ -656,18 +657,51 @@ class _SplitGroup:
     """Driver-side lifetime anchor for a SplitCoordinator actor: when the
     driver's iterators are garbage-collected, the coordinator (which holds
     CPU resources for the whole execution) is killed rather than leaked.
-    The coordinator also self-exits once every split drains."""
+    The coordinator also self-exits once every split drains.
+
+    Live groups register in a WeakSet so shutdown() can reap their
+    coordinators deterministically. The finalizer alone cannot be trusted
+    with this: a group collected during interpreter finalization used to
+    re-enter the worker API, whose auto-init then tried to START a fresh
+    cluster — Thread.start() wedges forever at that point, hanging the
+    interpreter on exit."""
 
     def __init__(self, coordinator):
         self._coordinator = coordinator
+        _live_split_groups.add(self)
 
-    def __del__(self):
+    def close(self) -> None:
+        """Kill the coordinator (idempotent, best-effort). Only acts
+        while the runtime is up — never triggers auto-init."""
+        coordinator, self._coordinator = self._coordinator, None
+        if coordinator is None:
+            return
         try:
-            from .. import kill
+            from .. import _worker_api
 
-            kill(self._coordinator)
+            if _worker_api.is_initialized():
+                _worker_api.kill(coordinator)
         except Exception:
             pass
+
+    # is_finalizing bound at class-creation: an `import sys` inside the
+    # finalizer itself raises once interpreter teardown begins
+    def __del__(self, _is_finalizing=__import__("sys").is_finalizing):
+        if _is_finalizing():
+            return  # too late to RPC; the raylet reaps the actor
+        self.close()
+
+
+# weak registry of groups whose coordinator is still alive —
+# _worker_api.shutdown() reaps these before tearing the runtime down
+_live_split_groups: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _reap_split_groups() -> None:
+    """Kill every live split coordinator (called by shutdown, while the
+    runtime can still RPC)."""
+    for group in list(_live_split_groups):
+        group.close()
 
 
 class DataIterator:

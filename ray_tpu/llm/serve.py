@@ -90,6 +90,44 @@ class LLMServer:
             self.tokenizer = AutoTokenizer.from_pretrained(tokenizer)
         self._queues: Dict[str, asyncio.Queue] = {}
         self._pump_task: Optional[asyncio.Task] = None
+        # serving metrics (ref: vLLM's engine stat logger — TTFT/TPOT
+        # histograms, scheduler-state and cache-hit gauges), exported
+        # through the util.metrics -> GCS -> /metrics pipeline
+        from ..util import metrics
+
+        tags = {"model": self.model_name}
+        self._m_ttft = metrics.Histogram(
+            "llm_ttft_seconds", "Time to first token per request",
+            boundaries=metrics.LATENCY_BUCKETS,
+            tag_keys=("model",)).set_default_tags(tags)
+        self._m_tpot = metrics.Histogram(
+            "llm_tpot_seconds", "Time per output token (decode) "
+            "per request", boundaries=metrics.LATENCY_BUCKETS,
+            tag_keys=("model",)).set_default_tags(tags)
+        self._m_e2e = metrics.Histogram(
+            "llm_request_e2e_seconds", "Arrival-to-finish request latency",
+            boundaries=metrics.LATENCY_BUCKETS,
+            tag_keys=("model",)).set_default_tags(tags)
+        self._m_queue = metrics.Gauge(
+            "llm_queue_depth", "Requests waiting for a decode slot",
+            tag_keys=("model",)).set_default_tags(tags)
+        self._m_occupancy = metrics.Gauge(
+            "llm_batch_slot_occupancy",
+            "Fraction of decode slots running (continuous batching)",
+            tag_keys=("model",)).set_default_tags(tags)
+        self._m_kv_util = metrics.Gauge(
+            "llm_kv_page_utilization", "Fraction of KV-cache pages in use",
+            tag_keys=("model",)).set_default_tags(tags)
+        self._m_cache_hit = metrics.Counter(
+            "llm_prefix_cache_hit_tokens_total",
+            "Prompt tokens served from the prefix cache",
+            tag_keys=("model",)).set_default_tags(tags)
+        self._m_prompt = metrics.Counter(
+            "llm_prompt_tokens_total", "Prompt tokens received",
+            tag_keys=("model",)).set_default_tags(tags)
+        self._m_generated = metrics.Counter(
+            "llm_generation_tokens_total", "Tokens generated",
+            tag_keys=("model",)).set_default_tags(tags)
 
     # --- engine pump: one thread-hop per step, fan-out to request queues ---
 
@@ -99,6 +137,8 @@ class LLMServer:
                 self._pump())
 
     async def _pump(self) -> None:
+        import time
+
         loop = asyncio.get_event_loop()
         while self.engine.has_unfinished():
             outs = await loop.run_in_executor(None, self.engine.step)
@@ -111,14 +151,49 @@ class LLMServer:
                     # the engine's state so a long-lived replica doesn't
                     # accumulate every past request
                     self._queues.pop(out.request_id, None)
-                    self.engine.requests.pop(out.request_id, None)
+                    state = self.engine.requests.pop(out.request_id, None)
+                    if state is not None:
+                        self._observe_finished(state,
+                                               time.perf_counter())
+            stats = self.engine.stats()
+            self._m_queue.set(stats["waiting"])
+            self._m_occupancy.set(
+                stats["running"] / max(1, self.engine.ecfg.max_num_seqs))
+            self._m_kv_util.set(
+                1.0 - stats["free_pages"] / max(1, stats["total_pages"]))
             if not outs:
                 await asyncio.sleep(0.002)
+
+    def _observe_finished(self, state, now: float) -> None:
+        """Fold one finished request into the latency histograms.
+        Timestamps are engine-side perf_counter marks (RequestState
+        arrival_t / first_token_t), so TTFT includes queueing."""
+        tags = ({"model": state.model_id} if state.model_id else None)
+        n_out = len(state.output)
+        if state.first_token_t:
+            self._m_ttft.observe(state.first_token_t - state.arrival_t,
+                                 tags)
+            if n_out > 1:
+                self._m_tpot.observe(
+                    (now - state.first_token_t) / (n_out - 1), tags)
+        self._m_e2e.observe(now - state.arrival_t, tags)
+        if state.cached_tokens:
+            self._m_cache_hit.inc(state.cached_tokens, tags)
+        self._m_prompt.inc(len(state.prompt), tags)
+        if n_out:
+            self._m_generated.inc(n_out, tags)
 
     async def _submit(self, prompt_ids: List[int],
                       params: SamplingParams,
                       model_id: Optional[str] = None) -> asyncio.Queue:
+        from ..serve.replica import current_request_id
+
+        rid_in = current_request_id()
+        if rid_in and (rid_in in self._queues
+                       or rid_in in self.engine.requests):
+            rid_in = None  # client reused an id mid-flight: don't collide
         rid = self.engine.add_request(prompt_ids, params,
+                                      request_id=rid_in,
                                       model_id=model_id)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q

@@ -24,7 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..util.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import apply_rotary, attention, ring_attention, rms_norm, rope_frequencies

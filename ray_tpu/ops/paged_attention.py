@@ -40,6 +40,10 @@ try:  # pragma: no cover - exercised on TPU builds
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
     pltpu = None
+else:
+    if not hasattr(pltpu, "CompilerParams"):
+        # pre-rename jax spells it TPUCompilerParams
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
 
 _NEG_INF = -1e30
 

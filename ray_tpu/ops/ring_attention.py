@@ -18,6 +18,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..util.jax_compat import axis_size
+
 from .attention import NEG_INF
 
 
@@ -32,7 +34,7 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
     """
     b, sq, hq, d = q.shape
     skv = k.shape[1]
-    ring = jax.lax.axis_size(axis)
+    ring = axis_size(axis)
     rank = jax.lax.axis_index(axis)
     scale_ = scale if scale is not None else d ** -0.5
 

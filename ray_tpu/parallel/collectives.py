@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..util.jax_compat import axis_size, shard_map
 
 AxisName = Union[str, tuple]
 
@@ -96,7 +96,7 @@ def send(x, axis: AxisName, *, shift: int = 1):
     device simultaneously sends and receives, riding neighbouring ICI
     links (ref: NCCL send at collective.py:541).
     """
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis, perm)
 

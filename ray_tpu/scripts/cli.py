@@ -333,6 +333,30 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_summary(args) -> int:
+    """Critical-path report: cluster task wall time attributed to
+    scheduling / dep-fetch / execution / transfer (from the flight
+    recorder's clock-corrected state transitions)."""
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(address=_resolve_address(args))
+    report = state_api.summarize_tasks(breakdown=True)
+    print("tasks by state:")
+    for st in sorted(report["states"]):
+        print(f"  {st:24s} {report['states'][st]}")
+    phases = report["phases"]
+    total = sum(phases.values())
+    print(f"phase breakdown ({report['tasks_with_transitions']} task(s) "
+          f"with transitions, {report['wall_time_s']:.3f}s wall):")
+    for ph in ("scheduling", "dep_fetch", "execution", "transfer", "other"):
+        v = phases.get(ph, 0.0)
+        pct = 100.0 * v / total if total > 0 else 0.0
+        print(f"  {ph:12s} {v:10.3f}s  {pct:5.1f}%")
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_memory(args) -> int:
     """Per-node store usage + per-lease resource holdings + object
     directory (ref: `ray memory` — the leak-hunting view)."""
@@ -452,6 +476,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("summary",
+                        help="critical-path report: wall time by "
+                             "scheduling/dep-fetch/execution/transfer")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_summary)
 
     sp = sub.add_parser("memory",
                         help="store usage, leases, object directory")

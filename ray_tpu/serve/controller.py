@@ -102,7 +102,7 @@ class ServeController:
             max_restarts=3,
             **actor_opts,
         ).remote(dep["cls_blob"], dep["init_args_blob"],
-                 config.get("max_ongoing_requests", 100))
+                 config.get("max_ongoing_requests", 100), dep["name"])
         return handle
 
     async def _stop_replica(self, handle) -> None:

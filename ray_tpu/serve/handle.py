@@ -136,16 +136,18 @@ class DeploymentHandle:
         """Route one request; returns the ObjectRef of the replica call."""
         return self.route(*args, **kwargs)[0]
 
-    def route(self, *args, **kwargs):
+    def route(self, *args, request_id: Optional[str] = None, **kwargs):
         """Route one request, returning (ref, replica handle). The replica
         is exposed for stream follow-ups that must stay pinned to the
-        replica holding the stream state."""
+        replica holding the stream state. ``request_id`` (proxy-minted or
+        caller-supplied) rides to the replica for telemetry propagation —
+        it is NOT forwarded to the user callable's kwargs."""
         self._refresh()
         replica = self._pick()
         with self._lock:
             self._ongoing[replica._actor_id] = \
                 self._ongoing.get(replica._actor_id, 0) + 1
-        ref = replica.handle.remote(self._method, args, kwargs)
+        ref = replica.handle.remote(self._method, args, kwargs, request_id)
 
         def _done(_):
             with self._lock:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import uuid
 from typing import Dict
 
 from .replica import _STREAM_END
@@ -19,6 +20,13 @@ class ProxyActor:
         self._handles: Dict[str, "DeploymentHandle"] = {}
         self._runner = None
         self._port = None
+        from ..util import metrics
+
+        self._m_http = metrics.Histogram(
+            "serve_http_request_seconds",
+            "Proxy-side HTTP request latency by deployment/status",
+            boundaries=metrics.LATENCY_BUCKETS,
+            tag_keys=("deployment", "status"))
 
     def _handle_for(self, name: str):
         from .handle import DeploymentHandle
@@ -32,7 +40,20 @@ class ProxyActor:
         from aiohttp import web
 
         async def dispatch(request: "web.Request") -> "web.StreamResponse":
+            import time
+
             name = request.match_info["deployment"]
+            # request id: honor a caller-supplied X-Request-ID, else mint
+            # one; it rides handle.route -> replica -> user callable and
+            # is echoed back so clients can correlate traces
+            rid = request.headers.get("X-Request-ID") or uuid.uuid4().hex
+            rid_hdr = {"X-Request-ID": rid}
+            start = time.time()
+
+            def _observe(status: int):
+                self._m_http.observe(time.time() - start, tags={
+                    "deployment": name, "status": str(status)})
+
             try:
                 if request.can_read_body:
                     body = await request.read()
@@ -41,15 +62,23 @@ class ProxyActor:
                     payload = dict(request.query) or None
                 handle = self._handle_for(name)
                 args = () if payload is None else (payload,)
-                result, replica = await self._route(handle, args)
+                result, replica = await self._route(handle, args, rid)
             except ValueError as e:
-                return web.json_response({"error": str(e)}, status=404)
+                _observe(404)
+                return web.json_response({"error": str(e)}, status=404,
+                                         headers=rid_hdr)
             except Exception as e:  # noqa: BLE001
-                return web.json_response({"error": repr(e)}, status=500)
+                _observe(500)
+                return web.json_response({"error": repr(e)}, status=500,
+                                         headers=rid_hdr)
             if isinstance(result, dict) and "__stream__" in result:
-                return await self._stream_response(
-                    request, replica, result["__stream__"])
-            return web.json_response({"result": result})
+                response = await self._stream_response(
+                    request, replica, result["__stream__"],
+                    headers=rid_hdr)
+                _observe(200)
+                return response
+            _observe(200)
+            return web.json_response({"result": result}, headers=rid_hdr)
 
         app = web.Application()
         app.router.add_route("*", "/{deployment}", dispatch)
@@ -61,18 +90,21 @@ class ProxyActor:
         self._port = site._server.sockets[0].getsockname()[1]
         return self._port
 
-    async def _route(self, handle, args):
+    async def _route(self, handle, args, request_id=None):
         ref, replica = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: handle.route(*args))
+            None, lambda: handle.route(*args, request_id=request_id))
         return await ref, replica
 
-    async def _stream_response(self, request, replica, stream_id: int):
+    async def _stream_response(self, request, replica, stream_id: int,
+                               headers=None):
         """Chunked transfer of a replica's async-generator output (the
         streamed-tokens path, ref: proxy.py streaming responses). Pinned to
         the replica holding the stream state."""
         from aiohttp import web
 
         response = web.StreamResponse()
+        for key, value in (headers or {}).items():
+            response.headers[key] = value
         response.headers["Content-Type"] = "text/plain; charset=utf-8"
         await response.prepare(request)
         finished = False

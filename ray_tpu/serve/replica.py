@@ -11,31 +11,69 @@ responses)."""
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import itertools
+import time
 from typing import Any, Dict, Optional
 
 import cloudpickle
 
 _STREAM_END = "__serve_stream_end__"
 
+# Request-id propagation (ref: serve's RequestContext): the proxy mints
+# an id per HTTP request and it rides handle.route -> Replica.handle,
+# which exposes it here so user callables (e.g. LLMServer) can stamp
+# downstream work — engine request ids, spans, logs.
+_request_id: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_request_id", default=None)
+
+
+def current_request_id() -> Optional[str]:
+    """The serve request id of the request being handled, or None when
+    called outside a replica request."""
+    return _request_id.get()
+
 
 class Replica:
     def __init__(self, cls_blob: bytes, init_args_blob: bytes,
-                 max_ongoing_requests: int):
+                 max_ongoing_requests: int, deployment_name: str = ""):
         cls = cloudpickle.loads(cls_blob)
         args, kwargs = cloudpickle.loads(init_args_blob)
         self.user = cls(*args, **kwargs)
         self.max_ongoing = max_ongoing_requests
+        self.deployment_name = deployment_name
         self._sem = asyncio.Semaphore(max_ongoing_requests)
         self._ongoing = 0
         self._streams: Dict[int, Any] = {}
         self._stream_ids = itertools.count(1)
+        # serving metrics (ref: serve_deployment_processing_latency_ms /
+        # serve_replica_queued_queries in serve's metric set)
+        from ..util import metrics
 
-    async def handle(self, method_name: str, args: tuple, kwargs: dict):
+        tags = {"deployment": deployment_name or "?"}
+        self._m_e2e = metrics.Histogram(
+            "serve_request_e2e_seconds",
+            "End-to-end replica request latency by deployment/method",
+            boundaries=metrics.LATENCY_BUCKETS,
+            tag_keys=("deployment", "method")).set_default_tags(tags)
+        self._m_queue = metrics.Gauge(
+            "serve_replica_queue_depth",
+            "Requests admitted and executing on this replica",
+            tag_keys=("deployment",)).set_default_tags(tags)
+        self._m_errors = metrics.Counter(
+            "serve_request_errors_total",
+            "Replica requests that raised, by deployment/method",
+            tag_keys=("deployment", "method")).set_default_tags(tags)
+
+    async def handle(self, method_name: str, args: tuple, kwargs: dict,
+                     request_id: Optional[str] = None):
         """One request. Returns the call result, or {"__stream__": id} when
         the user callable produced an async generator."""
         async with self._sem:
             self._ongoing += 1
+            self._m_queue.set(self._ongoing)
+            token = _request_id.set(request_id)
+            start = time.time()
             try:
                 # resolve the bound method — iscoroutinefunction(instance)
                 # is False even when the instance's __call__ is async
@@ -44,8 +82,11 @@ class Replica:
                     result = await target(*args, **kwargs)
                 else:
                     loop = asyncio.get_event_loop()
+                    # executor threads don't inherit contextvars; carry
+                    # the request context across explicitly
+                    ctx = contextvars.copy_context()
                     result = await loop.run_in_executor(
-                        None, lambda: target(*args, **kwargs))
+                        None, lambda: ctx.run(target, *args, **kwargs))
                     if asyncio.iscoroutine(result):
                         result = await result
                 if hasattr(result, "__anext__"):
@@ -53,8 +94,21 @@ class Replica:
                     self._streams[stream_id] = result
                     return {"__stream__": stream_id}
                 return result
+            except BaseException:
+                self._m_errors.inc(tags={"method": method_name})
+                raise
             finally:
+                end = time.time()
+                self._m_e2e.observe(end - start,
+                                    tags={"method": method_name})
+                from ..util.tracing import record_lane_event
+
+                record_lane_event(
+                    "serve", f"{self.deployment_name}.{method_name}",
+                    start, end, request_id=request_id or "")
+                _request_id.reset(token)
                 self._ongoing -= 1
+                self._m_queue.set(self._ongoing)
 
     async def next_chunk(self, stream_id: int):
         """Advance a response stream (ref: handle_request_streaming — here

@@ -1,0 +1,32 @@
+"""Version shims for jax APIs the codebase targets (ref: the
+jax.shard_map promotion out of jax.experimental).
+
+The code is written against the modern surface (`jax.shard_map` with
+``check_vma=``); on older jax the experimental entry point is wrapped so
+call sites stay version-agnostic."""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-promotion jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f=None, /, **kwargs):
+        # the experimental signature predates the check_vma rename
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return lambda g: _shard_map_exp(g, **kwargs)
+        return _shard_map_exp(f, **kwargs)
+
+import jax as _jax
+
+if hasattr(_jax.lax, "axis_size"):
+    axis_size = _jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        # pre-axis_size jax: the size of a mapped axis is psum(1)
+        return _jax.lax.psum(1, axis_name)
+
+__all__ = ["shard_map", "axis_size"]

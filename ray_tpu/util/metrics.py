@@ -16,6 +16,13 @@ from typing import Dict, List, Optional, Tuple
 
 _FLUSH_PERIOD_S = 2.0
 
+# Latency-histogram preset (ref: prometheus client default buckets,
+# extended down to sub-ms): request latencies span cache-hit TTFTs well
+# under a millisecond to multi-second generations — the Histogram
+# default boundaries (decades up to 1000) are far too coarse for them.
+LATENCY_BUCKETS = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0]
+
 _registry_lock = threading.Lock()
 _registry: List["_Metric"] = []
 _flusher_started = False

@@ -13,13 +13,22 @@ def _core():
     return _worker_api.core()
 
 
+def _hexid(v) -> str:
+    """Render an ID-ish value as hex; tolerate the string ids some
+    raylet-side synthetic events carry (e.g. oom_kill_*)."""
+    if v is None:
+        return ""
+    return v.hex() if hasattr(v, "hex") else str(v)
+
+
 def list_nodes() -> List[Dict[str, Any]]:
     core = _core()
     infos = core.io.run(core.gcs.call("get_all_nodes", {}))
     return [
         {"node_id": n.node_id.hex(), "state": "ALIVE" if n.alive else "DEAD",
          "address": n.address, "resources_total": n.resources_total,
-         "resources_available": n.resources_available, "labels": n.labels}
+         "resources_available": n.resources_available, "labels": n.labels,
+         "clock_offset": getattr(n, "clock_offset", 0.0)}
         for n in infos
     ]
 
@@ -56,9 +65,12 @@ def list_tasks(*, state: Optional[str] = None) -> List[Dict[str, Any]]:
     core = _core()
     events = core.io.run(core.gcs.call("list_task_events", {}))
     out = [
-        {"task_id": e["task_id"].hex(), "name": e["name"],
+        {"task_id": _hexid(e["task_id"]), "name": e["name"],
          "state": e["state"], "start_time": e["start_time"],
-         "end_time": e["end_time"], "error": e.get("error", "")}
+         "end_time": e["end_time"], "error": e.get("error", ""),
+         "node_id": _hexid(e.get("node_id", "")),
+         "worker_id": _hexid(e.get("worker_id", "")),
+         "state_transitions": e.get("state_transitions", [])}
         for e in events
     ]
     if state is not None:
@@ -76,11 +88,88 @@ def list_objects() -> List[Dict[str, Any]]:
     ]
 
 
-def summarize_tasks() -> Dict[str, int]:
+# Canonical lifecycle order (flight recorder). Transitions sort by this
+# rank first, timestamp second, so a skewed clock cannot reorder the
+# logical state machine.
+LIFECYCLE_ORDER = (
+    "SUBMITTED", "PENDING_NODE_ASSIGNMENT", "SUBMITTED_TO_WORKER",
+    "WORKER_STARTED", "PENDING_ARGS_FETCH", "RUNNING", "OUTPUT_SEALED",
+    "FINISHED", "FAILED",
+)
+_STATE_RANK = {s: i for i, s in enumerate(LIFECYCLE_ORDER)}
+# FINISHED and FAILED are alternatives at the same terminal rank
+_STATE_RANK["FAILED"] = _STATE_RANK["FINISHED"]
+
+# Wall-time attribution: the interval ENDING at a state belongs to the
+# phase that interval spent its time in. Worker setup (dispatch, env,
+# function load) counts as scheduling; PENDING_ARGS_FETCH->RUNNING is
+# the dependency wait; OUTPUT_SEALED->terminal is reply/result transfer.
+PHASE_OF_DEST = {
+    "PENDING_NODE_ASSIGNMENT": "scheduling",
+    "SUBMITTED_TO_WORKER": "scheduling",
+    "WORKER_STARTED": "scheduling",
+    "PENDING_ARGS_FETCH": "scheduling",
+    "RUNNING": "dep_fetch",
+    "OUTPUT_SEALED": "execution",
+    "FINISHED": "transfer",
+    "FAILED": "transfer",
+}
+
+
+def clock_offsets() -> Dict[str, float]:
+    """Per-node clock offsets from the GCS node table (raylet clock-sync
+    loop): node_id hex -> seconds to ADD to that node's timestamps."""
+    try:
+        return {n["node_id"]: float(n.get("clock_offset") or 0.0)
+                for n in list_nodes()}
+    except Exception:
+        return {}
+
+
+def corrected_transitions(task: Dict[str, Any],
+                          offsets: Dict[str, float]) -> List[Dict[str, Any]]:
+    """A task's state_transitions with per-node clock offsets applied,
+    ordered canonically (lifecycle rank, then corrected timestamp)."""
+    out = []
+    for tr in task.get("state_transitions") or []:
+        st, ts = tr.get("state"), tr.get("ts")
+        if st is None or ts is None:
+            continue
+        node = tr.get("node_id", "") or ""
+        out.append({"state": st, "ts": ts + offsets.get(node, 0.0),
+                    "node_id": node})
+    out.sort(key=lambda t: (_STATE_RANK.get(t["state"], 99), t["ts"]))
+    return out
+
+
+def summarize_tasks(breakdown: bool = False):
+    """State -> count summary (default), or — with ``breakdown=True`` —
+    the critical-path report: cluster wall time attributed to
+    scheduling / dep-fetch / execution / transfer from clock-corrected
+    state transitions."""
     counts: Dict[str, int] = {}
-    for task in list_tasks():
+    tasks = list_tasks()
+    for task in tasks:
         counts[task["state"]] = counts.get(task["state"], 0) + 1
-    return counts
+    if not breakdown:
+        return counts
+    offsets = clock_offsets()
+    phases: Dict[str, float] = {"scheduling": 0.0, "dep_fetch": 0.0,
+                                "execution": 0.0, "transfer": 0.0,
+                                "other": 0.0}
+    wall = 0.0
+    covered = 0
+    for task in tasks:
+        trs = corrected_transitions(task, offsets)
+        if len(trs) < 2:
+            continue
+        covered += 1
+        wall += trs[-1]["ts"] - trs[0]["ts"]
+        for a, b in zip(trs, trs[1:]):
+            dur = max(0.0, b["ts"] - a["ts"])
+            phases[PHASE_OF_DEST.get(b["state"], "other")] += dur
+    return {"states": counts, "phases": phases,
+            "tasks_with_transitions": covered, "wall_time_s": wall}
 
 
 def get_metrics(name: Optional[str] = None) -> List[Dict[str, Any]]:

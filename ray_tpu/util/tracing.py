@@ -136,29 +136,154 @@ def collect_spans() -> List[Dict[str, Any]]:
     return out
 
 
+def record_lane_event(lane: str, name: str, start: float, end: float,
+                      node_id: str = "", **args) -> None:
+    """Record one object-plane I/O interval (transfer/spill/restore) in
+    the span sink; timeline() renders these as per-process I/O lanes.
+    No-op unless tracing is enabled — zero cost on the data plane."""
+    if not tracing_enabled():
+        return
+    if not node_id:
+        try:
+            from .. import _worker_api
+
+            if _worker_api._core is not None:
+                node_id = _worker_api._core.node_id.hex()
+        except Exception:
+            node_id = ""
+    _emit_span({"kind": "lane", "lane": lane, "name": name,
+                "start": start, "end": end, "pid": os.getpid(),
+                "node_id": node_id, "args": args})
+
+
+# worker-side lifecycle states: slices for intervals ending in one of
+# these render on the executing worker's track, the rest on the owner's
+_WORKER_SIDE = ("WORKER_STARTED", "PENDING_ARGS_FETCH", "RUNNING",
+                "OUTPUT_SEALED", "FINISHED", "FAILED")
+
+
+class _TrackAllocator:
+    """Stable int pid/tid assignment + chrome metadata events. Perfetto
+    groups rows by process/thread; names ride ph:'M' records."""
+
+    def __init__(self):
+        self.pids: Dict[str, int] = {}
+        self.tids: Dict[tuple, int] = {}
+        self.meta: List[Dict[str, Any]] = []
+
+    def pid(self, node_hex: str, label: Optional[str] = None) -> int:
+        key = node_hex or "<unknown>"
+        if key not in self.pids:
+            self.pids[key] = len(self.pids) + 1
+            self.meta.append({
+                "name": "process_name", "ph": "M", "pid": self.pids[key],
+                "args": {"name": label or (f"node {key[:12]}" if node_hex
+                                           else "unknown node")}})
+        return self.pids[key]
+
+    def tid(self, pid: int, label: str) -> int:
+        key = (pid, label)
+        if key not in self.tids:
+            self.tids[key] = len(self.tids) + 1
+            self.meta.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": self.tids[key], "args": {"name": label}})
+        return self.tids[key]
+
+
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Export task events as a chrome://tracing / Perfetto JSON array
-    (ref: ray.timeline — dashboard's chrome-trace exporter). Rows group
-    by task name; each completed task becomes a duration event."""
+    """Export the cluster flight recorder as a chrome://tracing /
+    Perfetto JSON array (ref: ray.timeline — dashboard's chrome-trace
+    exporter). Per-node processes, per-worker threads; each completed
+    task renders as one whole-task slice plus one slice per lifecycle
+    phase (from the GCS state_transitions table, per-node clock offsets
+    applied), with a flow event linking submit (owner track) to execute
+    (worker track) across processes. Object-transfer/spill lane records
+    (record_lane_event, tracing-gated) render as per-process I/O rows."""
     from . import state as state_api
 
-    events = []
+    offsets = state_api.clock_offsets()
+    tracks = _TrackAllocator()
+    events: List[Dict[str, Any]] = []
     for task in state_api.list_tasks():
-        start, end = task["start_time"], task["end_time"]
-        if not start:
+        trs = state_api.corrected_transitions(task, offsets)
+        worker = task.get("worker_id") or ""
+        common = {"task_id": task["task_id"], "state": task["state"],
+                  **({"error": task["error"]} if task.get("error") else {})}
+        if len(trs) < 2:
+            # no recorded lifecycle (pre-transition record): fall back to
+            # the flat start/end slice
+            start, end = task.get("start_time"), task.get("end_time")
+            if not start:
+                continue
+            pid = tracks.pid(task.get("node_id") or "")
+            events.append({
+                "name": task["name"], "cat": "task", "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(((end or start) - start) * 1e6, 1.0),
+                "pid": pid, "tid": tracks.tid(pid, "tasks"),
+                "args": common})
             continue
-        event = {
-            "name": task["name"],
-            "cat": "task",
-            "ph": "X",                        # complete (duration) event
-            "ts": start * 1e6,                # chrome trace wants us
-            "dur": max(((end or start) - start) * 1e6, 1.0),
-            "pid": "ray_tpu",
-            "tid": task["name"],
-            "args": {"task_id": task["task_id"], "state": task["state"],
-                     **({"error": task["error"]} if task["error"] else {})},
-        }
-        events.append(event)
+        worker_trs = [t for t in trs if t["state"] in _WORKER_SIDE]
+        exec_node = (worker_trs[0]["node_id"] if worker_trs
+                     else (task.get("node_id") or ""))
+        exec_pid = tracks.pid(exec_node)
+        exec_tid = tracks.tid(
+            exec_pid, f"worker {worker[:12]}" if worker else "tasks")
+        owner_pid = tracks.pid(trs[0]["node_id"])
+        owner_tid = tracks.tid(owner_pid, "driver")
+        # whole-task slice on the executing worker's track (falls back to
+        # the full transition span when no worker-side marks exist)
+        span_trs = worker_trs if len(worker_trs) >= 2 else trs
+        events.append({
+            "name": task["name"], "cat": "task", "ph": "X",
+            "ts": span_trs[0]["ts"] * 1e6,
+            "dur": max((span_trs[-1]["ts"] - span_trs[0]["ts"]) * 1e6, 1.0),
+            "pid": exec_pid, "tid": exec_tid,
+            "args": {**common,
+                     "node": exec_node[:12], "worker": worker[:12]}})
+        # one slice per lifecycle phase interval
+        for a, b in zip(trs, trs[1:]):
+            phase = state_api.PHASE_OF_DEST.get(b["state"], "other")
+            on_worker = b["state"] in _WORKER_SIDE and worker_trs
+            pid = exec_pid if on_worker else owner_pid
+            tid = exec_tid if on_worker else owner_tid
+            events.append({
+                "name": f"{task['name']}:{b['state'].lower()}",
+                "cat": "phase", "ph": "X",
+                "ts": a["ts"] * 1e6,
+                "dur": max((b["ts"] - a["ts"]) * 1e6, 1.0),
+                "pid": pid, "tid": tid,
+                "args": {"task_id": task["task_id"], "phase": phase,
+                         "from": a["state"], "to": b["state"]}})
+        # flow event linking submit (owner) -> first worker-side mark
+        if worker_trs:
+            events.append({
+                "name": "submit", "cat": "flow", "ph": "s",
+                "id": task["task_id"], "ts": trs[0]["ts"] * 1e6,
+                "pid": owner_pid, "tid": owner_tid})
+            events.append({
+                "name": "submit", "cat": "flow", "ph": "f", "bp": "e",
+                "id": task["task_id"], "ts": worker_trs[0]["ts"] * 1e6,
+                "pid": exec_pid, "tid": exec_tid})
+    # object-plane I/O lanes (transfer/spill/restore span records)
+    for rec in collect_spans():
+        if rec.get("kind") != "lane":
+            continue
+        node = rec.get("node_id") or ""
+        pid = (tracks.pid(node) if node
+               else tracks.pid(f"io-{rec.get('pid')}",
+                               label=f"io pid {rec.get('pid')}"))
+        off = offsets.get(node, 0.0)
+        events.append({
+            "name": rec.get("name", rec.get("lane", "io")),
+            "cat": "lane", "ph": "X",
+            "ts": (rec["start"] + off) * 1e6,
+            "dur": max((rec["end"] - rec["start"]) * 1e6, 1.0),
+            "pid": pid,
+            "tid": tracks.tid(pid, f"{rec.get('lane', 'io')} lane"),
+            "args": dict(rec.get("args") or {})})
+    events = tracks.meta + events
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

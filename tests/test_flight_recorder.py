@@ -1,0 +1,420 @@
+"""Flight recorder: task-lifecycle state telemetry, clock-corrected
+timeline export, critical-path attribution, and serving metrics
+(ref: python/ray/tests/test_task_events.py + test_metrics_agent.py;
+`ray timeline` chrome-trace export)."""
+
+import asyncio
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state, tracing
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _finished_tasks_with_transitions(suffix, want, timeout=20):
+    deadline = time.time() + timeout
+    tasks = []
+    while time.time() < deadline:
+        tasks = [t for t in state.list_tasks(state="FINISHED")
+                 if t["name"].endswith(suffix)
+                 and len(t["state_transitions"]) >= 6]
+        if len(tasks) >= want:
+            return tasks
+        time.sleep(0.25)
+    return tasks
+
+
+# --------------------------------------------------- lifecycle pipeline
+
+def test_lifecycle_transitions_recorded(ray_cluster):
+    """Every completed normal task reports the full state machine:
+    owner-side scheduling marks plus worker-side execution marks."""
+    # num_cpus=0.5 keeps the task off the fast lane, which skips the
+    # lease pipeline (and with it the owner-side scheduling marks)
+    @ray_tpu.remote(num_cpus=0.5)
+    def traced_lifecycle(x):
+        return x * 2
+
+    assert ray_tpu.get([traced_lifecycle.remote(i) for i in range(4)],
+                       timeout=60) == [0, 2, 4, 6]
+    tasks = _finished_tasks_with_transitions("traced_lifecycle", 4)
+    assert len(tasks) >= 4, [len(t["state_transitions"]) for t in
+                             state.list_tasks()]
+    for task in tasks:
+        states = [tr["state"] for tr in task["state_transitions"]]
+        for expect in ("SUBMITTED", "PENDING_NODE_ASSIGNMENT",
+                       "SUBMITTED_TO_WORKER", "PENDING_ARGS_FETCH",
+                       "RUNNING", "OUTPUT_SEALED", "FINISHED"):
+            assert expect in states, (expect, states)
+        for tr in task["state_transitions"]:
+            assert tr["ts"] > 0 and tr["node_id"]
+        # record carries the executing node/worker for the dashboard
+        assert task["node_id"] and task["worker_id"]
+
+
+def test_perfetto_timeline_valid_and_flow_paired(ray_cluster, tmp_path):
+    """timeline() emits a valid flat chrome-trace array: per-node
+    process metadata, >=3 lifecycle-phase slices per completed task, and
+    submit->execute flow events in matched s/f pairs."""
+    @ray_tpu.remote(num_cpus=0.5)
+    def traced_flow(x):
+        return x + 1
+
+    assert ray_tpu.get([traced_flow.remote(i) for i in range(3)],
+                       timeout=60) == [1, 2, 3]
+    assert _finished_tasks_with_transitions("traced_flow", 3)
+
+    out = tmp_path / "timeline.json"
+    events = tracing.timeline(str(out))
+    loaded = json.loads(out.read_text())
+    assert isinstance(loaded, list) and len(loaded) == len(events)
+
+    meta = [e for e in loaded if e["ph"] == "M"]
+    assert any(e["name"] == "process_name"
+               and e["args"]["name"].startswith("node ") for e in meta)
+    assert any(e["name"] == "thread_name" for e in meta)
+
+    # every duration slice is well-formed
+    for e in loaded:
+        if e["ph"] == "X":
+            assert e["dur"] > 0 and e["ts"] > 0
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+
+    # >=3 phase slices per traced task, monotone within the task
+    task_ids = {e["args"]["task_id"] for e in loaded
+                if e.get("cat") == "task" and "traced_flow" in e["name"]}
+    assert task_ids
+    for tid in task_ids:
+        phases = [e for e in loaded if e.get("cat") == "phase"
+                  and e["args"]["task_id"] == tid]
+        assert len(phases) >= 3, phases
+        assert {p["args"]["phase"] for p in phases} >= {
+            "scheduling", "dep_fetch", "execution"}
+
+    # flow events pair: one 's' (owner) and one 'f' (worker) per id,
+    # with the finish at or after the start
+    flows = [e for e in loaded if e.get("cat") == "flow"]
+    assert flows
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    for fid, pair in by_id.items():
+        kinds = sorted(e["ph"] for e in pair)
+        assert kinds == ["f", "s"], (fid, pair)
+        start = next(e for e in pair if e["ph"] == "s")
+        fin = next(e for e in pair if e["ph"] == "f")
+        assert fin["ts"] >= start["ts"] - 1.0  # clock-corrected ordering
+
+
+# --------------------------------------------------------- clock skew
+
+def test_clock_offset_reported(ray_cluster):
+    """The raylet's NTP-style sync loop stores an offset on the node
+    table; report_clock_offset round-trips through the state API."""
+    nodes = state.list_nodes()
+    assert nodes and "clock_offset" in nodes[0]
+
+    core = ray_tpu._worker_api.core()
+    node_hex = nodes[0]["node_id"]
+    ok = core.io.run(core.gcs.call("report_clock_offset", {
+        "node_id": node_hex, "offset": 1.25, "rtt": 0.001}))
+    assert ok
+    offsets = state.clock_offsets()
+    assert offsets.get(node_hex) == pytest.approx(1.25)
+    # restore ~0 so other tests see uncorrected local time
+    core.io.run(core.gcs.call("report_clock_offset", {
+        "node_id": node_hex, "offset": 0.0, "rtt": 0.001}))
+
+
+def test_skewed_transitions_corrected_monotone(ray_cluster):
+    """A task whose worker-side marks came from a node with a skewed
+    clock reorders raw timestamps; corrected_transitions restores a
+    monotone, canonically-ordered state machine."""
+    base = time.time()
+    skew = 7.5  # the remote node's clock runs 7.5 s fast
+    task = {
+        "task_id": "skewtask", "state": "FINISHED",
+        "state_transitions": [
+            {"state": "SUBMITTED", "ts": base, "node_id": "ownernode"},
+            {"state": "RUNNING", "ts": base + 0.2 + skew,
+             "node_id": "skewnode"},
+            {"state": "OUTPUT_SEALED", "ts": base + 0.5 + skew,
+             "node_id": "skewnode"},
+            {"state": "FINISHED", "ts": base + 0.6, "node_id": "ownernode"},
+        ],
+    }
+    raw = [tr["ts"] for tr in task["state_transitions"]]
+    assert raw != sorted(raw)  # raw timestamps ARE out of order
+    corrected = state.corrected_transitions(
+        task, {"skewnode": -skew, "ownernode": 0.0})
+    assert [t["state"] for t in corrected] == [
+        "SUBMITTED", "RUNNING", "OUTPUT_SEALED", "FINISHED"]
+    ts = [t["ts"] for t in corrected]
+    assert ts == sorted(ts)
+    assert ts[-1] - ts[0] == pytest.approx(0.6)
+
+
+# ------------------------------------------------------ critical path
+
+def test_critical_path_breakdown_sums_to_wall(ray_cluster):
+    @ray_tpu.remote(num_cpus=0.5)
+    def busy(x):
+        time.sleep(0.05)
+        return x
+
+    ray_tpu.get([busy.remote(i) for i in range(4)], timeout=60)
+    assert _finished_tasks_with_transitions("busy", 4)
+
+    report = state.summarize_tasks(breakdown=True)
+    assert report["tasks_with_transitions"] >= 4
+    assert report["states"].get("FINISHED", 0) >= 4
+    phases = report["phases"]
+    assert set(phases) == {"scheduling", "dep_fetch", "execution",
+                           "transfer", "other"}
+    # phase attribution partitions each task's transition span exactly
+    assert sum(phases.values()) == pytest.approx(
+        report["wall_time_s"], rel=1e-6, abs=1e-6)
+    assert phases["execution"] > 0.0  # the sleep lands in execution
+
+    # back-compat: the bare call is still the plain state->count map
+    bare = state.summarize_tasks()
+    assert isinstance(bare, dict) and "phases" not in bare
+
+
+# ----------------------------------------------------- GCS task table
+
+def test_gcs_eviction_prefers_terminal_records():
+    """A full task_events table evicts FINISHED/FAILED records before
+    live ones — an eviction storm must not erase in-flight tasks."""
+    from ray_tpu._private.gcs import GcsServer
+
+    gcs = object.__new__(GcsServer)
+    gcs.task_events = {}
+    gcs.MAX_TASK_EVENTS = 3
+
+    def report(events):
+        asyncio.run(gcs.handle_report_task_events({"events": events}, None))
+
+    report([{"task_id": "a", "state": "FINISHED"},
+            {"task_id": "b", "state": "RUNNING"},
+            {"task_id": "c", "state": "FINISHED"}])
+    report([{"task_id": "d", "state": "RUNNING"}])  # evicts a terminal
+    assert "b" in gcs.task_events and "d" in gcs.task_events
+    assert sum(k in gcs.task_events for k in ("a", "c")) == 1
+    report([{"task_id": "e", "state": "RUNNING"}])  # evicts the other
+    assert set(gcs.task_events) == {"b", "d", "e"}
+    report([{"task_id": "f", "state": "RUNNING"}])  # no terminal left:
+    assert len(gcs.task_events) == 3                # falls back to FIFO
+    assert "f" in gcs.task_events
+
+    # transitions accumulate across reports instead of being clobbered
+    report([{"task_id": "f", "transitions": [
+        {"state": "SUBMITTED", "ts": 1.0, "node_id": "n"}]}])
+    report([{"task_id": "f", "state": "FINISHED", "transitions": [
+        {"state": "FINISHED", "ts": 2.0, "node_id": "n"}]}])
+    rec = gcs.task_events["f"]
+    assert [t["state"] for t in rec["state_transitions"]] == [
+        "SUBMITTED", "FINISHED"]
+    assert rec["state"] == "FINISHED"
+
+
+# ------------------------------------------------------- prometheus
+
+def test_prometheus_histogram_buckets_sorted_numerically():
+    from ray_tpu._private.prometheus import render
+
+    entries = [
+        {"name": "lat", "kind": "histogram", "tags": {"le": "10"},
+         "value": 3},
+        {"name": "lat", "kind": "histogram", "tags": {"le": "+Inf"},
+         "value": 4},
+        {"name": "lat", "kind": "histogram", "tags": {"le": "2.5"},
+         "value": 2},
+        {"name": "lat", "kind": "histogram",
+         "tags": {"__stat__": "sum"}, "value": 11.5},
+        {"name": "lat", "kind": "histogram", "tags": {"le": "0.5"},
+         "value": 1},
+        {"name": "lat", "kind": "histogram",
+         "tags": {"__stat__": "count"}, "value": 4},
+    ]
+    lines = [ln for ln in render(entries).splitlines()
+             if not ln.startswith("#")]
+    les = [ln.split('le="')[1].split('"')[0]
+           for ln in lines if "_bucket" in ln]
+    assert les == ["0.5", "2.5", "10", "+Inf"]  # numeric, +Inf last
+    # buckets precede sum/count
+    assert lines[-2].startswith("lat_sum") \
+        and lines[-1].startswith("lat_count")
+
+
+def test_latency_buckets_preset():
+    from ray_tpu.util.metrics import LATENCY_BUCKETS
+
+    assert LATENCY_BUCKETS[0] <= 0.001 and LATENCY_BUCKETS[-1] >= 10
+    assert LATENCY_BUCKETS == sorted(LATENCY_BUCKETS)
+
+
+# ------------------------------------------------- shutdown regression
+
+def test_streaming_split_then_shutdown_exits_cleanly(tmp_path):
+    """Regression: Dataset.streaming_split followed by an immediate
+    shutdown() used to hang the interpreter at exit — the _SplitGroup
+    finalizer re-entered the (torn-down) worker API, whose auto-init
+    wedged starting threads during finalization. shutdown() now reaps
+    live split coordinators deterministically."""
+    script = tmp_path / "split_shutdown.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "from ray_tpu import data\n"
+        "ray_tpu.init(num_cpus=4)\n"
+        "ds = data.range(100, parallelism=4)\n"
+        "its = ds.streaming_split(2)\n"
+        "ray_tpu.shutdown()\n"
+        "print('SPLIT_SHUTDOWN_OK')\n")
+    run = subprocess.run([sys.executable, str(script)],
+                         capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, (run.stdout, run.stderr)
+    assert "SPLIT_SHUTDOWN_OK" in run.stdout
+    assert "Exception ignored" not in run.stderr
+
+
+# ---------------------------------------------------- cli on 4 nodes
+
+def test_cli_summary_on_four_node_cluster(tmp_path):
+    """`cli summary` prints the scheduling/dep-fetch/execution/transfer
+    breakdown against a fake 4-node cluster (own subprocess: the
+    module fixture's single-node runtime must not be connected)."""
+    script = tmp_path / "summary_cluster.py"
+    script.write_text(
+        "import subprocess, sys, time\n"
+        "import ray_tpu\n"
+        "from ray_tpu.cluster_utils import Cluster\n"
+        "from ray_tpu.util import state\n"
+        "cluster = Cluster(head_node_args={'resources': {'CPU': 1.0}},\n"
+        "                  connect=True)\n"
+        "for _ in range(3):\n"
+        "    cluster.add_node(num_cpus=2)\n"
+        "assert len([n for n in ray_tpu.nodes() if n['Alive']]) == 4\n"
+        "@ray_tpu.remote(num_cpus=2)\n"  # only fits on worker nodes
+        "def f(x):\n"
+        "    time.sleep(0.02)\n"
+        "    return x * 2\n"
+        "assert ray_tpu.get([f.remote(i) for i in range(6)],\n"
+        "                   timeout=120) == [0, 2, 4, 6, 8, 10]\n"
+        "deadline = time.time() + 20\n"
+        "while time.time() < deadline:\n"
+        "    done = [t for t in state.list_tasks(state='FINISHED')\n"
+        "            if len(t.get('state_transitions') or []) >= 6]\n"
+        "    if len(done) >= 6:\n"
+        "        break\n"
+        "    time.sleep(0.25)\n"
+        "out = subprocess.run(\n"
+        "    [sys.executable, '-m', 'ray_tpu.scripts.cli', 'summary',\n"
+        "     '--address', cluster.address],\n"
+        "    capture_output=True, text=True, timeout=120)\n"
+        "assert out.returncode == 0, out.stderr\n"
+        "print(out.stdout)\n"
+        "for phase in ('scheduling', 'dep_fetch', 'execution',\n"
+        "              'transfer'):\n"
+        "    assert phase in out.stdout, out.stdout\n"
+        "assert 'FINISHED' in out.stdout\n"
+        "cluster.shutdown()\n"
+        "print('CLI_SUMMARY_OK')\n")
+    run = subprocess.run([sys.executable, str(script)],
+                         capture_output=True, text=True, timeout=160)
+    assert run.returncode == 0, (run.stdout, run.stderr)
+    assert "CLI_SUMMARY_OK" in run.stdout
+
+
+# ------------------------------------------------------ serving metrics
+
+def test_serve_request_metrics_and_request_id(ray_cluster):
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class EchoObs:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    try:
+        serve.run(EchoObs.bind())
+        port = serve.start()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/EchoObs",
+            data=json.dumps({"x": 1}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-ID": "obs-test-rid-1"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["X-Request-ID"] == "obs-test-rid-1"
+            assert json.loads(resp.read())["result"] == {
+                "echo": {"x": 1}}
+        # no header -> the proxy mints one
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/EchoObs",
+            data=json.dumps({"x": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2, timeout=60) as resp:
+            assert len(resp.headers["X-Request-ID"]) >= 16
+
+        # the replica's e2e histogram reaches the GCS metrics table,
+        # tagged with the deployment
+        deadline = time.time() + 20
+        rows = []
+        while time.time() < deadline:
+            rows = [m for m in state.get_metrics(
+                        "serve_request_e2e_seconds")
+                    if m["tags"].get("deployment") == "EchoObs"
+                    and m["tags"].get("__stat__") == "count"]
+            if rows and sum(m["value"] for m in rows) >= 2:
+                break
+            time.sleep(0.5)
+        assert rows and sum(m["value"] for m in rows) >= 2, rows
+    finally:
+        serve.shutdown()
+
+
+def test_llm_ttft_tpot_histograms():
+    """One completed engine request populates TTFT and TPOT histograms
+    tagged with the model (in-process LLMServer: the same metrics path
+    a serve replica exports)."""
+    from ray_tpu.llm.serve import LLMServer
+    from ray_tpu.util.metrics import snapshot_local
+
+    server = LLMServer("tiny", init="random", engine_config={
+        "max_num_seqs": 2, "page_size": 4, "num_pages": 64,
+        "max_seq_len": 64})
+    before = snapshot_local("llm_")
+
+    async def go():
+        return await server.completions(
+            {"prompt_ids": [5, 17, 99, 3], "temperature": 0.0,
+             "max_tokens": 4})
+
+    out = asyncio.run(go())
+    assert len(out["choices"][0]["token_ids"]) == 4
+    after = snapshot_local("llm_")
+
+    def delta(key):
+        return after.get(key, 0.0) - before.get(key, 0.0)
+
+    ttft = "llm_ttft_seconds{__stat__=count,model=tiny}"
+    tpot = "llm_tpot_seconds{__stat__=count,model=tiny}"
+    e2e = "llm_request_e2e_seconds{__stat__=count,model=tiny}"
+    assert delta(ttft) >= 1, after
+    assert delta(tpot) >= 1, after
+    assert delta(e2e) >= 1, after
+    assert delta("llm_prompt_tokens_total{model=tiny}") >= 4
+    assert delta("llm_generation_tokens_total{model=tiny}") >= 4

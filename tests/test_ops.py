@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from ray_tpu.util.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.ops import (

@@ -147,7 +147,10 @@ def test_impala_improves_on_cartpole(ray_cluster):
     try:
         first = algo.train()
         best = first["episode_reward_mean"]
-        for _ in range(14):
+        # async actor-learner interleaving makes the curve machine-
+        # dependent: on a loaded 4-cpu host the 80 bar falls around
+        # iteration ~22, so budget ~30
+        for _ in range(29):
             res = algo.train()
             if not np.isnan(res["episode_reward_mean"]):
                 best = max(best, res["episode_reward_mean"])
