@@ -80,11 +80,13 @@ timeout "${CI_SMOKE_TIMEOUT_S:-600}" \
     python -m pytest tests/test_object_transfer.py tests/test_spilling.py \
         tests/test_data_shuffle.py -q
 
-echo "== [3/5] observability smoke: lifecycle + timeline + serve metrics =="
+echo "== [3/5] observability smoke: lifecycle + timeline + serve metrics + stall sentinel =="
 # the flight recorder (task state transitions, Perfetto export, serving
 # histograms) gets a live end-to-end check: a silent telemetry
 # regression would otherwise only show up as weaker dashboards, not a
-# test failure
+# test failure. The stall-injection leg hangs a task on purpose and
+# requires the sentinel to flag it (WARNING event + captured stack)
+# through `cli health` and `cli stacks` with no human action
 JAX_PLATFORMS=cpu \
 timeout "${CI_OBS_TIMEOUT_S:-300}" \
     python -m ray_tpu.scripts.obs_smoke

@@ -135,6 +135,29 @@ class Config:
     # Chaos/unreliable setups set this so dropped frames trigger a retry,
     # which the raylet dedups by request id.
     lease_rpc_timeout_s: float = 0.0
+    # --- stall sentinel (hang/straggler detection) ---
+    # raylet task watchdog period; 0 disables the watchdog. Each tick the
+    # raylet compares every RUNNING task's age against an adaptive
+    # per-scheduling-class threshold (EMA of completed durations times
+    # task_stall_ema_factor, floored at task_stall_threshold_s), captures
+    # the implicated worker's stack via its dump_stacks RPC, and emits a
+    # WARNING cluster event with the stack attached.
+    task_watchdog_interval_s: float = 5.0
+    # floor for the adaptive RUNNING-too-long threshold; a class with no
+    # completion history yet stalls only past this floor
+    task_stall_threshold_s: float = 60.0
+    # a task is suspect once it runs this multiple of its class's EMA
+    task_stall_ema_factor: float = 10.0
+    # GCS collective watchdog period; 0 disables. A collective step with
+    # some-but-not-all participant arrivals older than
+    # collective_stall_timeout_s emits a "hung collective" event naming
+    # the missing ranks/hosts and pulls their stacks.
+    collective_watchdog_interval_s: float = 2.0
+    collective_stall_timeout_s: float = 30.0
+    # transfer stall detector: a pull whose contiguous byte watermark has
+    # not advanced for this long is flagged (0 disables); checked by the
+    # raylet watchdog tick against the store's in-progress registry.
+    transfer_stall_timeout_s: float = 30.0
     # --- logging / metrics ---
     event_log_enabled: bool = True
     metrics_report_interval_ms: int = 2000

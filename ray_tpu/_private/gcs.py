@@ -60,6 +60,11 @@ class NodeInfo:
     # reported by the raylet's clock-sync loop; timestamps from this
     # node compose cluster-wide as local_ts + clock_offset
     clock_offset: float = 0.0
+    # GCS wall clock of the last sign of life from this node (successful
+    # health probe or resource report) — heartbeat age in `cli status` /
+    # dashboard is now - last_heartbeat_t (wire schema rule: appended
+    # field, decode fills the default)
+    last_heartbeat_t: float = 0.0
 
 
 @dataclass
@@ -131,6 +136,18 @@ class GcsServer:
         import collections as _collections
 
         self.events: "_collections.deque" = _collections.deque(maxlen=5000)
+        # stall sentinel: collective/barrier arrival tables. Key
+        # (group, step) -> record with per-rank clock-corrected arrival
+        # timestamps; the collective watchdog flags records with
+        # some-but-not-all arrivals past the deadline, and completed
+        # steps roll their arrival-skew histogram into per-host
+        # straggler scores.
+        self.collectives: Dict[tuple, dict] = {}
+        self.MAX_COLLECTIVES = 2000
+        self._collective_waiters: Dict[tuple, list] = {}
+        # host key (node hex, or reported host name) -> skew aggregates
+        self.straggler_stats: Dict[str, dict] = {}
+        self._collective_watchdog_task: Optional[asyncio.Task] = None
         self._next_job = 1
         if self._remote_store is None:
             self._restore_tables()
@@ -178,6 +195,9 @@ class GcsServer:
         if global_config().health_check_timeout_ms > 0:
             self._node_health_task = asyncio.ensure_future(
                 self._node_health_loop())
+        if global_config().collective_watchdog_interval_s > 0:
+            self._collective_watchdog_task = asyncio.ensure_future(
+                self._collective_watchdog_loop())
         # restored placement groups that never finished reserving resume
         # scheduling now that the loop is live (restart recovery)
         for pg in self.placement_groups.values():
@@ -227,6 +247,7 @@ class GcsServer:
                         ok = False
                     if ok:
                         misses.pop(node_id, None)
+                        info.last_heartbeat_t = time.time()
                         return
                     n = misses.get(node_id, 0) + 1
                     misses[node_id] = n
@@ -286,6 +307,8 @@ class GcsServer:
             self._storage_health_task.cancel()
         if self._node_health_task is not None:
             self._node_health_task.cancel()
+        if self._collective_watchdog_task is not None:
+            self._collective_watchdog_task.cancel()
         for client in self._pg_raylet_clients.values():
             try:
                 await client.close()
@@ -324,6 +347,290 @@ class GcsServer:
                     payload.get("message", ""),
                     **payload.get("fields", {}))
         return True
+
+    # ---- stall sentinel: collective arrivals + straggler scores ----
+    def _corrected_time(self, node_hex: str, t_local: float) -> float:
+        """Apply the reporting node's NTP-style clock offset so arrival
+        timestamps from different hosts compose on the GCS clock."""
+        if node_hex:
+            try:
+                info = self.nodes.get(NodeID.from_hex(node_hex))
+            except Exception:
+                info = None
+            if info is not None:
+                return t_local + info.clock_offset
+        return t_local
+
+    def _prune_collectives(self) -> None:
+        if len(self.collectives) <= self.MAX_COLLECTIVES:
+            return
+        done = [k for k, r in self.collectives.items()
+                if r.get("completed_t") is not None]
+        for k in done[:len(self.collectives) - self.MAX_COLLECTIVES]:
+            self.collectives.pop(k, None)
+
+    async def handle_collective_arrival(self, payload, conn):
+        """One participant reached a collective/barrier step. Arrival
+        timestamps are clock-corrected via the node table; a step whose
+        arrivals complete rolls its skew histogram into the per-host
+        straggler scores, and one left incomplete past its deadline is
+        the collective watchdog's hung-collective signal."""
+        group = payload["group"]
+        step = int(payload["step"])
+        rank = int(payload["rank"])
+        size = int(payload["size"])
+        node_hex = payload.get("node_id") or ""
+        t = self._corrected_time(
+            node_hex, float(payload.get("t") or time.time()))
+        key = (group, step)
+        rec = self.collectives.get(key)
+        if rec is None:
+            self._prune_collectives()
+            rec = self.collectives[key] = {
+                "group": group, "step": step,
+                "op": payload.get("op", "barrier"), "size": size,
+                "arrivals": {}, "first_t": t, "flagged": False,
+                "completed_t": None,
+                "deadline_s": float(payload.get("deadline_s") or 0.0),
+            }
+        rec["size"] = max(rec["size"], size)
+        if payload.get("deadline_s"):
+            dl = float(payload["deadline_s"])
+            rec["deadline_s"] = (min(rec["deadline_s"], dl)
+                                 if rec["deadline_s"] else dl)
+        rec["arrivals"][rank] = {
+            "t": t, "node_id": node_hex,
+            "host": payload.get("host") or node_hex or f"rank{rank}",
+        }
+        rec["first_t"] = min(rec["first_t"], t)
+        if (rec["completed_t"] is None
+                and len(rec["arrivals"]) >= rec["size"]):
+            rec["completed_t"] = time.time()
+            self._roll_straggler_stats(rec)
+        # wake collective_wait blockers (complete or not — they re-check)
+        for fut in self._collective_waiters.pop(key, []):
+            if not fut.done():
+                fut.set_result(None)
+        return {"arrived": len(rec["arrivals"]), "size": rec["size"],
+                "complete": rec["completed_t"] is not None}
+
+    async def handle_collective_wait(self, payload, conn):
+        """Block until every rank reached (group, step) or timeout_s
+        passes; the reply names missing ranks so the caller can raise a
+        CollectiveTimeoutError that points at the hung participants."""
+        key = (payload["group"], int(payload["step"]))
+        deadline = time.monotonic() + float(payload.get("timeout_s", 30.0))
+        while True:
+            rec = self.collectives.get(key)
+            if rec is not None and rec["completed_t"] is not None:
+                return {"complete": True, "missing": [],
+                        "arrived": len(rec["arrivals"]),
+                        "size": rec["size"]}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                arrivals = rec["arrivals"] if rec else {}
+                size = rec["size"] if rec else int(payload.get("size", 0))
+                missing = sorted(set(range(size)) - set(arrivals))
+                return {"complete": False, "missing": missing,
+                        "arrived": len(arrivals), "size": size}
+            fut = asyncio.get_event_loop().create_future()
+            self._collective_waiters.setdefault(key, []).append(fut)
+            try:
+                await asyncio.wait_for(fut, min(remaining, 0.5))
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                waiters = self._collective_waiters.get(key, [])
+                if fut in waiters:
+                    waiters.remove(fut)
+
+    @staticmethod
+    def _skew_bucket(late_s: float) -> str:
+        for limit, label in ((0.001, "<1ms"), (0.01, "1-10ms"),
+                             (0.1, "10-100ms"), (1.0, "100ms-1s"),
+                             (10.0, "1-10s")):
+            if late_s < limit:
+                return label
+        return ">10s"
+
+    def _roll_straggler_stats(self, rec: dict) -> None:
+        """Completed step: fold each rank's lateness (arrival - earliest
+        arrival, clock-corrected) into its host's running aggregates.
+        The straggler score read off these is the host's EMA lateness
+        relative to the cluster mean — persistently-late hosts float to
+        the top regardless of absolute step cadence."""
+        arrivals = rec["arrivals"]
+        if not arrivals:
+            return
+        t0 = min(a["t"] for a in arrivals.values())
+        span = max(a["t"] for a in arrivals.values()) - t0
+        rec["skew_s"] = span
+        worst_rank = max(arrivals, key=lambda r: arrivals[r]["t"])
+        for rank, a in arrivals.items():
+            late = a["t"] - t0
+            st = self.straggler_stats.setdefault(a["host"], {
+                "host": a["host"], "steps": 0, "sum_lateness_s": 0.0,
+                "max_lateness_s": 0.0, "ema_lateness_s": 0.0,
+                "worst_count": 0, "hist": {}})
+            st["steps"] += 1
+            st["sum_lateness_s"] += late
+            st["max_lateness_s"] = max(st["max_lateness_s"], late)
+            st["ema_lateness_s"] = (late if st["steps"] == 1
+                                    else 0.8 * st["ema_lateness_s"]
+                                    + 0.2 * late)
+            bucket = self._skew_bucket(late)
+            st["hist"][bucket] = st["hist"].get(bucket, 0) + 1
+            # only count "worst in step" when the skew is material —
+            # someone is always last even in a perfectly healthy step
+            if rank == worst_rank and span >= 0.005:
+                st["worst_count"] += 1
+
+    async def handle_straggler_scores(self, payload, conn):
+        stats = list(self.straggler_stats.values())
+        if not stats:
+            return []
+        mean_ema = (sum(s["ema_lateness_s"] for s in stats)
+                    / len(stats)) or 1e-9
+        out = []
+        for s in stats:
+            rec = dict(s)
+            rec["score"] = s["ema_lateness_s"] / max(mean_ema, 1e-9)
+            out.append(rec)
+        out.sort(key=lambda s: s["score"], reverse=True)
+        return out
+
+    async def handle_list_collectives(self, payload, conn):
+        out = []
+        for rec in self.collectives.values():
+            r = {k: v for k, v in rec.items() if k != "arrivals"}
+            r["arrived_ranks"] = sorted(rec["arrivals"])
+            r["missing_ranks"] = sorted(
+                set(range(rec["size"])) - set(rec["arrivals"]))
+            out.append(r)
+        return out
+
+    async def _collective_watchdog_loop(self):
+        """Flag collectives with some-but-not-all arrivals past their
+        deadline: emit a WARNING "hung collective" event naming the
+        missing ranks/hosts and pull Python stacks from the implicated
+        nodes' workers."""
+        from .config import global_config
+
+        cfg = global_config()
+        period = cfg.collective_watchdog_interval_s
+        while True:
+            await asyncio.sleep(period)
+            now = time.time()
+            for key, rec in list(self.collectives.items()):
+                if rec["completed_t"] is not None or rec["flagged"]:
+                    continue
+                deadline = rec["deadline_s"] or cfg.collective_stall_timeout_s
+                if now - rec["first_t"] < deadline:
+                    continue
+                rec["flagged"] = True
+                try:
+                    await self._flag_hung_collective(rec, deadline)
+                except Exception:
+                    pass  # forensics must never kill the watchdog
+
+    def _rank_host_map(self, group: str) -> Dict[int, dict]:
+        """rank -> {node_id, host} learned from every observed step of
+        this group (a missing rank never arrived THIS step, but earlier
+        steps tell us where it lives)."""
+        mapping: Dict[int, dict] = {}
+        for (g, _), rec in self.collectives.items():
+            if g != group:
+                continue
+            for rank, a in rec["arrivals"].items():
+                mapping[rank] = {"node_id": a["node_id"],
+                                 "host": a["host"]}
+        return mapping
+
+    async def _flag_hung_collective(self, rec: dict, deadline: float):
+        missing = sorted(set(range(rec["size"])) - set(rec["arrivals"]))
+        known = self._rank_host_map(rec["group"])
+        missing_hosts = {r: known.get(r, {}).get("host", "?")
+                         for r in missing}
+        # pull stacks from the missing ranks' nodes; when a rank's home
+        # is unknown (it never arrived in any step), sweep all alive
+        # nodes — the hung worker is on one of them
+        node_hexes = {known[r]["node_id"] for r in missing
+                      if r in known and known[r]["node_id"]}
+        if not node_hexes:
+            node_hexes = {n.node_id.hex() for n in self.nodes.values()
+                          if n.alive}
+        stacks = {}
+        for node_hex in list(node_hexes)[:16]:
+            info = None
+            try:
+                info = self.nodes.get(NodeID.from_hex(node_hex))
+            except Exception:
+                pass
+            if info is None or not info.alive:
+                continue
+            try:
+                client = await self._raylet_client(info.address)
+                dump = await client.call("dump_worker_stacks", {},
+                                         timeout=5)
+                stacks[node_hex] = dump.get("workers", [])
+            except Exception as e:
+                stacks[node_hex] = [{"error": str(e) or repr(e)}]
+        age = time.time() - rec["first_t"]
+        self._event(
+            "stall_sentinel", "WARNING",
+            (f"hung collective {rec['group']} step {rec['step']} "
+             f"({rec['op']}): {len(missing)}/{rec['size']} ranks missing "
+             f"after {age:.1f}s — missing ranks {missing} "
+             f"(hosts: {missing_hosts})"),
+            kind="collective_stall", group=rec["group"],
+            step=rec["step"], op=rec["op"], size=rec["size"],
+            missing_ranks=missing, missing_hosts=missing_hosts,
+            arrived_ranks=sorted(rec["arrivals"]), age_s=age,
+            deadline_s=deadline, stacks=stacks)
+
+    async def handle_list_stalls(self, payload, conn):
+        """Cluster-wide stall view: hung collectives from this table,
+        task/transfer stalls fanned in from every alive raylet."""
+        out = {"tasks": [], "transfers": [], "collectives": []}
+        for rec in self.collectives.values():
+            if rec["flagged"] and rec["completed_t"] is None:
+                out["collectives"].append({
+                    "kind": "collective_stall",
+                    "group": rec["group"], "step": rec["step"],
+                    "op": rec["op"], "size": rec["size"],
+                    "arrived_ranks": sorted(rec["arrivals"]),
+                    "missing_ranks": sorted(
+                        set(range(rec["size"])) - set(rec["arrivals"])),
+                    "age_s": time.time() - rec["first_t"],
+                })
+        for info in list(self.nodes.values()):
+            if not info.alive:
+                continue
+            try:
+                client = await self._raylet_client(info.address)
+                local = await client.call("list_stalls", {}, timeout=5)
+            except Exception:
+                continue
+            out["tasks"].extend(local.get("tasks", []))
+            out["transfers"].extend(local.get("transfers", []))
+        return out
+
+    async def handle_dump_all_stacks(self, payload, conn):
+        """Fan dump_worker_stacks across every alive node (cli stacks
+        without a node filter)."""
+        out = []
+        for info in list(self.nodes.values()):
+            if not info.alive:
+                continue
+            try:
+                client = await self._raylet_client(info.address)
+                dump = await client.call("dump_worker_stacks", {},
+                                         timeout=10)
+            except Exception as e:
+                dump = {"node_id": info.node_id.hex(),
+                        "workers": [], "error": str(e) or repr(e)}
+            out.append(dump)
+        return out
 
     # ---- pubsub ----
     async def _publish(self, channel: str, payload: Any):
@@ -447,6 +754,7 @@ class GcsServer:
     # ---- nodes ----
     async def handle_register_node(self, payload, conn):
         info = NodeInfo(**payload)
+        info.last_heartbeat_t = time.time()
         self.nodes[info.node_id] = info
         self._node_conns[conn] = info.node_id
         await self._publish("node", {"event": "added", "node": info})
@@ -464,6 +772,7 @@ class GcsServer:
             seq = payload.get("seq", 0)
             if seq and seq <= info.resource_seq:
                 return True  # stale retry of an older report — ignore
+            info.last_heartbeat_t = time.time()
             info.resource_seq = seq
             info.resources_available = payload["available"]
             info.pending_demands = payload.get("pending", [])

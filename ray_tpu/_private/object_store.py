@@ -69,7 +69,7 @@ class InProgress:
     so a dead upstream fails children fast instead of stranding them."""
 
     __slots__ = ("oid", "size", "buf", "watermark", "done", "failed",
-                 "_lock", "_waiters")
+                 "started_at", "last_progress_t", "_lock", "_waiters")
 
     def __init__(self, oid: ObjectID, size: int, buf: memoryview):
         self.oid = oid
@@ -78,6 +78,10 @@ class InProgress:
         self.watermark = 0
         self.done = False
         self.failed = False
+        # stall sentinel reads these: a pull whose watermark stopped
+        # moving shows up as (now - last_progress_t) in stalled_pulls()
+        self.started_at = time.time()
+        self.last_progress_t = self.started_at
         self._lock = threading.Lock()
         self._waiters: List[tuple] = []
 
@@ -86,6 +90,7 @@ class InProgress:
             if self.done or watermark <= self.watermark:
                 return
             self.watermark = watermark
+            self.last_progress_t = time.time()
             ready = [w for w in self._waiters if w[0] <= watermark]
             self._waiters = [w for w in self._waiters if w[0] > watermark]
         for _, loop, fut in ready:
@@ -509,6 +514,28 @@ class SharedObjectStore:
     def inprogress(self, oid: ObjectID) -> Optional[InProgress]:
         with self._lock:
             return self._inprogress.get(oid)
+
+    def stalled_pulls(self, stall_after_s: float) -> List[dict]:
+        """In-progress creations whose contiguous watermark has not
+        advanced for `stall_after_s` seconds — the transfer stall
+        detector's input (watermark registry doubles as progress meter)."""
+        now = time.time()
+        with self._lock:
+            entries = list(self._inprogress.values())
+        out = []
+        for e in entries:
+            if e.done:
+                continue
+            idle = now - e.last_progress_t
+            if idle >= stall_after_s:
+                out.append({
+                    "object_id": e.oid.hex(),
+                    "size": e.size,
+                    "watermark": e.watermark,
+                    "stalled_for_s": idle,
+                    "age_s": now - e.started_at,
+                })
+        return out
 
     def _finish_inprogress(self, oid: ObjectID, failed: bool) -> None:
         with self._lock:

@@ -273,6 +273,12 @@ class Raylet:
         # smoothed NTP-style estimate of (GCS clock - local clock);
         # None until the first clock-sync round completes
         self._clock_offset: Optional[float] = None
+        # stall sentinel: per-scheduling-class EMA of completed task
+        # durations (the adaptive RUNNING-too-long threshold's memory),
+        # plus currently-flagged stalls so each hang alerts once
+        self._class_ema: Dict[str, float] = {}
+        self._stalled_tasks: Dict[str, dict] = {}
+        self._stalled_transfers: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -341,6 +347,8 @@ class Raylet:
             asyncio.ensure_future(self._memory_monitor_loop())
         if self.cfg.clock_sync_interval_s > 0:
             asyncio.ensure_future(self._clock_sync_loop())
+        if self.cfg.task_watchdog_interval_s > 0:
+            asyncio.ensure_future(self._task_watchdog_loop())
 
     async def _clock_sync_loop(self):
         """Estimate this node's clock offset against the GCS clock by
@@ -469,6 +477,161 @@ class Raylet:
                 }]})
             except Exception:
                 pass
+
+    # ------------------------------------------------------- stall sentinel
+    async def _task_watchdog_loop(self):
+        """Hang detector for the compute plane: each tick probes this
+        node's workers for RUNNING-task ages and completed-duration
+        samples, flags tasks past an adaptive per-scheduling-class
+        threshold (EMA of past durations x task_stall_ema_factor,
+        floored at task_stall_threshold_s), captures the implicated
+        worker's Python stack over its dump_stacks RPC, and emits a
+        WARNING cluster event with the stack attached. The transfer
+        stall check (watermark registry, no byte progress) rides the
+        same tick."""
+        period = self.cfg.task_watchdog_interval_s
+        while True:
+            await asyncio.sleep(period)
+            try:
+                await self._task_watchdog_tick()
+            except Exception:
+                pass  # a failed tick must never kill the watchdog
+
+    async def _task_watchdog_tick(self):
+        floor = self.cfg.task_stall_threshold_s
+        factor = self.cfg.task_stall_ema_factor
+        seen = set()
+        for worker in list(self._workers.values()):
+            if not worker.alive or worker.conn is None:
+                continue
+            try:
+                client = await self._peer_client(worker.address)
+                probe = await client.call("stall_probe", {}, timeout=5)
+            except Exception:
+                continue  # worker busy dying; health plane owns that
+            for fn, dur in probe.get("completed", []):
+                prev = self._class_ema.get(fn)
+                self._class_ema[fn] = (dur if prev is None
+                                       else 0.8 * prev + 0.2 * dur)
+            for rec in probe.get("running", []):
+                seen.add(rec["task_id"])
+                ema = self._class_ema.get(rec["fn"])
+                threshold = max(floor, ema * factor) if ema else floor
+                if rec["age_s"] < threshold:
+                    continue
+                if rec["task_id"] in self._stalled_tasks:
+                    # already alerted; keep the record's age fresh
+                    self._stalled_tasks[rec["task_id"]]["age_s"] = \
+                        rec["age_s"]
+                    continue
+                await self._flag_stalled_task(worker, rec, threshold)
+        # a flagged task that is no longer RUNNING resolved itself
+        for tid in list(self._stalled_tasks):
+            if tid not in seen:
+                self._stalled_tasks.pop(tid, None)
+        if self.cfg.transfer_stall_timeout_s > 0:
+            await self._check_transfer_stalls()
+
+    async def _flag_stalled_task(self, worker: WorkerHandle, rec: dict,
+                                 threshold: float):
+        stack = ""
+        try:
+            client = await self._peer_client(worker.address)
+            dump = await client.call("dump_stacks", {}, timeout=5)
+            for th in dump.get("threads", []):
+                if th.get("task_id") == rec["task_id"]:
+                    stack = th["stack"]
+                    break
+            else:
+                # interpreter-level hang (e.g. a wedged C extension):
+                # attach every thread rather than nothing
+                stack = "\n".join(th["stack"]
+                                  for th in dump.get("threads", []))
+        except Exception:
+            stack = "<stack capture failed: worker unreachable>"
+        record = {
+            "kind": "task_stall",
+            "task_id": rec["task_id"],
+            "fn": rec["fn"],
+            "age_s": rec["age_s"],
+            "threshold_s": threshold,
+            "node_id": self.node_id.hex(),
+            "worker_id": worker.worker_id.hex(),
+            "pid": worker.pid,
+            "stack": stack,
+            "detected_at": time.time(),
+        }
+        self._stalled_tasks[rec["task_id"]] = record
+        try:
+            await self.gcs.call("report_event", {
+                "source": "stall_sentinel",
+                "severity": "WARNING",
+                "message": (
+                    f"task {rec['task_id'][:12]} ({rec['fn']}) stalled: "
+                    f"RUNNING for {rec['age_s']:.1f}s on node "
+                    f"{self.node_id.hex()[:12]} worker pid {worker.pid} "
+                    f"(threshold {threshold:.1f}s)"),
+                "fields": record,
+            })
+        except Exception:
+            pass
+
+    async def _check_transfer_stalls(self):
+        stalls = self.store.stalled_pulls(self.cfg.transfer_stall_timeout_s)
+        current = set()
+        for s in stalls:
+            oid = s["object_id"]
+            current.add(oid)
+            src = self._pull_sources.get(ObjectID.from_hex(oid))
+            s.update({"kind": "transfer_stall",
+                      "node_id": self.node_id.hex(),
+                      "source_node": src.hex() if src else None,
+                      "detected_at": time.time()})
+            if oid in self._stalled_transfers:
+                self._stalled_transfers[oid].update(s)
+                continue
+            self._stalled_transfers[oid] = s
+            try:
+                await self.gcs.call("report_event", {
+                    "source": "stall_sentinel",
+                    "severity": "WARNING",
+                    "message": (
+                        f"pull {oid[:12]} stalled on node "
+                        f"{self.node_id.hex()[:12]}: no byte progress for "
+                        f"{s['stalled_for_s']:.1f}s "
+                        f"({s['watermark']}/{s['size']} bytes)"),
+                    "fields": s,
+                })
+            except Exception:
+                pass
+        for oid in list(self._stalled_transfers):
+            if oid not in current:
+                self._stalled_transfers.pop(oid, None)
+
+    async def handle_list_stalls(self, payload, conn):
+        """This node's currently-flagged stalls (state api / cli health)."""
+        return {
+            "tasks": list(self._stalled_tasks.values()),
+            "transfers": list(self._stalled_transfers.values()),
+        }
+
+    async def handle_dump_worker_stacks(self, payload, conn):
+        """Fan dump_stacks across this node's live workers (cli stacks,
+        GCS hung-collective forensics). Unreachable workers report an
+        error entry instead of wedging the whole dump."""
+        out = []
+        for worker in list(self._workers.values()):
+            if not worker.alive:
+                continue
+            try:
+                client = await self._peer_client(worker.address)
+                dump = await client.call("dump_stacks", {}, timeout=5)
+            except Exception as e:
+                dump = {"pid": worker.pid, "error": str(e) or repr(e)}
+            dump["worker_id"] = worker.worker_id.hex()
+            dump["node_id"] = self.node_id.hex()
+            out.append(dump)
+        return {"node_id": self.node_id.hex(), "workers": out}
 
     async def stop(self):
         for task in list(self._token_conn_watchers.values()):

@@ -121,6 +121,7 @@ def _register_all() -> None:
     register_exception(5, _exc.ObjectLostError)
     register_exception(6, _exc.GetTimeoutError)
     register_exception(7, _exc.ActorDiedError)
+    register_exception(8, _exc.CollectiveTimeoutError)
 
 
 _registered = False

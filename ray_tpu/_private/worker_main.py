@@ -22,6 +22,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional, Tuple
@@ -185,13 +186,81 @@ class TaskExecutor:
         self._cancel_requested: set = set()
         # streaming: task_id -> producer budget
         self._gen_budgets: dict = {}
+        # stall sentinel: task_id -> (thread ident, fn name, started at);
+        # feeds dump_stacks (stack annotation) and stall_probe (the
+        # raylet watchdog's RUNNING-age / per-class EMA inputs)
+        self._running_since: dict = {}
+        # (fn name, duration) of completions since the last stall_probe
+        self._completed_durations: List[Tuple[str, float]] = []
+        self._durations_lock = threading.Lock()
 
-    def _register_running(self, task_id) -> None:
+    def _register_running(self, task_id, fn_name: str = "") -> None:
         """Bind the executing thread; honor a cancel that raced startup."""
         self._running[task_id] = threading.current_thread()
+        self._running_since[task_id] = (
+            threading.get_ident(), fn_name, time.time())
         if task_id in self._cancel_requested:
             self._cancel_requested.discard(task_id)
             raise exc.TaskCancelledError("task cancelled before start")
+
+    def _unregister_running(self, task_id) -> None:
+        self._running.pop(task_id, None)
+        entry = self._running_since.pop(task_id, None)
+        if entry is not None:
+            with self._durations_lock:
+                self._completed_durations.append(
+                    (entry[1], time.time() - entry[2]))
+                # bound the backlog if no watchdog ever drains it
+                if len(self._completed_durations) > 512:
+                    del self._completed_durations[:256]
+
+    # ------------------------------------------------------ stall sentinel
+    def stall_probe(self) -> dict:
+        """Cheap watchdog input: tasks currently RUNNING on this worker
+        (with age) plus completed (fn, duration) samples drained since
+        the last probe — the raylet's per-scheduling-class EMA feed."""
+        now = time.time()
+        with self._durations_lock:
+            completed, self._completed_durations = \
+                self._completed_durations, []
+        running = [
+            {"task_id": tid.hex(), "fn": fn, "age_s": now - t0}
+            for tid, (_, fn, t0) in list(self._running_since.items())
+        ]
+        return {"pid": os.getpid(), "running": running,
+                "completed": completed}
+
+    def dump_stacks(self) -> dict:
+        """sys._current_frames() snapshot, each thread annotated with the
+        task it is executing (if any) and its time-in-state. The remote
+        half of `cli.py stacks` and the watchdogs' hang forensics."""
+        now = time.time()
+        by_ident = {ident: (tid, fn, t0)
+                    for tid, (ident, fn, t0) in
+                    list(self._running_since.items())}
+        names = {t.ident: t.name for t in threading.enumerate()}
+        threads = []
+        for ident, frame in sys._current_frames().items():
+            tid_fn = by_ident.get(ident)
+            threads.append({
+                "thread_id": ident,
+                "name": names.get(ident, "?"),
+                "task_id": tid_fn[0].hex() if tid_fn else None,
+                "fn": tid_fn[1] if tid_fn else None,
+                "running_for_s": (now - tid_fn[2]) if tid_fn else None,
+                "stack": "".join(traceback.format_stack(frame)),
+            })
+        # running task threads first, then by thread id — the hung one
+        # is what the reader came for
+        threads.sort(key=lambda t: (t["task_id"] is None,
+                                    t["thread_id"]))
+        return {
+            "pid": os.getpid(),
+            "worker_id": self.core.worker_id.hex(),
+            "actor_id": self.actor_id.hex() if self.actor_id else None,
+            "time": now,
+            "threads": threads,
+        }
 
     # ---------------------------------------------------------- arg loading
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
@@ -328,7 +397,7 @@ class TaskExecutor:
             self.core._record_transition(spec.task_id, "PENDING_ARGS_FETCH")
             args, kwargs = self._resolve_args(spec)
             self.core.set_task_context(spec.task_id)
-            self._register_running(spec.task_id)
+            self._register_running(spec.task_id, spec.function.repr_name)
             self.core._record_transition(spec.task_id, "RUNNING")
             try:
                 with _maybe_span(spec):
@@ -343,7 +412,7 @@ class TaskExecutor:
                     else:
                         values = func(*args, **kwargs)
             finally:
-                self._running.pop(spec.task_id, None)
+                self._unregister_running(spec.task_id)
                 self.core.clear_task_context()
             return self._ok_reply(spec, values)
         except BaseException as e:  # noqa: BLE001
@@ -397,7 +466,8 @@ class TaskExecutor:
                                              "PENDING_ARGS_FETCH")
                 args, kwargs = self._resolve_args(spec)
                 self.core.set_task_context(spec.task_id)
-                self._register_running(spec.task_id)
+                self._register_running(spec.task_id,
+                                       spec.function.repr_name)
                 self.core._record_transition(spec.task_id, "RUNNING")
                 try:
                     out = func(*args, **kwargs)
@@ -406,7 +476,7 @@ class TaskExecutor:
                         _emit(ser.serialize(value))
                         budget.wait_for_budget(index)
                 finally:
-                    self._running.pop(spec.task_id, None)
+                    self._unregister_running(spec.task_id)
                     self.core.clear_task_context()
             except BaseException as e:  # noqa: BLE001 — errors ride the stream
                 _emit(ser.serialize_error(e))
@@ -528,10 +598,16 @@ class TaskExecutor:
                 self.actor_instance, spec.function.method_name)
             args, kwargs = self._resolve_args(spec)
             self.core.set_task_context(spec.task_id)
+            # stall-sentinel annotation only (not self._running — actor
+            # cancellation semantics stay unchanged)
+            self._running_since[spec.task_id] = (
+                threading.get_ident(), spec.function.repr_name,
+                time.time())
             try:
                 with _maybe_span(spec):
                     values = method(*args, **kwargs)
             finally:
+                self._unregister_running(spec.task_id)
                 self.core.clear_task_context()
             if asyncio.iscoroutine(values):
                 values = asyncio.get_event_loop_policy().new_event_loop().run_until_complete(values)
@@ -768,11 +844,22 @@ async def _amain():
     async def handle_health(payload, conn):
         return {"pid": os.getpid(), "actor": executor.actor_id}
 
+    async def handle_dump_stacks(payload, conn):
+        # runs on the event loop, not a task thread — the loop itself
+        # stays responsive even while every executor thread is wedged,
+        # which is exactly when this RPC matters
+        return executor.dump_stacks()
+
+    async def handle_stall_probe(payload, conn):
+        return executor.stall_probe()
+
     server.register("push_task", handle_push_task)
     server.register("cancel_task", handle_cancel_task)
     server.register("generator_ack", handle_generator_ack)
     server.register("kill_self", handle_kill_self)
     server.register("health", handle_health)
+    server.register("dump_stacks", handle_dump_stacks)
+    server.register("stall_probe", handle_stall_probe)
     server.register("fastlane_attach", handle_fastlane_attach)
     # owner-serve: this worker's owned small objects (nested submissions)
     server.register("fetch_object", core._handle_fetch_object)

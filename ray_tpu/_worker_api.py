@@ -278,10 +278,15 @@ def available_resources() -> Dict[str, float]:
 
 
 def nodes() -> List[dict]:
+    import time as _time
+
     c = core()
     infos = c.io.run(c.gcs.call("get_all_nodes", {}))
-    return [
-        {
+    now = _time.time()
+    out = []
+    for n in infos:
+        hb = getattr(n, "last_heartbeat_t", 0.0) or 0.0
+        out.append({
             "NodeID": n.node_id.hex(),
             "Alive": n.alive,
             "Resources": n.resources_total,
@@ -289,6 +294,8 @@ def nodes() -> List[dict]:
             "Labels": n.labels,
             "Address": n.address,
             "PendingDemands": getattr(n, "pending_demands", []),
-        }
-        for n in infos
-    ]
+            "ClockOffset": getattr(n, "clock_offset", 0.0),
+            # None until the first heartbeat is stamped
+            "HeartbeatAgeS": max(0.0, now - hb) if hb > 0 else None,
+        })
+    return out

@@ -6,7 +6,7 @@ the same tables from the JSON API, no build step, no assets).
     port = ray_tpu.dashboard.start_dashboard()
     GET /                  — HTML UI (auto-refreshing tables)
     GET /api/nodes /api/actors /api/tasks /api/objects /api/jobs
-        /api/cluster_status /api/metrics
+        /api/cluster_status /api/metrics /api/health /api/stacks
     GET /metrics           — Prometheus text scrape endpoint
                              (ref: _private/prometheus_exporter.py)
 """
@@ -48,6 +48,7 @@ _UI_HTML = """<!doctype html>
  href="/metrics">/metrics</a></span></header>
 <main>
  <section><h2>Cluster</h2><div id="cluster"></div></section>
+ <section><h2>Health</h2><div id="health"></div></section>
  <section><h2>Nodes</h2><div id="nodes"></div></section>
  <section><h2>Actors</h2><div id="actors"></div></section>
  <section><h2>Jobs</h2><div id="jobs"></div></section>
@@ -90,8 +91,10 @@ async function refresh(){try{
   id:(n.NodeID||'').slice(0,12),address:n.NodeManagerAddress||n.Address||'',
   alive:{__html:n.Alive?'<span class="pill ok">alive</span>'
                        :'<span class="pill bad">dead</span>'},
+  heartbeat:n.HeartbeatAgeS==null?'never':n.HeartbeatAgeS.toFixed(1)+'s ago',
+  clock_offset:((n.ClockOffset||0)>=0?'+':'')+(n.ClockOffset||0).toFixed(4)+'s',
   resources:n.Resources||{},labels:n.Labels||{}})),
-  ['id','address','alive','resources','labels']);
+  ['id','address','alive','heartbeat','clock_offset','resources','labels']);
  const actors=await j('/api/actors');
  document.getElementById('actors').innerHTML=table(actors.map(a=>({
   id:(a.actor_id||'').slice(0,12),class:a.class_name,state:a.state,
@@ -107,6 +110,34 @@ async function refresh(){try{
  document.getElementById('status').textContent=
   'updated '+new Date().toLocaleTimeString();
 }catch(e){document.getElementById('status').textContent='error: '+e;}}
+async function refreshHealth(){try{
+ const h=await j('/api/health');
+ const st=h.stalls||{};
+ const rows=[];
+ for(const t of st.tasks||[])rows.push({kind:'task_stall',
+  what:'task '+(t.task_id||'').slice(0,12)+' ('+(t.fn||'?')+')',
+  detail:'RUNNING '+(t.age_s||0).toFixed(1)+'s (threshold '
+   +(t.threshold_s||0).toFixed(1)+'s) pid '+t.pid,
+  node:(t.node_id||'').slice(0,12)});
+ for(const t of st.transfers||[])rows.push({kind:'transfer_stall',
+  what:'pull '+(t.object_id||'').slice(0,12),
+  detail:'no progress '+(t.stalled_for_s||0).toFixed(1)+'s ('
+   +(t.watermark||0)+'/'+(t.size||0)+' bytes)',
+  node:(t.node_id||'').slice(0,12)});
+ for(const c of st.collectives||[])rows.push({kind:'collective_stall',
+  what:(c.group||'')+' step '+c.step+' ('+(c.op||'')+')',
+  detail:'missing ranks '+JSON.stringify(c.missing_ranks||[])+' of '
+   +c.size,node:(c.missing_hosts||[]).join(',')});
+ let html=rows.length?table(rows,['kind','what','detail','node'])
+  :'<span class="pill ok">no stalls detected</span>';
+ const sc=h.straggler_scores||[];
+ if(sc.length)html+='<div style="margin-top:8px">straggler scores</div>'
+  +table(sc.map(s=>({host:s.host,score:(s.score||0).toFixed(2),
+   ema_lateness_s:(s.ema_lateness_s||0).toFixed(4),
+   worst:(s.worst_count||0)+'/'+(s.steps||0)})),
+   ['host','score','ema_lateness_s','worst']);
+ document.getElementById('health').innerHTML=html;
+}catch(e){}}
 async function refreshTimeline(){try{
  const s=await j('/api/summary');
  const ph=s.phases||{};
@@ -151,9 +182,9 @@ async function tailLog(){
  const r=await fetch('/api/logs/tail?node_id='+encodeURIComponent(n)
   +'&file='+encodeURIComponent(f)+'&lines=200');
  document.getElementById('logview').textContent=await r.text();}
-refresh();refreshTimeline();refreshLogs();
+refresh();refreshTimeline();refreshLogs();refreshHealth();
 setInterval(refresh,5000);setInterval(refreshTimeline,10000);
-setInterval(refreshLogs,15000);
+setInterval(refreshLogs,15000);setInterval(refreshHealth,5000);
 </script></body></html>
 """
 
@@ -215,6 +246,18 @@ def _routes():
     async def api_summary(_req):
         return _json(state_api.summarize_tasks(breakdown=True))
 
+    async def api_health(_req):
+        return _json({
+            "stalls": state_api.list_stalls(),
+            "straggler_scores": state_api.straggler_scores(),
+            "events": state_api.list_cluster_events(
+                source="stall_sentinel", limit=50),
+        })
+
+    async def api_stacks(req):
+        node = req.query.get("node_id") or None
+        return _json(state_api.dump_stacks(node_id=node))
+
     async def api_logs(req):
         node = req.query.get("node_id") or None
         return _json(state_api.list_logs(node))
@@ -249,6 +292,8 @@ def _routes():
     app.router.add_get("/api/cluster_status", api_cluster_status)
     app.router.add_get("/api/timeline", api_timeline)
     app.router.add_get("/api/summary", api_summary)
+    app.router.add_get("/api/health", api_health)
+    app.router.add_get("/api/stacks", api_stacks)
     app.router.add_get("/api/logs", api_logs)
     app.router.add_get("/api/logs/tail", api_log_tail)
     return app

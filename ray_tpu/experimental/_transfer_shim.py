@@ -1,0 +1,142 @@
+"""Socket fallback for ``jax.experimental.transfer`` (absent in older
+jax builds — 0.4.x has no ``transfer`` submodule).
+
+Emulates exactly the API surface device_channel.py uses:
+
+    server = start_transfer_server(client, address="h:0",
+                                   transport_addresses=["h:0"])
+    server.address()            -> "host:port"
+    server.await_pull(uid, flat_arrays)
+    conn = server.connect("host:port")
+    flat = conn.pull(uid, specs)    # specs: jax.ShapeDtypeStruct
+
+Semantics match the real fabric where the channel depends on them:
+the pull protocol is a rendezvous (a reader that pulls before the
+writer registers blocks until the registration lands), and a payload
+is consumed by exactly one pull (the channel is 1:1 with capacity-1
+backpressure, so the registration is dropped once served — otherwise
+every write would pin its device arrays forever).
+
+Bytes move host-staged over TCP — correct but without the zero-copy
+ICI/DCN path of the real transfer server. When ``jax.experimental.
+transfer`` exists it is always preferred (see device_channel.py).
+
+Wire protocol (all integers big-endian):
+    request:  u64 uid
+    response: u32 narrays, then per array u64 length + raw bytes
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Dict, List
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("transfer peer closed mid-message")
+        buf += chunk
+    return bytes(buf)
+
+
+class _ShimConnection:
+    """One reader's link to a writer-side server; pulls are sequential
+    (the channel orders them via its control lane)."""
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._lock = threading.Lock()
+
+    def pull(self, uid: int, specs: List[Any]) -> List[Any]:
+        import jax
+        import numpy as np
+
+        with self._lock:
+            self._sock.sendall(struct.pack(">Q", uid))
+            (count,) = struct.unpack(">I", _recv_exact(self._sock, 4))
+            raw = []
+            for _ in range(count):
+                (size,) = struct.unpack(">Q", _recv_exact(self._sock, 8))
+                raw.append(_recv_exact(self._sock, size))
+        if count != len(specs):
+            raise ValueError(
+                f"transfer pull {uid}: peer sent {count} arrays, "
+                f"reader expected {len(specs)}")
+        out = []
+        for buf, spec in zip(raw, specs):
+            arr = np.frombuffer(buf, dtype=spec.dtype).reshape(spec.shape)
+            sharding = getattr(spec, "sharding", None)
+            out.append(jax.device_put(arr, sharding))
+        return out
+
+
+class _ShimTransferServer:
+    def __init__(self, address: str):
+        host = address.rsplit(":", 1)[0]
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, 0))
+        self._listener.listen()
+        self._address = f"{host}:{self._listener.getsockname()[1]}"
+        self._pending: Dict[int, list] = {}
+        self._cv = threading.Condition()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="transfer_shim_accept").start()
+
+    def address(self) -> str:
+        return self._address
+
+    def await_pull(self, uid: int, arrays: list) -> None:
+        with self._cv:
+            self._pending[uid] = list(arrays)
+            self._cv.notify_all()
+
+    def connect(self, address: str) -> _ShimConnection:
+        return _ShimConnection(address)
+
+    # --- serving side ---
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True,
+                             name="transfer_shim_serve").start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        import numpy as np
+
+        try:
+            while True:
+                (uid,) = struct.unpack(">Q", _recv_exact(conn, 8))
+                with self._cv:
+                    # rendezvous: block until the writer registers uid
+                    while uid not in self._pending:
+                        self._cv.wait()
+                    arrays = self._pending.pop(uid)
+                payloads = [np.ascontiguousarray(np.asarray(a)).tobytes()
+                            for a in arrays]
+                conn.sendall(struct.pack(">I", len(payloads)))
+                for p in payloads:
+                    conn.sendall(struct.pack(">Q", len(p)))
+                    conn.sendall(p)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+def start_transfer_server(client: Any = None, address: str = "127.0.0.1:0",
+                          transport_addresses: Any = None):
+    """Same signature as the real API; ``client`` and
+    ``transport_addresses`` are accepted and ignored (TCP is the only
+    transport here)."""
+    return _ShimTransferServer(address)

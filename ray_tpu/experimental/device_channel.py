@@ -65,7 +65,13 @@ def _transfer_server():
                     "the relay-attached backend exposes no PJRT "
                     "transfer server. Use experimental.channel.Channel "
                     "(host-shm tensor lane) instead.")
-            from jax.experimental import transfer
+            try:
+                from jax.experimental import transfer
+            except ImportError:
+                # older jax builds ship no transfer submodule: fall back
+                # to the host-staged TCP shim (same API, same rendezvous
+                # semantics, no zero-copy fabric)
+                from . import _transfer_shim as transfer
 
             from .._private.config import global_config
 

@@ -9,6 +9,8 @@ status` + dashboard/modules/job/cli.py — `ray job submit/...`; SURVEY
     python -m ray_tpu.scripts.cli job submit [--address ...] -- CMD...
     python -m ray_tpu.scripts.cli job {list,status,logs,stop} ...
     python -m ray_tpu.scripts.cli state {nodes,actors,tasks,objects}
+    python -m ray_tpu.scripts.cli health [--verbose]
+    python -m ray_tpu.scripts.cli stacks [--node PREFIX] [--json]
 """
 
 from __future__ import annotations
@@ -246,18 +248,107 @@ def cmd_stop(args) -> int:
 
 def cmd_status(args) -> int:
     import ray_tpu
+    from ray_tpu.util import state as state_api
 
     ray_tpu.init(address=_resolve_address(args))
-    nodes = ray_tpu.nodes()
+    nodes = state_api.list_nodes()
     total = ray_tpu.cluster_resources()
     avail = ray_tpu.available_resources()
     print(f"nodes: {len(nodes)}")
     for n in nodes:
-        state = "ALIVE" if n.get("Alive", True) else "DEAD"
-        print(f"  {n['NodeID'][:16]}  {state}  {n.get('Resources', {})}")
+        hb = n.get("heartbeat_age_s")
+        hb_s = f"hb {hb:.1f}s ago" if hb is not None else "hb never"
+        off = n.get("clock_offset") or 0.0
+        print(f"  {n['node_id'][:16]}  {n['state']:5s}  {hb_s:14s}  "
+              f"clock {off:+.4f}s  {n['resources_total']}")
     print("resources:")
     for key in sorted(total):
         print(f"  {key}: {avail.get(key, 0):g}/{total[key]:g} available")
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_health(args) -> int:
+    """Stall-sentinel view: stalled tasks / transfers / hung collectives
+    with captured stacks, per-host straggler scores, and recent
+    stall_sentinel WARNING events."""
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(address=_resolve_address(args))
+    stalls = state_api.list_stalls()
+    tasks = stalls.get("tasks", [])
+    transfers = stalls.get("transfers", [])
+    collectives = stalls.get("collectives", [])
+    rc = 0
+    print(f"stalled tasks: {len(tasks)}")
+    for s in tasks:
+        print(f"  task {s['task_id'][:16]} ({s.get('fn', '?')}) RUNNING "
+              f"{s.get('age_s', 0):.1f}s (threshold "
+              f"{s.get('threshold_s', 0):.1f}s) on node "
+              f"{s.get('node_id', '')[:12]} pid {s.get('pid')}")
+        if args.verbose and s.get("stack"):
+            print("    " + s["stack"].replace("\n", "\n    "))
+    print(f"stalled transfers: {len(transfers)}")
+    for s in transfers:
+        print(f"  pull {s['object_id'][:16]} on node "
+              f"{s.get('node_id', '')[:12]}: no progress for "
+              f"{s.get('stalled_for_s', 0):.1f}s "
+              f"({s.get('watermark', 0)}/{s.get('size', 0)} bytes)")
+    print(f"hung collectives: {len(collectives)}")
+    for s in collectives:
+        print(f"  {s.get('group')} step {s.get('step')} ({s.get('op')}): "
+              f"missing ranks {s.get('missing_ranks')} of "
+              f"{s.get('size')}")
+    if tasks or transfers or collectives:
+        rc = 1
+    scores = state_api.straggler_scores()
+    if scores:
+        print("straggler scores (ema lateness / cluster mean):")
+        for s in scores:
+            print(f"  {s['host']:24s} score {s.get('score', 0):6.2f}  "
+                  f"ema {s.get('ema_lateness_s', 0):.4f}s  worst in "
+                  f"{s.get('worst_count', 0)}/{s.get('steps', 0)} step(s)")
+    events = state_api.list_cluster_events(source="stall_sentinel",
+                                           limit=args.events)
+    print(f"recent stall_sentinel events: {len(events)}")
+    for e in events:
+        print(f"  [{e.get('severity')}] {e.get('message')}")
+    ray_tpu.shutdown()
+    return rc
+
+
+def cmd_stacks(args) -> int:
+    """Live Python stacks of every worker in the cluster (or one node
+    with --node), annotated with running task ids and time-in-state —
+    `py-spy dump` for the whole cluster, over the control plane."""
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(address=_resolve_address(args))
+    dumps = state_api.dump_stacks(node_id=args.node)
+    if args.json:
+        print(json.dumps(dumps, default=str))
+        ray_tpu.shutdown()
+        return 0
+    for node in dumps:
+        print(f"node {node.get('node_id', '')[:16]}: "
+              f"{len(node.get('workers', []))} worker(s)")
+        if node.get("error"):
+            print(f"  <error: {node['error']}>")
+        for w in node.get("workers", []):
+            if w.get("error"):
+                print(f"  worker pid {w.get('pid')}: <error: {w['error']}>")
+                continue
+            print(f"  worker pid {w.get('pid')} "
+                  f"({w.get('worker_id', '')[:12]})")
+            for th in w.get("threads", []):
+                task = th.get("task_id")
+                tag = (f" task {task[:16]} ({th.get('fn', '?')}) running "
+                       f"{th.get('running_for_s', 0):.1f}s" if task else "")
+                print(f"    thread {th.get('name')}{tag}")
+                stack = th.get("stack", "")
+                print("      " + stack.rstrip().replace("\n", "\n      "))
     ray_tpu.shutdown()
     return 0
 
@@ -448,6 +539,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("status", help="cluster nodes + resources")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("health",
+                        help="stall sentinel: stalled tasks/transfers, "
+                             "hung collectives, straggler scores")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--verbose", action="store_true",
+                    help="print captured stacks inline")
+    sp.add_argument("--events", type=int, default=20,
+                    help="recent stall_sentinel events to show")
+    sp.set_defaults(fn=cmd_health)
+
+    sp = sub.add_parser("stacks",
+                        help="live Python stacks of every worker "
+                             "(cluster-wide py-spy dump)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--node", default=None,
+                    help="node id hex prefix (default: all nodes)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_stacks)
 
     sp = sub.add_parser("job")
     sp.add_argument("--address", default=None)

@@ -1,12 +1,15 @@
 """Observability smoke lane (run by ci.sh): exercise the flight
 recorder end to end on a tiny live cluster — task lifecycle transitions
 in GCS, Perfetto timeline export with flow events, critical-path
-summary, and the serving histograms on the Prometheus scrape."""
+summary, the serving histograms on the Prometheus scrape — and the
+stall sentinel: an injected hang must flag, emit a WARNING event with a
+captured stack, and surface through `cli health` / `cli stacks`."""
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 import urllib.request
@@ -28,21 +31,85 @@ def _wait(pred, timeout_s: float, what: str):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+def _cli(gcs_address: str, *argv: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *argv,
+         "--address", gcs_address],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def _stall_sentinel_smoke() -> None:
+    """Injected hang -> automatic WARNING event with the worker's stack,
+    surfaced end to end through `cli health` and `cli stacks`."""
+    @ray_tpu.remote
+    def smoke_hang():
+        time.sleep(12)
+        return "ok"
+
+    ref = smoke_hang.remote()
+    stalls = _wait(lambda: state.list_stalls().get("tasks"), 15,
+                   "stall sentinel to flag the hung task")
+    assert "time.sleep" in stalls[0]["stack"], stalls[0]
+    events = _wait(
+        lambda: [e for e in state.list_cluster_events(
+            source="stall_sentinel", severity="WARNING")
+            if e.get("kind") == "task_stall"],
+        10, "WARNING cluster event for the stall")
+    assert "smoke_hang" in events[-1]["message"], events[-1]
+    assert "time.sleep" in events[-1].get("stack", ""), events[-1]
+
+    from ray_tpu import _worker_api
+
+    addr = _worker_api.node().gcs_address
+    health = _cli(addr, "health")
+    # rc=1 is the health view's "stalls present" signal
+    assert health.returncode == 1, (health.returncode, health.stdout,
+                                    health.stderr)
+    assert "stalled tasks: 1" in health.stdout, health.stdout
+    assert "smoke_hang" in health.stdout, health.stdout
+    assert "stall_sentinel events" in health.stdout, health.stdout
+
+    stacks = _cli(addr, "stacks")
+    assert stacks.returncode == 0, (stacks.returncode, stacks.stderr)
+    assert "smoke_hang" in stacks.stdout, stacks.stdout
+    assert "time.sleep" in stacks.stdout, stacks.stdout
+
+    assert ray_tpu.get(ref, timeout=60) == "ok"
+    _wait(lambda: not state.list_stalls().get("tasks"), 10,
+          "stall record to clear after completion")
+    health = _cli(addr, "health")
+    assert health.returncode == 0, (health.returncode, health.stdout,
+                                    health.stderr)
+    assert "stalled tasks: 0" in health.stdout, health.stdout
+
+
 def main() -> int:
-    ray_tpu.init(num_cpus=4)
+    ray_tpu.init(num_cpus=4, _system_config={
+        # tight stall thresholds so the injected hang flags in seconds
+        "task_watchdog_interval_s": 0.5,
+        "task_stall_threshold_s": 2.0,
+    })
     try:
         # num_cpus=0.5 forces the full lease pipeline (the fastlane
         # shortcut skips the scheduling-phase transitions)
         @ray_tpu.remote(num_cpus=0.5)
         def double(x):
+            # measurable execution phase: a microsecond-fast body can
+            # collapse RUNNING->OUTPUT_SEALED to 0 and flake the
+            # execution>0 assertion below
+            time.sleep(0.05)
             return x * 2
 
         assert ray_tpu.get([double.remote(i) for i in range(4)],
                            timeout=60) == [0, 2, 4, 6]
 
+        # >= 6 means the worker-side transitions (RUNNING/OUTPUT_SEALED/
+        # FINISHED) landed too — owner-side records alone satisfy >= 3
+        # and would let the summary below run on a partial lifecycle
         recorded = _wait(
             lambda: [t for t in state.list_tasks()
-                     if len(t.get("state_transitions") or []) >= 3],
+                     if len(t.get("state_transitions") or []) >= 6],
             10, "task lifecycle transitions in GCS")
         assert len(recorded) >= 4, f"only {len(recorded)} tasks recorded"
 
@@ -83,6 +150,7 @@ def main() -> int:
         assert "serve_replica_queue_depth" in text, text[-2000:]
 
         serve.shutdown()
+        _stall_sentinel_smoke()
         print("observability smoke ok")
         return 0
     finally:

@@ -4,6 +4,7 @@ list_placement_groups/list_tasks/list_objects, backed by GCS tables)."""
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 
@@ -24,13 +25,19 @@ def _hexid(v) -> str:
 def list_nodes() -> List[Dict[str, Any]]:
     core = _core()
     infos = core.io.run(core.gcs.call("get_all_nodes", {}))
-    return [
-        {"node_id": n.node_id.hex(), "state": "ALIVE" if n.alive else "DEAD",
-         "address": n.address, "resources_total": n.resources_total,
-         "resources_available": n.resources_available, "labels": n.labels,
-         "clock_offset": getattr(n, "clock_offset", 0.0)}
-        for n in infos
-    ]
+    now = time.time()
+    out = []
+    for n in infos:
+        hb = getattr(n, "last_heartbeat_t", 0.0) or 0.0
+        out.append(
+            {"node_id": n.node_id.hex(),
+             "state": "ALIVE" if n.alive else "DEAD",
+             "address": n.address, "resources_total": n.resources_total,
+             "resources_available": n.resources_available, "labels": n.labels,
+             "clock_offset": getattr(n, "clock_offset", 0.0),
+             # None until the first heartbeat lands (pre-upgrade records)
+             "heartbeat_age_s": max(0.0, now - hb) if hb > 0 else None})
+    return out
 
 
 def list_actors(*, state: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -168,8 +175,42 @@ def summarize_tasks(breakdown: bool = False):
         for a, b in zip(trs, trs[1:]):
             dur = max(0.0, b["ts"] - a["ts"])
             phases[PHASE_OF_DEST.get(b["state"], "other")] += dur
+    try:
+        stragglers = straggler_scores()
+    except Exception:
+        stragglers = []
     return {"states": counts, "phases": phases,
-            "tasks_with_transitions": covered, "wall_time_s": wall}
+            "tasks_with_transitions": covered, "wall_time_s": wall,
+            "straggler_scores": stragglers}
+
+
+def list_stalls() -> Dict[str, Any]:
+    """Current stall-sentinel suspects, cluster-wide: tasks RUNNING past
+    their adaptive threshold (raylet task watchdog), pulls with no byte
+    progress (transfer stall detector), and flagged hung collectives
+    (GCS collective watchdog). Each task record carries the captured
+    Python stack of the implicated worker."""
+    core = _core()
+    return core.io.run(core.gcs.call("list_stalls", {}))
+
+
+def straggler_scores() -> List[Dict[str, Any]]:
+    """Per-host straggler attribution from collective arrival skew:
+    hosts sorted by normalized EMA lateness (score > 1.0 means slower
+    than the cluster mean), with per-step skew histograms."""
+    core = _core()
+    return core.io.run(core.gcs.call("straggler_scores", {}))
+
+
+def dump_stacks(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Live Python stacks of every worker thread, annotated with the
+    task each thread is executing and its time-in-state. With
+    ``node_id`` (hex prefix) asks that node's raylet; otherwise fans
+    out over every alive node via the GCS."""
+    if node_id is not None:
+        return [_raylet_call(node_id, "dump_worker_stacks", {})]
+    core = _core()
+    return core.io.run(core.gcs.call("dump_all_stacks", {}))
 
 
 def get_metrics(name: Optional[str] = None) -> List[Dict[str, Any]]:

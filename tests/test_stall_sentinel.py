@@ -1,0 +1,259 @@
+"""Stall sentinel: hang/straggler detection with remote stack capture.
+
+Injected hangs — a task sleeping past its threshold, a collective with
+some-but-not-all arrivals, a pull whose watermark stops moving — must
+each produce a WARNING cluster event naming the stalled party (with a
+captured Python stack for task stalls) with no human action, plus show
+up in the state API (list_stalls / straggler_scores / dump_stacks)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import _worker_api
+from ray_tpu.exceptions import CollectiveTimeoutError
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, _system_config={
+        # tight thresholds so injected hangs flag within seconds
+        "task_watchdog_interval_s": 0.5,
+        "task_stall_threshold_s": 2.0,
+        "collective_watchdog_interval_s": 0.5,
+        "collective_stall_timeout_s": 2.0,
+        "transfer_stall_timeout_s": 1.0,
+    })
+    yield
+    ray_tpu.shutdown()
+
+
+def _poll(fn, timeout=20, period=0.25):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(period)
+    return last
+
+
+def _gcs_call(method, payload):
+    core = state._core()
+    return core.io.run(core.gcs.call(method, payload))
+
+
+def _sentinel_events(predicate):
+    return [e for e in state.list_cluster_events(source="stall_sentinel")
+            if predicate(e)]
+
+
+# ------------------------------------------------------------ task stalls
+
+def test_stalled_task_flagged_with_stack(ray_cluster):
+    """A task RUNNING past the adaptive threshold is flagged by the
+    raylet watchdog: list_stalls names it, the WARNING event carries the
+    worker's captured stack, and the record clears once it finishes."""
+    @ray_tpu.remote
+    def sleepy_stall_target():
+        time.sleep(14)
+        return "done"
+
+    ref = sleepy_stall_target.remote()
+    stalls = _poll(lambda: state.list_stalls().get("tasks"), timeout=12)
+    assert stalls, "watchdog never flagged the sleeping task"
+    rec = next(s for s in stalls if "sleepy_stall_target" in s["fn"])
+    assert rec["kind"] == "task_stall"
+    assert rec["age_s"] >= rec["threshold_s"] >= 2.0
+    assert rec["node_id"] and rec["worker_id"] and rec["pid"]
+    # the captured stack points INSIDE the hung function
+    assert "time.sleep" in rec["stack"], rec["stack"][:2000]
+    assert "sleepy_stall_target" in rec["stack"]
+
+    events = _sentinel_events(
+        lambda e: e.get("kind") == "task_stall"
+        and "sleepy_stall_target" in e.get("message", ""))
+    assert events, "no WARNING cluster event for the stalled task"
+    ev = events[-1]
+    assert ev["severity"] == "WARNING"
+    assert "stalled" in ev["message"]
+    assert "time.sleep" in ev.get("stack", "")
+
+    assert ray_tpu.get(ref, timeout=30) == "done"
+    # resolved stalls drop off the live view on the next tick
+    cleared = _poll(
+        lambda: not any("sleepy_stall_target" in s["fn"]
+                        for s in state.list_stalls().get("tasks", [])),
+        timeout=10)
+    assert cleared, "stall record survived task completion"
+
+
+def test_dump_stacks_annotates_running_task(ray_cluster):
+    """dump_stacks (the cluster py-spy) annotates the executor thread
+    with the task it is running and its time-in-state."""
+    @ray_tpu.remote
+    def sleepy_dump_target():
+        time.sleep(8)
+        return 1
+
+    ref = sleepy_dump_target.remote()
+
+    def _find():
+        for node in state.dump_stacks():
+            for w in node.get("workers", []):
+                for th in w.get("threads", []):
+                    if (th.get("task_id")
+                            and "sleepy_dump_target" in th.get("fn", "")):
+                        return [(node, w, th)]
+        return []
+
+    found = _poll(_find, timeout=10)
+    assert found, "no thread annotated with the running task"
+    node, worker, th = found[0]
+    assert node["node_id"] and worker.get("pid")
+    assert th["running_for_s"] >= 0
+    assert "time.sleep" in th["stack"]
+    assert ray_tpu.get(ref, timeout=30) == 1
+
+
+# ---------------------------------------------------- collective watchdog
+
+def test_barrier_timeout_names_missing_ranks(ray_cluster):
+    """barrier(timeout_s=...) on a multi-process group raises a
+    CollectiveTimeoutError naming the ranks that never arrived."""
+    from ray_tpu.parallel import build_mesh, MeshSpec, pgroup
+
+    import jax
+
+    mesh = build_mesh(MeshSpec(dp=8), jax.devices("cpu")[:8])
+    g = pgroup(mesh, "dp", group_name="tmo_group", rank=0, world_size=2)
+    t0 = time.time()
+    with pytest.raises(CollectiveTimeoutError) as exc:
+        g.barrier(timeout_s=1.5)
+    assert time.time() - t0 < 15
+    assert exc.value.missing_ranks == [1]
+    assert "barrier" in str(exc.value)
+    assert "missing ranks" in str(exc.value)
+
+
+def test_hung_collective_event_with_stacks(ray_cluster):
+    """A collective with some-but-not-all arrivals past its deadline is
+    flagged by the GCS watchdog: the WARNING event names the missing
+    ranks/hosts and attaches worker stacks pulled from the cluster."""
+    core = state._core()
+    now = time.time()
+    for rank, host in ((0, "hostA"), (1, "hostB")):
+        _gcs_call("collective_arrival", {
+            "group": "hung_group", "step": 0, "rank": rank, "size": 3,
+            "op": "allreduce", "t": now,
+            "node_id": core.node_id.hex(), "host": host,
+            "deadline_s": 1.0})
+
+    events = _poll(lambda: _sentinel_events(
+        lambda e: e.get("kind") == "collective_stall"
+        and e.get("group") == "hung_group"), timeout=15)
+    assert events, "watchdog never flagged the hung collective"
+    ev = events[-1]
+    assert ev["severity"] == "WARNING"
+    assert "hung collective" in ev["message"]
+    assert ev["missing_ranks"] == [2]
+    assert ev["arrived_ranks"] == [0, 1]
+    assert "rank" in str(ev["missing_hosts"]) or ev["missing_hosts"]
+    # stack forensics swept from the implicated (here: all alive) nodes
+    assert isinstance(ev.get("stacks"), dict) and ev["stacks"]
+
+    stalls = state.list_stalls()
+    hung = [c for c in stalls.get("collectives", [])
+            if c["group"] == "hung_group"]
+    assert hung and hung[0]["missing_ranks"] == [2]
+    assert hung[0]["size"] == 3 and hung[0]["op"] == "allreduce"
+
+
+def test_straggler_scores_attribute_slow_host(ray_cluster):
+    """Completed steps roll arrival skew into per-host straggler scores:
+    the persistently-late host floats to the top with score > 1."""
+    core = state._core()
+    base = time.time()
+    for step in range(3):
+        t0 = base + step
+        _gcs_call("collective_arrival", {
+            "group": "skew_group", "step": step, "rank": 0, "size": 2,
+            "op": "allreduce", "t": t0, "node_id": "", "host": "fasthost",
+            "deadline_s": 0})
+        _gcs_call("collective_arrival", {
+            "group": "skew_group", "step": step, "rank": 1, "size": 2,
+            "op": "allreduce", "t": t0 + 0.4, "node_id": "",
+            "host": "slowhost", "deadline_s": 0})
+
+    scores = state.straggler_scores()
+    by_host = {s["host"]: s for s in scores}
+    assert "slowhost" in by_host and "fasthost" in by_host
+    slow, fast = by_host["slowhost"], by_host["fasthost"]
+    assert slow["score"] > 1.0 > fast["score"]
+    assert slow["worst_count"] == 3 and slow["steps"] == 3
+    assert slow["hist"].get("100ms-1s") == 3
+    assert slow["ema_lateness_s"] > fast["ema_lateness_s"]
+    # ranked slowest-first, and surfaced in the task summary report
+    assert scores[0]["host"] == "slowhost" or scores[0]["score"] >= slow["score"]
+    report = state.summarize_tasks(breakdown=True)
+    assert any(s["host"] == "slowhost"
+               for s in report["straggler_scores"])
+
+
+# ------------------------------------------------------- transfer stalls
+
+def test_transfer_stall_detected(ray_cluster):
+    """A pull whose contiguous watermark stops advancing shows up in
+    stalled_pulls and is flagged by the raylet watchdog tick."""
+    from ray_tpu._private.ids import ObjectID
+
+    node = _worker_api.node()
+    store = node.store
+    oid = ObjectID.from_random()
+    buf, entry = store.create_streaming(oid, 4096)
+    try:
+        entry.advance(1024)  # some progress, then silence
+        # immediate unit view: watermark registry doubles as progress meter
+        assert store.stalled_pulls(0.0)
+        assert not store.stalled_pulls(3600.0)
+
+        stalls = _poll(
+            lambda: [s for s in state.list_stalls().get("transfers", [])
+                     if s["object_id"] == oid.hex()], timeout=12)
+        assert stalls, "watchdog never flagged the byte-stalled pull"
+        rec = stalls[0]
+        assert rec["kind"] == "transfer_stall"
+        assert rec["watermark"] == 1024 and rec["size"] == 4096
+        assert rec["stalled_for_s"] >= 1.0
+        assert rec["node_id"] == node.node_id.hex()
+
+        events = _sentinel_events(
+            lambda e: e.get("kind") == "transfer_stall"
+            and e.get("object_id") == oid.hex())
+        assert events and events[-1]["severity"] == "WARNING"
+        assert "no byte progress" in events[-1]["message"]
+    finally:
+        store.abort(oid)
+    cleared = _poll(
+        lambda: not any(s["object_id"] == oid.hex()
+                        for s in state.list_stalls().get("transfers", [])),
+        timeout=10)
+    assert cleared, "transfer stall record survived the abort"
+
+
+# ----------------------------------------------------- node health surface
+
+def test_nodes_report_heartbeat_and_clock(ray_cluster):
+    nodes = state.list_nodes()
+    assert nodes
+    for n in nodes:
+        assert "clock_offset" in n
+        assert n["heartbeat_age_s"] is not None
+        assert 0 <= n["heartbeat_age_s"] < 120
+    api_nodes = _worker_api.nodes()
+    for n in api_nodes:
+        assert "ClockOffset" in n
+        assert n["HeartbeatAgeS"] is not None
