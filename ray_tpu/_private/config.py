@@ -127,6 +127,12 @@ class Config:
     max_lineage_bytes: int = 1024**3
     # --- chaos / testing (mirrors rpc_chaos.h fault injection) ---
     testing_rpc_failure: str = ""             # "method=prob_req:prob_resp,..."
+    # graftlint runtime lock-order witness (devtools/graftlint/witness):
+    # control-plane locks built through _private/locking.py become
+    # instrumented WitnessLocks feeding a global lockdep-style order
+    # graph that raises on cycle formation. Debug/CI-stress only —
+    # read at lock CONSTRUCTION, so flip it before init().
+    lock_witness_enabled: bool = False
     # locality-aware leasing: lease at the node holding a task's argument
     # bytes when the known dependency mass there reaches this many bytes
     # (ref: lease_policy.h LocalityAwareLeasePolicy). 0 disables.

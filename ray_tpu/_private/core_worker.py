@@ -37,10 +37,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from .config import global_config
+from . import locking
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_ref import ObjectRef, ObjectRefGenerator, _set_ref_registry
 from .object_store import MemoryStore, SharedObjectStore
-from .rpc import ConnectionLost, EventLoopThread, RpcClient
+from .rpc import ConnectionLost, EventLoopThread, RpcClient, background
 from . import serialization as ser
 from .task_spec import (
     ArgKind,
@@ -144,11 +145,11 @@ class CoreWorker:
                                  else TaskID.for_normal_task(job_id))
         self._task_local = threading.local()  # per-execution-thread task context
         self._put_index = 0
-        self._put_lock = threading.Lock()
+        self._put_lock = locking.make_lock("CoreWorker._put_lock")
         self._subscribed_channels: set = set()
         self._actor_sub_tasks: Dict[str, asyncio.Task] = {}
         self._block_depth = 0          # worker dep-block nesting
-        self._block_lock = threading.Lock()
+        self._block_lock = locking.make_lock("CoreWorker._block_lock")
 
         # reference counting — native C++ table by default (ref:
         # reference_count.h:66; native/core_tables.cc), Python dicts as
@@ -164,7 +165,7 @@ class CoreWorker:
         self._local_refs: Dict[ObjectID, int] = {}
         self._borrowed: Dict[ObjectID, str] = {}
         self._task_deps: Dict[ObjectID, int] = {}
-        self._ref_lock = threading.Lock()
+        self._ref_lock = locking.make_lock("CoreWorker._ref_lock")
         self._owned_in_plasma: set = set()
 
         # submission state
@@ -200,7 +201,7 @@ class CoreWorker:
         self._streams: Dict[TaskID, _StreamState] = {}
         # task events buffered toward the GCS (ref: task_event_buffer.h)
         self._task_events: List[dict] = []
-        self._task_events_lock = threading.Lock()
+        self._task_events_lock = locking.make_lock("CoreWorker._task_events_lock")
         self._task_event_flusher_armed = False
         self.address = ""  # worker-mode processes set their push address
         self._owner_server = None  # drivers: serves owned small objects
@@ -1695,7 +1696,7 @@ class CoreWorker:
             return None
         state.consumed += 1
         if state.worker_address:
-            asyncio.ensure_future(self._send_stream_ack(task_id, state))
+            background(self._send_stream_ack(task_id, state))
         return item
 
     async def _send_stream_ack(self, task_id: TaskID, state: _StreamState):

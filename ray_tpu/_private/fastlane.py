@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import locking
 from .ids import ObjectID
 from .task_spec import ArgKind, TaskSpec
 from .. import exceptions as exc
@@ -94,13 +95,13 @@ class _Lane:
         self.dead = False
         self.on_slot: Optional[Callable[[], None]] = None  # pool wakeup
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("_Lane._lock")
         # serializes EVERY cross-thread ring touch against teardown:
         # free() (munmap) must never run under a concurrent push OR
         # close_write — rtpu_ring_close on a freed mapping segfaults
         # (observed: reclaim-path close() racing the reply thread's
         # _cleanup_rings)
-        self._push_lock = threading.Lock()
+        self._push_lock = locking.make_lock("_Lane._push_lock")
         self._sub_freed = False
         self._rep_freed = False
         self._reply_thread = threading.Thread(
@@ -340,10 +341,10 @@ class LanePool:
         self.lanes: List[_Lane] = []
         self._growing = False
         self._grow_fail_until = 0.0
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("LanePool._lock")
         self.closed = False
         self._queue: List[Tuple[TaskSpec, threading.Event]] = []
-        self._qlock = threading.Lock()
+        self._qlock = locking.make_lock("LanePool._qlock")
         self._qevent = threading.Event()
         self._slot = threading.Event()
         self._feeder = threading.Thread(target=self._feed_loop, daemon=True,
@@ -623,7 +624,7 @@ class ActorLane:
         self.lane: Optional[_Lane] = None
         self.state = "attaching"  # attaching | up | down
         self._buffer: List[Tuple[TaskSpec, threading.Event]] = []
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("ActorLane._lock")
         self._flush_event = threading.Event()
         core.io.spawn(self._attach())
 

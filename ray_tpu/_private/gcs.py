@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .gcs_storage import RemoteStoreClient, Storage
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
-from .rpc import RpcServer, ServerConnection
+from .rpc import RpcServer, ServerConnection, background
 
 # Actor lifecycle states (ref: gcs.proto ActorTableData.ActorState)
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
@@ -329,7 +329,7 @@ class GcsServer:
                "severity": severity, "message": message, **fields}
         self.events.append(rec)
         # streamed to subscribers too (dashboard live tail)
-        asyncio.ensure_future(self._publish("events", rec))
+        background(self._publish("events", rec))
 
     async def handle_list_events(self, payload, conn):
         source = payload.get("source")
@@ -738,7 +738,7 @@ class GcsServer:
                 self._persist("actors", actor.actor_id.hex(), actor)
                 await self._publish_actor(actor)
                 if address:
-                    asyncio.ensure_future(self._kill_actor_process(address))
+                    background(self._kill_actor_process(address))
 
     async def _kill_actor_process(self, address: str):
         from .rpc import RpcClient
@@ -889,7 +889,7 @@ class GcsServer:
         if actor.state == DEAD:
             # killed while still creating (driver exited, explicit kill):
             # do NOT resurrect — put the late-arriving worker down instead
-            asyncio.ensure_future(
+            background(
                 self._kill_actor_process(payload["address"]))
             return False
         actor.state = ALIVE

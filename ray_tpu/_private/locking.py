@@ -1,0 +1,50 @@
+"""Lock construction for the control plane.
+
+Every instance lock in the Python planes is made here instead of via
+bare ``threading.Lock()`` so the graftlint runtime witness (devtools/
+graftlint/witness.py) can interpose: with ``lock_witness_enabled`` set
+(``RAY_TPU_LOCK_WITNESS_ENABLED=1``, used by tests/CI stress runs),
+every acquisition feeds a global lockdep-style order graph that raises
+``LockOrderViolation`` — with both formation stacks — the moment two
+threads establish inverted orders, instead of wedging silently later.
+
+Production pays one config check per lock *construction* and zero cost
+per acquisition.
+
+The ``name`` is the lock's class in the witness graph: one name per
+role ("ObjectStore._lock"), shared across instances.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .config import global_config
+
+
+def witness_enabled() -> bool:
+    return bool(getattr(global_config(), "lock_witness_enabled", False))
+
+
+def make_lock(name: str):
+    if witness_enabled():
+        from ..devtools.graftlint.witness import WitnessLock
+
+        return WitnessLock(name)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    if witness_enabled():
+        from ..devtools.graftlint.witness import WitnessLock
+
+        return WitnessLock(name, reentrant=True)
+    return threading.RLock()
+
+
+def make_condition(name: str):
+    if witness_enabled():
+        from ..devtools.graftlint.witness import make_condition as _mk
+
+        return _mk(name)
+    return threading.Condition()

@@ -29,6 +29,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from . import locking
 from .ids import ObjectID
 from ..util.tracing import record_lane_event
 
@@ -82,7 +83,7 @@ class InProgress:
         # moving shows up as (now - last_progress_t) in stalled_pulls()
         self.started_at = time.time()
         self.last_progress_t = self.started_at
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("InProgress._lock")
         self._waiters: List[tuple] = []
 
     def advance(self, watermark: int) -> None:
@@ -156,7 +157,7 @@ class _RestoreGate:
         self.budget = budget
         self._inflight = 0
         self._count = 0
-        self._cond = threading.Condition()
+        self._cond = locking.make_condition("_RestoreGate._cond")
 
     def acquire(self, nbytes: int) -> None:
         with self._cond:
@@ -294,7 +295,7 @@ class SharedObjectStore:
         else:
             self.spill_dir = None
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("SharedObjectStore._lock")
         self._used = 0
         # streaming creations (cut-through watermark), per process
         self._inprogress: Dict[ObjectID, InProgress] = {}
@@ -961,7 +962,7 @@ class MemoryStore:
 
     def __init__(self):
         self._objects: Dict[ObjectID, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("MemoryStore._lock")
         self._waiters: Dict[ObjectID, list] = {}
 
     def put(self, oid: ObjectID, data: bytes) -> None:

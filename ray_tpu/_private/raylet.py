@@ -38,7 +38,8 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from .config import global_config, session_log_dir
 from .ids import ActorID, NodeID, ObjectID, WorkerID
 from .object_store import SharedObjectStore
-from .rpc import ConnectionLost, RpcClient, RpcServer, ServerConnection
+from .rpc import (ConnectionLost, RpcClient, RpcServer, ServerConnection,
+                  background)
 from .task_spec import (
     DefaultSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
@@ -344,11 +345,11 @@ class Raylet:
             for _ in range(min(2, self.max_workers)):
                 self._spawn_worker()
         if self.cfg.memory_monitor_refresh_ms > 0:
-            asyncio.ensure_future(self._memory_monitor_loop())
+            background(self._memory_monitor_loop())
         if self.cfg.clock_sync_interval_s > 0:
-            asyncio.ensure_future(self._clock_sync_loop())
+            background(self._clock_sync_loop())
         if self.cfg.task_watchdog_interval_s > 0:
-            asyncio.ensure_future(self._task_watchdog_loop())
+            background(self._task_watchdog_loop())
 
     async def _clock_sync_loop(self):
         """Estimate this node's clock offset against the GCS clock by
@@ -664,7 +665,7 @@ class Raylet:
                     proc.kill()
                 except Exception:
                     pass
-        self._await_factory_workers(deadline)
+        await self._await_factory_workers(deadline)
         self._signal_factory_workers(9)
 
     async def die(self):
@@ -703,7 +704,7 @@ class Raylet:
         if entry is not None:
             self._remote_nodes[node_id] = (entry[0], ResourceSet(avail))
             if self._pending_leases:  # capacity elsewhere: try spillback
-                asyncio.ensure_future(self._pump_pending())
+                background(self._pump_pending())
 
     def _apply_peer_resources(self, node_hex: str,
                               available: dict) -> None:
@@ -721,7 +722,7 @@ class Raylet:
             return
         self._remote_nodes[node_id] = (entry[0], ResourceSet(available))
         if self._pending_leases:
-            asyncio.ensure_future(self._pump_pending())
+            background(self._pump_pending())
 
     async def handle_syncer_sync(self, payload, conn):
         if self.syncer is None:
@@ -747,7 +748,7 @@ class Raylet:
                 self._remote_nodes[info.node_id] = (info.address, ResourceSet(info.resources_available))
                 self._node_labels[info.node_id] = dict(info.labels or {})
                 if self._pending_leases:  # a new node may fit queued work
-                    asyncio.ensure_future(self._pump_pending())
+                    background(self._pump_pending())
         elif payload["event"] == "removed":
             node_id = payload.get("node_id")
             self._remote_nodes.pop(node_id, None)
@@ -779,14 +780,14 @@ class Raylet:
             except Exception:
                 pass
 
-        asyncio.ensure_future(_send())
+        background(_send())
 
     # ---------------------------------------------------------- worker pool
     def _spawn_worker(self) -> None:
         self._starting += 1
         env, log_path = self._worker_env()
         if self.cfg.worker_factory_enabled:
-            asyncio.ensure_future(self._spawn_via_factory(env, log_path))
+            background(self._spawn_via_factory(env, log_path))
         else:
             self._popen_worker(env, log_path)
 
@@ -946,10 +947,13 @@ class Raylet:
             except PermissionError:
                 pass
 
-    def _await_factory_workers(self, deadline: float) -> None:
+    async def _await_factory_workers(self, deadline: float) -> None:
         """Give SIGTERM'd factory workers the same grace window Popen
         workers get before the SIGKILL pass (they are the factory's
-        children, not ours — no waitpid, poll liveness instead)."""
+        children, not ours — no waitpid, poll liveness instead).
+        Async: this runs on the raylet's io loop during stop(), and a
+        sleeping poll there would freeze every other connection for the
+        full grace window (graftlint: blocking-call-on-loop)."""
         while self._factory_pids and time.monotonic() < deadline:
             for pid in list(self._factory_pids):
                 try:
@@ -959,7 +963,7 @@ class Raylet:
                 except PermissionError:
                     pass
             if self._factory_pids:
-                time.sleep(0.05)
+                await asyncio.sleep(0.05)
 
     async def handle_register_worker(self, payload, conn):
         worker = WorkerHandle(
@@ -1588,7 +1592,7 @@ class Raylet:
     async def handle_object_sealed(self, payload, conn):
         oid, size = payload["object_id"], payload["size"]
         self._mark_local_sealed(oid, size)
-        asyncio.ensure_future(self._report_location(oid))
+        background(self._report_location(oid))
         return True
 
     async def handle_objects_sealed_batch(self, payload, conn):
@@ -1598,7 +1602,7 @@ class Raylet:
         for oid, size in payload["objects"]:
             self._mark_local_sealed(oid, size)
             oids.append(oid)
-        asyncio.ensure_future(self._report_locations(oids))
+        background(self._report_locations(oids))
         return True
 
     async def _report_locations(self, oids: List[ObjectID]):
@@ -1688,7 +1692,7 @@ class Raylet:
                                      self._transfer_token_high):
                             while len(book) > 4096:
                                 book.pop(next(iter(book)))
-                        asyncio.ensure_future(self._report_location(oid))
+                        background(self._report_location(oid))
                         return size
                     # holder no longer has it: drop the stale location
                     await self.gcs.call("remove_object_location", {
@@ -1697,7 +1701,7 @@ class Raylet:
                     continue
                 finally:
                     if token:
-                        asyncio.ensure_future(self._release_transfer_token(
+                        background(self._release_transfer_token(
                             oid, address))
             if denied:
                 # every holder is saturated: a fresh copy registers soon
@@ -1826,7 +1830,7 @@ class Raylet:
                 # our watermark instead of waiting for our seal, so a
                 # broadcast tree pipelines across its depth (retracted
                 # below if the pull dies)
-                asyncio.ensure_future(self._report_location(oid))
+                background(self._report_location(oid))
                 return buf
 
             try:
@@ -1974,7 +1978,7 @@ class Raylet:
     async def handle_free_objects(self, payload, conn):
         for oid in payload["object_ids"]:
             if self._sealed.pop(oid, None) is not None or self.store.contains(oid):
-                asyncio.ensure_future(self._drop_location(oid))
+                background(self._drop_location(oid))
             self.store.delete(oid)
             self._transfer_tokens.pop(oid, None)
             self._transfer_token_high.pop(oid, None)

@@ -28,6 +28,7 @@ import asyncio
 import itertools
 import random
 import struct
+import sys
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional
 
@@ -57,6 +58,35 @@ def parse_address(address: str):
         return ("unix", address)
     host, _, port = address.rpartition(":")
     return ("tcp", host or "127.0.0.1", int(port))
+
+
+# The event loop keeps only WEAK references to tasks: a bare
+# ``asyncio.ensure_future(coro())`` statement can be garbage-collected
+# mid-await (observed here as spurious GeneratorExit under GC pressure),
+# and its exception is never retrieved. Every fire-and-forget spawn in
+# the control plane goes through background(), which pins the task until
+# it finishes and drains the exception so the loop never logs
+# "exception was never retrieved" at interpreter teardown.
+_BACKGROUND_TASKS: set = set()
+
+
+def background(coro) -> "asyncio.Future":
+    """Spawn ``coro`` on the running loop, retaining a strong reference
+    until completion; exceptions are retrieved (and dropped) on done."""
+    task = asyncio.ensure_future(coro)
+    _BACKGROUND_TASKS.add(task)
+
+    def _done(t):
+        _BACKGROUND_TASKS.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None and not isinstance(
+                exc, (ConnectionError, ConnectionLost, OSError)):
+            print(f"[rpc] background task failed: {exc!r}", file=sys.stderr)
+
+    task.add_done_callback(_done)
+    return task
 
 
 class _ChaosInjector:
@@ -202,7 +232,7 @@ class RpcServer:
                     continue
                 if self._chaos.should_drop_request(method):
                     continue  # simulate lost request
-                asyncio.ensure_future(self._dispatch(conn, msg_id, method, payload))
+                background(self._dispatch(conn, msg_id, method, payload))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -284,7 +314,7 @@ class RpcClient:
         self._recv_task = asyncio.ensure_future(self._recv_loop())
         if self._ever_connected:
             for cb in list(self.on_reconnect):
-                asyncio.ensure_future(cb())
+                background(cb())
         self._ever_connected = True
 
     async def _recv_loop(self):
@@ -296,7 +326,7 @@ class RpcClient:
                     if handler is not None:
                         res = handler(payload)
                         if asyncio.iscoroutine(res):
-                            asyncio.ensure_future(res)
+                            background(res)
                     continue
                 fut = self._pending.pop(msg_id, None)
                 if fut is None or fut.done():
@@ -456,7 +486,7 @@ class EventLoopThread:
             self.loop.stop()
 
         def _kick():
-            asyncio.ensure_future(_drain())
+            background(_drain())
 
         try:
             self.loop.call_soon_threadsafe(_kick)

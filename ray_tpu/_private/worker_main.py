@@ -30,6 +30,7 @@ from typing import Any, List, Optional, Tuple
 import cloudpickle
 
 from .config import global_config
+from . import locking
 from .core_worker import CoreWorker
 from .ids import JobID, NodeID, ObjectID, WorkerID
 from .object_store import SharedObjectStore
@@ -100,7 +101,7 @@ class _GenBudget:
     def __init__(self, threshold: int):
         self.threshold = threshold
         self.consumed = 0
-        self._cond = threading.Condition()
+        self._cond = locking.make_condition("_GenBudget._cond")
 
     def ack(self, consumed: int) -> None:
         with self._cond:
@@ -127,7 +128,7 @@ class SealBatcher:
         self.raylet = raylet
         self.window_s = window_s
         self._q: List[Tuple[ObjectID, int]] = []
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("SealBatcher._lock")
         self._event = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="seal_batcher")
@@ -192,7 +193,7 @@ class TaskExecutor:
         self._running_since: dict = {}
         # (fn name, duration) of completions since the last stall_probe
         self._completed_durations: List[Tuple[str, float]] = []
-        self._durations_lock = threading.Lock()
+        self._durations_lock = locking.make_lock("TaskExecutor._durations_lock")
 
     def _register_running(self, task_id, fn_name: str = "") -> None:
         """Bind the executing thread; honor a cancel that raced startup."""

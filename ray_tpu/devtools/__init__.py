@@ -1,0 +1,5 @@
+"""Developer tooling for the ray_tpu codebase (not part of the runtime).
+
+Everything under this package is import-safe without jax/np — the lint
+runs in CI before the native build, so it must not drag the framework in.
+"""

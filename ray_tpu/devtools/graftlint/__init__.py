@@ -1,0 +1,97 @@
+"""Graftlint — concurrency-hazard static analysis for the ray_tpu
+control plane, plus a runtime lock-order witness (see witness.py).
+
+Five AST passes over the whole package (ref: the reference Ray core
+leans on C++-side TSan/ASan for these bug classes; our Python planes
+get their own tooling):
+
+  * ``blocking``   — event-loop blocking-call detector
+  * ``lock-order`` — static lock-acquisition graph, cycles = deadlocks
+  * ``finalizer``  — ``__del__``/weakref callbacks touching loops/RPC/locks
+  * ``leak``       — unawaited coroutines, fire-and-forget tasks,
+    never-joined non-daemon threads
+  * ``wire``       — wire-tag registry consistency (_private/wire.py)
+
+Usage (CI runs this; `cli.py lint` is the same entry point):
+
+    python -m ray_tpu.devtools.graftlint --baseline graftlint_baseline.json
+    python -m ray_tpu.devtools.graftlint --update-baseline ...
+
+Inline suppression: ``# graftlint: ignore[pass-name]`` on the offending
+line or its enclosing ``def`` line.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Iterable, List, Optional
+
+from . import blocking, finalizers, leaks, lockorder, wirecheck
+from ._astutil import iter_functions, parse_module
+from .findings import Finding, Suppressions, assign_fingerprints
+
+PASSES: Dict[str, Callable] = {
+    "blocking": blocking.run,
+    "lock-order": lockorder.run,
+    "finalizer": finalizers.run,
+    "leak": leaks.run,
+    "wire": wirecheck.run,
+}
+
+
+def lint_source(source: str, path: str,
+                select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the selected passes over one file's source. ``path`` is the
+    repo-relative path recorded in findings."""
+    tree = parse_module(source, path)
+    if tree is None:
+        return [Finding("parse", "syntax-error", path, 1, "<module>",
+                        "file does not parse; graftlint skipped it",
+                        detail="syntax-error")]
+    sup = Suppressions(source)
+    # enclosing-def lines also accept suppressions for their body
+    def_lines: Dict[str, int] = {
+        qn: fn.lineno for qn, fn, _ in iter_functions(tree)}
+    out: List[Finding] = []
+    for name, fn in PASSES.items():
+        if select is not None and name not in select:
+            continue
+        for f in fn(tree, source, path):
+            scope_head = f.scope.split("->")[0]
+            if sup.is_suppressed(f.pass_name, f.line,
+                                 def_lines.get(scope_head, -1)):
+                continue
+            out.append(f)
+    assign_fingerprints(out)
+    return out
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None,
+               select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint .py files under the given files/directories. Findings carry
+    paths relative to ``root`` (default: common prefix's dirname)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in ("__pycache__", "build",
+                                            ".git")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    if root is None:
+        root = os.getcwd()
+    findings: List[Finding] = []
+    for fp in files:
+        try:
+            with open(fp, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(fp, root)
+        findings.extend(lint_source(source, rel, select=select))
+    assign_fingerprints(findings)
+    return findings

@@ -1,0 +1,117 @@
+"""CLI entry: ``python -m ray_tpu.devtools.graftlint`` (ci.sh's lint
+phase, also reachable as ``cli.py lint``).
+
+Exit codes: 0 = clean vs baseline, 1 = new findings (or any finding
+with no baseline given), 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import PASSES, lint_paths
+from .baseline import diff, load, save
+
+
+def _default_target() -> str:
+    # the installed ray_tpu package itself
+    here = os.path.dirname(os.path.abspath(__file__))        # .../graftlint
+    return os.path.dirname(os.path.dirname(here))            # .../ray_tpu
+
+
+def _default_root() -> str:
+    return os.path.dirname(_default_target())                # repo root
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="concurrency-hazard static analysis for ray_tpu")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the ray_tpu package)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON; only findings not in it fail the"
+                        " run (default: <repo>/graftlint_baseline.json"
+                        " when present)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings and"
+                        " exit 0")
+    p.add_argument("--select", default=None,
+                   help="comma-separated pass names "
+                        f"(available: {', '.join(PASSES)})")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--list-passes", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_passes:
+        for name in PASSES:
+            print(name)
+        return 0
+
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = select - set(PASSES)
+        if unknown:
+            print(f"unknown pass(es): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    root = _default_root()
+    paths = args.paths or [_default_target()]
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        cand = os.path.join(root, "graftlint_baseline.json")
+        if os.path.exists(cand) or args.update_baseline:
+            baseline_path = cand
+
+    findings = lint_paths(paths, root=root, select=select)
+
+    if args.update_baseline:
+        if baseline_path is None:
+            print("--update-baseline requires --baseline", file=sys.stderr)
+            return 2
+        save(baseline_path, findings)
+        print(f"baseline updated: {len(findings)} finding(s) recorded in "
+              f"{baseline_path}")
+        return 0
+
+    baseline = load(baseline_path) if baseline_path else {}
+    new, stale = diff(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "new": [f.fingerprint for f in new],
+            "stale": [e["fingerprint"] for e in stale],
+        }, indent=1, default=str))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"-- {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed findings;"
+                  " prune with --update-baseline):")
+            for e in stale:
+                print(f"   {e['path']}: [{e['pass']}/{e['rule']}] "
+                      f"({e['fingerprint']})")
+        known = len(findings) - len(new)
+        print(f"graftlint: {len(findings)} finding(s) total, "
+              f"{known} baselined, {len(new)} new")
+    if new:
+        print("graftlint: FAIL — new concurrency hazards above; fix them"
+              " or (deliberately) --update-baseline", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

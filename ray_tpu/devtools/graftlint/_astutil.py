@@ -1,0 +1,172 @@
+"""Shared AST plumbing for the graftlint passes.
+
+Everything here is heuristic by design: the passes trade soundness for
+a near-zero false-positive rate on *this* codebase's idioms (locks are
+``self._lock``-shaped attributes or names assigned from
+``threading.Lock()`` / ``locking.make_lock()``; the io loop is an
+``EventLoopThread``). The fixture suite in tests/test_graftlint.py
+pins both the true positives and the false-positive guards.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# attribute/name shapes that read as a mutex even without seeing the
+# assignment ("_lock", "registry_lock", "_cv", "cond", "_mu"...)
+_LOCKISH_RE = re.compile(
+    r"(^|_)(lock|locks|mutex|mu|cv|cond|condition)$", re.IGNORECASE)
+
+_LOCK_CTORS = {
+    ("threading", "Lock"), ("threading", "RLock"),
+    ("threading", "Condition"), ("threading", "Semaphore"),
+    ("threading", "BoundedSemaphore"),
+    ("locking", "make_lock"), ("locking", "make_rlock"),
+    ("locking", "make_condition"),
+}
+
+
+def parse_module(source: str, path: str) -> Optional[ast.Module]:
+    try:
+        return ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def terminal_attr(node: ast.AST) -> Optional[str]:
+    """Last component of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class ImportMap:
+    """module-alias resolution: `import time as _time` -> _time => time,
+    `from time import sleep` -> sleep => time.sleep."""
+
+    def __init__(self, tree: ast.Module):
+        self.mod_alias: Dict[str, str] = {}   # local name -> module
+        self.from_name: Dict[str, str] = {}   # local name -> "mod.attr"
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.mod_alias[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.from_name[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def resolve_call(self, call: ast.Call) -> Optional[str]:
+        """Canonical dotted name of the callee, imports resolved.
+        `_time.sleep(...)` -> "time.sleep"; `sleep(...)` (from time
+        import sleep) -> "time.sleep"."""
+        name = call_name(call)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        if head in self.mod_alias:
+            base = self.mod_alias[head]
+            return f"{base}.{rest}" if rest else base
+        if not rest and head in self.from_name:
+            return self.from_name[head]
+        return name
+
+
+def is_lock_ctor(call: ast.Call, imports: ImportMap) -> bool:
+    resolved = imports.resolve_call(call)
+    if resolved is None:
+        return False
+    parts = resolved.split(".")
+    if len(parts) < 2:
+        return ("", parts[0]) in {(m, f) for m, f in _LOCK_CTORS}
+    return (parts[-2], parts[-1]) in _LOCK_CTORS
+
+
+@dataclass
+class LockNames:
+    """Names/attrs known (assignment-tracked) or presumed (shape) to be
+    locks within one module."""
+    assigned: Set[str] = field(default_factory=set)   # dotted exprs
+
+    def looks_like_lock(self, expr: ast.AST) -> bool:
+        name = dotted(expr)
+        if name is not None and name in self.assigned:
+            return True
+        term = terminal_attr(expr)
+        return term is not None and bool(_LOCKISH_RE.search(term))
+
+
+def collect_lock_names(tree: ast.Module, imports: ImportMap) -> LockNames:
+    names = LockNames()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if is_lock_ctor(node.value, imports):
+                for tgt in node.targets:
+                    name = dotted(tgt)
+                    if name:
+                        names.assigned.add(name)
+    return names
+
+
+class ScopeVisitor(ast.NodeVisitor):
+    """NodeVisitor that maintains the enclosing qualname ("Cls.meth")."""
+
+    def __init__(self):
+        self._stack: List[str] = []
+
+    @property
+    def scope(self) -> str:
+        return ".".join(self._stack) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_func(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+def iter_functions(tree: ast.Module):
+    """Yield (qualname, func_node, class_node_or_None) for every def."""
+    out: List[Tuple[str, ast.AST, Optional[ast.ClassDef]]] = []
+
+    def walk(node, prefix: str, cls: Optional[ast.ClassDef]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                out.append((qn, child, cls))
+                walk(child, qn + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.", child)
+            else:
+                walk(child, prefix, cls)
+
+    walk(tree, "", None)
+    return out

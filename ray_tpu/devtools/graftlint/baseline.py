@@ -1,0 +1,60 @@
+"""Baseline (suppression) file: the ratchet that lets graftlint gate CI.
+
+The baseline records the fingerprints of known, triaged findings.
+``--baseline FILE`` makes the run exit non-zero only on findings *not*
+in the file — new hazards gate, old ones don't block unrelated PRs.
+``--update-baseline`` rewrites the file from the current findings
+(after fixing something, or after deliberately accepting a new one).
+
+Fixed findings show up as *stale* baseline entries; they are reported
+(so the file gets pruned) but never fail the run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .findings import Finding
+
+BASELINE_VERSION = 1
+
+
+def load(path: str) -> Dict[str, dict]:
+    """fingerprint -> recorded entry. Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: unsupported baseline version {data.get('version')}")
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save(path: str, findings: List[Finding]) -> None:
+    entries = [{
+        "fingerprint": f.fingerprint,
+        "pass": f.pass_name,
+        "rule": f.rule,
+        "path": f.path,
+        "scope": f.scope,
+        "message": f.message,
+    } for f in sorted(findings,
+                      key=lambda f: (f.path, f.pass_name, f.scope,
+                                     f.fingerprint))]
+    with open(path, "w") as f:
+        json.dump({"version": BASELINE_VERSION, "findings": entries},
+                  f, indent=1, ensure_ascii=False)
+        f.write("\n")
+
+
+def diff(findings: List[Finding],
+         baseline: Dict[str, dict]) -> Tuple[List[Finding], List[dict]]:
+    """(new findings not in baseline, stale baseline entries)."""
+    current = {f.fingerprint for f in findings}
+    new = [f for f in findings if f.fingerprint not in baseline]
+    stale = [e for fp, e in sorted(baseline.items())
+             if fp not in current]
+    return new, stale

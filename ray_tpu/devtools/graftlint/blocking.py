@@ -1,0 +1,231 @@
+"""Pass 1 — event-loop blocking-call detector.
+
+Flags calls that wedge an asyncio loop when they run on it:
+
+  * lexically inside ``async def`` bodies (rule
+    ``blocking-call-in-async``), and
+  * inside sync functions that are *only ever referenced from loop
+    context* — called from async bodies or handed to
+    ``call_soon``/``call_soon_threadsafe``/``call_later``/
+    ``add_done_callback`` (rule ``blocking-call-on-loop``).
+
+The blocking set: ``time.sleep``, the waiting ``subprocess`` helpers,
+``socket.create_connection``, bare ``<lock>.acquire()`` (no
+``blocking=False`` / ``timeout=``), ``<thread>.join()``, and
+``concurrent.futures`` ``.result()`` on names that read as futures.
+
+False-positive guards (pinned by the fixture tests):
+  * subtrees handed to ``run_in_executor`` / ``asyncio.to_thread`` /
+    ``Thread(target=...)`` / ``<executor>.submit`` run OFF loop — never
+    flagged;
+  * nested sync ``def``/``lambda`` inside an async body are separate
+    functions, analyzed only via the reachability layer;
+  * a sync helper with even one non-loop reference (a plain thread also
+    calls it) is exempt — "reachable ONLY from io-loop callbacks".
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ._astutil import (ImportMap, LockNames, collect_lock_names, dotted,
+                       iter_functions, terminal_attr)
+from .findings import Finding
+
+PASS_NAME = "blocking"
+
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.getoutput",
+    "socket.create_connection", "socket.getaddrinfo",
+}
+
+# receiver escapes: the callable argument runs off the loop
+_OFFLOAD_CALLS = {"run_in_executor", "to_thread", "submit", "Thread",
+                  "start_new_thread", "map"}
+
+_LOOP_CALLBACK_REGISTRARS = {"call_soon", "call_soon_threadsafe",
+                             "call_later", "call_at", "add_done_callback",
+                             "add_reader", "add_writer"}
+
+_FUTUREISH = ("fut", "future")
+_THREADISH = ("thread", "_t",)
+
+
+def _is_offload_call(call: ast.Call) -> bool:
+    name = terminal_attr(call.func)
+    return name in _OFFLOAD_CALLS
+
+
+def _blocking_reason(call: ast.Call, imports: ImportMap,
+                     locks: LockNames) -> Optional[str]:
+    """Why this call blocks, or None."""
+    resolved = imports.resolve_call(call)
+    if resolved in _BLOCKING_CALLS:
+        return resolved
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    if attr == "acquire" and locks.looks_like_lock(func.value):
+        # non-blocking / bounded acquires are fine on the loop
+        for kw in call.keywords:
+            if kw.arg in ("blocking", "timeout"):
+                return None
+        if call.args:  # positional blocking=False / timeout
+            return None
+        return f"{dotted(func) or attr}() [unbounded lock acquire]"
+    if attr == "join":
+        recv = terminal_attr(func.value)
+        if recv and any(t in recv.lower() for t in _THREADISH):
+            return f"{dotted(func) or attr}() [thread join]"
+    if attr == "result":
+        recv = terminal_attr(func.value)
+        if recv and any(t in recv.lower() for t in _FUTUREISH):
+            return f"{dotted(func) or attr}() [blocking future wait]"
+    return None
+
+
+class _FuncInfo:
+    __slots__ = ("qualname", "node", "is_async", "loop_refs", "other_refs")
+
+    def __init__(self, qualname: str, node, is_async: bool):
+        self.qualname = qualname
+        self.node = node
+        self.is_async = is_async
+        self.loop_refs: int = 0     # references from loop context
+        self.other_refs: int = 0    # references from anywhere else
+
+
+def _scan_body(func_node, imports: ImportMap, locks: LockNames):
+    """Yield (call, reason) for blocking calls lexically in this
+    function's own body — skipping nested defs/lambdas and offloaded
+    subtrees."""
+    results: List[Tuple[ast.Call, str]] = []
+
+    def walk(node, offloaded: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue  # separate function; reachability layer's job
+            child_off = offloaded
+            if isinstance(child, ast.Call):
+                if not offloaded and not _is_offload_call(child):
+                    reason = _blocking_reason(child, imports, locks)
+                    if reason is not None:
+                        results.append((child, reason))
+                if _is_offload_call(child):
+                    child_off = True
+            walk(child, child_off)
+
+    walk(func_node, False)
+    return results
+
+
+def _local_target(node: ast.AST) -> Optional[str]:
+    """Name that may refer to a function in THIS module: a bare Name or
+    a `self.<attr>`. `self.loop.stop` / `writer.close` never resolve
+    locally — bare-name matching on those drowns the pass in FPs."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def run(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    imports = ImportMap(tree)
+    locks = collect_lock_names(tree, imports)
+    findings: List[Finding] = []
+
+    funcs: Dict[str, _FuncInfo] = {}
+    by_bare_name: Dict[str, List[_FuncInfo]] = {}
+    for qualname, node, _cls in iter_functions(tree):
+        info = _FuncInfo(qualname, node,
+                         isinstance(node, ast.AsyncFunctionDef))
+        funcs[qualname] = info
+        by_bare_name.setdefault(node.name, []).append(info)
+
+    # ---- layer 1: blocking calls lexically inside async bodies
+    for info in funcs.values():
+        if not info.is_async:
+            continue
+        for call, reason in _scan_body(info.node, imports, locks):
+            findings.append(Finding(
+                PASS_NAME, "blocking-call-in-async", path, call.lineno,
+                info.qualname,
+                f"blocking call `{reason}` inside `async def "
+                f"{info.node.name}` wedges the event loop",
+                detail=reason))
+
+    # ---- layer 2: sync functions reachable only from loop context
+    # Collect reference sites: (referencing qualname or None for module
+    # level, referenced bare name, via_callback_registrar)
+    refs: List[Tuple[Optional[str], str, bool]] = []
+
+    def collect_refs(node, owner: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            child_owner = owner
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = child.name if owner is None else f"{owner}.{child.name}"
+                # match qualnames produced by iter_functions
+                for info in by_bare_name.get(child.name, ()):
+                    if info.node is child:
+                        qn = info.qualname
+                child_owner = qn
+            elif isinstance(child, ast.Call):
+                nm = _local_target(child.func)
+                if nm:
+                    refs.append((owner, nm, False))
+                registrar = terminal_attr(child.func)
+                if registrar in _LOOP_CALLBACK_REGISTRARS:
+                    for arg in list(child.args) + \
+                            [kw.value for kw in child.keywords]:
+                        nm = _local_target(arg)
+                        if nm:
+                            refs.append((owner, nm, True))
+            collect_refs(child, child_owner)
+
+    collect_refs(tree, None)
+
+    # fixpoint: loop_ctx = async defs ∪ callback targets ∪ sync funcs
+    # whose every reference comes from loop_ctx members
+    loop_ctx: Set[str] = {qn for qn, i in funcs.items() if i.is_async}
+    for owner, nm, via_cb in refs:
+        if via_cb:
+            for info in by_bare_name.get(nm, ()):
+                loop_ctx.add(info.qualname)
+    changed = True
+    while changed:
+        changed = False
+        for info in funcs.values():
+            if info.qualname in loop_ctx or info.is_async:
+                continue
+            in_loop = 0
+            outside = 0
+            for owner, nm, _via in refs:
+                if nm != info.node.name:
+                    continue
+                if owner is not None and owner in loop_ctx:
+                    in_loop += 1
+                else:
+                    outside += 1
+            if in_loop > 0 and outside == 0:
+                loop_ctx.add(info.qualname)
+                changed = True
+
+    for qn in sorted(loop_ctx):
+        info = funcs[qn]
+        if info.is_async:
+            continue
+        for call, reason in _scan_body(info.node, imports, locks):
+            findings.append(Finding(
+                PASS_NAME, "blocking-call-on-loop", path, call.lineno,
+                info.qualname,
+                f"blocking call `{reason}` in `{info.node.name}`, which "
+                f"is reachable only from io-loop context",
+                detail=reason))
+    return findings

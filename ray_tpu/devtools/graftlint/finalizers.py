@@ -1,0 +1,154 @@
+"""Pass 3 — finalizer safety.
+
+``__del__`` and ``weakref`` finalizer callbacks run at arbitrary points
+— inside another thread's allocation, during interpreter teardown, or
+(the PR 1 bug class) *on the io-loop thread itself* while it drains a
+callback that dropped the last reference. From there, hopping onto the
+loop deadlocks, RPC may hit a torn-down transport, and lock acquisition
+can self-deadlock against the frame the GC interrupted.
+
+Flags, in a ``__del__`` body or a weakref callback (plus one hop into
+same-class ``self.m()`` helpers):
+
+  * loop hops: ``call_soon_threadsafe`` / ``run_coroutine_threadsafe``,
+    or ``.run(`` / ``.spawn(`` / ``.stop(`` on a loop-ish receiver
+    (name contains "loop"/"io")            -> ``finalizer-touches-loop``
+  * RPC: ``.call(`` / ``.call_retrying(`` / ``.connect(``
+                                           -> ``finalizer-does-rpc``
+  * process kills: ``.kill(`` / ``.terminate(``  (PR 1's exact bug)
+                                           -> ``finalizer-kills``
+  * blocking: ``time.sleep``, ``.join(``, ``.result(``, unbounded
+    ``.acquire()``, ``with <lock>:``       -> ``finalizer-blocks``
+
+Recognized mitigation (pinned as a false-positive guard in the fixture
+tests): a finalizer that consults ``sys.is_finalizing`` — the
+finalization-safe pattern PR 3 established in ``Dataset.__del__`` — is
+trusted to have thought this through and is skipped entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ._astutil import (ImportMap, collect_lock_names, dotted,
+                       iter_functions, terminal_attr)
+from .findings import Finding
+
+PASS_NAME = "finalizer"
+
+_LOOP_HOPS = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+_LOOPISH = ("loop", "_io", "io_thread", "ioloop")
+_RPC_CALLS = {"call", "call_retrying", "connect"}
+_KILLS = {"kill", "terminate"}
+_BLOCKING_ATTRS = {"join", "result"}
+
+
+def _mentions_is_finalizing(fnode) -> bool:
+    for node in ast.walk(fnode):
+        if isinstance(node, ast.Attribute) and node.attr == "is_finalizing":
+            return True
+        if isinstance(node, ast.Name) and "is_finalizing" in node.id:
+            return True
+    return False
+
+
+def _hazards(fnode, imports: ImportMap, locks) -> List[Tuple[int, str, str]]:
+    """(line, rule, description) hazards lexically in this function."""
+    out: List[Tuple[int, str, str]] = []
+    for node in ast.walk(fnode):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if locks.looks_like_lock(item.context_expr):
+                    out.append((node.lineno, "finalizer-blocks",
+                                f"acquires `{dotted(item.context_expr)}`"))
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = imports.resolve_call(node)
+        if resolved == "time.sleep":
+            out.append((node.lineno, "finalizer-blocks", "time.sleep"))
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        attr = func.attr
+        recv = (terminal_attr(func.value) or "").lower()
+        where = dotted(func) or attr
+        if attr in _LOOP_HOPS:
+            out.append((node.lineno, "finalizer-touches-loop",
+                        f"`{where}` hops onto the event loop"))
+        elif attr in ("run", "spawn", "stop") and \
+                (recv == "io" or any(t in recv for t in _LOOPISH)):
+            out.append((node.lineno, "finalizer-touches-loop",
+                        f"`{where}` targets the io loop"))
+        elif attr in _RPC_CALLS and recv not in ("self",):
+            out.append((node.lineno, "finalizer-does-rpc",
+                        f"`{where}` issues RPC"))
+        elif attr in _KILLS:
+            out.append((node.lineno, "finalizer-kills",
+                        f"`{where}` kills a process from a finalizer"))
+        elif attr == "acquire" and locks.looks_like_lock(func.value):
+            if not node.args and not node.keywords:
+                out.append((node.lineno, "finalizer-blocks",
+                            f"`{where}` unbounded lock acquire"))
+        elif attr in _BLOCKING_ATTRS:
+            out.append((node.lineno, "finalizer-blocks",
+                        f"`{where}` blocks"))
+    return out
+
+
+def run(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    imports = ImportMap(tree)
+    locks = collect_lock_names(tree, imports)
+    findings: List[Finding] = []
+
+    funcs = iter_functions(tree)
+    by_class: Dict[Optional[str], Dict[str, ast.AST]] = {}
+    for qualname, fnode, cls in funcs:
+        cname = cls.name if cls is not None else None
+        by_class.setdefault(cname, {})[fnode.name] = fnode
+
+    # weakref callback targets: weakref.finalize(obj, cb, ...) and
+    # weakref.ref(obj, cb) — collect bare callee names
+    weakref_cbs: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = imports.resolve_call(node)
+            if name in ("weakref.finalize", "weakref.ref") and \
+                    len(node.args) >= 2:
+                cb = terminal_attr(node.args[1])
+                if cb:
+                    weakref_cbs.add(cb)
+
+    def scan(qualname: str, fnode, cname: Optional[str], kind: str):
+        if _mentions_is_finalizing(fnode):
+            return  # finalization-guarded: the blessed pattern
+        for line, rule, desc in _hazards(fnode, imports, locks):
+            findings.append(Finding(
+                PASS_NAME, rule, path, line, qualname,
+                f"{kind} `{qualname}` {desc} — unsafe during GC/teardown",
+                detail=desc))
+        # one hop: self.m() helpers in the same class
+        for node in ast.walk(fnode):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                helper = by_class.get(cname, {}).get(node.func.attr)
+                if helper is None or _mentions_is_finalizing(helper):
+                    continue
+                for line, rule, desc in _hazards(helper, imports, locks):
+                    findings.append(Finding(
+                        PASS_NAME, rule, path, line,
+                        f"{qualname}->{node.func.attr}",
+                        f"{kind} `{qualname}` calls "
+                        f"`{node.func.attr}`, which {desc}",
+                        detail=f"via {node.func.attr}: {desc}"))
+
+    for qualname, fnode, cls in funcs:
+        cname = cls.name if cls is not None else None
+        if fnode.name == "__del__":
+            scan(qualname, fnode, cname, "finalizer")
+        elif fnode.name in weakref_cbs:
+            scan(qualname, fnode, cname, "weakref callback")
+    return findings

@@ -1,0 +1,75 @@
+"""Finding record + inline-suppression plumbing shared by every pass.
+
+A finding's *fingerprint* is what the baseline stores, so it must be
+stable under unrelated edits: it hashes the pass, rule, file (repo-
+relative), and the enclosing scope's qualified name plus a normalized
+detail string — never a line number. Two identical findings in one
+scope get an occurrence suffix (``#2``, ``#3``…) so a fixed one can be
+removed from the baseline without masking its twin.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# trailing-comment suppression, flake8-style:
+#   x = risky()  # graftlint: ignore[lock-order]
+#   x = risky()  # graftlint: ignore  (all passes)
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*ignore(?:\[(?P<passes>[\w,\- ]+)\])?")
+
+
+@dataclass
+class Finding:
+    pass_name: str          # "blocking", "lock-order", "finalizer", ...
+    rule: str               # machine id, e.g. "blocking-call-in-async"
+    path: str               # repo-relative path
+    line: int
+    scope: str              # enclosing qualname ("Class.method") or "<module>"
+    message: str            # human text; may embed line numbers freely
+    detail: str = ""        # fingerprint-normalized extra (no line numbers!)
+    fingerprint: str = field(default="", compare=False)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_name}/{self.rule}] "
+                f"{self.message}  ({self.fingerprint})")
+
+
+def assign_fingerprints(findings: List[Finding]) -> None:
+    seen: Dict[str, int] = {}
+    for f in findings:
+        base = hashlib.sha1(
+            f"{f.pass_name}|{f.rule}|{f.path}|{f.scope}|{f.detail}"
+            .encode()).hexdigest()[:16]
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        f.fingerprint = base if n == 0 else f"{base}#{n + 1}"
+
+
+class Suppressions:
+    """Per-file map of line -> suppressed pass names (None = all)."""
+
+    def __init__(self, source: str):
+        self._by_line: Dict[int, Optional[set]] = {}
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            passes = m.group("passes")
+            self._by_line[i] = (
+                None if passes is None
+                else {p.strip() for p in passes.split(",") if p.strip()})
+
+    def is_suppressed(self, pass_name: str, *lines: int) -> bool:
+        """True if any of the given lines (the finding's own line and,
+        by convention, its enclosing def's line) suppresses the pass."""
+        for ln in lines:
+            entry = self._by_line.get(ln, False)
+            if entry is False:
+                continue
+            if entry is None or pass_name in entry:
+                return True
+        return False

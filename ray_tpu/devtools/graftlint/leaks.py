@@ -1,0 +1,162 @@
+"""Pass 4 — leak pass: work that silently disappears.
+
+  * ``unawaited-coroutine`` — a bare expression-statement call to an
+    ``async def`` defined in the same module: the coroutine object is
+    created and dropped, the body never runs (RuntimeWarning at GC, and
+    only if you're lucky).
+  * ``fire-and-forget-task`` — ``asyncio.create_task`` /
+    ``ensure_future`` whose return value is discarded: the event loop
+    keeps only weak task references, so the task can be garbage-
+    collected mid-await (observed in this repo as spurious
+    ``GeneratorExit`` under GC pressure — see EventLoopThread.spawn),
+    and its exception is never retrieved.
+  * ``thread-never-joined`` — a non-daemon ``threading.Thread`` whose
+    name is never ``.join()``-ed anywhere in the module and never
+    demoted to daemon: it pins interpreter shutdown forever.
+
+False-positive guards (fixture-pinned): awaited/assigned/gathered
+coroutines; tasks kept in a variable or collection
+(``self._tasks.add(asyncio.create_task(...))``); ``daemon=True``
+threads; threads joined under any code path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ._astutil import ImportMap, dotted, iter_functions, terminal_attr
+from .findings import Finding
+
+PASS_NAME = "leak"
+
+_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def run(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    imports = ImportMap(tree)
+    findings: List[Finding] = []
+
+    # resolution is deliberately narrow (the FP guard): a bare-Name call
+    # resolves only to a module-level async def; a `self.m()` call only
+    # to an async method of the ENCLOSING class. `writer.close()` never
+    # matches an unrelated async `close` elsewhere in the module.
+    funcs = iter_functions(tree)
+    module_async: Set[str] = {
+        fn.name for qn, fn, cls in funcs
+        if isinstance(fn, ast.AsyncFunctionDef) and cls is None
+        and "." not in qn}
+    class_async: Dict[str, Set[str]] = {}
+    for qn, fn, cls in funcs:
+        if cls is not None and isinstance(fn, ast.AsyncFunctionDef):
+            class_async.setdefault(cls.name, set()).add(fn.name)
+    cls_of_scope: Dict[str, Optional[str]] = {
+        qn: (cls.name if cls is not None else None) for qn, fn, cls in funcs}
+
+    scopes = [("<module>", tree)] + [(qn, fn) for qn, fn, _ in funcs]
+
+    for scope_name, scope_node in scopes:
+        body_nodes = list(ast.iter_child_nodes(scope_node))
+        for node in ast.walk(scope_node):
+            if not isinstance(node, ast.Expr) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            # attribute Expr statements inside nested defs belong to the
+            # nested scope; only report once, for the innermost scope
+            if not _owns(scope_node, node, scopes):
+                continue
+            call = node.value
+            callee = terminal_attr(call.func)
+            if callee in _SPAWNERS:
+                findings.append(Finding(
+                    PASS_NAME, "fire-and-forget-task", path, node.lineno,
+                    scope_name,
+                    f"`{dotted(call.func) or callee}(...)` result discarded:"
+                    " the loop holds only weak task refs — the task can be"
+                    " GC'd mid-await and its exception is never retrieved",
+                    detail=f"discarded {callee}"))
+            else:
+                is_coro_call = False
+                if isinstance(call.func, ast.Name):
+                    is_coro_call = call.func.id in module_async
+                elif (isinstance(call.func, ast.Attribute)
+                      and isinstance(call.func.value, ast.Name)
+                      and call.func.value.id == "self"):
+                    own_cls = cls_of_scope.get(scope_name)
+                    is_coro_call = call.func.attr in \
+                        class_async.get(own_cls or "", ())
+                if is_coro_call:
+                    findings.append(Finding(
+                        PASS_NAME, "unawaited-coroutine", path,
+                        node.lineno, scope_name,
+                        f"coroutine `{callee}(...)` is never awaited —"
+                        " the body never runs",
+                        detail=f"unawaited {callee}"))
+
+    # ---- non-daemon threads never joined
+    joined: Set[str] = set()
+    daemoned: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join":
+            name = dotted(node.func.value)
+            if name:
+                joined.add(name)
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.targets[0], ast.Attribute) and \
+                node.targets[0].attr == "daemon":
+            name = dotted(node.targets[0].value)
+            if name:
+                daemoned.add(name)
+
+    # innermost enclosing function per node, for stable fingerprints
+    owner_of: Dict[int, str] = {}
+    for qualname, fnode, _cls in iter_functions(tree):
+        for sub in ast.walk(fnode):
+            owner_of[id(sub)] = qualname  # later (inner) defs overwrite
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        if imports.resolve_call(call) != "threading.Thread":
+            continue
+        is_daemon = any(
+            kw.arg == "daemon" and
+            isinstance(kw.value, ast.Constant) and kw.value.value
+            for kw in call.keywords)
+        if is_daemon:
+            continue
+        target = dotted(node.targets[0])
+        if target and (target in joined or target in daemoned):
+            continue
+        findings.append(Finding(
+            PASS_NAME, "thread-never-joined", path, node.lineno,
+            owner_of.get(id(node), "<module>"),
+            f"non-daemon thread `{target or '<expr>'}` is never"
+            " joined or made daemon — it pins interpreter shutdown",
+            detail=f"thread {target or '<expr>'}"))
+    return findings
+
+
+def _owns(scope_node, node, scopes) -> bool:
+    """True if `node` belongs lexically to `scope_node` and not to a
+    nested function scope inside it."""
+    target_funcs = [s for _, s in scopes if s is not scope_node]
+
+    def contains(root, needle, stop_at_funcs) -> bool:
+        for child in ast.iter_child_nodes(root):
+            if child is needle:
+                return True
+            if stop_at_funcs and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+                continue
+            if contains(child, needle, stop_at_funcs):
+                return True
+        return False
+
+    del target_funcs
+    return contains(scope_node, node, True)

@@ -1,0 +1,182 @@
+"""Pass 2 — static lock-order analyzer.
+
+Builds a per-module lock-acquisition graph and reports cycles as
+potential deadlocks (rule ``lock-cycle``).
+
+Nodes are lock *classes* in the lockdep sense — canonical names like
+``Store._lock`` (``self._x`` inside class ``Store``) or a module-level
+lock's own name — not instances: an AB/BA inversion between two methods
+is a hazard even if each run only ever touches one instance.
+
+Edges:
+  * **lexical**: ``with a:`` containing ``with b:`` adds a→b;
+  * **call-through**: a ``self.m()`` call made while holding ``a`` adds
+    a→x for every lock ``x`` that same-class method ``m`` (transitively,
+    same class only) acquires.
+
+Guards: re-acquiring the same canonical lock never adds a self-edge
+(RLock re-entrancy is the witness's problem, not an ordering one), and
+``async with`` asyncio locks participate like thread locks — two tasks
+on one loop invert the same way two threads do.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ._astutil import (ImportMap, collect_lock_names, dotted,
+                       iter_functions, terminal_attr)
+from .findings import Finding
+
+PASS_NAME = "lock-order"
+
+
+def _canon(expr: ast.AST, cls_name: Optional[str],
+           locks) -> Optional[str]:
+    """Canonical lock-class key for a with-item expression, or None if
+    it doesn't look like a lock."""
+    if not locks.looks_like_lock(expr):
+        return None
+    name = dotted(expr)
+    if name is None:
+        return None
+    if name.startswith("self."):
+        owner = cls_name or "<func>"
+        return f"{owner}.{name[5:]}"
+    return name
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "line", "scope", "via")
+
+    def __init__(self, src, dst, line, scope, via):
+        self.src, self.dst = src, dst
+        self.line, self.scope, self.via = line, scope, via
+
+
+def run(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    imports = ImportMap(tree)
+    locks = collect_lock_names(tree, imports)
+
+    # per-function: locks acquired anywhere inside (for call-through),
+    # and raw edges from lexical nesting / held-set call sites
+    edges: List[_Edge] = []
+    func_acquires: Dict[Tuple[Optional[str], str], Set[str]] = {}
+    calls_under_lock: List[Tuple[Set[str], Optional[str], str, int, str]] = []
+    intra_calls: Dict[Tuple[Optional[str], str], Set[str]] = {}
+
+    for qualname, fnode, cls in iter_functions(tree):
+        cls_name = cls.name if cls is not None else None
+        key = (cls_name, fnode.name)
+        acquired: Set[str] = set()
+        callees: Set[str] = set()
+
+        def walk(node, held: Tuple[str, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # analyzed as its own function
+                new_held = held
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    got: List[str] = []
+                    for item in child.items:
+                        lk = _canon(item.context_expr, cls_name, locks)
+                        if lk is None:
+                            continue
+                        acquired.add(lk)
+                        for h in held + tuple(got):
+                            if h != lk:
+                                edges.append(_Edge(
+                                    h, lk, child.lineno, qualname,
+                                    "nested-with"))
+                        got.append(lk)
+                    new_held = held + tuple(
+                        g for g in got if g not in held)
+                elif isinstance(child, ast.Call):
+                    fn = child.func
+                    if (isinstance(fn, ast.Attribute)
+                            and isinstance(fn.value, ast.Name)
+                            and fn.value.id == "self"):
+                        callees.add(fn.attr)
+                        if held:
+                            calls_under_lock.append(
+                                (set(held), cls_name, fn.attr,
+                                 child.lineno, qualname))
+                walk(child, new_held)
+
+        walk(fnode, ())
+        func_acquires.setdefault(key, set()).update(acquired)
+        intra_calls.setdefault(key, set()).update(callees)
+
+    # transitive closure of same-class acquisitions: what does calling
+    # self.m() eventually lock?
+    closure: Dict[Tuple[Optional[str], str], Set[str]] = {
+        k: set(v) for k, v in func_acquires.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, callees in intra_calls.items():
+            cls_name = key[0]
+            acc = closure.setdefault(key, set())
+            for callee in callees:
+                sub = closure.get((cls_name, callee))
+                if sub and not sub <= acc:
+                    acc |= sub
+                    changed = True
+
+    for held, cls_name, callee, line, scope in calls_under_lock:
+        for lk in sorted(closure.get((cls_name, callee), ())):
+            for h in held:
+                if h != lk:
+                    edges.append(_Edge(h, lk, line, scope,
+                                       f"call self.{callee}()"))
+
+    # ---- cycle detection over the dedup'd graph
+    adj: Dict[str, Set[str]] = {}
+    best_edge: Dict[Tuple[str, str], _Edge] = {}
+    for e in edges:
+        adj.setdefault(e.src, set()).add(e.dst)
+        best_edge.setdefault((e.src, e.dst), e)
+
+    findings: List[Finding] = []
+    reported: Set[frozenset] = set()
+
+    def path_exists(src: str, dst: str) -> Optional[List[str]]:
+        stack, seen, parent = [src], {src}, {}
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                chain, cur = [dst], dst
+                while cur != src:
+                    cur = parent[cur]
+                    chain.append(cur)
+                return list(reversed(chain))
+            for m in adj.get(n, ()):
+                if m not in seen:
+                    seen.add(m)
+                    parent[m] = n
+                    stack.append(m)
+        return None
+
+    for (a, b), e in sorted(best_edge.items(),
+                            key=lambda kv: kv[1].line):
+        back = path_exists(b, a)
+        if back is None:
+            continue
+        cycle = frozenset([a] + back)
+        if cycle in reported:
+            continue
+        reported.add(cycle)
+        legs = []
+        chain = [a] + back
+        for s, d in zip(chain, chain[1:]):
+            le = best_edge.get((s, d))
+            if le is not None:
+                legs.append(f"{s}→{d} at {le.scope} "
+                            f"(line {le.line}, {le.via})")
+        findings.append(Finding(
+            PASS_NAME, "lock-cycle", path, e.line, e.scope,
+            "lock-order cycle (potential deadlock): " + "; ".join(legs),
+            detail="cycle:" + "→".join(sorted(cycle))))
+    return findings

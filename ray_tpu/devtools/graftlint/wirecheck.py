@@ -1,0 +1,146 @@
+"""Pass 5 — wire-protocol consistency.
+
+The wire layer (_private/wire.py) is a hand-maintained set of tag
+registries — ids, structs, exceptions, msgpack EXT codes. Nothing
+type-checks them: a duplicate tag silently shadows the earlier class
+(decode returns the wrong type cluster-wide), a class registered twice
+encodes ambiguously, and a tag special-cased in the encoder but not the
+decoder (or vice versa) is a ghost that round-trips to a WireError in
+production only.
+
+Applies to any module that calls ``register_id`` / ``register_struct``
+/ ``register_exception`` (so fixtures can pin behavior), and checks:
+
+  * ``duplicate-tag``       — one tag registered twice in a registry
+  * ``duplicate-class``     — one class under two tags in a registry
+  * ``duplicate-ext-code``  — two ``EXT_*`` constants share a value
+  * ``ghost-tag``           — a literal tag special-cased in the encode
+    path (``_default``) or decode path (``_ext_hook``) but not
+    registered AND not handled on the other side
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ._astutil import iter_functions, terminal_attr
+from .findings import Finding
+
+PASS_NAME = "wire"
+
+_REGISTRARS = {"register_id": "id", "register_struct": "struct",
+               "register_exception": "exception"}
+
+
+def _int_const(node) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _literal_ints(fnode) -> Set[int]:
+    """Integer literals used in comparisons or as list heads inside a
+    function — the special-case tag shapes (`tag == 100`,
+    `_pack([100, ...])`)."""
+    out: Set[int] = set()
+    for node in ast.walk(fnode):
+        if isinstance(node, ast.Compare):
+            for cmp in [node.left] + list(node.comparators):
+                v = _int_const(cmp)
+                if v is not None:
+                    out.add(v)
+        elif isinstance(node, ast.List) and node.elts:
+            v = _int_const(node.elts[0])
+            if v is not None:
+                out.add(v)
+    return out
+
+
+def run(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    registrations: Dict[str, List[Tuple[int, str, int]]] = {}  # kind -> [(tag, cls, line)]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_attr(node.func)
+        kind = _REGISTRARS.get(name or "")
+        if kind is None or len(node.args) < 2:
+            continue
+        tag = _int_const(node.args[0])
+        if tag is None:
+            continue
+        cls = terminal_attr(node.args[1]) or "<expr>"
+        registrations.setdefault(kind, []).append((tag, cls, node.lineno))
+
+    if not registrations:
+        return []
+    findings: List[Finding] = []
+
+    for kind, entries in registrations.items():
+        by_tag: Dict[int, List[Tuple[str, int]]] = {}
+        by_cls: Dict[str, List[Tuple[int, int]]] = {}
+        for tag, cls, line in entries:
+            by_tag.setdefault(tag, []).append((cls, line))
+            by_cls.setdefault(cls, []).append((tag, line))
+        for tag, uses in sorted(by_tag.items()):
+            if len(uses) > 1:
+                names = ", ".join(f"{c} (line {ln})" for c, ln in uses)
+                findings.append(Finding(
+                    PASS_NAME, "duplicate-tag", path, uses[-1][1],
+                    "<module>",
+                    f"{kind} tag {tag} registered {len(uses)}x: {names} —"
+                    " later registration silently shadows the earlier",
+                    detail=f"{kind} tag {tag}"))
+        for cls, uses in sorted(by_cls.items()):
+            if len(uses) > 1:
+                tags = ", ".join(str(t) for t, _ in uses)
+                findings.append(Finding(
+                    PASS_NAME, "duplicate-class", path, uses[-1][1],
+                    "<module>",
+                    f"{kind} class {cls} registered under tags {tags} —"
+                    " encode is ambiguous",
+                    detail=f"{kind} class {cls}"))
+
+    # EXT_* constant collisions
+    ext: Dict[int, List[Tuple[str, int]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id.startswith("EXT_"):
+            v = _int_const(node.value)
+            if v is not None:
+                ext.setdefault(v, []).append(
+                    (node.targets[0].id, node.lineno))
+    for v, uses in sorted(ext.items()):
+        if len(uses) > 1:
+            names = ", ".join(n for n, _ in uses)
+            findings.append(Finding(
+                PASS_NAME, "duplicate-ext-code", path, uses[-1][1],
+                "<module>",
+                f"EXT codes {names} share value {v} — the ext_hook"
+                " dispatch is ambiguous",
+                detail=f"ext code {v}"))
+
+    # ghost tags: literals special-cased in _default (encode) and
+    # _ext_hook (decode) must be registered or handled on BOTH sides
+    encode_lits: Set[int] = set()
+    decode_lits: Set[int] = set()
+    for qualname, fnode, _cls in iter_functions(tree):
+        if fnode.name == "_default":
+            encode_lits |= _literal_ints(fnode)
+        elif fnode.name == "_ext_hook":
+            decode_lits |= _literal_ints(fnode)
+    registered: Set[int] = {t for entries in registrations.values()
+                            for t, _, _ in entries}
+    ext_values = set(ext.keys())
+    for tag in sorted((encode_lits ^ decode_lits)
+                      - registered - ext_values):
+        side = "encode (_default)" if tag in encode_lits \
+            else "decode (_ext_hook)"
+        findings.append(Finding(
+            PASS_NAME, "ghost-tag", path, 1, "<module>",
+            f"tag {tag} is special-cased only on the {side} side and"
+            " never registered — peers cannot round-trip it",
+            detail=f"ghost tag {tag}"))
+    return findings

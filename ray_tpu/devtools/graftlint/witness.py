@@ -1,0 +1,244 @@
+"""Runtime lock-order witness — TSan-lite for the Python planes.
+
+Where the static lock-order pass sees lexical structure, the witness
+sees truth: every ``WitnessLock`` records, per thread, the order locks
+are actually taken, merges those orders into one global directed graph
+of lock *classes* (lockdep-style: keyed by the name given at
+construction, not instance identity — an AB/BA inversion observed on
+different instances is the same future deadlock), and raises
+``LockOrderViolation`` the moment an acquisition would close a cycle —
+*before* the threads wedge, with both stacks attached: the one
+acquiring now and the one that established the reverse edge.
+
+Enabled via the ``lock_witness_enabled`` config flag
+(``RAY_TPU_LOCK_WITNESS_ENABLED=1``); production builds pay a single
+``if`` per lock construction (see _private/locking.py) and nothing per
+acquisition.
+
+Re-entrancy: re-acquiring a lock instance already held by this thread
+never adds graph edges (that is RLock semantics' problem, and the
+plain-Lock self-deadlock is caught separately as ``self-deadlock``).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderViolation(RuntimeError):
+    """An acquisition would close a cycle in the global lock-order
+    graph. Carries the forward path and both formation stacks."""
+
+    def __init__(self, message: str, cycle: List[str],
+                 acquiring_stack: str, prior_stack: str):
+        super().__init__(message)
+        self.cycle = cycle
+        self.acquiring_stack = acquiring_stack
+        self.prior_stack = prior_stack
+
+
+class _EdgeInfo:
+    __slots__ = ("stack", "thread_name", "count")
+
+    def __init__(self, stack: str, thread_name: str):
+        self.stack = stack
+        self.thread_name = thread_name
+        self.count = 1
+
+
+class LockWitness:
+    """The global acquisition-order graph. One per process."""
+
+    def __init__(self):
+        # plain lock, never witnessed: guards only the graph itself
+        self._mu = threading.Lock()
+        self._adj: Dict[str, Set[str]] = {}
+        self._edges: Dict[Tuple[str, str], _EdgeInfo] = {}
+        self._tls = threading.local()
+        self.violations: List[LockOrderViolation] = []
+
+    # ---- per-thread held stack -------------------------------------
+    def _held(self) -> List["WitnessLock"]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    # ---- graph -----------------------------------------------------
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """Lock-class path src -> ... -> dst, caller holds self._mu."""
+        stack, seen, parent = [src], {src}, {}
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                chain, cur = [dst], dst
+                while cur != src:
+                    cur = parent[cur]
+                    chain.append(cur)
+                return list(reversed(chain))
+            for m in self._adj.get(n, ()):
+                if m not in seen:
+                    seen.add(m)
+                    parent[m] = n
+                    stack.append(m)
+        return None
+
+    def before_acquire(self, lock: "WitnessLock",
+                       blocking: bool = True) -> None:
+        held = self._held()
+        if any(h is lock for h in held):
+            # non-blocking probes (Condition._is_owned fallback, try-
+            # locks) are legitimate; only a blocking re-acquire wedges
+            if not lock.reentrant and blocking:
+                raise LockOrderViolation(
+                    f"self-deadlock: thread "
+                    f"{threading.current_thread().name!r} re-acquires "
+                    f"non-reentrant lock class {lock.name!r} it already "
+                    f"holds",
+                    [lock.name, lock.name],
+                    "".join(traceback.format_stack(limit=16)), "")
+            return  # re-entrant re-acquire: no ordering information
+        # edges from every distinct held lock CLASS to this one
+        srcs = []
+        seen: Set[str] = {lock.name}
+        for h in held:
+            if h.name not in seen:
+                seen.add(h.name)
+                srcs.append(h.name)
+        if not srcs:
+            return
+        me = threading.current_thread().name
+        with self._mu:
+            for src in srcs:
+                back = self._path(lock.name, src)
+                if back is not None:
+                    # closing src -> lock.name would create a cycle
+                    prior = self._edges.get((back[0], back[1]))
+                    now_stack = "".join(traceback.format_stack(limit=24))
+                    cycle = [src] + back
+                    v = LockOrderViolation(
+                        "lock-order violation: acquiring "
+                        f"{lock.name!r} while holding {src!r} inverts "
+                        f"the established order {'→'.join(back)} "
+                        f"(first taken by thread "
+                        f"{prior.thread_name if prior else '?'!r})."
+                        f"\n--- this thread ({me}) now:\n{now_stack}"
+                        f"\n--- prior {back[0]}→{back[1]} formation "
+                        f"({prior.thread_name if prior else '?'}):\n"
+                        f"{prior.stack if prior else '<unrecorded>'}",
+                        cycle, now_stack,
+                        prior.stack if prior else "")
+                    self.violations.append(v)
+                    raise v
+            stack = None
+            for src in srcs:
+                info = self._edges.get((src, lock.name))
+                if info is not None:
+                    info.count += 1
+                    continue
+                if stack is None:
+                    stack = "".join(traceback.format_stack(limit=24))
+                self._adj.setdefault(src, set()).add(lock.name)
+                self._edges[(src, lock.name)] = _EdgeInfo(stack, me)
+
+    def on_acquired(self, lock: "WitnessLock") -> None:
+        self._held().append(lock)
+
+    def on_release(self, lock: "WitnessLock") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # ---- introspection (tests, debugging) --------------------------
+    def edges(self) -> Dict[Tuple[str, str], int]:
+        with self._mu:
+            return {k: v.count for k, v in self._edges.items()}
+
+    def edge_count(self) -> int:
+        with self._mu:
+            return len(self._edges)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._adj.clear()
+            self._edges.clear()
+            self.violations.clear()
+
+
+_global: Optional[LockWitness] = None
+_global_mu = threading.Lock()
+
+
+def global_witness() -> LockWitness:
+    global _global
+    if _global is None:
+        with _global_mu:
+            if _global is None:
+                _global = LockWitness()
+    return _global
+
+
+class WitnessLock:
+    """Drop-in threading.Lock/RLock with acquisition-order recording.
+
+    Named: the name is the lock *class* in the witness graph — give one
+    name per lock role (``"ObjectStore._lock"``), not per instance.
+    """
+
+    def __init__(self, name: str, *, reentrant: bool = False,
+                 witness: Optional[LockWitness] = None):
+        self.name = name
+        self.reentrant = reentrant
+        self._witness = witness or global_witness()
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._witness.before_acquire(self, blocking)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._witness.on_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._witness.on_release(self)
+
+    def _is_owned(self) -> bool:
+        # threading.Condition adopts this instead of its acquire(False)
+        # probe fallback, which the witness would misread as a blocking
+        # re-acquire
+        return any(h is self for h in self._witness._held())
+
+    def locked(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "locked"):
+            return inner.locked()
+        if inner.acquire(False):  # RLock pre-3.12 has no .locked()
+            inner.release()
+            return False
+        return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<WitnessLock {self.name!r} reentrant={self.reentrant}>"
+
+
+def make_condition(name: str,
+                   witness: Optional[LockWitness] = None
+                   ) -> threading.Condition:
+    """Condition whose underlying lock participates in the witness
+    graph. ``wait()`` releases through the wrapper, so held-stack
+    bookkeeping stays correct across waits."""
+    return threading.Condition(
+        WitnessLock(name, witness=witness))

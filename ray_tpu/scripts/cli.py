@@ -493,6 +493,17 @@ def cmd_down(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """graftlint — concurrency-hazard static analysis (same entry point
+    as ``python -m ray_tpu.devtools.graftlint``; ci.sh's lint phase)."""
+    from ..devtools.graftlint.__main__ import main as lint_main
+
+    argv = list(args.lint_args)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    return lint_main(argv)
+
+
 # ------------------------------------------------------------------ main
 
 def build_parser() -> argparse.ArgumentParser:
@@ -597,6 +608,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="store usage, leases, object directory")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("lint",
+                        help="graftlint: concurrency-hazard static "
+                             "analysis (flags pass through; see "
+                             "`ray-tpu lint -- --help`)")
+    sp.add_argument("lint_args", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_lint)
     return p
 
 
