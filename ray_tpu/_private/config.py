@@ -127,6 +127,12 @@ class Config:
     max_lineage_bytes: int = 1024**3
     # --- chaos / testing (mirrors rpc_chaos.h fault injection) ---
     testing_rpc_failure: str = ""             # "method=prob_req:prob_resp,..."
+    # failpoint harness (_private/failpoints.py): named fault-injection
+    # sites at the hazard boundaries the graftlint error-plane passes
+    # audit. "site=action[:arg][:max_hits],..." — actions raise/delay/
+    # drop; "site@detail=..." scopes to one RPC method. Empty = every
+    # site is a single dict lookup (inert).
+    failpoints: str = ""
     # graftlint runtime lock-order witness (devtools/graftlint/witness):
     # control-plane locks built through _private/locking.py become
     # instrumented WitnessLocks feeding a global lockdep-style order
@@ -141,6 +147,11 @@ class Config:
     # Chaos/unreliable setups set this so dropped frames trigger a retry,
     # which the raylet dedups by request id.
     lease_rpc_timeout_s: float = 0.0
+    # bound on the GCS's outbound control RPCs to raylets (placement-
+    # group reserve/commit/cancel fan-out): a dead or wedged raylet
+    # surfaces as GcsTimeoutError instead of hanging the scheduling
+    # loop. 0 = wait forever.
+    gcs_rpc_timeout_s: float = 30.0
     # --- stall sentinel (hang/straggler detection) ---
     # raylet task watchdog period; 0 disables the watchdog. Each tick the
     # raylet compares every RUNNING task's age against an adaptive

@@ -897,7 +897,9 @@ class CoreWorker:
         await self.raylet.call("forget_lost", {"object_ids": spec.return_ids()})
         try:
             await self._run_on_leased_worker(spec)
-        except BaseException:  # noqa: BLE001 — unrecoverable, surface as lost
+        except asyncio.CancelledError:
+            raise  # recovery itself cancelled: don't report "lost"
+        except Exception:  # any resubmit failure surfaces as "lost"
             return False
         return True
 
@@ -1304,8 +1306,11 @@ class CoreWorker:
                     break
                 except (ConnectionLost, exc.WorkerCrashedError) as e:
                     if info["canceled"]:
+                        # the lease loss is incidental — the user asked
+                        # for cancellation; don't chain the crash noise
                         raise exc.TaskCancelledError(
-                            f"task {spec.function.repr_name} was cancelled")
+                            f"task {spec.function.repr_name} was "
+                            "cancelled") from None
                     last_error = e
                     await asyncio.sleep(0.02 * (2 ** attempt))
             if last_error is not None:

@@ -1,0 +1,199 @@
+"""Named failpoints: runtime fault injection at the hazard boundaries.
+
+The error-plane lint (devtools/graftlint swallow/cleanup/rpc-timeout
+passes) proves statically that faults *can* surface; this harness
+proves dynamically that they *do*. Each control-plane hazard boundary
+declares a named site::
+
+    from . import failpoints
+    if failpoints.fire("rpc.client.send", detail=method) == "drop":
+        ...skip the write, let the timeout/retry machinery engage...
+
+Unarmed, ``fire`` is one dict lookup on an empty dict — nothing to
+configure out in production. Armed (``RAY_TPU_FAILPOINTS`` env var, the
+``failpoints`` config flag — which the driver's ``_system_config``
+propagates to every worker — or programmatic :func:`arm`), a site
+performs its configured action when hit:
+
+  * ``raise``  — raise :class:`FailpointError` naming the site, so the
+    chaos harness can assert the surfaced error is *attributed*;
+  * ``delay``  — sleep ``arg`` seconds (default 0.05) then proceed,
+    modelling stragglers and slow networks;
+  * ``drop``   — return ``"drop"``; the call site skips the operation
+    (an unsent frame, an unanswered request), modelling loss.
+
+Spec grammar (comma-separated)::
+
+    site=action[:arg][:max_hits]
+    rpc.server.dispatch=delay:0.05:5,raylet.lease.grant=raise
+    rpc.client.send@request_worker_lease=drop:0:2
+
+``site@detail`` keys scope the fault to one RPC method / one detail
+value; they match before the bare site key. ``max_hits`` bounds how
+many times the action fires (0 or absent = unlimited) — essential for
+drop-faults on non-retried paths, where an unbounded drop would turn
+injected loss into a permanent hang instead of a recoverable blip.
+
+Mirrors the reference fault-injection plane (ref: rpc_chaos.h
+RpcFailure + testing_rpc_failure flag) but is callable from *any*
+subsystem boundary, not just RPC interposition.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = [
+    "FailpointError", "SITES", "fire", "afire", "arm", "disarm",
+    "hit_counts", "active_spec",
+]
+
+
+class FailpointError(RuntimeError):
+    """An injected fault. The message names the armed site so chaos
+    assertions can attribute the surfaced error to the injection."""
+
+
+# canonical site registry: chaos_smoke draws from this, and the
+# failpoint tests assert instrumented modules only use declared names
+SITES = (
+    "rpc.client.send",       # RpcClient.call, before the request frame write
+    "rpc.server.dispatch",   # RpcServer._dispatch, before the handler runs
+    "raylet.lease.grant",    # Raylet.handle_request_worker_lease entry
+    "raylet.heartbeat",      # raylet clock-sync ping round against the GCS
+    "object.seal",           # SharedObjectStore.seal entry
+    "spill.write",           # SharedObjectStore staged-spill flush to disk
+)
+
+_lock = threading.Lock()
+_override_spec: Optional[str] = None       # arm() beats config/env
+_parsed_for: Optional[str] = None          # spec string the rules came from
+_rules: Dict[str, dict] = {}
+_hits: Dict[str, int] = {}
+
+
+def _current_spec() -> str:
+    if _override_spec is not None:
+        return _override_spec
+    try:
+        from .config import global_config
+        return global_config().failpoints
+    except Exception:
+        return ""
+
+
+def _parse(spec: str) -> Dict[str, dict]:
+    rules: Dict[str, dict] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry or "=" not in entry:
+            continue
+        key, _, rhs = entry.partition("=")
+        parts = rhs.split(":")
+        action = parts[0].strip()
+        if action not in ("raise", "delay", "drop"):
+            continue
+        arg = float(parts[1]) if len(parts) > 1 and parts[1] else 0.0
+        max_hits = int(float(parts[2])) if len(parts) > 2 and parts[2] else 0
+        rules[key.strip()] = {
+            "key": key.strip(), "action": action, "arg": arg,
+            "max_hits": max_hits, "fired": 0,
+        }
+    return rules
+
+
+def arm(spec: str) -> None:
+    """Programmatically arm this process (tests); overrides config/env
+    until :func:`disarm`. Resets hit counters."""
+    global _override_spec
+    with _lock:
+        _override_spec = spec
+        _refresh_locked(force=True)
+
+
+def disarm() -> None:
+    """Drop the programmatic override, falling back to the config flag
+    (usually empty → all sites inert)."""
+    global _override_spec
+    with _lock:
+        _override_spec = None
+        _refresh_locked(force=True)
+
+
+def _refresh_locked(force: bool = False) -> None:
+    global _parsed_for, _rules
+    spec = _current_spec()
+    if force or spec != _parsed_for:
+        _parsed_for = spec
+        _rules = _parse(spec)
+        _hits.clear()
+
+
+def _begin(name: str, detail: Optional[str]) -> Optional[dict]:
+    """Match + hit accounting under the lock; returns the rule to apply
+    (action performed by the sync/async wrappers, outside the lock)."""
+    with _lock:
+        _refresh_locked()
+        if not _rules:
+            return None
+        rule = None
+        if detail is not None:
+            rule = _rules.get(f"{name}@{detail}")
+        if rule is None:
+            rule = _rules.get(name)
+        if rule is None:
+            return None
+        if rule["max_hits"] and rule["fired"] >= rule["max_hits"]:
+            return None
+        rule["fired"] += 1
+        _hits[rule["key"]] = rule["fired"]
+        return dict(rule)
+
+
+def fire(name: str, detail: Optional[str] = None) -> Optional[str]:
+    """Sync failpoint. Returns None (inert/pass), "delay" (after
+    sleeping), or "drop" (caller skips the op); raises FailpointError
+    for raise-armed sites."""
+    rule = _begin(name, detail)
+    if rule is None:
+        return None
+    if rule["action"] == "raise":
+        raise FailpointError(
+            f"failpoint '{rule['key']}' injected fault at {name}"
+            + (f" (detail={detail})" if detail else ""))
+    if rule["action"] == "delay":
+        time.sleep(rule["arg"] or 0.05)
+        return "delay"
+    return "drop"
+
+
+async def afire(name: str, detail: Optional[str] = None) -> Optional[str]:
+    """Async failpoint: as :func:`fire` but delays via asyncio.sleep so
+    an injected straggler never blocks the io loop it runs on."""
+    rule = _begin(name, detail)
+    if rule is None:
+        return None
+    if rule["action"] == "raise":
+        raise FailpointError(
+            f"failpoint '{rule['key']}' injected fault at {name}"
+            + (f" (detail={detail})" if detail else ""))
+    if rule["action"] == "delay":
+        import asyncio
+        await asyncio.sleep(rule["arg"] or 0.05)
+        return "delay"
+    return "drop"
+
+
+def hit_counts() -> Dict[str, int]:
+    """Spec-key -> times fired, for chaos assertions ("the armed site
+    actually tripped")."""
+    with _lock:
+        return dict(_hits)
+
+
+def active_spec() -> str:
+    with _lock:
+        _refresh_locked()
+        return _parsed_for or ""

@@ -310,6 +310,11 @@ class _Lane:
                     self.rep.close_write()
                 except Exception:
                     pass
+        # reap the reply thread (it exits on dead-flag + ring close
+        # within one 200ms pop timeout); the reply loop itself calls
+        # close() on lane-fatal errors, so never self-join
+        if threading.current_thread() is not self._reply_thread:
+            self._reply_thread.join(timeout=2.0)
         if release_lease and not self.client.closed:
             async def _ret():
                 try:
@@ -568,6 +573,8 @@ class LanePool:
             self.closed = True
             lanes, self.lanes = self.lanes, []
         self._qevent.set()  # wake the feeder so it drains and exits
+        if threading.current_thread() is not self._feeder:
+            self._feeder.join(timeout=2.0)
         for lane in lanes:
             lane.close(release_lease=False)
 

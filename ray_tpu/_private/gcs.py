@@ -1160,10 +1160,10 @@ class GcsServer:
                 ok = False
                 break
             try:
-                client = await self._raylet_client(info.address)
-                granted = await client.call("reserve_bundle", {
-                    "pg_id": pg_id, "bundle_index": i,
-                    "resources": pg["bundles"][i]})
+                granted = await self._raylet_call(
+                    info.address, "reserve_bundle", {
+                        "pg_id": pg_id, "bundle_index": i,
+                        "resources": pg["bundles"][i]})
             except Exception:
                 granted = False
             if not granted:
@@ -1183,9 +1183,9 @@ class GcsServer:
             info = self.nodes.get(nid)
             if info is not None:
                 try:
-                    client = await self._raylet_client(info.address)
-                    committed = bool(await client.call("commit_bundle", {
-                        "pg_id": pg_id, "bundle_index": i}))
+                    committed = bool(await self._raylet_call(
+                        info.address, "commit_bundle",
+                        {"pg_id": pg_id, "bundle_index": i}))
                 except Exception:
                     committed = False
             if committed:
@@ -1200,10 +1200,11 @@ class GcsServer:
         if info is None or not info.alive:
             return
         try:
-            client = await self._raylet_client(info.address)
-            await client.call("cancel_bundle", {
+            await self._raylet_call(info.address, "cancel_bundle", {
                 "pg_id": pg_id, "bundle_index": bundle_index})
-        except Exception:
+        except Exception:  # graftlint: ignore[swallow]
+            # rollback best-effort: the raylet may already be dead, and
+            # its bundle ledger resets with it — nothing to unwind
             pass
 
     async def handle_remove_placement_group(self, payload, conn):
@@ -1273,6 +1274,24 @@ class GcsServer:
             await client.connect(timeout=10)
             self._pg_raylet_clients[address] = client
         return client
+
+    async def _raylet_call(self, address: str, method: str, payload: dict):
+        """Outbound raylet RPC bounded by gcs_rpc_timeout_s.
+
+        The GCS event loop serves every control-plane handler; one
+        unresponsive raylet (wedged host, partitioned network) must
+        surface as GcsTimeoutError at the call site — never park a
+        scheduler loop forever."""
+        from ..exceptions import GcsTimeoutError
+        from .config import global_config
+
+        timeout = global_config().gcs_rpc_timeout_s
+        client = await self._raylet_client(address)
+        try:
+            return await client.call(
+                method, payload, timeout=timeout if timeout > 0 else None)
+        except asyncio.TimeoutError as e:
+            raise GcsTimeoutError(method, address, timeout) from e
 
     # ---- object directory ----
     async def handle_add_object_location(self, payload, conn):

@@ -87,7 +87,11 @@ class RemoteStoreClient:
     async def _writer_loop(self) -> None:
         import itertools
 
-        while True:
+        # durability daemon: retrying the external store forever (0.5s
+        # cadence, call_retrying already backs off per call) is the
+        # point — dropping the queue on a persistent outage is the one
+        # unacceptable outcome. close() bounds it via flush(timeout).
+        while True:  # graftlint: ignore[rpc-timeout]
             if not self._queue:
                 if self._closed:
                     return  # drained: safe to exit

@@ -87,7 +87,7 @@ def main() -> None:
     args = parser.parse_args()
     try:
         asyncio.run(_amain(args.address, args.data))
-    except KeyboardInterrupt:
+    except KeyboardInterrupt:  # graftlint: ignore[swallow] — quiet ^C exit
         pass
 
 

@@ -29,7 +29,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from . import locking
+from . import failpoints, locking
 from .ids import ObjectID
 from ..util.tracing import record_lane_event
 
@@ -364,6 +364,9 @@ class SharedObjectStore:
         staged = os.path.join(self.dir, oid.hex() + ".spilling")
         if not os.path.exists(staged):
             return
+        # after the staged-exists check so inert flushes stay free; a
+        # raise propagates through _reserve_native to the putting caller
+        failpoints.fire("spill.write")
         dest = os.path.join(self.spill_dir, oid.hex())
         try:
             try:
@@ -545,6 +548,9 @@ class SharedObjectStore:
             entry.finish(failed)
 
     def seal(self, oid: ObjectID) -> None:
+        # before any state change: an injected seal fault must leave the
+        # unsealed entry intact so abort/cleanup paths still work
+        failpoints.fire("object.seal")
         with self._lock:
             entry = self._entries[oid]
             entry.mm.flush()
@@ -782,20 +788,29 @@ class SharedObjectStore:
             record_lane_event("restore", f"restore {oid.hex()[:12]}",
                               wall0, time.time(), bytes=size)
             buf.release()
+            # pin across seal -> spill-copy unlink: the instant seal()
+            # lands, capacity pressure may evict this object again and
+            # re-stage it into the spill dir — unlinking then would
+            # delete the only surviving copy (observed as get() -> None
+            # under restore thrash)
+            self.pin(oid)
             try:
-                self.seal(oid)
-            except (ObjectStoreFullError, OSError):
-                return False
+                try:
+                    self.seal(oid)
+                except (ObjectStoreFullError, OSError):
+                    return False
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+            finally:
+                self.unpin(oid)
         except OSError:
             return False
         finally:
             if acquired:
                 gate.release(acquired)
             os.close(sfd)
-        try:
-            os.unlink(path)
-        except FileNotFoundError:
-            pass
         return True
 
     def contains(self, oid: ObjectID) -> bool:

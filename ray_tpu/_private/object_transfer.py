@@ -139,8 +139,10 @@ class TransferServer:
         while True:
             try:
                 conn, _ = await loop.sock_accept(self._listener)
-            except (asyncio.CancelledError, OSError):
-                return
+            except asyncio.CancelledError:
+                raise  # teardown cancel: keep the accept task CANCELLED
+            except OSError:
+                return  # listener closed under us: clean exit
             conn.setblocking(False)
             task = asyncio.ensure_future(self._serve(conn))
             self._conn_tasks.add(task)
@@ -181,8 +183,10 @@ class TransferServer:
                     # straight from the sealed mmap to the kernel
                     await loop.sock_sendall(
                         conn, view[offset:offset + length])
-        except (ConnectionError, OSError, asyncio.CancelledError):
-            pass
+        except asyncio.CancelledError:
+            raise  # serve task cancelled at close: finally still closes conn
+        except (ConnectionError, OSError):
+            pass  # peer went away mid-serve: its puller retries elsewhere
         finally:
             conn.close()
 
@@ -486,8 +490,10 @@ class PullManager:
     async def _run(self, oid: ObjectID) -> None:
         try:
             await self._start_pull(oid)
-        except (asyncio.CancelledError, Exception):
-            pass
+        except asyncio.CancelledError:
+            raise  # pull cancelled (release/shutdown): finally cleans up
+        except Exception:
+            pass  # pull failure is re-queued/surfaced by the directory
         finally:
             self.release_bytes(oid)  # safety net if the pull leaked one
             self._active.pop(oid, None)

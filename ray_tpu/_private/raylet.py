@@ -35,6 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from . import failpoints
 from .config import global_config, session_log_dir
 from .ids import ActorID, NodeID, ObjectID, WorkerID
 from .object_store import SharedObjectStore
@@ -364,6 +365,10 @@ class Raylet:
         warmup = 3
         while True:
             try:
+                # chaos: a dropped/slow heartbeat must perturb only this
+                # round — the loop itself neither dies nor wedges
+                if await failpoints.afire("raylet.heartbeat") == "drop":
+                    raise ConnectionError("heartbeat dropped (failpoint)")
                 t0 = time.time()
                 reply = await self.gcs.call("ping", {}, timeout=5)
                 t1 = time.time()
@@ -904,7 +909,7 @@ class Raylet:
             try:
                 reader, writer = await asyncio.open_unix_connection(sock_path)
                 break
-            except (FileNotFoundError, ConnectionRefusedError, OSError):
+            except (FileNotFoundError, ConnectionRefusedError, OSError) as e:
                 if (time.monotonic() > deadline
                         or self._factory_proc.poll() is not None):
                     proc, self._factory_proc = self._factory_proc, None
@@ -912,7 +917,8 @@ class Raylet:
                         proc.kill()
                     except Exception:
                         pass
-                    raise TimeoutError("worker factory did not come up")
+                    raise TimeoutError(
+                        "worker factory did not come up") from e
                 await asyncio.sleep(0.05)
         self._factory_reader, self._factory_writer = reader, writer
 
@@ -1128,6 +1134,10 @@ class Raylet:
         reply:   {granted: bool, worker_address, lease_id, node_id}
                | {retry_at: (node_id, address)}
         """
+        # a raise here rides the ERROR reply into the core_worker's
+        # lease pipeline and lands in the task's return objects —
+        # chaos asserts the driver's ray.get names this site
+        await failpoints.afire("raylet.lease.grant")
         payload["_conn"] = conn  # reclaim push channel for lane leases
         rid = payload.get("request_id")
         if rid is not None:
@@ -1787,7 +1797,7 @@ class Raylet:
         try:
             await conn.closed.wait()
         except asyncio.CancelledError:
-            return
+            raise  # watcher cancelled at teardown: keep the task CANCELLED
         for oid, puller in self._token_conn_grants.pop(conn, ()):
             grants = self._transfer_tokens.get(oid)
             if grants is not None:

@@ -32,7 +32,7 @@ import sys
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional
 
-from . import wire
+from . import failpoints, wire
 from .config import global_config
 
 _LEN = struct.Struct("<I")
@@ -248,6 +248,11 @@ class RpcServer:
     async def _dispatch(self, conn, msg_id, method, payload):
         handler = self.handlers.get(method)
         try:
+            # inside the try: a raise-armed failpoint rides the ERROR
+            # reply to the caller — surfaced and attributed, not a hang
+            if await failpoints.afire("rpc.server.dispatch",
+                                      detail=method) == "drop":
+                return  # injected lost request: never dispatched, no reply
             if handler is None:
                 raise RpcError(f"{self.name}: no handler for '{method}'")
             result = await handler(payload, conn)
@@ -302,9 +307,10 @@ class RpcClient:
                 else:
                     self._reader, self._writer = await asyncio.open_connection(kind[1], kind[2])
                 break
-            except (ConnectionError, FileNotFoundError, OSError):
+            except (ConnectionError, FileNotFoundError, OSError) as e:
                 if asyncio.get_event_loop().time() > deadline:
-                    raise ConnectionLost(f"cannot connect to {self.address}")
+                    raise ConnectionLost(
+                        f"cannot connect to {self.address}") from e
                 await asyncio.sleep(0.05)
         self.closed = False
         # a reconnect must not leave the previous loop reading the stream —
@@ -357,19 +363,24 @@ class RpcClient:
     async def call(self, method: str, payload: Any = None, timeout: Optional[float] = None):
         if self.closed:
             raise ConnectionLost(self.socket_path)
+        # before the pending-future registration so a raise-armed site
+        # can't leak an entry; "drop" skips the write below and lets the
+        # caller's timeout/retry machinery see a lost frame
+        injected = await failpoints.afire("rpc.client.send", detail=method)
         msg_id = next(self._msg_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
-        try:
-            async with self._write_lock:
-                self._writer.write(_frame(msg_id, REQUEST, method, payload))
-                await self._writer.drain()
-        except (ConnectionError, RuntimeError, OSError) as e:
-            # a dead transport surfaces as ConnectionLost so retrying
-            # callers reconnect instead of crashing on the raw OS error
-            self._pending.pop(msg_id, None)
-            self.closed = True
-            raise ConnectionLost(f"{self.socket_path}: {e}") from e
+        if injected != "drop":
+            try:
+                async with self._write_lock:
+                    self._writer.write(_frame(msg_id, REQUEST, method, payload))
+                    await self._writer.drain()
+            except (ConnectionError, RuntimeError, OSError) as e:
+                # a dead transport surfaces as ConnectionLost so retrying
+                # callers reconnect instead of crashing on the raw OS error
+                self._pending.pop(msg_id, None)
+                self.closed = True
+                raise ConnectionLost(f"{self.socket_path}: {e}") from e
         if timeout is None:
             return await fut
         return await asyncio.wait_for(fut, timeout)
@@ -402,8 +413,10 @@ class RpcClient:
         if task is not None and task is not asyncio.current_task():
             task.cancel()
             try:
+                # awaiting a task we just cancelled: absorbing its
+                # CancelledError IS the await's purpose here
                 await task
-            except BaseException:
+            except BaseException:  # graftlint: ignore[swallow]
                 pass
         if self._writer is not None:
             try:
@@ -481,7 +494,10 @@ class EventLoopThread:
                     await asyncio.wait_for(
                         asyncio.gather(*tasks, return_exceptions=True),
                         max(0.1, deadline - self.loop.time()))
-                except (asyncio.TimeoutError, asyncio.CancelledError):
+                # re-raising cancellation here would skip loop.stop()
+                # below and hang the thread join — break IS the handling
+                except (asyncio.TimeoutError,  # graftlint: ignore[swallow]
+                        asyncio.CancelledError):
                     break
             self.loop.stop()
 

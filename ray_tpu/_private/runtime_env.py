@@ -308,7 +308,8 @@ def run_task_in_container(container: dict, fn, args, kwargs,
                            timeout=60)
             raise RuntimeError(
                 f"container task timed out after {timeout:.0f}s "
-                f"(image {container['image']!r}); container reaped")
+                f"(image {container['image']!r}); container reaped"
+            ) from None  # the TimeoutExpired adds nothing to the message
         if proc.returncode != 0:
             raise RuntimeError(
                 f"container task failed (image {container['image']!r}): "

@@ -127,7 +127,7 @@ class ResourceSyncer:
                 await asyncio.sleep(self.interval_s)
                 await self._round()
             except asyncio.CancelledError:
-                return
+                raise  # stop() cancelled us: keep the task CANCELLED
             except Exception:
                 continue  # a bad peer/round must not stop anti-entropy
 

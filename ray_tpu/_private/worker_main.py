@@ -643,6 +643,10 @@ async def _amain():
 
         def __init__(self, loop):
             self.loop = loop
+            # _amain's loop runs on the worker's main thread; callers
+            # (e.g. kill_actor) compare against .thread to pick the
+            # non-deadlocking submission path, same as EventLoopThread
+            self.thread = threading.main_thread()
 
         def run(self, coro, timeout=None):
             import concurrent.futures as cf
@@ -866,32 +870,36 @@ async def _amain():
     server.register("fetch_object", core._handle_fetch_object)
     executor.seal_batcher = SealBatcher(core, raylet)
     await server.start()
-    my_socket = server.address  # resolved (TCP port 0)
-    core.address = my_socket
+    try:
+        my_socket = server.address  # resolved (TCP port 0)
+        core.address = my_socket
 
-    # register with raylet last — once registered, tasks may arrive
-    raylet.on_push("shutdown", lambda payload: shutdown_event.set())
-    # die with the raylet: an abrupt raylet death (SIGKILL, node crash)
-    # sends no shutdown push, and an orphaned worker would outlive the
-    # whole cluster (ref: core_worker shuts down when the local raylet
-    # connection breaks). call_soon_threadsafe not needed — the recv
-    # loop runs on this same loop.
-    raylet.on_close = shutdown_event.set
-    await raylet.call("register_worker", {
-        "worker_id": worker_id,
-        "pid": os.getpid(),
-        "address": my_socket,
-    })
+        # register with raylet last — once registered, tasks may arrive
+        raylet.on_push("shutdown", lambda payload: shutdown_event.set())
+        # die with the raylet: an abrupt raylet death (SIGKILL, node
+        # crash) sends no shutdown push, and an orphaned worker would
+        # outlive the whole cluster (ref: core_worker shuts down when
+        # the local raylet connection breaks). call_soon_threadsafe not
+        # needed — the recv loop runs on this same loop.
+        raylet.on_close = shutdown_event.set
+        await raylet.call("register_worker", {
+            "worker_id": worker_id,
+            "pid": os.getpid(),
+            "address": my_socket,
+        })
 
-    await shutdown_event.wait()
-    await server.stop()
+        await shutdown_event.wait()
+    finally:
+        # a failed registration must still unbind the socket before the
+        # process exits, or a fast raylet retry can hit a stale address
+        await server.stop()
     os._exit(0)
 
 
 def main():
     try:
         asyncio.run(_amain())
-    except KeyboardInterrupt:
+    except KeyboardInterrupt:  # graftlint: ignore[swallow] — quiet ^C exit
         pass
 
 

@@ -54,8 +54,8 @@ def _dag_exec_loop(actor_self, spec_blob: bytes):
         for ch in writers.values():
             try:
                 ch.close_write()
-            except Exception:
-                pass
+            except (ChannelTimeout, RuntimeError, ValueError, OSError):
+                pass  # peer already gone / mapping torn down
 
     while True:
         results: Dict[int, Any] = {}
@@ -118,8 +118,9 @@ def _dag_exec_loop(actor_self, spec_blob: bytes):
             for path in spec["write_paths"]:
                 try:
                     writers[path].write(err, timeout=5.0)
-                except Exception:
-                    pass
+                except (ChannelTimeout, RuntimeError, TypeError,
+                        ValueError, OSError):
+                    pass  # dead consumer: it can't observe the error
             shutdown()
             if isinstance(e, _Propagated):
                 return False  # upstream already raised the original
@@ -198,11 +199,11 @@ class CompiledDAG:
             for ch in self._channels:
                 try:
                     ch.close()
-                except Exception:
+                except (RuntimeError, ValueError, OSError):
                     pass
                 try:  # unlink even when close() raised — the shm file
                     ch.unlink()  # is what must not leak
-                except Exception:
+                except OSError:
                     pass
             self._torn_down = True
             raise
@@ -526,8 +527,8 @@ class CompiledDAG:
         if self._input_channel is not None:
             try:
                 self._input_channel.close_write()
-            except Exception:
-                pass
+            except (ChannelTimeout, RuntimeError, ValueError, OSError):
+                pass  # loops already gone; draining below still runs
         # drain leftover outputs so mid-pipeline writers unblock
         for ch, slot, _ in self._outputs:
             for _ in range(self.max_inflight + 1):
@@ -535,8 +536,9 @@ class CompiledDAG:
                     ch.read(slot, timeout=0.2)
                 except (ChannelClosed, ChannelTimeout):
                     break
-                except Exception:
-                    break
+                except (RuntimeError, ValueError, OSError,
+                        EOFError, AttributeError):
+                    break  # torn-down mapping or a half-written payload
         for ch in self._channels:
             ch.close()
             ch.unlink()

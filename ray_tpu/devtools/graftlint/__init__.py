@@ -12,6 +12,18 @@ get their own tooling):
     never-joined non-daemon threads
   * ``wire``       — wire-tag registry consistency (_private/wire.py)
 
+plus the error-plane suite (PR 8 — faults must surface as attributed
+errors, never as the hangs the stall sentinel then has to chase):
+
+  * ``swallow``     — discard-shaped exception handlers; hard errors for
+    clauses that can absorb cancellation/interrupt, and for
+    ``raise X`` inside ``except`` without ``from``
+  * ``cleanup``     — resource acquires without try/finally or ``with``
+    protection, and lifecycle methods that never release what
+    ``__init__``/``start`` acquired
+  * ``rpc-timeout`` — unbounded ``await ....call(...)`` and constant-
+    sleep retry loops with no backoff cap or deadline
+
 Usage (CI runs this; `cli.py lint` is the same entry point):
 
     python -m ray_tpu.devtools.graftlint --baseline graftlint_baseline.json
@@ -26,7 +38,8 @@ from __future__ import annotations
 import os
 from typing import Callable, Dict, Iterable, List, Optional
 
-from . import blocking, finalizers, leaks, lockorder, wirecheck
+from . import (blocking, cleanup, finalizers, leaks, lockorder,
+               rpctimeout, swallow, wirecheck)
 from ._astutil import iter_functions, parse_module
 from .findings import Finding, Suppressions, assign_fingerprints
 
@@ -36,6 +49,9 @@ PASSES: Dict[str, Callable] = {
     "finalizer": finalizers.run,
     "leak": leaks.run,
     "wire": wirecheck.run,
+    "swallow": swallow.run,
+    "cleanup": cleanup.run,
+    "rpc-timeout": rpctimeout.run,
 }
 
 

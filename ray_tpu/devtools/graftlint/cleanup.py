@@ -1,0 +1,271 @@
+"""Pass 7 — cleanup pass: resource lifecycles without a release path.
+
+The control plane holds kernel-backed resources everywhere: shm
+segments, unix sockets, file handles, temp spill files, background rpc
+tasks. A raise between acquire and release strands the resource until
+GC gets around to the finalizer — on a raylet that can mean an fd or a
+pinned shm segment held across the whole incident. Two rules:
+
+  * ``unguarded-acquire`` — a local name bound from a resource
+    constructor (``open``, ``socket.socket``, ``SharedMemory``,
+    ``mmap.mmap``, ``os.open``, ``NamedTemporaryFile``...) that is
+    neither ``with``-managed nor released in a ``finally``, while a
+    raise-capable call sits between acquire and release. Split into
+    two details: the name is released but only on the happy path
+    (``release-not-in-finally``), or never released in this scope at
+    all (``never-released``).
+  * ``stop-leaks-resource`` — a class whose ``__init__``/``start``
+    stores a resource or background task on ``self`` and which HAS a
+    lifecycle method (``stop``/``shutdown``/``close``/...), but no
+    lifecycle method ever touches that attribute: shutdown completes
+    "cleanly" with the ring thread / server socket / retained task
+    still live.
+
+False-positive guards (fixture-pinned): ``with`` statements; release
+inside any ``finally``; ownership escape — the name is returned,
+yielded, stored onto an attribute/subscript, or appended into a
+collection (the resource outlives the scope on purpose); acquire
+functions whose result is immediately guarded by ``try/finally``;
+classes with no lifecycle method at all (value objects — nothing to
+wire the release into); attributes the lifecycle methods do reference,
+even via delegation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ._astutil import ImportMap, dotted, iter_functions, terminal_attr
+from .findings import Finding
+
+PASS_NAME = "cleanup"
+
+# constructors (import-resolved) whose return value is a kernel-backed
+# resource the caller must release
+_ACQUIRERS = {
+    "open", "os.open", "os.fdopen", "os.pipe",
+    "socket.socket", "socket.create_connection", "socket.socketpair",
+    "mmap.mmap",
+    "multiprocessing.shared_memory.SharedMemory",
+    "shared_memory.SharedMemory",
+    "tempfile.NamedTemporaryFile", "tempfile.TemporaryFile",
+    "tempfile.mkstemp",
+}
+# terminal names that read as resource ctors regardless of module path
+# (this codebase's own lifecycled types + stdlib spellings)
+_ACQUIRER_TERMINALS = {
+    "SharedMemory", "NamedTemporaryFile", "RpcServer",
+    "EventLoopThread", "ThreadPoolExecutor",
+}
+# attribute-valued ctors that spawn a background computation the class
+# must cancel/join at stop (for the class-level rule only)
+_SPAWNER_SUFFIXES = {
+    "ensure_future", "create_task", "Thread", "background", "Timer",
+}
+_RELEASE_METHODS = {
+    "close", "aclose", "release", "unlink", "shutdown", "stop",
+    "terminate", "cancel", "join", "cleanup", "destroy",
+}
+_LIFECYCLE_METHODS = {
+    "stop", "shutdown", "close", "aclose", "teardown", "destroy",
+    "stop_all", "__exit__", "__aexit__",
+}
+_INIT_METHODS = {"__init__", "start", "_start"}
+
+
+def _walk_skip_defs(node: ast.AST) -> Iterable[ast.AST]:
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _is_acquirer(call: ast.Call, imports: ImportMap) -> bool:
+    resolved = imports.resolve_call(call)
+    if resolved in _ACQUIRERS:
+        return True
+    term = terminal_attr(call.func)
+    return term in _ACQUIRER_TERMINALS
+
+
+def _is_spawner(call: ast.Call, imports: ImportMap) -> bool:
+    if _is_acquirer(call, imports):
+        return True
+    term = terminal_attr(call.func)
+    return term in _SPAWNER_SUFFIXES
+
+
+def _release_of(node: ast.AST, name: str, imports: ImportMap) -> bool:
+    """`name.close()` / `os.close(name)`-shaped release of the local."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _RELEASE_METHODS \
+            and isinstance(f.value, ast.Name) and f.value.id == name:
+        return True
+    resolved = imports.resolve_call(node)
+    if resolved in ("os.close", "os.unlink", "os.remove"):
+        return any(isinstance(a, ast.Name) and a.id == name
+                   for a in node.args)
+    return False
+
+
+def _escapes(fnode: ast.AST, name: str, imports: ImportMap) -> bool:
+    """Ownership leaves the scope: returned/yielded, stored onto an
+    attribute/subscript, or handed to a collection/registry call. Such
+    a resource is released elsewhere by design."""
+    for sub in _walk_skip_defs(fnode):
+        if isinstance(sub, (ast.Global, ast.Nonlocal)):
+            if name in sub.names:
+                return True  # module/outer-scope lifetime by declaration
+        elif isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+            val = sub.value
+            if val is not None and any(
+                    isinstance(n, ast.Name) and n.id == name
+                    for n in ast.walk(val)):
+                return True
+        elif isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)) \
+                        and any(isinstance(n, ast.Name) and n.id == name
+                                for n in ast.walk(sub.value)):
+                    return True
+        elif isinstance(sub, ast.Call) and not _release_of(
+                sub, name, imports):
+            # passed as an argument to anything that isn't a release:
+            # transfer of ownership (registry.add(f), spawn(sock=s)...)
+            # or at minimum shared custody we can't track
+            for a in list(sub.args) + [kw.value for kw in sub.keywords]:
+                if isinstance(a, ast.Name) and a.id == name:
+                    return True
+    return False
+
+
+def _finally_lines(fnode: ast.AST) -> Set[int]:
+    lines: Set[int] = set()
+    for sub in _walk_skip_defs(fnode):
+        if isinstance(sub, ast.Try) and sub.finalbody:
+            for stmt in sub.finalbody:
+                for n in ast.walk(stmt):
+                    if hasattr(n, "lineno"):
+                        lines.add(n.lineno)
+    return lines
+
+
+def _risky_between(fnode: ast.AST, lo: int, hi: int) -> bool:
+    """A raise-capable node (call/await/raise) strictly between the
+    acquire line and the first release line."""
+    for sub in _walk_skip_defs(fnode):
+        if isinstance(sub, (ast.Call, ast.Await, ast.Raise)) \
+                and lo < getattr(sub, "lineno", lo) < hi:
+            return True
+    return False
+
+
+def _scan_function(qualname: str, fnode: ast.AST, imports: ImportMap,
+                   path: str, findings: List[Finding]) -> None:
+    if getattr(fnode, "name", "") == "__del__":
+        return  # finalizers are the release path, not an acquire site
+    fin_lines = _finally_lines(fnode)
+    for stmt in _walk_skip_defs(fnode):
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        tgt = stmt.targets[0]
+        val = stmt.value
+        # fd, path = tempfile.mkstemp() — track the fd element only if
+        # simple; give tuple unpacking a pass otherwise
+        if not isinstance(tgt, ast.Name):
+            continue
+        if not isinstance(val, ast.Call) or not _is_acquirer(val, imports):
+            continue
+        name = tgt.id
+        if _escapes(fnode, name, imports):
+            continue
+        releases = [sub for sub in _walk_skip_defs(fnode)
+                    if _release_of(sub, name, imports)
+                    and sub.lineno > stmt.lineno]
+        ctor = dotted(val.func) or "<ctor>"
+        if not releases:
+            findings.append(Finding(
+                PASS_NAME, "unguarded-acquire", path, stmt.lineno,
+                qualname,
+                f"`{name} = {ctor}(...)` is never released in this "
+                "scope — a raise (or plain fall-through) strands the "
+                "resource until GC",
+                detail=f"never-released {name} {ctor}"))
+            continue
+        if any(r.lineno in fin_lines for r in releases):
+            continue  # released in a finally — protected
+        first_rel = min(r.lineno for r in releases)
+        if _risky_between(fnode, stmt.lineno, first_rel):
+            findings.append(Finding(
+                PASS_NAME, "unguarded-acquire", path, stmt.lineno,
+                qualname,
+                f"`{name} = {ctor}(...)` is released only on the happy "
+                f"path (release at line {first_rel} not in a finally); "
+                "a raise in between leaks it",
+                detail=f"release-not-in-finally {name} {ctor}"))
+
+
+def _scan_class(cls: ast.ClassDef, imports: ImportMap, path: str,
+                findings: List[Finding]) -> None:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    lifecycle = [m for name, m in methods.items()
+                 if name in _LIFECYCLE_METHODS]
+    if not lifecycle:
+        return  # value object / externally managed — nothing to check
+    # attrs the lifecycle methods (and __del__, and helpers they could
+    # reach — we approximate with every non-init method) touch
+    released_attrs: Set[str] = set()
+    for name, m in methods.items():
+        if name in _INIT_METHODS:
+            continue
+        for sub in ast.walk(m):
+            if isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == "self":
+                released_attrs.add(sub.attr)
+    for init_name in _INIT_METHODS:
+        init = methods.get(init_name)
+        if init is None:
+            continue
+        for stmt in _walk_skip_defs(init):
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            tgt = stmt.targets[0]
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            val = stmt.value
+            if not isinstance(val, ast.Call) \
+                    or not _is_spawner(val, imports):
+                continue
+            if tgt.attr in released_attrs:
+                continue
+            ctor = dotted(val.func) or "<ctor>"
+            findings.append(Finding(
+                PASS_NAME, "stop-leaks-resource", path, stmt.lineno,
+                f"{cls.name}.{init_name}",
+                f"`self.{tgt.attr} = {ctor}(...)` is acquired here but "
+                f"no lifecycle method "
+                f"({'/'.join(sorted(m.name for m in lifecycle))}) ever "
+                "references it — shutdown leaves it live",
+                detail=f"stop-leaks self.{tgt.attr} {ctor}"))
+
+
+def run(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    imports = ImportMap(tree)
+    for qualname, fnode, _cls in iter_functions(tree):
+        _scan_function(qualname, fnode, imports, path, findings)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _scan_class(node, imports, path, findings)
+    return findings

@@ -1,0 +1,117 @@
+"""Pass 8 — rpc-timeout pass: control-plane waits with no bound.
+
+A lost frame on an unbounded await is the purest form of the
+fault-becomes-hang failure mode: nothing raises, nothing logs, the
+caller just never resumes, and the stall sentinel inherits the
+debugging job. Two rules:
+
+  * ``unbounded-rpc-await`` — ``await x.call(...)`` with no
+    ``timeout=`` kwarg. In this codebase ``.call`` is the RPC verb
+    (RpcClient.call / GcsClient-style wrappers take ``timeout=``);
+    ``call_retrying`` is exempt (it carries a per-try timeout
+    default), as is a ``.call`` wrapped in ``asyncio.wait_for`` —
+    there the awaited expression is the ``wait_for``, not the
+    ``.call``, so the pattern is naturally blessed.
+  * ``uncapped-retry`` — a ``while True`` retry loop (it contains a
+    ``break``/``return`` success exit AND a try/except that does not
+    re-raise) sleeping a *constant* interval: no backoff cap, no
+    deadline, so a persistent fault spins forever at fixed frequency.
+    Periodic daemon loops (no loop exit) and loops whose handler
+    re-raises past a deadline are exempt, as are sleeps with computed
+    (escalating) arguments.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List
+
+from ._astutil import ImportMap, iter_functions
+from .findings import Finding
+
+PASS_NAME = "rpc-timeout"
+
+_SLEEPS = {"time.sleep", "asyncio.sleep"}
+
+
+def _walk_skip_defs(node: ast.AST) -> Iterable[ast.AST]:
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _owner_map(tree: ast.Module) -> Dict[int, str]:
+    owner: Dict[int, str] = {}
+    for qualname, fnode, _cls in iter_functions(tree):
+        for sub in ast.walk(fnode):
+            owner[id(sub)] = qualname
+    return owner
+
+
+def run(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    imports = ImportMap(tree)
+    owner = _owner_map(tree)
+
+    def scope_of(node: ast.AST) -> str:
+        return owner.get(id(node), "<module>")
+
+    for node in ast.walk(tree):
+        # --- unbounded-rpc-await ---
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "call" \
+                    and not any(kw.arg == "timeout" for kw in call.keywords):
+                method = ""
+                if call.args and isinstance(call.args[0], ast.Constant) \
+                        and isinstance(call.args[0].value, str):
+                    method = call.args[0].value
+                findings.append(Finding(
+                    PASS_NAME, "unbounded-rpc-await", path, node.lineno,
+                    scope_of(node),
+                    f"`await ....call({method or '...'!r}...)` has no "
+                    "timeout= bound — a lost frame hangs the caller "
+                    "instead of raising",
+                    detail=f"unbounded call {method or '<dynamic>'}"))
+
+        # --- uncapped-retry ---
+        if isinstance(node, ast.While) \
+                and isinstance(node.test, ast.Constant) \
+                and node.test.value is True:
+            has_exit = False
+            has_try = False
+            bounded_handler = False
+            const_sleep = None
+            for sub in _walk_skip_defs(node):
+                if isinstance(sub, (ast.Break, ast.Return)):
+                    has_exit = True
+                elif isinstance(sub, ast.Try):
+                    has_try = True
+                    # a handler that can raise/return/break is a bound:
+                    # the deadline-reraise and check-stop-flag idioms
+                    for handler in sub.handlers:
+                        if any(isinstance(n, (ast.Raise, ast.Return,
+                                              ast.Break))
+                               for n in ast.walk(handler)):
+                            bounded_handler = True
+                elif isinstance(sub, ast.Call):
+                    if imports.resolve_call(sub) in _SLEEPS and sub.args \
+                            and isinstance(sub.args[0], ast.Constant):
+                        const_sleep = sub.args[0].value
+            if has_exit and has_try and not bounded_handler \
+                    and const_sleep is not None:
+                findings.append(Finding(
+                    PASS_NAME, "uncapped-retry", path, node.lineno,
+                    scope_of(node),
+                    "`while True` retry loop with a constant "
+                    f"sleep({const_sleep}) and an except that never "
+                    "re-raises — no backoff cap or deadline, a "
+                    "persistent fault retries forever",
+                    detail=f"uncapped retry sleep={const_sleep}"))
+    return findings

@@ -1,0 +1,242 @@
+"""Pass 6 — swallow pass: exception flow that silently dies.
+
+The error plane's analog of the blocking pass: a node fault that lands
+in a discard-shaped handler becomes the hang the stall sentinel later
+has to attribute, instead of an error the caller could act on *now*.
+Three rules:
+
+  * ``absorbs-cancellation`` (hard class — the baseline must stay empty
+    of these): a clause that can catch ``asyncio.CancelledError``,
+    ``KeyboardInterrupt``, or ``CollectiveTimeoutError`` — bare
+    ``except:``, ``except BaseException``, or naming one of them
+    explicitly — whose body neither re-raises nor forwards the bound
+    exception. Absorbing cancellation on the io loop turns task
+    cancellation (cancel-the-loser hedging, loop drain at shutdown)
+    into a task that keeps running.
+  * ``silent-swallow`` — a broad clause (``Exception``/``BaseException``
+    /bare) whose body *discards* the exception: only ``pass``/
+    ``continue``/constant ``return``/log-calls, no re-raise, no use of
+    the bound variable. Best-effort cleanup sites get ratcheted into
+    the baseline; new ones gate.
+  * ``raise-without-from`` — ``raise X(...)`` inside an ``except``
+    without ``from``: the wrapped error loses its explicit cause chain,
+    so fault attribution stops at the wrapper.
+
+False-positive guards (fixture-pinned): a clause whose body contains
+any ``raise``; a handler that *uses* the bound exception outside
+logging (error forwarded over the wire, stored, wrapped with ``from``);
+an earlier clause in the same ``try`` that catches the cancellation
+type and re-raises; handlers inside ``__del__`` (a finalizer must never
+raise — swallowing there is the contract, and the finalizer pass owns
+that scope); non-broad clauses with fallback logic; handlers that
+capture the traceback (``format_exc``/``exc_info``) for later
+surfacing; fork/process boundaries whose try calls ``os._exit``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ._astutil import dotted, iter_functions, terminal_attr
+from .findings import Finding
+
+PASS_NAME = "swallow"
+
+# types whose absorption turns faults into hangs (cancellation never
+# reaches the loop's drain; a collective timeout never reaches the
+# caller that would re-form the gang)
+_CANCELLATION_TYPES = {"CancelledError", "KeyboardInterrupt",
+                       "CollectiveTimeoutError"}
+_BROAD_TYPES = {"Exception", "BaseException"}
+
+_LOGGISH = {"print", "debug", "info", "warning", "warn", "error",
+            "exception", "critical", "log", "write"}
+
+
+def _walk_skip_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class defs
+    (their bodies are separate scopes, analyzed on their own)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _clause_types(handler: ast.ExceptHandler) -> Optional[List[str]]:
+    """Terminal names of the caught types; None = bare ``except:``."""
+    t = handler.type
+    if t is None:
+        return None
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    return [terminal_attr(n) or "<expr>" for n in nodes]
+
+
+def _clause_repr(types: Optional[List[str]]) -> str:
+    if types is None:
+        return "except:"
+    return f"except {', '.join(types)}"
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise)
+               for n in _walk_skip_defs_body(handler))
+
+
+def _walk_skip_defs_body(handler: ast.ExceptHandler) -> Iterable[ast.AST]:
+    for stmt in handler.body:
+        yield stmt
+        yield from _walk_skip_defs(stmt)
+
+
+def _is_loggish_call(call: ast.Call) -> bool:
+    name = terminal_attr(call.func)
+    return name in _LOGGISH
+
+
+def _uses_exc_var(handler: ast.ExceptHandler) -> bool:
+    """The bound name is referenced outside log-ish calls: the error is
+    forwarded/stored/wrapped — handled, not discarded."""
+    if handler.name is None:
+        return False
+    log_spans: List[ast.Call] = []
+    for n in _walk_skip_defs_body(handler):
+        if isinstance(n, ast.Call) and _is_loggish_call(n):
+            log_spans.append(n)
+    in_logs = {id(sub) for call in log_spans for sub in ast.walk(call)}
+    for n in _walk_skip_defs_body(handler):
+        if isinstance(n, ast.Name) and n.id == handler.name \
+                and id(n) not in in_logs:
+            return True
+    return False
+
+
+def _captures_exc_info(handler: ast.ExceptHandler) -> bool:
+    """The handler stores the live traceback (``format_exc``/
+    ``exc_info``) — the thread-boundary error-trap idiom where the
+    fault is surfaced later via poll()/status, not discarded."""
+    for n in _walk_skip_defs_body(handler):
+        if isinstance(n, ast.Call) \
+                and terminal_attr(n.func) in ("format_exc", "exc_info"):
+            return True
+    return False
+
+
+def _exits_process(try_node: ast.Try, handler: ast.ExceptHandler) -> bool:
+    """The handler (or the try's finally) calls ``os._exit``: a fork/
+    process boundary that must never unwind — catching everything is
+    the contract there, not a hazard."""
+    nodes = list(_walk_skip_defs_body(handler))
+    for stmt in try_node.finalbody:
+        nodes.append(stmt)
+        nodes.extend(_walk_skip_defs(stmt))
+    return any(isinstance(n, ast.Call)
+               and terminal_attr(n.func) == "_exit" for n in nodes)
+
+
+def _discard_shaped(handler: ast.ExceptHandler) -> bool:
+    """Body is only pass/continue/break/constant-return/log calls: the
+    exception evaporates. Any assignment or non-log call counts as
+    fallback logic (handling), not discarding."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return):
+            if stmt.value is None or isinstance(stmt.value, ast.Constant):
+                continue
+            return False
+        if isinstance(stmt, ast.Expr):
+            if isinstance(stmt.value, ast.Constant):
+                continue  # docstring / ellipsis
+            if isinstance(stmt.value, ast.Call) \
+                    and _is_loggish_call(stmt.value):
+                continue
+            return False
+        return False
+    return True
+
+
+def run(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # innermost enclosing function per Try/Raise, for scope + __del__
+    owner_of: Dict[int, str] = {}
+    fname_of: Dict[str, str] = {}
+    for qualname, fnode, _cls in iter_functions(tree):
+        fname_of[qualname] = fnode.name
+        for sub in ast.walk(fnode):
+            owner_of[id(sub)] = qualname  # inner defs overwrite
+
+    def scope_of(node: ast.AST) -> str:
+        return owner_of.get(id(node), "<module>")
+
+    def in_finalizer(node: ast.AST) -> bool:
+        return fname_of.get(scope_of(node), "") == "__del__"
+
+    def emit(rule: str, node: ast.AST, message: str, detail: str):
+        findings.append(Finding(PASS_NAME, rule, path, node.lineno,
+                                scope_of(node), message, detail=detail))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try):
+            # cancellation types already caught-and-reraised by an
+            # earlier clause bless later broad clauses for those types
+            reraised_earlier: Set[str] = set()
+            for handler in node.handlers:
+                types = _clause_types(handler)
+                crepr = _clause_repr(types)
+                reraise = _reraises(handler)
+                uses_var = (_uses_exc_var(handler)
+                            or _captures_exc_info(handler)
+                            or _exits_process(node, handler))
+                if types is None or "BaseException" in types:
+                    absorbed = set(_CANCELLATION_TYPES)
+                else:
+                    absorbed = set(types) & _CANCELLATION_TYPES
+                absorbed -= reraised_earlier
+                if reraise:
+                    reraised_earlier |= (set(_CANCELLATION_TYPES)
+                                         if types is None
+                                         or "BaseException" in types
+                                         else absorbed)
+                if absorbed and not reraise and not uses_var \
+                        and not in_finalizer(handler):
+                    emit("absorbs-cancellation", handler,
+                         f"`{crepr}` can absorb "
+                         f"{'/'.join(sorted(absorbed))} without re-raising"
+                         " — cancellation/interrupt dies here and the"
+                         " task runs on (hang, not error)",
+                         detail=f"absorbs {crepr}")
+                    continue  # one finding per clause
+                broad = types is None or bool(set(types) & _BROAD_TYPES)
+                if broad and not reraise and not uses_var \
+                        and _discard_shaped(handler) \
+                        and not in_finalizer(handler):
+                    emit("silent-swallow", handler,
+                         f"`{crepr}` discards the exception (pass/"
+                         "log-only, no re-raise) — the fault surfaces"
+                         " nowhere",
+                         detail=f"swallow {crepr}")
+
+            # raise X(...) without `from` inside a handler
+            for handler in node.handlers:
+                for sub in _walk_skip_defs_body(handler):
+                    if isinstance(sub, ast.Try):
+                        break  # nested try owns its own handlers' raises
+                    if not isinstance(sub, ast.Raise):
+                        continue
+                    if sub.exc is None or sub.cause is not None:
+                        continue  # bare re-raise / explicit chain
+                    if not isinstance(sub.exc, ast.Call):
+                        continue  # `raise e` re-raise of the bound error
+                    name = dotted(sub.exc.func) or "<exc>"
+                    emit("raise-without-from", sub,
+                         f"`raise {name}(...)` inside `except` without"
+                         " `from` — the cause chain is implicit and"
+                         " attribution stops at the wrapper",
+                         detail=f"raise {name} no-cause")
+    return findings

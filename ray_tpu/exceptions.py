@@ -68,6 +68,19 @@ class CollectiveTimeoutError(RayTpuError, TimeoutError):
         super().__init__(msg)
 
 
+class GcsTimeoutError(RayTpuError, TimeoutError):
+    """A GCS control-plane RPC exceeded its bound (gcs_rpc_timeout_s)."""
+
+    def __init__(self, method: str = "", peer: str = "",
+                 timeout_s: float = 0.0):
+        self.method = method
+        self.peer = peer
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"GCS rpc {method!r} to {peer or '<peer>'} timed out "
+            f"after {timeout_s:.1f}s")
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -104,7 +104,10 @@ class _JobSupervisor:
                 self._message = f"exit code {rc}"
             self._put_status()
 
-        self._thread = threading.Thread(target=_wait, daemon=True)
+        # reaper: exits when the child it waits on dies — stop() releases
+        # it by killing the process group, not by touching the thread
+        self._thread = threading.Thread(  # graftlint: ignore[cleanup]
+            target=_wait, daemon=True)
         self._thread.start()
         return True
 

@@ -1,0 +1,174 @@
+"""Chaos smoke lane (run by ci.sh, non-gating): boot a mini cluster,
+trip randomized failpoints, and prove the error-plane invariant the
+graftlint passes check statically — every injected fault surfaces as an
+attributed error (or is absorbed by bounded retry), and NONE of them
+becomes a hang the stall sentinel has to flag.
+
+Each round draws from the entry table below, arms one failpoint spec
+(programmatic arm(): the GCS, raylet, and object store all live in the
+driver process), runs a small workload, asserts the expected outcome
+(raise-faults carry the failpoint's site name; delay/drop-faults
+complete through timeout+retry), then asserts stall-sentinel silence.
+
+Repro: the chosen seed is printed; rerun with CHAOS_SEED=<n>.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+import ray_tpu
+from ray_tpu._private import failpoints
+from ray_tpu.util import state
+
+
+def _wait(pred, timeout_s: float, what: str):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.25)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@ray_tpu.remote(num_cpus=0.5)  # sub-integer: force the full lease pipeline
+def _double(x):
+    return x * 2
+
+
+def _expect_tasks_ok(n: int = 4) -> None:
+    got = ray_tpu.get([_double.remote(i) for i in range(n)], timeout=60)
+    assert got == [2 * i for i in range(n)], got
+
+
+def _expect_raise(fn, site: str) -> None:
+    try:
+        fn()
+    except BaseException as e:  # includes wrapped task errors
+        text = f"{type(e).__name__}: {e}"
+        assert site in text, (
+            f"fault at {site} surfaced an UNattributed error: {text}")
+        return
+    raise AssertionError(f"fault at {site} surfaced no error at all")
+
+
+# ---- round bodies -----------------------------------------------------
+
+def round_lease_raise() -> None:
+    """raise on lease grant: the submit pipeline must carry the error
+    into the task's return objects — ray.get raises, attributed."""
+    failpoints.arm("raylet.lease.grant=raise")
+    _expect_raise(lambda: ray_tpu.get(_double.remote(1), timeout=60),
+                  "raylet.lease.grant")
+
+
+def round_seal_raise() -> None:
+    """raise on object seal: put() of a non-inline object raises in the
+    putting caller, store bookkeeping stays consistent for later puts."""
+    failpoints.arm("object.seal=raise:0:1")
+    _expect_raise(lambda: ray_tpu.put(b"x" * 200 * 1024), "object.seal")
+    failpoints.disarm()
+    ref = ray_tpu.put(b"y" * 200 * 1024)  # store usable after the fault
+    assert ray_tpu.get(ref, timeout=30) == b"y" * 200 * 1024
+
+
+def round_spill_raise() -> None:
+    """raise on spill write: eviction-triggered spill I/O failure must
+    propagate to the caller whose reservation forced the eviction."""
+    failpoints.arm("spill.write=raise")
+    refs = []
+
+    def fill():
+        for i in range(64):  # enough to overflow the shrunken store
+            refs.append(ray_tpu.put(os.urandom(1024 * 1024)))
+    _expect_raise(fill, "spill.write")
+
+
+def round_dispatch_delay() -> None:
+    """delay in RPC dispatch: straggler control-plane handlers; work
+    completes and nothing stalls."""
+    failpoints.arm("rpc.server.dispatch=delay:0.05:10")
+    _expect_tasks_ok()
+    assert failpoints.hit_counts().get("rpc.server.dispatch", 0) > 0, \
+        "delay failpoint armed but never hit"
+
+
+def round_heartbeat_delay() -> None:
+    """delay in the raylet->GCS clock-sync ping: slow heartbeats must
+    not wedge the raylet loop or flag anything."""
+    failpoints.arm("raylet.heartbeat=delay:0.2:3")
+    _wait(lambda: failpoints.hit_counts().get("raylet.heartbeat", 0) >= 1,
+          15, "heartbeat failpoint to trip")
+    _expect_tasks_ok()
+
+
+def round_lease_send_drop() -> None:
+    """drop the first two lease request frames: lease_rpc_timeout_s
+    turns the loss into per-try timeouts and the retry (raylet dedups
+    by request id) completes the task — loss, bounded, recovered."""
+    failpoints.arm("rpc.client.send@request_worker_lease=drop:0:2")
+    _expect_tasks_ok(n=1)
+    assert failpoints.hit_counts().get(
+        "rpc.client.send@request_worker_lease", 0) == 2, \
+        failpoints.hit_counts()
+
+
+ROUNDS = [
+    ("lease-grant-raise", round_lease_raise),
+    ("object-seal-raise", round_seal_raise),
+    ("spill-write-raise", round_spill_raise),
+    ("rpc-dispatch-delay", round_dispatch_delay),
+    ("heartbeat-delay", round_heartbeat_delay),
+    ("lease-send-drop", round_lease_send_drop),
+]
+
+
+def main() -> int:
+    seed = int(os.environ.get("CHAOS_SEED", time.time_ns() % 100000))
+    n_rounds = int(os.environ.get("CHAOS_ROUNDS", "3"))
+    rng = random.Random(seed)
+    chosen = rng.sample(ROUNDS, k=min(n_rounds, len(ROUNDS)))
+    print(f"chaos smoke: seed={seed} rounds="
+          f"{[name for name, _ in chosen]}", flush=True)
+
+    ray_tpu.init(num_cpus=4, _system_config={
+        # tight sentinel so a fault-turned-hang WOULD flag within the round
+        "task_watchdog_interval_s": 0.5,
+        "task_stall_threshold_s": 5.0,
+        # frequent heartbeats so heartbeat-site rounds trip quickly
+        "clock_sync_interval_s": 0.5,
+        # small store so spill-site rounds reach eviction in a few puts
+        "object_store_memory_bytes": 32 * 1024 * 1024,
+        # dropped lease frames become per-try timeouts, not forever-waits
+        "lease_rpc_timeout_s": 1.0,
+    })
+    try:
+        for name, body in chosen:
+            print(f"-- round: {name}", flush=True)
+            try:
+                body()
+            finally:
+                failpoints.disarm()
+            # the invariant: injected faults surface as errors; the
+            # sentinel (armed tight above) saw no hang to flag
+            events = [e for e in state.list_cluster_events(
+                source="stall_sentinel", severity="WARNING")]
+            assert not events, (
+                f"round {name}: injected fault became a stall: {events}")
+            assert not state.list_stalls().get("tasks"), \
+                f"round {name}: stalled tasks survived the round"
+            _expect_tasks_ok(n=2)  # cluster still healthy post-fault
+            print(f"   round {name}: ok", flush=True)
+        print(f"chaos smoke ok ({len(chosen)} rounds, seed={seed})")
+        return 0
+    finally:
+        failpoints.disarm()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,7 +64,7 @@ def cmd_kv_server(args) -> int:
 
     try:
         asyncio.run(_amain(args.address, args.data))
-    except KeyboardInterrupt:
+    except KeyboardInterrupt:  # graftlint: ignore[swallow] — quiet ^C exit
         pass
     return 0
 
@@ -494,8 +494,9 @@ def cmd_down(args) -> int:
 
 
 def cmd_lint(args) -> int:
-    """graftlint — concurrency-hazard static analysis (same entry point
-    as ``python -m ray_tpu.devtools.graftlint``; ci.sh's lint phase)."""
+    """graftlint — concurrency- and error-plane-hazard static analysis
+    (same entry point as ``python -m ray_tpu.devtools.graftlint``;
+    ci.sh's lint phase)."""
     from ..devtools.graftlint.__main__ import main as lint_main
 
     argv = list(args.lint_args)
@@ -610,9 +611,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("lint",
-                        help="graftlint: concurrency-hazard static "
-                             "analysis (flags pass through; see "
-                             "`ray-tpu lint -- --help`)")
+                        help="graftlint: concurrency- and error-plane-"
+                             "hazard static analysis (flags pass "
+                             "through; see `ray-tpu lint -- --help`)")
     sp.add_argument("lint_args", nargs=argparse.REMAINDER)
     sp.set_defaults(fn=cmd_lint)
     return p

@@ -13,8 +13,17 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Dict, List, Optional
 
+from .._private.rpc import RpcError
+from ..exceptions import RayTpuError
+
 CONTROLLER_NAME = "SERVE::controller"
 HEALTH_PERIOD_S = 2.0
+
+# What best-effort calls against a possibly-dead replica/proxy can
+# raise (transport loss, timeouts, the actor already being gone).
+# Anything outside this set is a controller bug and must surface.
+_REMOTE_ERRORS = (asyncio.TimeoutError, ConnectionError, OSError,
+                  RuntimeError, ValueError, RpcError, RayTpuError)
 
 
 async def _await_ref(ref):
@@ -110,8 +119,8 @@ class ServeController:
 
         try:
             kill(handle)
-        except Exception:
-            pass
+        except _REMOTE_ERRORS:
+            pass  # already dead: the goal state
 
     async def _autoscale_target(self, dep: dict, auto: dict) -> int:
         """Queue-length-driven replica target (ref: serve/_private/
@@ -149,7 +158,7 @@ class ServeController:
             try:
                 return await asyncio.wait_for(
                     _await_ref(entry[0].queue_len.remote()), 5)
-            except Exception:
+            except _REMOTE_ERRORS:
                 return -1
 
         return list(await asyncio.gather(*[_one(e) for e in replicas]))
@@ -172,7 +181,7 @@ class ServeController:
                 await asyncio.wait_for(
                     _await_ref(replica.health_check.remote()), 15)
                 return version == code_version  # stale code = replace
-            except Exception:
+            except _REMOTE_ERRORS:
                 return False
 
         results = await asyncio.gather(
@@ -221,7 +230,7 @@ class ServeController:
             from .._worker_api import core
 
             core().publish_channel("serve", {"version": self._version})
-        except Exception:
+        except _REMOTE_ERRORS + (ImportError, KeyError):
             pass  # pushes are an optimization; handles still fall back
 
     def _ensure_reconcile_loop(self) -> None:
@@ -239,7 +248,14 @@ class ServeController:
                     try:
                         await self._reconcile_deployment(dep)
                     except Exception:
-                        pass
+                        # the loop must survive a bad round, but the
+                        # failure has to be visible somewhere
+                        import sys
+                        import traceback
+
+                        print(f"[serve] reconcile({name}) failed:\n"
+                              f"{traceback.format_exc()}",
+                              file=sys.stderr)
 
     # ------------------------------------------------------------ queries
     async def get_replicas(self, name: str):
@@ -292,8 +308,8 @@ class ServeController:
 
                     try:
                         kill(getattr(self, slot))
-                    except Exception:
-                        pass
+                    except _REMOTE_ERRORS:
+                        pass  # it's being replaced either way
                     setattr(self, slot, None)
                     setattr(self, slot + "_port", None)
             actor = remote(actor_cls).options(
@@ -325,11 +341,11 @@ class ServeController:
         if self._proxy is not None:
             try:
                 kill(self._proxy)
-            except Exception:
+            except _REMOTE_ERRORS:
                 pass
         if self._grpc_proxy is not None:
             try:
                 kill(self._grpc_proxy)
-            except Exception:
+            except _REMOTE_ERRORS:
                 pass
         return True

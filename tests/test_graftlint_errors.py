@@ -1,0 +1,657 @@
+"""Graftlint error plane (swallow/cleanup/rpc-timeout passes) + the
+failpoint fault-injection harness.
+
+Each pass is pinned the same way the concurrency passes are: fixture
+sources assert BOTH the true positives (a seeded hazard must be found)
+and the false-positive guards (the blessed idioms must stay clean).
+The failpoint tests cover the harness in isolation (arm/disarm, spec
+grammar, hit bounds, detail scoping) and against a live mini cluster:
+a raise-armed lease grant must surface an *attributed* error through
+ray.get, a delay-armed dispatch and a drop-armed heartbeat must perturb
+without error — and in every case the stall sentinel stays silent."""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import failpoints
+from ray_tpu._private.failpoints import FailpointError
+from ray_tpu.devtools.graftlint import lint_source
+from ray_tpu.devtools.graftlint.baseline import diff, load, save
+
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src, select, path="fixture.py"):
+    return lint_source(textwrap.dedent(src), path, select=select)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# pass 6: swallow
+# ---------------------------------------------------------------------------
+
+class TestSwallowPass:
+    def test_bare_except_pass_is_cancellation_hazard(self):
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except:
+                    pass
+            """, {"swallow"})
+        assert _rules(out) == ["absorbs-cancellation"]
+
+    def test_base_exception_discard_is_cancellation_hazard(self):
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except BaseException:
+                    pass
+            """, {"swallow"})
+        assert _rules(out) == ["absorbs-cancellation"]
+
+    def test_explicit_cancelled_error_discard_detected(self):
+        out = _lint("""
+            import asyncio
+
+            async def f():
+                try:
+                    await work()
+                except asyncio.CancelledError:
+                    log.warning("cancelled")
+            """, {"swallow"})
+        assert _rules(out) == ["absorbs-cancellation"]
+
+    def test_keyboard_interrupt_in_tuple_detected(self):
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except (ValueError, KeyboardInterrupt):
+                    pass
+            """, {"swallow"})
+        assert _rules(out) == ["absorbs-cancellation"]
+
+    def test_broad_except_pass_is_silent_swallow(self):
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+            """, {"swallow"})
+        assert _rules(out) == ["silent-swallow"]
+
+    def test_log_only_handler_is_silent_swallow(self):
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except Exception as e:
+                    log.warning("failed: %s", e)
+            """, {"swallow"})
+        assert _rules(out) == ["silent-swallow"]
+
+    def test_reraise_is_clean(self):
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except BaseException:
+                    cleanup()
+                    raise
+            """, {"swallow"})
+        assert out == []
+
+    def test_forwarding_the_exception_is_clean(self):
+        # rpc._dispatch shape: the error is sent over the wire
+        out = _lint("""
+            async def dispatch(self, conn):
+                try:
+                    await handler()
+                except asyncio.CancelledError:
+                    raise
+                except BaseException as e:
+                    await self.reply_error(conn, e)
+            """, {"swallow"})
+        assert out == []
+
+    def test_earlier_cancellation_reraise_downgrades_broad_clause(self):
+        # cancellation re-raised first: the remaining broad discard is
+        # a ratchetable silent-swallow, NOT the hard cancellation class
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except (CancelledError, KeyboardInterrupt,
+                        CollectiveTimeoutError):
+                    raise
+                except BaseException:
+                    pass
+            """, {"swallow"})
+        assert _rules(out) == ["silent-swallow"]
+
+    def test_del_finalizer_is_exempt(self):
+        out = _lint("""
+            class C:
+                def __del__(self):
+                    try:
+                        self.close()
+                    except Exception:
+                        pass
+            """, {"swallow"})
+        assert out == []
+
+    def test_fallback_logic_is_clean(self):
+        out = _lint("""
+            def probe():
+                try:
+                    return check()
+                except Exception:
+                    ok = False
+                    return ok
+            """, {"swallow"})
+        assert out == []
+
+    def test_traceback_capture_is_clean(self):
+        # thread-boundary error trap: fault recorded, surfaced via poll()
+        out = _lint("""
+            import traceback
+
+            def run(self):
+                try:
+                    work()
+                except BaseException:
+                    self._error = traceback.format_exc()
+            """, {"swallow"})
+        assert out == []
+
+    def test_process_exit_boundary_is_clean(self):
+        # forked child: must never unwind into parent code
+        out = _lint("""
+            import os
+            import traceback
+
+            def child():
+                code = 1
+                try:
+                    work()
+                    code = 0
+                except BaseException:
+                    traceback.print_exc()
+                finally:
+                    os._exit(code)
+            """, {"swallow"})
+        assert out == []
+
+    def test_raise_without_from_detected(self):
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except ValueError:
+                    raise RuntimeError("wrapped")
+            """, {"swallow"})
+        assert _rules(out) == ["raise-without-from"]
+
+    def test_raise_from_and_bare_raise_are_clean(self):
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except ValueError as e:
+                    if fatal():
+                        raise RuntimeError("wrapped") from e
+                    raise
+            """, {"swallow"})
+        assert out == []
+
+    def test_suppression_comment_silences(self):
+        out = _lint("""
+            def f():
+                try:
+                    work()
+                except BaseException:  # graftlint: ignore[swallow]
+                    pass
+            """, {"swallow"})
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# pass 7: cleanup
+# ---------------------------------------------------------------------------
+
+class TestCleanupPass:
+    def test_never_released_open_detected(self):
+        out = _lint("""
+            def f(p):
+                fh = open(p)
+                data = fh.read()
+                return data
+            """, {"cleanup"})
+        assert _rules(out) == ["unguarded-acquire"]
+        assert "never released" in out[0].message
+
+    def test_release_on_happy_path_only_detected(self):
+        out = _lint("""
+            def f(p):
+                fh = open(p)
+                data = parse(fh.read())
+                fh.close()
+                return data
+            """, {"cleanup"})
+        assert _rules(out) == ["unguarded-acquire"]
+        assert "not in a finally" in out[0].message
+
+    def test_with_statement_is_clean(self):
+        out = _lint("""
+            def f(p):
+                with open(p) as fh:
+                    return parse(fh.read())
+            """, {"cleanup"})
+        assert out == []
+
+    def test_try_finally_release_is_clean(self):
+        out = _lint("""
+            def f(p):
+                fh = open(p)
+                try:
+                    return parse(fh.read())
+                finally:
+                    fh.close()
+            """, {"cleanup"})
+        assert out == []
+
+    def test_immediate_release_no_risky_call_is_clean(self):
+        out = _lint("""
+            import socket
+
+            def probe():
+                s = socket.socket()
+                s.close()
+            """, {"cleanup"})
+        assert out == []
+
+    def test_escape_via_return_is_clean(self):
+        out = _lint("""
+            import socket
+
+            def make():
+                s = socket.socket()
+                return s
+            """, {"cleanup"})
+        assert out == []
+
+    def test_escape_via_attribute_store_is_clean(self):
+        out = _lint("""
+            import socket
+
+            class C:
+                def start(self):
+                    s = socket.socket()
+                    self.sock = s
+            """, {"cleanup"})
+        assert out == []
+
+    def test_global_declared_name_is_clean(self):
+        # lazily-opened module-lifetime sink: released at process exit
+        out = _lint("""
+            _sink = None
+
+            def emit(rec):
+                global _sink
+                if _sink is None:
+                    _sink = open("spans.jsonl", "a")
+                _sink.write(rec)
+            """, {"cleanup"})
+        assert out == []
+
+    def test_escape_via_registry_call_is_clean(self):
+        out = _lint("""
+            def f(p, registry):
+                fh = open(p)
+                registry.add(fh)
+            """, {"cleanup"})
+        assert out == []
+
+    def test_stop_leaks_background_task_detected(self):
+        out = _lint("""
+            import asyncio
+
+            class Pinger:
+                def __init__(self):
+                    self._task = asyncio.ensure_future(self._loop())
+
+                def stop(self):
+                    self.stopped = True
+            """, {"cleanup"})
+        assert _rules(out) == ["stop-leaks-resource"]
+        assert "_task" in out[0].message
+
+    def test_stop_cancelling_the_task_is_clean(self):
+        out = _lint("""
+            import asyncio
+
+            class Pinger:
+                def __init__(self):
+                    self._task = asyncio.ensure_future(self._loop())
+
+                def stop(self):
+                    self._task.cancel()
+            """, {"cleanup"})
+        assert out == []
+
+    def test_class_without_lifecycle_methods_is_exempt(self):
+        out = _lint("""
+            import asyncio
+
+            class FireAndForget:
+                def __init__(self):
+                    self._task = asyncio.ensure_future(self._loop())
+            """, {"cleanup"})
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# pass 8: rpc-timeout
+# ---------------------------------------------------------------------------
+
+class TestRpcTimeoutPass:
+    def test_unbounded_call_detected(self):
+        out = _lint("""
+            async def f(self):
+                return await self.gcs.call("ping", {})
+            """, {"rpc-timeout"})
+        assert _rules(out) == ["unbounded-rpc-await"]
+        assert "ping" in out[0].message
+
+    def test_timeout_kwarg_is_clean(self):
+        out = _lint("""
+            async def f(self):
+                return await self.gcs.call("ping", {}, timeout=5.0)
+            """, {"rpc-timeout"})
+        assert out == []
+
+    def test_call_retrying_is_clean(self):
+        out = _lint("""
+            async def f(self):
+                return await self.gcs.call_retrying("ping", {})
+            """, {"rpc-timeout"})
+        assert out == []
+
+    def test_wait_for_wrapped_call_is_clean(self):
+        out = _lint("""
+            import asyncio
+
+            async def f(self):
+                return await asyncio.wait_for(
+                    self.gcs.call("ping", {}), 5.0)
+            """, {"rpc-timeout"})
+        assert out == []
+
+    def test_uncapped_retry_loop_detected(self):
+        out = _lint("""
+            import asyncio
+
+            async def f():
+                while True:
+                    try:
+                        return await attempt()
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.1)
+            """, {"rpc-timeout"})
+        assert _rules(out) == ["uncapped-retry"]
+
+    def test_deadline_reraise_in_loop_is_clean(self):
+        out = _lint("""
+            import asyncio
+            import time
+
+            async def f(deadline):
+                while True:
+                    try:
+                        return await attempt()
+                    except Exception:
+                        if time.monotonic() > deadline:
+                            raise
+                    await asyncio.sleep(0.1)
+            """, {"rpc-timeout"})
+        assert out == []
+
+    def test_handler_with_stop_flag_exit_is_clean(self):
+        # consumer pump: the except path checks a stop flag and returns
+        out = _lint("""
+            import queue
+            import time
+
+            def pump(buf, stop_event):
+                while True:
+                    try:
+                        item = buf.get(timeout=0.5)
+                    except queue.Empty:
+                        if stop_event.is_set():
+                            return
+                        continue
+                    handle(item)
+                    time.sleep(0.01)
+            """, {"rpc-timeout"})
+        assert out == []
+
+    def test_periodic_daemon_loop_is_clean(self):
+        out = _lint("""
+            import asyncio
+
+            async def daemon():
+                while True:
+                    try:
+                        await tick()
+                    except Exception:
+                        pass
+                    await asyncio.sleep(1.0)
+            """, {"rpc-timeout"})
+        assert out == []
+
+    def test_escalating_backoff_is_clean(self):
+        out = _lint("""
+            import asyncio
+
+            async def f():
+                delay = 0.1
+                while True:
+                    try:
+                        return await attempt()
+                    except Exception:
+                        pass
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 5.0)
+            """, {"rpc-timeout"})
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip with the new passes
+# ---------------------------------------------------------------------------
+
+class TestErrorPlaneBaseline:
+    SRC = """
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+
+        async def g(self):
+            await self.gcs.call("ping", {})
+        """
+
+    def test_ratchet_roundtrip(self, tmp_path):
+        found = _lint(self.SRC, {"swallow", "rpc-timeout"})
+        assert len(found) == 2
+        path = tmp_path / "baseline.json"
+        save(str(path), found)
+        baseline = load(str(path))
+        new, stale = diff(found, baseline)
+        assert new == [] and stale == []
+        # fixing one finding makes its entry stale, introduces nothing
+        fixed = [f for f in found if f.rule != "silent-swallow"]
+        new, stale = diff(fixed, baseline)
+        assert new == [] and len(stale) == 1
+
+    def test_new_finding_not_masked_by_baseline(self, tmp_path):
+        found = _lint(self.SRC, {"swallow"})
+        path = tmp_path / "baseline.json"
+        save(str(path), found)
+        grown = self.SRC + """
+        def h():
+            try:
+                work()
+            except BaseException:
+                pass
+        """
+        new, _ = diff(_lint(grown, {"swallow"}), load(str(path)))
+        assert _rules(new) == ["absorbs-cancellation"]
+
+    def test_repo_cancellation_class_is_baseline_empty(self):
+        """The hard class gates at zero: the shipped baseline must not
+        ratchet a single absorbs-cancellation finding."""
+        baseline = load(os.path.join(REPO, "graftlint_baseline.json"))
+        absorbed = [fp for fp, meta in baseline.items()
+                    if meta.get("rule") == "absorbs-cancellation"]
+        assert absorbed == [], absorbed
+
+
+# ---------------------------------------------------------------------------
+# failpoint harness (in isolation)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fp():
+    failpoints.disarm()
+    yield failpoints
+    failpoints.disarm()
+
+
+class TestFailpointHarness:
+    def test_unarmed_is_inert(self, fp):
+        assert fp.fire("rpc.client.send") is None
+        assert fp.hit_counts() == {}
+
+    def test_raise_action_names_the_site(self, fp):
+        fp.arm("raylet.lease.grant=raise")
+        with pytest.raises(FailpointError, match="raylet.lease.grant"):
+            fp.fire("raylet.lease.grant")
+        assert fp.fire("object.seal") is None  # other sites untouched
+
+    def test_delay_action_sleeps_then_proceeds(self, fp):
+        fp.arm("object.seal=delay:0.05")
+        t0 = time.monotonic()
+        assert fp.fire("object.seal") == "delay"
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_drop_action_and_hit_bound(self, fp):
+        fp.arm("rpc.client.send=drop:0:2")
+        assert fp.fire("rpc.client.send") == "drop"
+        assert fp.fire("rpc.client.send") == "drop"
+        assert fp.fire("rpc.client.send") is None  # bound exhausted
+        assert fp.hit_counts() == {"rpc.client.send": 2}
+
+    def test_detail_scoped_match_beats_bare_site(self, fp):
+        fp.arm("rpc.client.send@request_worker_lease=drop,"
+               "rpc.client.send=delay:0.01")
+        assert fp.fire("rpc.client.send",
+                       detail="request_worker_lease") == "drop"
+        assert fp.fire("rpc.client.send", detail="ping") == "delay"
+
+    def test_disarm_restores_inert(self, fp):
+        fp.arm("object.seal=raise")
+        fp.disarm()
+        assert fp.fire("object.seal") is None
+
+    def test_async_fire_delay(self, fp):
+        fp.arm("rpc.server.dispatch=delay:0.05")
+
+        async def go():
+            t0 = time.monotonic()
+            assert await failpoints.afire("rpc.server.dispatch") == "delay"
+            return time.monotonic() - t0
+
+        assert asyncio.run(go()) >= 0.04
+
+    def test_malformed_spec_entries_are_skipped(self, fp):
+        fp.arm("not-an-entry,object.seal=explode,raylet.heartbeat=raise")
+        assert fp.fire("object.seal") is None
+        with pytest.raises(FailpointError):
+            fp.fire("raylet.heartbeat")
+
+
+# ---------------------------------------------------------------------------
+# failpoints against a live mini cluster
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fp_cluster():
+    ray_tpu.init(num_cpus=2, _system_config={
+        "task_watchdog_interval_s": 0.5,
+        "task_stall_threshold_s": 5.0,
+        "clock_sync_interval_s": 0.5,
+        "lease_rpc_timeout_s": 1.0,
+    })
+    yield failpoints
+    failpoints.disarm()
+    ray_tpu.shutdown()
+
+
+def _assert_sentinel_silent():
+    from ray_tpu.util import state
+    events = state.list_cluster_events(source="stall_sentinel",
+                                       severity="WARNING")
+    assert events == [], events
+    assert not state.list_stalls().get("tasks")
+
+
+@ray_tpu.remote(num_cpus=0.5)  # sub-integer: full lease pipeline
+def _plus(x):
+    return x + 1
+
+
+class TestFailpointCluster:
+    def test_raise_at_lease_grant_surfaces_attributed_error(self, fp_cluster):
+        fp_cluster.arm("raylet.lease.grant=raise")
+        with pytest.raises(BaseException, match="raylet.lease.grant"):
+            ray_tpu.get(_plus.remote(1), timeout=60)
+        fp_cluster.disarm()
+        _assert_sentinel_silent()
+        # pipeline recovers once the fault clears
+        assert ray_tpu.get(_plus.remote(1), timeout=60) == 2
+
+    def test_delay_at_dispatch_completes_without_stall(self, fp_cluster):
+        fp_cluster.arm("rpc.server.dispatch=delay:0.05:10")
+        assert ray_tpu.get([_plus.remote(i) for i in range(4)],
+                           timeout=60) == [1, 2, 3, 4]
+        assert fp_cluster.hit_counts().get("rpc.server.dispatch", 0) > 0
+        fp_cluster.disarm()
+        _assert_sentinel_silent()
+
+    def test_drop_at_heartbeat_completes_without_stall(self, fp_cluster):
+        fp_cluster.arm("raylet.heartbeat=drop:0:3")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if fp_cluster.hit_counts().get("raylet.heartbeat", 0) >= 1:
+                break
+            time.sleep(0.2)
+        assert fp_cluster.hit_counts().get("raylet.heartbeat", 0) >= 1
+        assert ray_tpu.get(_plus.remote(5), timeout=60) == 6
+        fp_cluster.disarm()
+        _assert_sentinel_silent()
