@@ -371,7 +371,7 @@ def _bench_envelope_summary():
          os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "bench_envelope.py"),
          "sched", "queued", "inflight", "getmany", "bigobj", "actors",
-         "broadcast", "syncer", "gang", "spill", "--moderate"],
+         "broadcast", "syncer", "gang", "spill", "tail", "--moderate"],
         env=env, capture_output=True, text=True, timeout=2700)
     for line in proc.stdout.splitlines():
         try:

@@ -15,6 +15,8 @@ Families:
   * broadcast — 1 GiB object pulled by every node of a 4-node cluster
   * getmany   — one ray.get over 10k store objects
   * bigobj    — a single multi-GiB numpy object round-trip
+  * tail      — task + serve p50/p99/p999 with one slow node/replica,
+                hedged speculative execution off vs on
 
 Run:  python bench_envelope.py [family ...] [--quick]
 """
@@ -653,6 +655,152 @@ def bench_shuffle(results, blocks=16, rows_per_block=50_000,
         ray.shutdown()
 
 
+# ---------------------------------------------------------------- tail
+def _pctl(samples, q):
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]
+
+
+def bench_tail(results):
+    """Tail-latency envelope (The Tail at Scale): task and serve
+    p50/p99/p999 with one deterministically slow node / periodically
+    slow replica, hedging off vs on. The before/after pair is the
+    record that speculative re-execution buys its p99 claim."""
+    import ray_tpu as ray
+    from ray_tpu._private.config import global_config
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.metrics import snapshot_local
+
+    waves = 8 if QUICK else 25
+    slow_s = 1.0
+
+    def run_tasks(speculate: bool):
+        # driver-only head: every task leases remotely; SPREAD straddles
+        # the fast and straggler nodes, so roughly half of each 4-wide
+        # wave lands slow — the tail the hedges must erase
+        from ray_tpu.util.scheduling_strategies import (
+            SpreadSchedulingStrategy)
+
+        global_config().apply_overrides({
+            "prestart_workers": False,
+            "task_speculation_enabled": speculate,
+            "task_hedge_min_delay_s": 0.1,
+            "task_hedge_ema_factor": 3.0,
+            "task_watchdog_interval_s": 0.25,
+            "task_stall_threshold_s": 0.35,
+        })
+        cluster = Cluster(head_node_args={"num_cpus": 0})
+        try:
+            cluster.add_node(num_cpus=2)          # the healthy node
+            slow = cluster.add_node(num_cpus=2)
+            os.environ["RAY_TPU_FAILPOINTS"] = (
+                f"worker.task.run@{slow.node_id.hex()}=slow:{slow_s}")
+            cluster.connect()
+
+            @ray.remote(idempotent=True,
+                        scheduling_strategy=SpreadSchedulingStrategy())
+            def unit():
+                time.sleep(0.02)
+                return 1
+
+            ray.get([unit.remote() for _ in range(4)], timeout=120)
+            lat = []
+            for _ in range(waves):
+                t0 = time.perf_counter()
+                refs = [unit.remote() for _ in range(4)]
+                for r in refs:
+                    ray.get(r, timeout=120)
+                    lat.append(time.perf_counter() - t0)
+            return lat
+        finally:
+            os.environ.pop("RAY_TPU_FAILPOINTS", None)
+            cluster.shutdown()
+
+    snap0 = snapshot_local("task_hedge")
+    lat_before = run_tasks(False)
+    lat_after = run_tasks(True)
+    snap1 = snapshot_local("task_hedge")
+    delta = {k: snap1.get(k, 0) - snap0.get(k, 0)
+             for k in ("task_hedges_launched", "task_hedges_won",
+                       "task_hedge_duplicate_publishes")}
+    n = 4 * waves
+    p99_speedup = _pctl(lat_before, 0.99) / max(1e-9,
+                                                _pctl(lat_after, 0.99))
+    assert delta["task_hedge_duplicate_publishes"] == 0, \
+        "a hedged task sealed its output twice"
+    results.append(emit(
+        "envelope_tail_tasks", n=n, slow_node_penalty_s=slow_s,
+        p50_before_ms=_pctl(lat_before, 0.5) * 1e3,
+        p99_before_ms=_pctl(lat_before, 0.99) * 1e3,
+        p999_before_ms=_pctl(lat_before, 0.999) * 1e3,
+        p50_after_ms=_pctl(lat_after, 0.5) * 1e3,
+        p99_after_ms=_pctl(lat_after, 0.99) * 1e3,
+        p999_after_ms=_pctl(lat_after, 0.999) * 1e3,
+        p99_speedup=p99_speedup,
+        hedges_launched=delta["task_hedges_launched"],
+        hedges_won=delta["task_hedges_won"],
+        hedge_rate=round(delta["task_hedges_launched"] / n, 3),
+        duplicate_publishes=delta["task_hedge_duplicate_publishes"]))
+
+    # ---- serve: 2 replicas, every 10th request on a replica stalls ----
+    n_serve = 40 if QUICK else 150
+    budget = 0.25
+
+    def run_serve(hedge: bool):
+        # the hedge quantile must sit BELOW the tail fraction: with every
+        # 10th request slow, a p95 trigger delay IS the straggle latency
+        # and the backup always fires too late; p80 sits in the fast band
+        ray.init(num_cpus=4, _system_config={
+            "serve_hedge_quantile": 0.8 if hedge else 0.0,
+            "serve_hedge_budget": budget,
+            "serve_hedge_min_samples": 8,
+        })
+        try:
+            from ray_tpu import serve
+
+            @serve.deployment(num_replicas=2)
+            class Unit:
+                def __init__(self):
+                    self.i = 0
+
+                def __call__(self, x):
+                    self.i += 1
+                    if self.i % 10 == 0:
+                        time.sleep(0.4)  # the periodic straggle
+                    return x
+
+            handle = serve.run(Unit.bind())
+            for i in range(16):  # warm replicas + latency profile
+                ray.get(handle.remote(i), timeout=60)
+            lat = []
+            for i in range(n_serve):
+                t0 = time.perf_counter()
+                assert ray.get(handle.remote(i), timeout=60) == i
+                lat.append(time.perf_counter() - t0)
+            return lat, handle._requests_total, handle._hedges_launched
+        finally:
+            serve.shutdown()
+            ray.shutdown()
+
+    lat_before, _, _ = run_serve(False)
+    lat_after, total, hedged = run_serve(True)
+    assert hedged <= budget * total + 1, \
+        f"hedge budget exceeded: {hedged}/{total}"
+    results.append(emit(
+        "envelope_tail_serve", n=n_serve, slow_every=10,
+        replica_penalty_s=0.4,
+        p50_before_ms=_pctl(lat_before, 0.5) * 1e3,
+        p99_before_ms=_pctl(lat_before, 0.99) * 1e3,
+        p999_before_ms=_pctl(lat_before, 0.999) * 1e3,
+        p50_after_ms=_pctl(lat_after, 0.5) * 1e3,
+        p99_after_ms=_pctl(lat_after, 0.99) * 1e3,
+        p999_after_ms=_pctl(lat_after, 0.999) * 1e3,
+        p99_speedup=_pctl(lat_before, 0.99) / max(
+            1e-9, _pctl(lat_after, 0.99)),
+        hedge_rate=round(hedged / max(1, total), 3),
+        hedge_budget=budget))
+
+
 # in-session families in dict order = default run order: "actors" LAST
 # among them so its creations contend with the task-event backlog the
 # earlier families leave (the regime the r4 bench dodged)
@@ -668,6 +816,7 @@ ALL = {
     "gang": bench_gang_restart,
     "spill": bench_spill,
     "shuffle": bench_shuffle,
+    "tail": bench_tail,
 }
 
 # families that run inside a ray.init'd single-node session; "actors"

@@ -42,6 +42,7 @@ _OPTION_KEYS = {
     "name", "namespace", "scheduling_strategy", "runtime_env", "lifetime",
     "placement_group", "placement_group_bundle_index",
     "generator_backpressure_num_objects", "accelerator_type",
+    "idempotent", "speculation",
 }
 
 

@@ -175,6 +175,42 @@ class Config:
     # not advanced for this long is flagged (0 disables); checked by the
     # raylet watchdog tick against the store's in-progress registry.
     transfer_stall_timeout_s: float = 30.0
+    # --- tail tolerance (hedged execution + straggler-aware scheduling,
+    #     ref: The Tail at Scale — the mitigation half of the stall
+    #     sentinel's detection plane) ---
+    # speculative re-execution of idempotent tasks: when a RUNNING task
+    # outlives its hedge delay (per-fn EMA of past push->reply durations
+    # times task_hedge_ema_factor, floored at task_hedge_min_delay_s) or
+    # the raylet watchdog flags it and hints the owner, the owner pushes
+    # a second copy of the same TaskSpec to a different node; the first
+    # reply wins and is published exactly once, the loser is cancelled.
+    # Only tasks declared @remote(idempotent=True) (or
+    # speculation="auto") are eligible.
+    task_speculation_enabled: bool = False
+    task_hedge_ema_factor: float = 3.0
+    task_hedge_min_delay_s: float = 1.0
+    # serve request hedging (serve/handle.py): once a handle has
+    # serve_hedge_min_samples latency samples, a request still pending
+    # past that sample set's serve_hedge_quantile latency is hedged to
+    # the second-choice replica (first response wins, loser's reply is
+    # discarded), provided hedges stay under serve_hedge_budget of total
+    # requests. 0.0 disables hedging entirely (default: zero overhead).
+    serve_hedge_quantile: float = 0.0
+    serve_hedge_budget: float = 0.05
+    serve_hedge_min_samples: int = 16
+    # straggler-aware scheduling: the raylet refreshes per-node straggler
+    # scores (GCS lateness EMA relative to cluster mean) on its watchdog
+    # tick and deprioritizes nodes scoring >= this threshold in spread /
+    # hybrid placement whenever a non-straggler alternative is feasible.
+    # 0 disables score-based deprioritization (avoid_nodes still works).
+    straggler_deprioritize_threshold: float = 3.0
+    # drain-and-restart: when the watchdog flags a non-actor task wedged
+    # past straggler_drain_after_factor x its stall threshold, the raylet
+    # kills the worker so the owner's retry path resubmits elsewhere —
+    # rescuing gang collectives before CollectiveTimeoutError. Off by
+    # default: it trades a duplicate execution for tail latency.
+    straggler_drain_enabled: bool = False
+    straggler_drain_after_factor: float = 2.0
     # --- logging / metrics ---
     event_log_enabled: bool = True
     metrics_report_interval_ms: int = 2000

@@ -41,7 +41,8 @@ from . import locking
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_ref import ObjectRef, ObjectRefGenerator, _set_ref_registry
 from .object_store import MemoryStore, SharedObjectStore
-from .rpc import ConnectionLost, EventLoopThread, RpcClient, background
+from .rpc import (ConnectionLost, EventLoopThread, RpcClient, RpcError,
+                  background)
 from . import serialization as ser
 from .task_spec import (
     ArgKind,
@@ -79,6 +80,20 @@ class _ActorState:
 
 
 _STREAM_DONE = object()
+
+# tail-tolerance hedge counters, created lazily: metric construction
+# spins up the flusher thread, which only processes that actually hedge
+# should pay for
+_hedge_counters: Dict[str, Any] = {}
+
+
+def _hedge_counter(name: str):
+    c = _hedge_counters.get(name)
+    if c is None:
+        from ..util.metrics import Counter
+        c = _hedge_counters.setdefault(name, Counter(
+            name, "tail-tolerance hedged-execution counter"))
+    return c
 
 
 @dataclass
@@ -187,6 +202,12 @@ class CoreWorker:
         self._reconstructions: Dict[TaskID, int] = {}
         # cancellation: in-flight normal tasks (ref: core_worker.cc Cancel)
         self._inflight: Dict[TaskID, dict] = {}
+        # tail tolerance (The Tail at Scale): per-fn EMA of push->reply
+        # durations (the owner-side latency profile hedge delays derive
+        # from) + per-task events the raylet watchdog's hedge_hint RPC
+        # sets to trigger an immediate hedge of a flagged task
+        self._hedge_ema: Dict[str, float] = {}
+        self._hedge_hints: Dict[str, asyncio.Event] = {}  # task hex -> event
         # object-locality hints: oid -> (node_hex, bytes) for sealed
         # plasma objects this owner knows about (its puts + its tasks'
         # large returns). Feeds locality-aware leasing (ref:
@@ -298,8 +319,22 @@ class CoreWorker:
         self._owner_server = RpcServer(
             addr, name=f"owner-{self.worker_id.hex()[:8]}")
         self._owner_server.register("fetch_object", self._handle_fetch_object)
+        self._owner_server.register("hedge_hint", self.handle_hedge_hint)
         await self._owner_server.start()
         self.address = self._owner_server.address
+
+    async def handle_hedge_hint(self, payload, conn=None):
+        """Raylet watchdog push: the named task is flagged as stalled —
+        hedge it now instead of waiting out the owner-side delay. Workers
+        register this on their task server, drivers on the owner server
+        (the same split as fetch_object)."""
+        tid = payload.get("task_id")
+        if hasattr(tid, "hex"):
+            tid = tid.hex()
+        ev = self._hedge_hints.get(tid)
+        if ev is not None:
+            ev.set()
+        return True
 
     async def _handle_fetch_object(self, payload, conn):
         """Serve one owned object: {"status": ok|in_plasma|pending|gone}.
@@ -1224,6 +1259,10 @@ class CoreWorker:
         # validate options BEFORE packing args: _pack_args pins dependencies
         # that are only released through the submit coroutine's finally
         strategy = self._resolve_strategy(opts)
+        if opts.get("speculation", "") not in ("", "auto", "off"):
+            raise ValueError(
+                f"speculation must be 'auto' or 'off', got "
+                f"{opts.get('speculation')!r}")
         descriptor = self.export_function(func)
         packed, deps = self._pack_args(args, kwargs)
         num_returns = opts.get("num_returns", 1)
@@ -1247,6 +1286,8 @@ class CoreWorker:
             owner_address=self.address,
             runtime_env=self._prepare_runtime_env(
                 opts, allow_container=not streaming),
+            idempotent=bool(opts.get("idempotent", False)),
+            speculation=opts.get("speculation", "") or "",
         )
         from ..util.tracing import inject_trace_ctx
 
@@ -1272,8 +1313,11 @@ class CoreWorker:
 
     def _lane_eligible(self, spec: TaskSpec, deps: List[ObjectID]) -> bool:
         """Fast-lane tasks: default-shaped, dependency-free, one return.
-        Everything else takes the asyncio control plane."""
+        Everything else — including hedge-eligible tasks, whose backup
+        copy management lives on the asyncio control plane — takes the
+        normal submit path."""
         return (self._lane_pool is not None
+                and not self._hedge_eligible(spec)
                 and not deps
                 and spec.num_returns == 1
                 and spec.runtime_env is None
@@ -1360,12 +1404,148 @@ class CoreWorker:
                 pass  # store already destroyed (shutdown race)
 
     async def _run_on_leased_worker(self, spec: TaskSpec, info: Optional[dict] = None):
+        if self._hedge_eligible(spec):
+            return await self._run_hedged(spec, info)
+        return await self._run_attempt(spec, info)
+
+    # ------------------------------------------- hedged speculative execution
+    # (The Tail at Scale: issue a backup copy of a slow idempotent task on
+    #  a different node, first reply wins, loser is cancelled)
+    def _hedge_eligible(self, spec: TaskSpec) -> bool:
+        return (self.cfg.task_speculation_enabled
+                and spec.idempotent
+                and spec.speculation != "off"
+                and not spec.streaming
+                and spec.actor_id is None
+                and not spec.actor_creation)
+
+    def _hedge_delay(self, spec: TaskSpec) -> Optional[float]:
+        """Owner-side hedge trigger delay: the per-fn latency profile
+        (EMA of past push->reply durations) times the hedge factor. None
+        when no profile exists yet — then only a raylet watchdog
+        hedge_hint triggers the backup."""
+        ema = self._hedge_ema.get(spec.function.repr_name)
+        if ema is None:
+            return None
+        return max(self.cfg.task_hedge_min_delay_s,
+                   ema * self.cfg.task_hedge_ema_factor)
+
+    async def _run_hedged(self, spec: TaskSpec, info: Optional[dict]):
+        state = {"published": False, "publishes": 0}
+        hint = asyncio.Event()
+        self._hedge_hints[spec.task_id.hex()] = hint
+        hedge: Optional[asyncio.Future] = None
+        primary = asyncio.ensure_future(
+            self._run_attempt(spec, info, publish_state=state,
+                              role="primary"))
+        try:
+            hint_task = asyncio.ensure_future(hint.wait())
+            try:
+                await asyncio.wait({primary, hint_task},
+                                   timeout=self._hedge_delay(spec),
+                                   return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                # a bare Event.wait() holds no resource: safe to cancel
+                if not hint_task.done():
+                    hint_task.cancel()
+            if primary.done() or (info is not None and info["canceled"]):
+                return await primary
+            _hedge_counter("task_hedges_launched").inc()
+            hedge = asyncio.ensure_future(
+                self._run_attempt(spec, info, publish_state=state,
+                                  avoid_node=state.get("primary_node"),
+                                  role="hedge"))
+            # first reply to publish wins (an attempt that aborted because
+            # the other copy sealed returns None); an attempt dying with an
+            # infra error (ConnectionLost/WorkerCrashed) defers to the
+            # other copy, and only if BOTH fail does the error escape into
+            # _submit_normal's retry loop
+            pending = {primary, hedge}
+            winner: Optional[asyncio.Future] = None
+            first_exc: Optional[BaseException] = None
+            while pending and winner is None:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for fut in done:
+                    if fut.exception() is not None:
+                        if first_exc is None:
+                            first_exc = fut.exception()
+                    # fut came out of asyncio.wait's done set: result()
+                    # is an immediate read, not a blocking future wait
+                    elif fut.result() is not None:  # graftlint: ignore[blocking]
+                        winner = fut
+                        break
+            if winner is None:
+                if first_exc is not None:
+                    raise first_exc
+                raise exc.RayTpuError(
+                    f"hedged task {spec.function.repr_name}: no attempt "
+                    "published a result")
+            loser = hedge if winner is primary else primary
+            if winner is hedge:
+                _hedge_counter("task_hedges_won").inc()
+                self._report_primary_straggler(spec, state)
+            if not loser.done():
+                background(self._finalize_hedge_loser(
+                    spec, loser,
+                    state.get("hedge_addr" if winner is primary
+                              else "primary_addr")))
+            else:
+                loser.exception()  # retrieved: both replies are in
+            return winner.result()
+        finally:
+            self._hedge_hints.pop(spec.task_id.hex(), None)
+
+    async def _finalize_hedge_loser(self, spec: TaskSpec,
+                                    loser: asyncio.Future,
+                                    address: Optional[str]):
+        """Cancel the losing copy through the normal cancel_task path and
+        drain its attempt coroutine (which skips publication — the winner
+        already sealed — and releases its own lease)."""
+        if address:
+            try:
+                client = await self._client_for(address)
+                await client.call("cancel_task", {
+                    "task_id": spec.task_id, "force": False}, timeout=5)
+                _hedge_counter("task_hedges_cancelled").inc()
+            except (asyncio.TimeoutError, ConnectionLost, RpcError, OSError):
+                pass  # loser's worker already gone — nothing to cancel
+        try:
+            await loser
+        except (exc.RayTpuError, ConnectionLost, RpcError,
+                asyncio.TimeoutError, OSError):
+            pass  # loser infra errors are moot once the winner published
+
+    def _report_primary_straggler(self, spec: TaskSpec, state: dict) -> None:
+        """A won hedge is a measured straggle of the primary's node: feed
+        it into the GCS straggler stats so scheduling deprioritization
+        sees task-plane stragglers, not just collective skew."""
+        node = state.get("primary_node")
+        push_t = state.get("primary_push_t")
+        if not node or push_t is None:
+            return
+        ema = self._hedge_ema.get(spec.function.repr_name) or 0.0
+        late = max(0.0, time.monotonic() - push_t - ema)
+        background(self.gcs.call("report_straggler", {
+            "node_id": node, "late_s": late,
+            "source": "task_hedge"}, timeout=self.cfg.gcs_rpc_timeout_s or None))
+
+    async def _run_attempt(self, spec: TaskSpec, info: Optional[dict] = None,
+                           publish_state: Optional[dict] = None,
+                           avoid_node: Optional[str] = None,
+                           role: str = "primary"):
         sched_class = spec.scheduling_class()
         pool = self._lease_pools.setdefault(sched_class, _LeasePool())
         self._record_transition(spec.task_id, "PENDING_NODE_ASSIGNMENT")
-        grant = await self._acquire_lease(pool, spec)
+        grant = await self._acquire_lease(pool, spec, avoid_node=avoid_node)
         keep = False
         try:
+            if publish_state is not None and publish_state["published"]:
+                # the other copy won while this lease was in flight:
+                # never cancel mid-acquisition (rid-deduped grants would
+                # leak) — take the grant, skip the push, return it clean
+                keep = True
+                return None
             if info is not None:
                 if info["canceled"]:
                     keep = True  # lease unused; return it to the pool clean
@@ -1376,28 +1556,61 @@ class CoreWorker:
                 spec.chip_ids = grant["chip_ids"]
             gnode_id = grant.get("node_id")
             gworker = grant.get("worker_id")
+            if publish_state is not None:
+                publish_state[f"{role}_node"] = (
+                    gnode_id.hex() if gnode_id else "")
+                publish_state[f"{role}_addr"] = grant["worker_address"]
+                publish_state[f"{role}_push_t"] = time.monotonic()
             self._record_transition(
                 spec.task_id, "SUBMITTED_TO_WORKER",
                 node_id=gnode_id.hex() if gnode_id else "",
                 worker_id=gworker.hex() if gworker else "")
             client = await self._client_for(grant["worker_address"])
-            reply = await client.call("push_task", cloudpickle.dumps(spec))
+            t_push = time.monotonic()
+            # the reply arrives when the task finishes — unbounded by
+            # design (tasks may run for hours); the stall sentinel and
+            # hedging bound the wait instead of a wire timeout
+            reply = await client.call(  # graftlint: ignore[rpc-timeout]
+                "push_task", cloudpickle.dumps(spec))
+            if publish_state is not None:
+                if publish_state["published"]:
+                    keep = True  # loser replied after the winner: discard
+                    return None
+                publish_state["published"] = True
+                publish_state["publishes"] += 1
+                if publish_state["publishes"] > 1:  # defensive: must stay 0
+                    _hedge_counter("task_hedge_duplicate_publishes").inc()
             gnode = grant.get("node_id")
             errored = self._handle_task_reply(
                 spec, reply, node_id=gnode.hex() if gnode else "")
+            if self.cfg.task_speculation_enabled and not errored:
+                fn = spec.function.repr_name
+                dur = time.monotonic() - t_push
+                prev = self._hedge_ema.get(fn)
+                self._hedge_ema[fn] = (dur if prev is None
+                                       else 0.8 * prev + 0.2 * dur)
             keep = True
             return errored
         finally:
             await self._release_lease(pool, grant, spec, reusable=keep)
 
-    async def _acquire_lease(self, pool: _LeasePool, spec: TaskSpec) -> dict:
+    async def _acquire_lease(self, pool: _LeasePool, spec: TaskSpec,
+                             avoid_node: Optional[str] = None) -> dict:
         while True:
             if pool.idle:
-                return pool.idle.pop()
+                if avoid_node is None:
+                    return pool.idle.pop()
+                # hedge attempts must land off the primary's node: take the
+                # first idle grant elsewhere, else fall through to a fresh
+                # lease request carrying avoid_nodes
+                for i, g in enumerate(pool.idle):
+                    gnode = g.get("node_id")
+                    if (gnode.hex() if gnode else "") != avoid_node:
+                        return pool.idle.pop(i)
             if pool.in_flight < self.cfg.max_pending_lease_requests_per_scheduling_class:
                 pool.in_flight += 1
                 try:
-                    return await self._request_lease(spec)
+                    return await self._request_lease(spec, avoid_node=avoid_node)
                 finally:
                     pool.in_flight -= 1
                     # the freed request slot must wake a queued submission:
@@ -1411,7 +1624,8 @@ class CoreWorker:
             pool.waiters.append(fut)
             await fut
 
-    async def _request_lease(self, spec: TaskSpec) -> dict:
+    async def _request_lease(self, spec: TaskSpec,
+                             avoid_node: Optional[str] = None) -> dict:
         import uuid
 
         payload = {
@@ -1426,6 +1640,10 @@ class CoreWorker:
             # a lost reply cannot leak a second worker lease
             "request_id": uuid.uuid4().hex,
         }
+        if avoid_node:
+            # hedge placement: the serving raylet excludes these nodes
+            # when picking (spilling elsewhere if the local node is one)
+            payload["avoid_nodes"] = [avoid_node]
         info = self._inflight.get(spec.task_id)
         strategy = spec.scheduling_strategy
         pg_strategy = (isinstance(strategy, PlacementGroupSchedulingStrategy)

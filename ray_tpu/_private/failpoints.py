@@ -21,6 +21,12 @@ performs its configured action when hit:
     modelling stragglers and slow networks;
   * ``drop``   — return ``"drop"``; the call site skips the operation
     (an unsent frame, an unanswered request), modelling loss.
+  * ``slow``   — sleep ``arg`` seconds (default 0.25) then proceed:
+    site-scoped injected *latency* rather than a fault. Distinct from
+    ``delay`` so tail-tolerance benches/tests can arm a deterministic
+    straggler (e.g. ``worker.task.run@<node_hex>=slow:2``) without
+    tripping chaos legs that treat delay/raise/drop hits as injected
+    faults that must surface as errors.
 
 Spec grammar (comma-separated)::
 
@@ -65,6 +71,8 @@ SITES = (
     "raylet.heartbeat",      # raylet clock-sync ping round against the GCS
     "object.seal",           # SharedObjectStore.seal entry
     "spill.write",           # SharedObjectStore staged-spill flush to disk
+    "worker.task.run",       # TaskExecutor.execute_normal, detail=node hex
+    "serve.replica.handle",  # serve Replica.handle, detail=deployment name
 )
 
 _lock = threading.Lock()
@@ -93,7 +101,7 @@ def _parse(spec: str) -> Dict[str, dict]:
         key, _, rhs = entry.partition("=")
         parts = rhs.split(":")
         action = parts[0].strip()
-        if action not in ("raise", "delay", "drop"):
+        if action not in ("raise", "delay", "drop", "slow"):
             continue
         arg = float(parts[1]) if len(parts) > 1 and parts[1] else 0.0
         max_hits = int(float(parts[2])) if len(parts) > 2 and parts[2] else 0
@@ -166,6 +174,9 @@ def fire(name: str, detail: Optional[str] = None) -> Optional[str]:
     if rule["action"] == "delay":
         time.sleep(rule["arg"] or 0.05)
         return "delay"
+    if rule["action"] == "slow":
+        time.sleep(rule["arg"] or 0.25)
+        return "slow"
     return "drop"
 
 
@@ -183,6 +194,10 @@ async def afire(name: str, detail: Optional[str] = None) -> Optional[str]:
         import asyncio
         await asyncio.sleep(rule["arg"] or 0.05)
         return "delay"
+    if rule["action"] == "slow":
+        import asyncio
+        await asyncio.sleep(rule["arg"] or 0.25)
+        return "slow"
     return "drop"
 
 

@@ -468,22 +468,53 @@ class GcsServer:
         worst_rank = max(arrivals, key=lambda r: arrivals[r]["t"])
         for rank, a in arrivals.items():
             late = a["t"] - t0
-            st = self.straggler_stats.setdefault(a["host"], {
-                "host": a["host"], "steps": 0, "sum_lateness_s": 0.0,
-                "max_lateness_s": 0.0, "ema_lateness_s": 0.0,
-                "worst_count": 0, "hist": {}})
-            st["steps"] += 1
-            st["sum_lateness_s"] += late
-            st["max_lateness_s"] = max(st["max_lateness_s"], late)
-            st["ema_lateness_s"] = (late if st["steps"] == 1
-                                    else 0.8 * st["ema_lateness_s"]
-                                    + 0.2 * late)
-            bucket = self._skew_bucket(late)
-            st["hist"][bucket] = st["hist"].get(bucket, 0) + 1
+            st = self._straggler_entry(a["host"], a.get("node_id"))
+            self._fold_lateness(st, late)
             # only count "worst in step" when the skew is material —
             # someone is always last even in a perfectly healthy step
             if rank == worst_rank and span >= 0.005:
                 st["worst_count"] += 1
+
+    def _straggler_entry(self, host: str, node_id: Optional[str]) -> dict:
+        st = self.straggler_stats.setdefault(host, {
+            "host": host, "node_id": node_id or "", "steps": 0,
+            "sum_lateness_s": 0.0, "max_lateness_s": 0.0,
+            "ema_lateness_s": 0.0, "worst_count": 0, "hist": {}})
+        if node_id:
+            # scheduling deprioritization keys on node ids; collective
+            # arrivals and direct reports both refresh the mapping
+            st["node_id"] = node_id
+        return st
+
+    def _fold_lateness(self, st: dict, late: float) -> None:
+        st["steps"] += 1
+        st["sum_lateness_s"] += late
+        st["max_lateness_s"] = max(st["max_lateness_s"], late)
+        st["ema_lateness_s"] = (late if st["steps"] == 1
+                                else 0.8 * st["ema_lateness_s"]
+                                + 0.2 * late)
+        bucket = self._skew_bucket(late)
+        st["hist"][bucket] = st["hist"].get(bucket, 0) + 1
+
+    async def handle_report_straggler(self, payload, conn):
+        """Direct lateness sample outside the collective plane: a raylet
+        watchdog flagging a RUNNING task past threshold, or an owner
+        whose hedge beat the primary copy. Folds into the same per-host
+        aggregates that drive straggler_scores, so task-plane stragglers
+        deprioritize scheduling exactly like collective-skew ones."""
+        node_id = payload.get("node_id") or ""
+        # host key matches what collective arrivals use (node hex when no
+        # host name rides the payload) so both planes fold into one entry
+        host = payload.get("host") or node_id
+        if not host:
+            return False  # unattributable sample
+        st = self._straggler_entry(host, node_id)
+        self._fold_lateness(st, max(0.0, float(payload.get("late_s", 0.0))))
+        if payload.get("source"):
+            st.setdefault("sources", {})
+            st["sources"][payload["source"]] = \
+                st["sources"].get(payload["source"], 0) + 1
+        return True
 
     async def handle_straggler_scores(self, payload, conn):
         stats = list(self.straggler_stats.values())

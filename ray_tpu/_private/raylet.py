@@ -39,8 +39,8 @@ from . import failpoints
 from .config import global_config, session_log_dir
 from .ids import ActorID, NodeID, ObjectID, WorkerID
 from .object_store import SharedObjectStore
-from .rpc import (ConnectionLost, RpcClient, RpcServer, ServerConnection,
-                  background)
+from .rpc import (ConnectionLost, RpcClient, RpcError, RpcServer,
+                  ServerConnection, background)
 from .task_spec import (
     DefaultSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
@@ -281,6 +281,11 @@ class Raylet:
         self._class_ema: Dict[str, float] = {}
         self._stalled_tasks: Dict[str, dict] = {}
         self._stalled_transfers: Dict[str, dict] = {}
+        # tail tolerance: node hex -> straggler score (EMA lateness over
+        # cluster mean, from GCS straggler_scores), refreshed each
+        # watchdog tick; scheduling deprioritizes nodes past threshold
+        self._straggler_scores: Dict[str, float] = {}
+        self._drained_workers: Set[int] = set()  # pids killed for draining
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -526,9 +531,16 @@ class Raylet:
                 if rec["age_s"] < threshold:
                     continue
                 if rec["task_id"] in self._stalled_tasks:
-                    # already alerted; keep the record's age fresh
+                    # already alerted; keep the record's age fresh and
+                    # re-check mitigation — the drain trigger is an age
+                    # multiple the task may only now have reached (the
+                    # hint/report half ran once at flag time: one stall
+                    # event must fold exactly one straggler sample)
                     self._stalled_tasks[rec["task_id"]]["age_s"] = \
                         rec["age_s"]
+                    await self._mitigate_stalled_task(worker, rec,
+                                                      threshold,
+                                                      first=False)
                     continue
                 await self._flag_stalled_task(worker, rec, threshold)
         # a flagged task that is no longer RUNNING resolved itself
@@ -537,6 +549,23 @@ class Raylet:
                 self._stalled_tasks.pop(tid, None)
         if self.cfg.transfer_stall_timeout_s > 0:
             await self._check_transfer_stalls()
+        await self._refresh_straggler_scores()
+
+    async def _refresh_straggler_scores(self):
+        """Pull the cluster straggler scores so _pick_node can
+        deprioritize persistently-late nodes without a per-lease RPC."""
+        if self.cfg.straggler_deprioritize_threshold <= 0:
+            return
+        try:
+            rows = await self.gcs.call("straggler_scores", {}, timeout=5)
+        except (asyncio.TimeoutError, ConnectionLost, RpcError, OSError):
+            return  # stale scores beat a dead watchdog
+        scores: Dict[str, float] = {}
+        for row in rows or []:
+            nid = row.get("node_id")
+            if nid:
+                scores[nid] = float(row.get("score", 0.0))
+        self._straggler_scores = scores
 
     async def _flag_stalled_task(self, worker: WorkerHandle, rec: dict,
                                  threshold: float):
@@ -581,6 +610,61 @@ class Raylet:
             })
         except Exception:
             pass
+        await self._mitigate_stalled_task(worker, rec, threshold)
+
+    async def _mitigate_stalled_task(self, worker: WorkerHandle, rec: dict,
+                                     threshold: float, first: bool = True):
+        """Tail-tolerance reactions to a flagged stall: nudge the task's
+        owner to hedge NOW (it only acts if the task opted into
+        speculation), feed the lateness into the GCS straggler stats —
+        both once, at flag time — and, re-checked every tick, drain a
+        wedged non-actor worker so its owner's retry lands on a healthy
+        one before a gang times out."""
+        if first:
+            lease = worker.lease
+            owner = lease.owner_address if lease is not None else ""
+            if owner:
+                background(self._send_hedge_hint(owner, rec["task_id"]))
+            background(self.gcs.call("report_straggler", {
+                "node_id": self.node_id.hex(),
+                "late_s": max(0.0, rec["age_s"] - threshold),
+                "source": "task_watchdog",
+            }, timeout=5))
+        if (self.cfg.straggler_drain_enabled
+                and worker.actor_id is None
+                and worker.pid not in self._drained_workers
+                and rec["age_s"] >= threshold
+                * max(1.0, self.cfg.straggler_drain_after_factor)):
+            self._drained_workers.add(worker.pid)
+            try:
+                os.kill(worker.pid, 9)
+            except (ProcessLookupError, PermissionError):
+                return
+            worker.alive = False
+            try:
+                await self.gcs.call("report_event", {
+                    "source": "stall_sentinel",
+                    "severity": "WARNING",
+                    "message": (
+                        f"drained wedged worker pid {worker.pid} on node "
+                        f"{self.node_id.hex()[:12]} (task "
+                        f"{rec['task_id'][:12]} RUNNING {rec['age_s']:.1f}s"
+                        f"); owner retry will resubmit elsewhere"),
+                    "fields": {"kind": "worker_drained",
+                               "task_id": rec["task_id"],
+                               "node_id": self.node_id.hex(),
+                               "pid": worker.pid},
+                }, timeout=5)
+            except (asyncio.TimeoutError, ConnectionLost, RpcError, OSError):
+                pass  # the drain itself already happened; event is best-effort
+
+    async def _send_hedge_hint(self, owner: str, task_id_hex: str):
+        try:
+            client = await self._peer_client(owner)
+            await client.call("hedge_hint", {"task_id": task_id_hex},
+                              timeout=5)
+        except (asyncio.TimeoutError, ConnectionLost, RpcError, OSError):
+            pass  # owner gone or pre-hedging: the hint is best-effort
 
     async def _check_transfer_stalls(self):
         stalls = self.store.stalled_pulls(self.cfg.transfer_stall_timeout_s)
@@ -1153,7 +1237,8 @@ class Raylet:
         resources = ResourceSet(payload.get("resources", {}))
         strategy = payload.get("strategy")
         target = (None if payload.get("no_spill")
-                  else self._pick_node(resources, strategy))
+                  else self._pick_node(resources, strategy,
+                                       avoid=payload.get("avoid_nodes")))
         if target is not None and target != self.node_id:
             addr, _ = self._remote_nodes[target]
             return {"granted": False, "retry_at": (target, addr)}
@@ -1371,7 +1456,8 @@ class Raylet:
                             continue
                         target = self._pick_node(
                             pending.resources,
-                            pending.payload.get("strategy"))
+                            pending.payload.get("strategy"),
+                            avoid=pending.payload.get("avoid_nodes"))
                         if (target is not None and target != self.node_id
                                 and target in self._remote_nodes):
                             addr, _ = self._remote_nodes[target]
@@ -1394,12 +1480,31 @@ class Raylet:
             self._pumping = False
 
     # ------------------------------------------------------ scheduling policy
-    def _pick_node(self, resources: ResourceSet, strategy) -> Optional[NodeID]:
+    def _pick_node(self, resources: ResourceSet, strategy,
+                   avoid: Optional[List[str]] = None) -> Optional[NodeID]:
         """Returns the node the lease should run on; None means "queue here".
 
         Hybrid default (ref: hybrid_scheduling_policy.h:50): prefer local while
         local utilization < threshold; otherwise least-utilized feasible node.
+
+        Tail tolerance: nodes in ``avoid`` (a hedge steering off its
+        primary's node) and nodes whose straggler score crossed
+        ``straggler_deprioritize_threshold`` are soft-excluded — skipped
+        while any clean feasible node exists, used as a last resort
+        rather than failing the lease.
         """
+        bad = set(avoid or ())
+        thresh = self.cfg.straggler_deprioritize_threshold
+        if thresh > 0:
+            for nhex, score in self._straggler_scores.items():
+                if score >= thresh:
+                    bad.add(nhex)
+
+        def _prefer(feasible):
+            good = [(nid, a) for nid, a in feasible
+                    if nid.hex() not in bad]
+            return good or feasible
+
         if isinstance(strategy, NodeAffinitySchedulingStrategy) and strategy.node_id:
             target = NodeID.from_hex(strategy.node_id)
             if target == self.node_id or target in self._remote_nodes:
@@ -1427,7 +1532,7 @@ class Raylet:
                 return None  # queue: a matching node may join/free up
             soft_ok = [(nid, a) for nid, a in feasible
                        if label_expr_matches(_labels(nid), strategy.soft)]
-            pool = soft_ok or feasible
+            pool = _prefer(soft_ok or feasible)
             for nid, _ in pool:
                 if nid == self.node_id:
                     return nid  # local preferred within the match set
@@ -1439,24 +1544,38 @@ class Raylet:
             feasible = [(nid, a) for nid, a in candidates if resources.fits(a)]
             if not feasible:
                 return None
+            feasible = _prefer(feasible)
             self._spill_rr += 1
             return feasible[self._spill_rr % len(feasible)][0]
         # default / hybrid
-        if local_fits and self.resources.utilization() < self.cfg.scheduler_spread_threshold:
+        local_bad = self.node_id.hex() in bad
+        if (local_fits and not local_bad
+                and self.resources.utilization()
+                < self.cfg.scheduler_spread_threshold):
             return self.node_id
         best, best_util = None, None
+        best_bad = None  # least-utilized feasible node among the avoided
         for nid, (_, avail) in self._remote_nodes.items():
             if resources.fits(avail):
                 util = 1.0 - min(
                     (avail.get(k, 0.0) / v) for k, v in resources.res.items() if v > 0
                 ) if resources.res else 0.0
+                if nid.hex() in bad:
+                    if best_bad is None:
+                        best_bad = nid
+                    continue
                 if best_util is None or util < best_util:
                     best, best_util = nid, util
-        if local_fits and (best is None or self.resources.utilization() <= (best_util or 1.0)):
+        if (local_fits and not local_bad
+                and (best is None
+                     or self.resources.utilization() <= (best_util or 1.0))):
             return self.node_id
         if best is not None:
             return best
-        return self.node_id if local_fits else None
+        # only avoided/straggler options remain: degrade rather than fail
+        if local_bad and best_bad is not None:
+            return best_bad
+        return self.node_id if local_fits else best_bad
 
     # ------------------------------------------------- placement group bundles
     def _release_lease_resources(self, lease: Lease) -> None:

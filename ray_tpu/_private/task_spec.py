@@ -244,6 +244,14 @@ class TaskSpec:
     # span context (trace_id, parent_span_id) when tracing is enabled
     # (ref: tracing_helper.py — span context rides the task options)
     trace_ctx: Optional[tuple] = None
+    # tail tolerance (The Tail at Scale): a task declared idempotent may
+    # be speculatively re-executed — both executions can run (and seal)
+    # concurrently, so the body must be deterministic and side-effect
+    # free beyond its return objects. speculation: "" = default (hedge
+    # iff idempotent and task_speculation_enabled), "auto" = same,
+    # "off" = never hedge this task even when idempotent.
+    idempotent: bool = False
+    speculation: str = ""
 
     def is_actor_task(self) -> bool:
         return self.actor_id is not None and not self.actor_creation

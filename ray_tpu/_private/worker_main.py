@@ -18,6 +18,7 @@ in the reply as the owner's fast path.
 from __future__ import annotations
 
 import asyncio
+import collections
 import os
 import queue
 import sys
@@ -30,6 +31,7 @@ from typing import Any, List, Optional, Tuple
 import cloudpickle
 
 from .config import global_config
+from . import failpoints
 from . import locking
 from .core_worker import CoreWorker
 from .ids import JobID, NodeID, ObjectID, WorkerID
@@ -185,6 +187,13 @@ class TaskExecutor:
         # the task registers (still loading its function) are parked
         self._running: dict = {}
         self._cancel_requested: set = set()
+        # tasks that already finished here, kept briefly so a late
+        # cancel() — e.g. a hedge loser whose reply raced the winner's
+        # cancel RPC — is a silent no-op instead of parking forever in
+        # _cancel_requested (bounded: deque evicts, set membership-tests)
+        self._recently_done: "collections.deque" = collections.deque(
+            maxlen=1024)
+        self._recently_done_set: set = set()
         # streaming: task_id -> producer budget
         self._gen_budgets: dict = {}
         # stall sentinel: task_id -> (thread ident, fn name, started at);
@@ -206,6 +215,10 @@ class TaskExecutor:
 
     def _unregister_running(self, task_id) -> None:
         self._running.pop(task_id, None)
+        if len(self._recently_done) == self._recently_done.maxlen:
+            self._recently_done_set.discard(self._recently_done[0])
+        self._recently_done.append(task_id)
+        self._recently_done_set.add(task_id)
         entry = self._running_since.pop(task_id, None)
         if entry is not None:
             with self._durations_lock:
@@ -401,6 +414,10 @@ class TaskExecutor:
             self._register_running(spec.task_id, spec.function.repr_name)
             self.core._record_transition(spec.task_id, "RUNNING")
             try:
+                # inside the RUNNING window so injected straggle shows up
+                # in stall_probe age and trips the raylet watchdog
+                failpoints.fire("worker.task.run",
+                                detail=os.environ.get("RAY_TPU_NODE_ID"))
                 with _maybe_span(spec):
                     if spec.runtime_env and spec.runtime_env.get(
                             "container"):
@@ -429,6 +446,10 @@ class TaskExecutor:
             return True
         thread = self._running.get(task_id)
         if thread is None or not thread.is_alive():
+            if task_id in self._recently_done_set:
+                # already sealed (hedge loser, or cancel racing normal
+                # completion): nothing to interrupt, nothing to park
+                return True
             self._cancel_requested.add(task_id)
             return False
         import ctypes
@@ -868,6 +889,9 @@ async def _amain():
     server.register("fastlane_attach", handle_fastlane_attach)
     # owner-serve: this worker's owned small objects (nested submissions)
     server.register("fetch_object", core._handle_fetch_object)
+    # nested submissions from this worker can hedge too — the raylet
+    # watchdog's hint must reach whatever process owns the task
+    server.register("hedge_hint", core.handle_hedge_hint)
     executor.seal_batcher = SealBatcher(core, raylet)
     await server.start()
     try:

@@ -117,6 +117,66 @@ def round_lease_send_drop() -> None:
         failpoints.hit_counts()
 
 
+def round_tail_hedge() -> None:
+    """slow first copy of an idempotent task: the speculative hedge
+    (not the sentinel, not a retry) erases the straggle — the task
+    completes well under the injected latency, exactly one output
+    seals, and the hedge counters land on the Prometheus scrape."""
+    import tempfile
+
+    from ray_tpu._private.config import global_config
+    from ray_tpu._private.prometheus import render_cluster
+    from ray_tpu.util.metrics import snapshot_local
+
+    cfg = global_config()
+    saved = {"task_speculation_enabled": cfg.task_speculation_enabled,
+             "task_hedge_min_delay_s": cfg.task_hedge_min_delay_s,
+             "task_hedge_ema_factor": cfg.task_hedge_ema_factor}
+    cfg.apply_overrides({"task_speculation_enabled": True,
+                         "task_hedge_min_delay_s": 0.2,
+                         "task_hedge_ema_factor": 2.0})
+    marker = tempfile.mktemp(prefix="chaos_tail_")
+    try:
+        @ray_tpu.remote(idempotent=True, num_cpus=0.5)
+        def once_slow(marker, x):
+            try:
+                fd = os.open(marker, os.O_CREAT | os.O_EXCL)
+                os.close(fd)
+                time.sleep(3.0)  # the straggling first copy
+            except FileExistsError:
+                pass
+            return x * 2
+
+        # marker pre-claimed: fast runs warm the per-fn latency EMA so
+        # the owner-side hedge delay is armed (not just watchdog hints)
+        open(marker, "w").close()
+        assert ray_tpu.get([once_slow.remote(marker, i)
+                            for i in range(4)], timeout=60) == [0, 2, 4, 6]
+        os.unlink(marker)
+
+        before = snapshot_local("task_hedge")
+        t0 = time.monotonic()
+        assert ray_tpu.get(once_slow.remote(marker, 21), timeout=60) == 42
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, (
+            f"hedge never beat the 3s straggler ({elapsed:.1f}s)")
+        after = snapshot_local("task_hedge")
+
+        def delta(name):
+            return after.get(name, 0) - before.get(name, 0)
+
+        assert delta("task_hedges_launched") >= 1, after
+        assert delta("task_hedges_won") >= 1, after
+        assert delta("task_hedge_duplicate_publishes") == 0, after
+        # counters reach the cluster scrape (2s flusher period)
+        _wait(lambda: "task_hedges_launched" in render_cluster(),
+              15, "hedge counters on the Prometheus scrape")
+    finally:
+        cfg.apply_overrides(saved)
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
 ROUNDS = [
     ("lease-grant-raise", round_lease_raise),
     ("object-seal-raise", round_seal_raise),
@@ -124,6 +184,7 @@ ROUNDS = [
     ("rpc-dispatch-delay", round_dispatch_delay),
     ("heartbeat-delay", round_heartbeat_delay),
     ("lease-send-drop", round_lease_send_drop),
+    ("tail-hedge", round_tail_hedge),
 ]
 
 

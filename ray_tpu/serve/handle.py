@@ -9,10 +9,24 @@ refreshed from the controller when its version moves or a replica dies."""
 
 from __future__ import annotations
 
+import collections
 import random
 import threading
 import time
 from typing import Any, Dict, Optional
+
+# serve hedge counters, created lazily (metric construction starts the
+# flusher thread — only processes that actually hedge should pay for it)
+_hedge_counters: Dict[str, Any] = {}
+
+
+def _hedge_counter(name: str):
+    c = _hedge_counters.get(name)
+    if c is None:
+        from ..util.metrics import Counter
+        c = _hedge_counters.setdefault(name, Counter(
+            name, "serve hedged-request counter"))
+    return c
 
 # Config-push state (ref: serve/_private/long_poll.py:66 LongPollClient):
 # the controller publishes its version on the "serve" GCS pubsub channel;
@@ -60,6 +74,12 @@ class DeploymentHandle:
         self._version = -1
         self._ongoing: Dict[Any, int] = {}
         self._last_refresh = 0.0
+        # tail tolerance (The Tail at Scale, hedged requests): per-handle
+        # latency samples feed the hedge trigger quantile; launched/total
+        # counts enforce the hedge budget as a hard cap
+        self._latencies: "collections.deque" = collections.deque(maxlen=256)
+        self._requests_total = 0
+        self._hedges_launched = 0
 
     def __reduce__(self):
         return (DeploymentHandle, (self._name, self._method))
@@ -133,8 +153,146 @@ class DeploymentHandle:
         return a if na <= nb else b
 
     def remote(self, *args, **kwargs):
-        """Route one request; returns the ObjectRef of the replica call."""
-        return self.route(*args, **kwargs)[0]
+        """Route one request; returns the ObjectRef of the replica call.
+
+        Hedging (only here, never in :meth:`route` — streams must stay
+        pinned to one replica): with ``serve_hedge_quantile`` armed and
+        the latency profile warm, a request still unanswered past that
+        quantile of recent latencies gets a backup copy on a
+        second-choice replica; the first reply wins and the loser's is
+        dropped. ``serve_hedge_budget`` hard-caps the hedge rate."""
+        delay = self._hedge_delay()
+        if delay is None:
+            return self.route(*args, **kwargs)[0]
+        return self._hedged_remote(args, kwargs)
+
+    def _hedge_delay(self) -> Optional[float]:
+        from .._private.config import global_config
+
+        cfg = global_config()
+        q = cfg.serve_hedge_quantile
+        if q <= 0:
+            return None
+        with self._lock:
+            if len(self._replicas) < 2:
+                return None
+            if len(self._latencies) < cfg.serve_hedge_min_samples:
+                return None
+            if (self._hedges_launched + 1
+                    > cfg.serve_hedge_budget * max(1, self._requests_total)):
+                return None
+            samples = sorted(self._latencies)
+        return samples[min(len(samples) - 1, int(q * (len(samples) - 1)))]
+
+    def _dispatch(self, replica, args, kwargs,
+                  request_id: Optional[str] = None):
+        """One attempt: ongoing bookkeeping + latency sample on reply."""
+        with self._lock:
+            self._requests_total += 1
+            self._ongoing[replica._actor_id] = \
+                self._ongoing.get(replica._actor_id, 0) + 1
+        t0 = time.monotonic()
+        ref = replica.handle.remote(self._method, args, kwargs, request_id)
+
+        def _done(_):
+            with self._lock:
+                self._latencies.append(time.monotonic() - t0)
+                count = self._ongoing.get(replica._actor_id, 0)
+                if count > 0:
+                    self._ongoing[replica._actor_id] = count - 1
+
+        ref.future().add_done_callback(_done)
+        return ref
+
+    def _pick_other(self, primary):
+        """Second-choice replica for a hedge: lowest in-flight among the
+        others (pow-2 when there are enough to sample)."""
+        with self._lock:
+            others = [r for r in self._replicas
+                      if r._actor_id != primary._actor_id]
+            if not others:
+                return None
+            if len(others) > 2:
+                others = random.sample(others, 2)
+            return min(others,
+                       key=lambda r: self._ongoing.get(r._actor_id, 0))
+
+    def _hedged_remote(self, args, kwargs):
+        from .._private import serialization as ser
+        from .._private.ids import ObjectID, TaskID
+        from .._private.object_ref import ObjectRef
+        from .._worker_api import _core as core
+
+        delay = self._hedge_delay()
+        if core is None or delay is None:
+            return self.route(*args, **kwargs)[0]
+        self._refresh()
+        primary = self._pick()
+        # promise ref: a fresh return oid this process owns; the winner's
+        # reply is re-serialized into it exactly once. The registered
+        # event makes get()/wait() treat it as pending-here meanwhile.
+        tid = TaskID.for_normal_task(core.job_id)
+        oid = ObjectID.for_return(tid, 1)
+        event = threading.Event()
+        core._lane_events[oid] = event
+        state = {"published": False, "timer": None, "refs": []}
+
+        def publish(fut, role: str):
+            with self._lock:
+                if state["published"]:
+                    # loser's reply: drop it. Actor tasks are not
+                    # interruptible mid-await, so "cancel the loser" is
+                    # reply suppression (counted for observability).
+                    _hedge_counter("serve_hedges_cancelled").inc()
+                    return
+                state["published"] = True
+            timer = state["timer"]
+            if timer is not None:
+                timer.cancel()
+            try:
+                data = ser.serialize(fut.result())
+            except BaseException as e:  # noqa: BLE001 — errors ride the promise
+                data = ser.serialize_error(e)
+            core.memory_store.put(oid, data)
+            event.set()
+            core._lane_events.pop(oid, None)
+            if role == "hedge":
+                _hedge_counter("serve_hedges_won").inc()
+
+        primary_ref = self._dispatch(primary, args, kwargs)
+        state["refs"].append(primary_ref)
+        primary_ref.future().add_done_callback(
+            lambda f: publish(f, "primary"))
+
+        def fire_hedge():
+            from .._private.config import global_config
+
+            cfg = global_config()
+            with self._lock:
+                if state["published"]:
+                    return
+                # re-check under the lock at fire time: the budget is a
+                # hard cap even when many requests armed timers at once
+                if (self._hedges_launched + 1 > cfg.serve_hedge_budget
+                        * max(1, self._requests_total)):
+                    return
+                self._hedges_launched += 1
+            backup = self._pick_other(primary)
+            if backup is None:
+                with self._lock:
+                    self._hedges_launched -= 1
+                return
+            _hedge_counter("serve_hedges_launched").inc()
+            hedge_ref = self._dispatch(backup, args, kwargs)
+            state["refs"].append(hedge_ref)
+            hedge_ref.future().add_done_callback(
+                lambda f: publish(f, "hedge"))
+
+        timer = threading.Timer(delay, fire_hedge)
+        timer.daemon = True
+        state["timer"] = timer
+        timer.start()
+        return ObjectRef(oid, core.address)
 
     def route(self, *args, request_id: Optional[str] = None, **kwargs):
         """Route one request, returning (ref, replica handle). The replica
@@ -144,18 +302,7 @@ class DeploymentHandle:
         it is NOT forwarded to the user callable's kwargs."""
         self._refresh()
         replica = self._pick()
-        with self._lock:
-            self._ongoing[replica._actor_id] = \
-                self._ongoing.get(replica._actor_id, 0) + 1
-        ref = replica.handle.remote(self._method, args, kwargs, request_id)
-
-        def _done(_):
-            with self._lock:
-                count = self._ongoing.get(replica._actor_id, 0)
-                if count > 0:
-                    self._ongoing[replica._actor_id] = count - 1
-
-        ref.future().add_done_callback(_done)
+        ref = self._dispatch(replica, args, kwargs, request_id)
         return ref, replica
 
     def __repr__(self):

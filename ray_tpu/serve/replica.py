@@ -18,6 +18,8 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+from .._private import failpoints
+
 _STREAM_END = "__serve_stream_end__"
 
 # Request-id propagation (ref: serve's RequestContext): the proxy mints
@@ -75,6 +77,11 @@ class Replica:
             token = _request_id.set(request_id)
             start = time.time()
             try:
+                # tail-tolerance harness: an armed "slow" rule models a
+                # straggling replica (asyncio.sleep — other requests on
+                # this replica still interleave, as real stragglers allow)
+                await failpoints.afire("serve.replica.handle",
+                                       detail=self.deployment_name)
                 # resolve the bound method — iscoroutinefunction(instance)
                 # is False even when the instance's __call__ is async
                 target = getattr(self.user, method_name)

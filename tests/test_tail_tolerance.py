@@ -1,0 +1,316 @@
+"""Tail-tolerant execution: hedged speculative tasks, hedged serve
+requests, straggler-aware scheduling, drain-and-restart.
+
+A deterministic straggler (the ``worker.task.run`` failpoint's ``slow``
+action, scoped to one node) must not set the completion time: an
+idempotent task gets a speculative copy on another node and the first
+reply wins with exactly one sealed output; a slow serve replica gets a
+hedged backup request within the hedge budget; straggler-scored nodes
+are deprioritized in lease placement; and a wedged worker is drained so
+the owner's retry lands somewhere healthy."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import state
+from ray_tpu.util.metrics import snapshot_local
+
+
+def _poll(fn, timeout=20, period=0.25):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(period)
+    return last
+
+
+def _gcs_call(method, payload):
+    core = state._core()
+    return core.io.run(core.gcs.call(method, payload))
+
+
+def _counter(name) -> float:
+    return snapshot_local(name).get(name, 0.0)
+
+
+# ------------------------------------------------- hedged speculative tasks
+
+@pytest.fixture
+def hedge_cluster(monkeypatch):
+    """Two nodes; every worker on the HEAD node straggles (slow
+    failpoint), so a hedge steered off the primary's node lands on the
+    healthy second node. Env is set before the cluster so lazily-spawned
+    workers inherit the armed failpoint."""
+    from ray_tpu._private.config import global_config
+
+    # overrides BEFORE node construction: the in-process raylets/GCS read
+    # the driver's config singleton. prestart_workers=False so no worker
+    # exists until the failpoint env (inherited at spawn) is armed below.
+    global_config().apply_overrides({
+        "prestart_workers": False,
+        "task_speculation_enabled": True,
+        "task_hedge_min_delay_s": 0.3,
+        "task_hedge_ema_factor": 2.0,
+        "task_watchdog_interval_s": 0.3,
+        "task_stall_threshold_s": 1.0,
+    })
+    cluster = Cluster(head_node_args={"num_cpus": 2}, connect=True)
+    head_hex = cluster.head_node.node_id.hex()
+    # workers spawn lazily on first lease: armed before any task runs
+    monkeypatch.setenv("RAY_TPU_FAILPOINTS",
+                       f"worker.task.run@{head_hex}=slow:10")
+    node2 = cluster.add_node(num_cpus=2)
+    yield cluster, node2, head_hex
+    cluster.shutdown()  # driver shutdown resets the config overrides
+
+
+def test_hedge_beats_straggler_and_seals_once(hedge_cluster):
+    """An idempotent task whose primary straggles is speculatively
+    re-executed on the other node; the first reply wins, the loser is
+    cancelled, and exactly one output version publishes."""
+    cluster, node2, head_hex = hedge_cluster
+
+    @ray_tpu.remote(idempotent=True)
+    def where():
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    launched0 = _counter("task_hedges_launched")
+    won0 = _counter("task_hedges_won")
+    t0 = time.monotonic()
+    # no latency profile yet: the raylet watchdog's hedge_hint (flagged
+    # at the 1 s floor) is what triggers the backup copy
+    out = ray_tpu.get(where.remote(), timeout=30)
+    first_elapsed = time.monotonic() - t0
+    assert out == node2.node_id.hex(), "winner should be the healthy node"
+    assert first_elapsed < 8.0, (
+        f"hedge never rescued the stuck primary ({first_elapsed:.1f}s)")
+    assert _counter("task_hedges_launched") > launched0
+    assert _counter("task_hedges_won") > won0
+    # exactly-once publication: the duplicate-seal counter never moves
+    assert _counter("task_hedge_duplicate_publishes") == 0
+
+    # the win warmed the per-fn EMA: the next hedge fires on the
+    # owner-side delay (0.3 s), well before the watchdog would flag
+    t0 = time.monotonic()
+    out = ray_tpu.get(where.remote(), timeout=30)
+    assert out == node2.node_id.hex()
+    assert time.monotonic() - t0 < 8.0
+    assert _counter("task_hedge_duplicate_publishes") == 0
+    # the loser's cancel lands eventually (best-effort RPC)
+    _poll(lambda: _counter("task_hedges_cancelled") > 0, timeout=10)
+
+
+def test_non_idempotent_and_opted_out_never_hedge(hedge_cluster):
+    """Tasks without idempotent=True — and idempotent ones with
+    speculation="off" — never get a speculative copy, no matter how
+    long they straggle."""
+    cluster, node2, head_hex = hedge_cluster
+
+    @ray_tpu.remote
+    def plain():
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    @ray_tpu.remote(idempotent=True, speculation="off")
+    def opted_out():
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    launched0 = _counter("task_hedges_launched")
+    refs = [plain.remote(), opted_out.remote()]
+    outs = ray_tpu.get(refs, timeout=60)
+    # both ran to completion wherever they landed — slowly if on the
+    # straggler node — with zero hedges launched
+    assert all(o in (cluster.head_node.node_id.hex(), node2.node_id.hex())
+               for o in outs)
+    assert _counter("task_hedges_launched") == launched0
+
+    # option validation happens at submit time
+    with pytest.raises(ValueError, match="speculation"):
+        @ray_tpu.remote(idempotent=True, speculation="always")
+        def bad():
+            return 1
+        bad.remote()
+
+
+# --------------------------------------------------- sealed-loser cancel
+
+def test_cancel_after_completion_is_silent_noop():
+    """cancel() arriving after a task already sealed (the hedge loser
+    whose reply raced the winner's cancel RPC) is a silent no-op: it
+    must NOT park the task id in _cancel_requested, where it would leak
+    and spuriously kill an unrelated future registration."""
+    from ray_tpu._private.worker_main import TaskExecutor
+    from ray_tpu._private.ids import JobID, TaskID
+
+    ex = TaskExecutor(core=None, raylet=None)
+    tid = TaskID.for_normal_task(JobID.from_int(7))
+    ex._register_running(tid, "loser_fn")
+    ex._unregister_running(tid)
+    assert ex.cancel(tid, force=False) is True   # acknowledged no-op
+    assert tid not in ex._cancel_requested       # nothing parked
+    # an unknown (pre-start) task still parks — that path is load-bearing
+    other = TaskID.for_normal_task(JobID.from_int(7))
+    assert ex.cancel(other, force=False) is False
+    assert other in ex._cancel_requested
+    # the done-set is bounded: old entries evict, membership set follows
+    for _ in range(ex._recently_done.maxlen + 10):
+        t = TaskID.for_normal_task(JobID.from_int(7))
+        ex._register_running(t, "fill")
+        ex._unregister_running(t)
+    assert len(ex._recently_done_set) <= ex._recently_done.maxlen
+    assert tid not in ex._recently_done_set
+
+
+# ---------------------------------------------------- hedged serve requests
+
+SLOW_MARKER = "/tmp/ray_tpu_test_slow_replica_{}"
+
+
+def test_serve_hedge_budget_and_loser_dropped():
+    """With one straggling replica, requests unanswered past the latency
+    quantile get a backup on the other replica; the first reply wins,
+    losers' replies are dropped (counted), and the hedge rate stays
+    under the budget cap."""
+    marker = SLOW_MARKER.format(os.getpid())
+    if os.path.exists(marker):
+        os.unlink(marker)
+    ray_tpu.init(num_cpus=4, _system_config={
+        "serve_hedge_quantile": 0.5,
+        "serve_hedge_budget": 0.5,
+        "serve_hedge_min_samples": 8,
+    })
+    try:
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def __init__(self, marker):
+                # exactly one replica claims the straggler role
+                self.slow = False
+                try:
+                    fd = os.open(marker, os.O_CREAT | os.O_EXCL)
+                    os.close(fd)
+                    self.slow = True
+                except FileExistsError:
+                    pass
+
+            def __call__(self, x):
+                if self.slow:
+                    time.sleep(1.5)
+                return x * 2
+
+        handle = serve.run(Echo.bind(marker))
+        # warm the latency profile with KNOWN-fast samples so the hedge
+        # delay is deterministic and short
+        handle._latencies.extend([0.05] * 16)
+
+        launched0 = _counter("serve_hedges_launched")
+        won0 = _counter("serve_hedges_won")
+        refs = [handle.remote(i) for i in range(12)]
+        outs = ray_tpu.get(refs, timeout=60)
+        assert outs == [i * 2 for i in range(12)]
+
+        launched = _counter("serve_hedges_launched") - launched0
+        assert launched >= 1, "no hedge fired despite a 1.5s straggler"
+        # hard budget: hedges ≤ budget × dispatched requests (+1 for the
+        # in-flight check granularity)
+        assert launched <= 0.5 * handle._requests_total + 1
+        assert _counter("serve_hedges_won") > won0
+        # every hedged request eventually produces a losing reply, which
+        # is dropped and counted as the "cancel" of an actor-side copy
+        assert _poll(lambda: _counter("serve_hedges_cancelled") >= 1,
+                     timeout=15)
+        assert _counter("serve_hedges_launched") - launched0 >= \
+            _counter("serve_hedges_won") - won0
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+        from ray_tpu import serve as _serve
+        _serve.shutdown()
+        ray_tpu.shutdown()
+
+
+# -------------------------------------------- straggler-aware scheduling
+
+def test_straggler_node_deprioritized_in_leases():
+    """A node whose straggler score crossed the threshold stops
+    receiving SPREAD leases while a clean feasible node exists."""
+    from ray_tpu._private.config import global_config
+    from ray_tpu.util.scheduling_strategies import SpreadSchedulingStrategy
+
+    global_config().apply_overrides({
+        "straggler_deprioritize_threshold": 1.5,
+        "task_watchdog_interval_s": 0.3,
+    })
+    cluster = Cluster(head_node_args={"num_cpus": 4}, connect=True)
+    try:
+        node2 = cluster.add_node(num_cpus=4)
+        head_hex = cluster.head_node.node_id.hex()
+        # feed the GCS direct lateness samples: node2 persistently late,
+        # head essentially on time → node2's score ≈ 2 × mean
+        for _ in range(5):
+            _gcs_call("report_straggler", {
+                "node_id": node2.node_id.hex(), "late_s": 2.0,
+                "source": "test"})
+            _gcs_call("report_straggler", {
+                "node_id": head_hex, "late_s": 0.001, "source": "test"})
+        scores = {s.get("node_id"): s["score"]
+                  for s in _gcs_call("straggler_scores", {})}
+        assert scores[node2.node_id.hex()] >= 1.5
+
+        # wait for the head raylet's watchdog tick to pull the scores
+        raylet = cluster.head_node.raylet
+        assert _poll(lambda: raylet._straggler_scores.get(
+            node2.node_id.hex(), 0.0) >= 1.5, timeout=10), \
+            "raylet never refreshed straggler scores"
+
+        @ray_tpu.remote(scheduling_strategy=SpreadSchedulingStrategy())
+        def where():
+            return os.environ["RAY_TPU_NODE_ID"]
+
+        outs = ray_tpu.get([where.remote() for _ in range(8)], timeout=60)
+        assert all(o == head_hex for o in outs), (
+            f"leases landed on the straggler node: {outs}")
+    finally:
+        cluster.shutdown()  # driver shutdown resets the config overrides
+
+
+# ------------------------------------------------------ drain-and-restart
+
+def test_drain_and_restart_rescues_wedged_task(tmp_path):
+    """With draining enabled, a worker wedged far past the stall
+    threshold is killed by the watchdog; the owner's retry resubmits
+    and completes. The drain is announced as a cluster event."""
+    ray_tpu.init(num_cpus=2, _system_config={
+        "task_watchdog_interval_s": 0.3,
+        "task_stall_threshold_s": 1.0,
+        "straggler_drain_enabled": True,
+        "straggler_drain_after_factor": 1.5,
+    })
+    marker = str(tmp_path / "first_attempt")
+    try:
+        @ray_tpu.remote(max_retries=2)
+        def wedge_once(marker):
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                time.sleep(120)  # wedged: only a drain ends this attempt
+            return "rescued"
+
+        t0 = time.monotonic()
+        assert ray_tpu.get(wedge_once.remote(marker), timeout=60) \
+            == "rescued"
+        assert time.monotonic() - t0 < 45
+        events = [e for e in state.list_cluster_events(
+            source="stall_sentinel")
+            if e.get("kind") == "worker_drained"]
+        assert events, "no worker_drained event for the killed worker"
+        assert events[-1]["severity"] == "WARNING"
+        assert "drained" in events[-1]["message"]
+    finally:
+        ray_tpu.shutdown()
