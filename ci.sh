@@ -63,7 +63,7 @@ PY
     exit 0
 fi
 
-echo "== [1/8] graftlint: concurrency + error-plane static analysis =="
+echo "== [1/9] graftlint: concurrency + error-plane static analysis =="
 # gating: findings not in the checked-in baseline fail the round — fix
 # the hazard, suppress inline (# graftlint: ignore[pass]) with a
 # justification, or deliberately accept it via
@@ -71,7 +71,7 @@ echo "== [1/8] graftlint: concurrency + error-plane static analysis =="
 JAX_PLATFORMS=cpu timeout "${CI_LINT_TIMEOUT_S:-120}" \
     python -m ray_tpu.devtools.graftlint --baseline graftlint_baseline.json
 
-echo "== [2/8] native build =="
+echo "== [2/9] native build =="
 rm -rf ray_tpu/_native/build
 python - <<'PY'
 from ray_tpu._native import get_lib, native_unavailable_reason
@@ -79,7 +79,7 @@ assert get_lib() is not None, native_unavailable_reason()
 print("native lib built + loaded")
 PY
 
-echo "== [3/8] data-plane smoke: transfer + spilling + shuffle =="
+echo "== [3/9] data-plane smoke: transfer + spilling + shuffle =="
 # the bulk data plane (cut-through relay watermark, parallel spill I/O,
 # push-based shuffle exchange) gets its own early, explicit lane: a
 # broken transfer/spill/shuffle path fails the round in minutes instead
@@ -90,7 +90,7 @@ timeout "${CI_SMOKE_TIMEOUT_S:-600}" \
     python -m pytest tests/test_object_transfer.py tests/test_spilling.py \
         tests/test_data_shuffle.py -q
 
-echo "== [4/8] observability smoke: lifecycle + timeline + serve metrics + stall sentinel =="
+echo "== [4/9] observability smoke: lifecycle + timeline + serve metrics + stall sentinel =="
 # the flight recorder (task state transitions, Perfetto export, serving
 # histograms) gets a live end-to-end check: a silent telemetry
 # regression would otherwise only show up as weaker dashboards, not a
@@ -101,7 +101,16 @@ JAX_PLATFORMS=cpu \
 timeout "${CI_OBS_TIMEOUT_S:-300}" \
     python -m ray_tpu.scripts.obs_smoke
 
-echo "== [5/8] chaos smoke: failpoint fault injection (non-gating) =="
+echo "== [5/9] serve smoke: disaggregated prefill/decode + fleet KV routing =="
+# the fleet KV plane gets its own live lane: 1 prefill + 1 decode
+# replica on the tiny model, shared-prefix traffic — tokens must match
+# a local monolithic engine exactly, KV pages must move through the
+# object store, and prefix summaries must gossip to the controller
+JAX_PLATFORMS=cpu \
+timeout "${CI_SERVE_TIMEOUT_S:-600}" \
+    python -m ray_tpu.scripts.serve_smoke
+
+echo "== [6/9] chaos smoke: failpoint fault injection (non-gating) =="
 # randomized failpoint rounds (ray_tpu/scripts/chaos_smoke.py): every
 # injected fault — raised, delayed, or dropped at the RPC/lease/seal/
 # spill/heartbeat seams — must surface as an attributed error with the
@@ -115,7 +124,7 @@ if ! JAX_PLATFORMS=cpu \
         "printed CHAOS_SEED and triage before merging"
 fi
 
-echo "== [6/8] TSAN stress over the native plane (non-gating) =="
+echo "== [7/9] TSAN stress over the native plane (non-gating) =="
 # the --tsan lane, folded into every round as advisory signal: races it
 # finds are real, but sanitizer availability varies across builders, so
 # this leg never fails the round — it prints loudly and moves on.
@@ -128,14 +137,14 @@ else
     echo "toolchain lacks a working -fsanitize=thread; skipping"
 fi
 
-echo "== [7/8] test suite =="
+echo "== [8/9] test suite =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 JAX_PLATFORMS=cpu \
 RAY_TPU_TEST_TIMEOUT_S="${RAY_TPU_TEST_TIMEOUT_S:-180}" \
 timeout "${CI_SUITE_TIMEOUT_S:-3000}" \
     python -m pytest tests/ -q
 
-echo "== [8/8] multichip dry-run =="
+echo "== [9/9] multichip dry-run =="
 timeout "${CI_DRYRUN_TIMEOUT_S:-1200}" \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 

@@ -198,6 +198,24 @@ class Config:
     serve_hedge_quantile: float = 0.0
     serve_hedge_budget: float = 0.05
     serve_hedge_min_samples: int = 16
+    # --- fleet KV plane (serve/kv_router.py): prefix-cache-aware
+    #     routing + disaggregated prefill/decode serving ---
+    # route requests to the replica holding the longest cached prompt
+    # prefix (replicas publish truncated prefix-page digests through the
+    # controller's reconcile tick); off = pure pow-2 load routing
+    serve_prefix_routing_enabled: bool = True
+    # how often the controller re-polls replica prefix summaries AND how
+    # often handles re-pull the aggregated table; a summary older than
+    # 3x this is stale and the handle falls back to load routing
+    serve_prefix_summary_interval_s: float = 2.0
+    # spill threshold: a prefix-match winner with more than this many
+    # of the handle's own in-flight requests loses to pow-2 (cache
+    # affinity must not defeat load balancing under a hot prefix)
+    serve_prefix_spill_queue_depth: int = 8
+    # prefill->decode KV handoff: exported page payloads are split into
+    # object-store puts of at most this many bytes so one long prompt's
+    # KV doesn't serialize as a single giant object
+    serve_kv_handoff_chunk_bytes: int = 8 * 1024**2
     # straggler-aware scheduling: the raylet refreshes per-node straggler
     # scores (GCS lateness EMA relative to cluster mean) on its watchdog
     # tick and deprioritizes nodes scoring >= this threshold in spread /

@@ -73,6 +73,7 @@ SITES = (
     "spill.write",           # SharedObjectStore staged-spill flush to disk
     "worker.task.run",       # TaskExecutor.execute_normal, detail=node hex
     "serve.replica.handle",  # serve Replica.handle, detail=deployment name
+    "serve.kv_handoff",      # prefill->decode KV ship, detail=deployment
 )
 
 _lock = threading.Lock()

@@ -7,6 +7,7 @@ the same tables from the JSON API, no build step, no assets).
     GET /                  — HTML UI (auto-refreshing tables)
     GET /api/nodes /api/actors /api/tasks /api/objects /api/jobs
         /api/cluster_status /api/metrics /api/health /api/stacks
+        /api/serve
     GET /metrics           — Prometheus text scrape endpoint
                              (ref: _private/prometheus_exporter.py)
 """
@@ -51,6 +52,7 @@ _UI_HTML = """<!doctype html>
  <section><h2>Health</h2><div id="health"></div></section>
  <section><h2>Nodes</h2><div id="nodes"></div></section>
  <section><h2>Actors</h2><div id="actors"></div></section>
+ <section><h2>Serve</h2><div id="serve"></div></section>
  <section><h2>Jobs</h2><div id="jobs"></div></section>
  <section><h2>Task summary</h2><div id="tasks"></div></section>
  <section><h2>Events</h2><div id="events"></div></section>
@@ -138,6 +140,22 @@ async function refreshHealth(){try{
    ['host','score','ema_lateness_s','worst']);
  document.getElementById('health').innerHTML=html;
 }catch(e){}}
+async function refreshServe(){try{
+ const s=await j('/api/serve');
+ const deps=s.deployments||[];
+ let html=deps.length?table(deps.map(d=>({
+  name:d.name,replicas:d.num_replicas+'/'+d.target_replicas,
+  pools:d.pools?Object.entries(d.pools).map(([p,n])=>p+'='+n).join(' '):'',
+  prefix_summaries:d.prefix_summaries||0})),
+  ['name','replicas','pools','prefix_summaries'])
+  :'<i>no deployments</i>';
+ const rt=s.routing||[];
+ if(rt.length)html+='<div style="margin-top:8px">fleet KV routing</div>'
+  +table(rt.map(e=>({metric:e.name,value:e.value,
+   tags:Object.entries(e.tags||{}).map(([k,v])=>k+'='+v).join(' ')})),
+   ['metric','value','tags']);
+ document.getElementById('serve').innerHTML=html;
+}catch(e){}}
 async function refreshTimeline(){try{
  const s=await j('/api/summary');
  const ph=s.phases||{};
@@ -182,9 +200,10 @@ async function tailLog(){
  const r=await fetch('/api/logs/tail?node_id='+encodeURIComponent(n)
   +'&file='+encodeURIComponent(f)+'&lines=200');
  document.getElementById('logview').textContent=await r.text();}
-refresh();refreshTimeline();refreshLogs();refreshHealth();
+refresh();refreshTimeline();refreshLogs();refreshHealth();refreshServe();
 setInterval(refresh,5000);setInterval(refreshTimeline,10000);
 setInterval(refreshLogs,15000);setInterval(refreshHealth,5000);
+setInterval(refreshServe,5000);
 </script></body></html>
 """
 
@@ -254,6 +273,31 @@ def _routes():
                 source="stall_sentinel", limit=50),
         })
 
+    async def api_serve(_req):
+        """Serve deployments (pools, prefix-summary coverage) + fleet-KV
+        routing counters. Read-only: never creates the controller."""
+        from . import get, get_actor
+        from .serve.controller import CONTROLLER_NAME
+
+        deployments = []
+        try:
+            controller = get_actor(CONTROLLER_NAME)
+            deployments = get(controller.list_deployments.remote(),
+                              timeout=15)
+        except ValueError:
+            pass  # no serve controller running: empty panel
+        rows = []
+        try:
+            for name in ("serve_prefix_route_hits",
+                         "serve_prefix_route_misses",
+                         "serve_kv_handoff_bytes_total",
+                         "serve_kv_handoff_retries_total",
+                         "serve_hedges_launched", "serve_hedges_won"):
+                rows.extend(state_api.get_metrics(name))
+        except Exception:  # noqa: BLE001 — metrics plane is optional
+            rows = []
+        return _json({"deployments": deployments, "routing": rows})
+
     async def api_stacks(req):
         node = req.query.get("node_id") or None
         return _json(state_api.dump_stacks(node_id=node))
@@ -293,6 +337,7 @@ def _routes():
     app.router.add_get("/api/timeline", api_timeline)
     app.router.add_get("/api/summary", api_summary)
     app.router.add_get("/api/health", api_health)
+    app.router.add_get("/api/serve", api_serve)
     app.router.add_get("/api/stacks", api_stacks)
     app.router.add_get("/api/logs", api_logs)
     app.router.add_get("/api/logs/tail", api_log_tail)

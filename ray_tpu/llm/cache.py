@@ -13,8 +13,6 @@ the jitted kernels never branch on validity; it is never handed out.
 
 from __future__ import annotations
 
-import hashlib
-import struct
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
@@ -131,18 +129,14 @@ class PrefixCache:
         hash collision silently serves a DIFFERENT prompt's KV — the
         same class of cross-request leak as vLLM's prefix-cache hash
         fix. Tokens pack as fixed-width int64 so no two token sequences
-        share an encoding."""
-        keys: List[Any] = []
-        parent = b""
-        for start in range(0, (len(prompt) // page_size) * page_size,
-                           page_size):
-            chunk = prompt[start:start + page_size]
-            h = hashlib.sha256(parent)
-            h.update(struct.pack(f"<{len(chunk)}q",
-                                 *(int(t) for t in chunk)))
-            parent = h.digest()
-            keys.append(parent)
-        return keys
+        share an encoding.
+
+        The chain itself lives in serve/kv_router.py (stdlib-only, so
+        handles/proxies can derive it without importing jax); this
+        delegates so engines and routers can never drift apart."""
+        from ..serve.kv_router import chained_page_keys
+
+        return chained_page_keys(prompt, page_size)
 
     def __len__(self) -> int:
         return len(self._pages)

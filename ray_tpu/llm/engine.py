@@ -633,6 +633,130 @@ class LLMEngine:
             if stale is not None and stale.finished:
                 del self.requests[old]
 
+    # --- fleet KV plane: prefill->decode handoff ---
+
+    def export_kv_request(self, request_id: str) -> Dict[str, Any]:
+        """Export a prefilled request's KV pages for decode on ANOTHER
+        engine (disaggregated prefill/decode serving — DistServe/
+        Splitwise lineage; llm/serve.py pools). Valid once the request
+        has prefilled (ctx_len > 0), typically right after its first
+        sampled token. Copies the sequence's pages to host memory,
+        finishes the request locally (reason "handoff" — its slot and
+        pages free immediately for the next prompt) and returns a
+        payload :meth:`inject_request` accepts on the decode engine."""
+        state = self.requests.get(request_id)
+        if state is None:
+            raise ValueError(f"unknown request {request_id!r}")
+        if state.finished or state.slot < 0 or state.ctx_len <= 0:
+            raise ValueError(
+                f"request {request_id!r} is not exportable "
+                f"(finished={state.finished}, ctx_len={state.ctx_len})")
+        n_kv = self.allocator.pages_needed(state.ctx_len)
+        pages = self.seq_table.pages_of(state.slot)[:n_kv]
+        idx = jnp.asarray(pages, jnp.int32)
+        payload = {
+            "prompt": list(state.prompt),
+            "output": list(state.output),
+            "ctx_len": state.ctx_len,
+            "page_size": self.ecfg.page_size,
+            "model_id": state.model_id,
+            "k": np.asarray(self.cache.k[:, idx]),
+            "v": np.asarray(self.cache.v[:, idx]),
+        }
+        self._finish(state, "handoff")
+        return payload
+
+    def inject_request(self, payload: Dict[str, Any],
+                       params: Optional[SamplingParams] = None,
+                       request_id: Optional[str] = None) -> str:
+        """Admit a request whose prompt pass ran on ANOTHER engine (the
+        decode half of disaggregated serving). The shipped pages land in
+        free cache pages and the request joins decode directly — no
+        prefill compute here. When they CAN'T land (no free slot,
+        page-size mismatch, pool pressure, malformed/missing arrays)
+        the request joins the waiting queue and recomputes its prefill
+        locally (recompute-preemption semantics): slower, never wrong."""
+        prompt = [int(t) for t in payload["prompt"]]
+        output = [int(t) for t in payload.get("output") or ()]
+        ctx_len = int(payload["ctx_len"])
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) >= self.ecfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_seq_len "
+                f"{self.ecfg.max_seq_len}")
+        model_id = payload.get("model_id")
+        if model_id is not None:
+            if self.lora_pool is None:
+                raise ValueError("model_id requires EngineConfig."
+                                 "lora_rank > 0")
+            self.lora_pool.slot_of(model_id)
+        rid = request_id or f"req-{next(self._id)}"
+        if rid in self.requests:
+            rid = f"req-{next(self._id)}"
+        state = RequestState(rid, prompt, params or SamplingParams(),
+                             output=output,
+                             arrival_t=time.perf_counter(),
+                             model_id=model_id)
+        self.requests[rid] = state
+        if output and len(output) >= state.params.max_tokens:
+            # already at its token budget: nothing left to decode
+            self._finish(state, "length")
+            return rid
+        k, v = payload.get("k"), payload.get("v")
+        usable = (
+            k is not None and v is not None and output
+            and int(payload.get("page_size", -1)) == self.ecfg.page_size
+            and len(prompt) <= ctx_len < self.ecfg.max_seq_len
+            and tuple(k.shape) == (self.cfg.n_layers, k.shape[1],
+                                   self.ecfg.page_size,
+                                   self.cfg.n_kv_heads,
+                                   self.cfg.head_dim)
+            and tuple(v.shape) == tuple(k.shape)
+            and k.shape[1] >= self.allocator.pages_needed(ctx_len))
+        if not usable or not self._inject_pages(state, k, v, ctx_len):
+            self.waiting.append(state)  # recompute fallback
+        return rid
+
+    def _inject_pages(self, state: RequestState, k, v,
+                      ctx_len: int) -> bool:
+        slot = self._free_slot()
+        if slot < 0:
+            return False
+        n_kv = self.allocator.pages_needed(ctx_len)
+        # headroom for the next decoded token too (mirrors _admit's +1)
+        need = self.allocator.pages_needed(ctx_len + 1)
+        if not self.allocator.can_allocate(need) and self.prefix_cache:
+            self.prefix_cache.evict_for(ctx_len + 1)
+        if not self.allocator.can_allocate(need):
+            return False
+        pages = self.allocator.allocate(need)
+        idx = jnp.asarray(pages[:n_kv], jnp.int32)
+        self.cache = KVCache(
+            self.cache.k.at[:, idx].set(
+                jnp.asarray(k[:, :n_kv], self.cache.k.dtype)),
+            self.cache.v.at[:, idx].set(
+                jnp.asarray(v[:, :n_kv], self.cache.v.dtype)))
+        state.slot = slot
+        state.ctx_len = ctx_len
+        state.prefill_pos = ctx_len
+        if not state.first_token_t:
+            state.first_token_t = time.perf_counter()
+        self.slots[slot] = state
+        self.seq_table.assign(slot, pages)
+        if self.prefix_cache is not None:
+            # shipped pages double as prefix-cache warmth: register the
+            # prompt's full pages so future shared-prefix requests on
+            # THIS engine skip their prefill too (same insert the
+            # chunked prefill path does after filling them itself)
+            keys = PrefixCache.page_keys(state.prompt,
+                                         self.ecfg.page_size)
+            n_reg = min(len(keys), n_kv)
+            if n_reg > 0:
+                self.prefix_cache.insert(keys[:n_reg], pages[:n_reg])
+                state.prompt_page_keys = keys
+        return True
+
     # --- LoRA management (vLLM add_lora/remove_lora analog) ---
 
     def add_lora(self, name: str, adapter=None, *, seed: int = 0) -> None:

@@ -90,44 +90,129 @@ class LLMServer:
             self.tokenizer = AutoTokenizer.from_pretrained(tokenizer)
         self._queues: Dict[str, asyncio.Queue] = {}
         self._pump_task: Optional[asyncio.Task] = None
+        # fleet KV plane (disaggregated serving): pool role, set by the
+        # replica's configure_pool hook before any request lands.
+        # "mono" = classic all-in-one replica; "prefill" runs prompt
+        # passes only and ships KV to the decode pool; "decode" accepts
+        # injected KV and runs decode only.
+        self._pool = "mono"
+        self._dep_name: Optional[str] = None
+        self._decode_handle = None
+        self._m_handoff_bytes = None
+        self._m_handoff_lat = None
+        self._m_handoff_retries = None
+        self._last_summary = None
+        # serializes engine mutation between the pump's executor thread
+        # and loop-side KV export/inject
+        import threading
+
+        self._engine_lock = threading.Lock()
         # serving metrics (ref: vLLM's engine stat logger — TTFT/TPOT
         # histograms, scheduler-state and cache-hit gauges), exported
-        # through the util.metrics -> GCS -> /metrics pipeline
+        # through the util.metrics -> GCS -> /metrics pipeline. The
+        # "pool" tag splits TTFT/TPOT by replica role so disaggregated
+        # deployments meter prefill and decode separately.
         from ..util import metrics
 
-        tags = {"model": self.model_name}
+        tags = {"model": self.model_name, "pool": self._pool}
         self._m_ttft = metrics.Histogram(
             "llm_ttft_seconds", "Time to first token per request",
             boundaries=metrics.LATENCY_BUCKETS,
-            tag_keys=("model",)).set_default_tags(tags)
+            tag_keys=("model", "pool")).set_default_tags(tags)
         self._m_tpot = metrics.Histogram(
             "llm_tpot_seconds", "Time per output token (decode) "
             "per request", boundaries=metrics.LATENCY_BUCKETS,
-            tag_keys=("model",)).set_default_tags(tags)
+            tag_keys=("model", "pool")).set_default_tags(tags)
         self._m_e2e = metrics.Histogram(
             "llm_request_e2e_seconds", "Arrival-to-finish request latency",
             boundaries=metrics.LATENCY_BUCKETS,
-            tag_keys=("model",)).set_default_tags(tags)
+            tag_keys=("model", "pool")).set_default_tags(tags)
         self._m_queue = metrics.Gauge(
             "llm_queue_depth", "Requests waiting for a decode slot",
-            tag_keys=("model",)).set_default_tags(tags)
+            tag_keys=("model", "pool")).set_default_tags(tags)
         self._m_occupancy = metrics.Gauge(
             "llm_batch_slot_occupancy",
             "Fraction of decode slots running (continuous batching)",
-            tag_keys=("model",)).set_default_tags(tags)
+            tag_keys=("model", "pool")).set_default_tags(tags)
         self._m_kv_util = metrics.Gauge(
             "llm_kv_page_utilization", "Fraction of KV-cache pages in use",
-            tag_keys=("model",)).set_default_tags(tags)
+            tag_keys=("model", "pool")).set_default_tags(tags)
         self._m_cache_hit = metrics.Counter(
             "llm_prefix_cache_hit_tokens_total",
             "Prompt tokens served from the prefix cache",
-            tag_keys=("model",)).set_default_tags(tags)
+            tag_keys=("model", "pool")).set_default_tags(tags)
         self._m_prompt = metrics.Counter(
             "llm_prompt_tokens_total", "Prompt tokens received",
-            tag_keys=("model",)).set_default_tags(tags)
+            tag_keys=("model", "pool")).set_default_tags(tags)
         self._m_generated = metrics.Counter(
             "llm_generation_tokens_total", "Tokens generated",
-            tag_keys=("model",)).set_default_tags(tags)
+            tag_keys=("model", "pool")).set_default_tags(tags)
+
+    # --- serve replica hooks (fleet KV plane) ---
+
+    def configure_pool(self, pool: Optional[str],
+                       deployment_name: str) -> None:
+        """Replica hook: learn this replica's role in a disaggregated
+        deployment. Prefill replicas skip decode in their pump and ship
+        finished prompt KV to the decode pool; metrics re-tag so
+        TTFT/TPOT split by pool."""
+        self._pool = pool or "mono"
+        self._dep_name = deployment_name
+        tags = {"model": self.model_name, "pool": self._pool}
+        for m in (self._m_ttft, self._m_tpot, self._m_e2e, self._m_queue,
+                  self._m_occupancy, self._m_kv_util, self._m_cache_hit,
+                  self._m_prompt, self._m_generated):
+            m.set_default_tags(tags)
+        if pool == "prefill":
+            from ..serve.handle import DeploymentHandle
+            from ..util import metrics
+
+            self._decode_handle = DeploymentHandle(
+                deployment_name, "decode_from_kv", pool="decode")
+            mtags = {"model": self.model_name}
+            self._m_handoff_bytes = metrics.Counter(
+                "serve_kv_handoff_bytes_total",
+                "KV page bytes shipped prefill->decode",
+                tag_keys=("model",)).set_default_tags(mtags)
+            self._m_handoff_lat = metrics.Histogram(
+                "serve_kv_handoff_seconds",
+                "Prefill->decode KV handoff latency (export+ship+reply)",
+                boundaries=metrics.LATENCY_BUCKETS,
+                tag_keys=("model",)).set_default_tags(mtags)
+            self._m_handoff_retries = metrics.Counter(
+                "serve_kv_handoff_retries_total",
+                "KV handoffs retried against another decode replica",
+                tag_keys=("model",)).set_default_tags(mtags)
+
+    def prefix_cache_summary(self):
+        """Replica hook: publish this engine's cached prefix pages for
+        the fleet KV router (serve/kv_router.py). None when prefix
+        caching is off — the controller then stops polling this
+        deployment version entirely.
+
+        Never blocks on the engine lock: a step can hold it for seconds
+        (jit compile), and waiting here would stall the replica's whole
+        event loop and time out the controller's gossip probe. When the
+        engine is mid-step, the previous snapshot goes out instead —
+        routing hints tolerate a tick of staleness by design."""
+        cache = self.engine.prefix_cache
+        if cache is None:
+            return None
+        from ..serve import kv_router
+
+        if self._engine_lock.acquire(blocking=False):
+            try:
+                keys = list(cache._pages.keys())
+            finally:
+                self._engine_lock.release()
+            self._last_summary = kv_router.make_summary(
+                keys, self.engine.ecfg.page_size)
+        if self._last_summary is None:
+            # first poll raced a step: publish an empty summary, NOT
+            # None — None means "no hook" and stops gossip for good
+            return kv_router.make_summary(
+                (), self.engine.ecfg.page_size)
+        return self._last_summary
 
     # --- engine pump: one thread-hop per step, fan-out to request queues ---
 
@@ -136,12 +221,19 @@ class LLMServer:
             self._pump_task = asyncio.get_event_loop().create_task(
                 self._pump())
 
+    def _step_engine(self):
+        # prefill replicas never decode: exported requests finish with
+        # the handoff, so decode slots would only ever idle-spin
+        with self._engine_lock:
+            return self.engine.step(
+                skip_decode=(self._pool == "prefill"))
+
     async def _pump(self) -> None:
         import time
 
         loop = asyncio.get_event_loop()
         while self.engine.has_unfinished():
-            outs = await loop.run_in_executor(None, self.engine.step)
+            outs = await loop.run_in_executor(None, self._step_engine)
             for out in outs:
                 q = self._queues.get(out.request_id)
                 if q is not None:
@@ -185,7 +277,7 @@ class LLMServer:
 
     async def _submit(self, prompt_ids: List[int],
                       params: SamplingParams,
-                      model_id: Optional[str] = None) -> asyncio.Queue:
+                      model_id: Optional[str] = None):
         from ..serve.replica import current_request_id
 
         rid_in = current_request_id()
@@ -198,7 +290,7 @@ class LLMServer:
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         self._ensure_pump()
-        return q
+        return rid, q
 
     def _parse(self, payload: Dict[str, Any]):
         if "prompt_ids" in payload:
@@ -252,7 +344,10 @@ class LLMServer:
         router). ``stream=True`` returns an async generator serve turns
         into chunked HTTP (SSE-style ``data:`` lines)."""
         prompt_ids, params, model_id = self._parse(payload)
-        queue = await self._submit(prompt_ids, params, model_id)
+        if self._pool == "prefill" and self._decode_handle is not None:
+            return await self._prefill_handoff(payload, prompt_ids,
+                                               params, model_id)
+        _rid, queue = await self._submit(prompt_ids, params, model_id)
         if payload.get("stream"):
             return self._stream_from(queue)
         tokens: List[int] = []
@@ -281,6 +376,238 @@ class LLMServer:
             if out.finished:
                 return
 
+    # --- disaggregated prefill/decode (fleet KV plane) ---
+
+    async def _prefill_handoff(self, payload: Dict[str, Any],
+                               prompt_ids: List[int],
+                               params: SamplingParams,
+                               model_id: Optional[str]):
+        """Prefill-pool request path: run the prompt pass here, export
+        the sequence's KV pages, ship them to a decode replica
+        (chunked object-store puts) and proxy its reply back. A failed
+        handoff retries against another decode replica; after the
+        retry budget it raises an attributed error — never a hang."""
+        import time
+
+        loop = asyncio.get_event_loop()
+        rid, q = await self._submit(prompt_ids, params, model_id)
+        first = await q.get()
+        self._queues.pop(rid, None)
+        if first.finished:
+            # done at its first token (stop token / max_tokens=1):
+            # nothing to hand off; the pump already observed the state
+            tokens = [first.token]
+            if payload.get("stream"):
+                chunk = {"token": first.token, "finished": True,
+                         "finish_reason": first.finish_reason}
+
+                async def _one():
+                    yield f"data: {json.dumps(chunk)}\n\n"
+
+                return _one()
+            body = {"object": "text_completion",
+                    "choices": [{"token_ids": tokens,
+                                 "finish_reason": first.finish_reason}]}
+            text = self._detok(tokens)
+            if text is not None:
+                body["choices"][0]["text"] = text
+            return body
+
+        t0 = time.perf_counter()
+
+        def _export():
+            with self._engine_lock:
+                return self.engine.export_kv_request(rid)
+
+        handoff = await loop.run_in_executor(None, _export)
+        # export finished the request outside step(), so the pump never
+        # emits its terminal output: observe + drop the state here
+        state = self.engine.requests.pop(rid, None)
+        if state is not None:
+            self._observe_finished(state, time.perf_counter())
+        k = handoff.pop("k")
+        v = handoff.pop("v")
+        nbytes = int(k.nbytes) + int(v.nbytes)
+
+        from .. import put
+        from .._private import failpoints
+        from .._private.config import global_config
+
+        # ship pages in serve_kv_handoff_chunk_bytes slices so one huge
+        # context doesn't materialize as a single giant object
+        chunk_bytes = max(1, int(global_config().serve_kv_handoff_chunk_bytes))
+        n_pages = int(k.shape[1])
+        per_page = max(1, (nbytes // max(1, n_pages)))
+        pages_per_chunk = max(1, chunk_bytes // per_page)
+
+        def _ship():
+            refs = []
+            for s in range(0, n_pages, pages_per_chunk):
+                e = min(n_pages, s + pages_per_chunk)
+                refs.append(put((k[:, s:e], v[:, s:e])))
+            return refs
+
+        refs = await loop.run_in_executor(None, _ship)
+        decode_payload = {
+            "handoff": handoff,
+            "kv_refs": refs,
+            "sampling": {"temperature": params.temperature,
+                         "top_k": params.top_k, "top_p": params.top_p,
+                         "max_tokens": params.max_tokens,
+                         "stop_token_ids": list(params.stop_token_ids)},
+            "stream": bool(payload.get("stream")),
+        }
+        last_err: Optional[BaseException] = None
+        result = replica = None
+        for _attempt in range(3):
+            try:
+                await failpoints.afire("serve.kv_handoff",
+                                       detail=self._dep_name or "")
+                ref, replica = await loop.run_in_executor(
+                    None, lambda: self._decode_handle.route(
+                        decode_payload, request_id=rid))
+                result = await ref
+                break
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — retried, then attributed
+                last_err = e
+                if self._m_handoff_retries is not None:
+                    self._m_handoff_retries.inc()
+                # a dead decode replica is the expected failure: force a
+                # replica-set refresh so the retry lands elsewhere
+                await loop.run_in_executor(
+                    None, lambda: self._decode_handle._refresh(force=True))
+        else:
+            raise RuntimeError(
+                f"KV handoff for request {rid} failed after 3 attempts "
+                f"against the decode pool of deployment "
+                f"{self._dep_name!r}; last error: {last_err!r}")
+        if self._m_handoff_bytes is not None:
+            self._m_handoff_bytes.inc(nbytes)
+            self._m_handoff_lat.observe(time.perf_counter() - t0)
+        if isinstance(result, dict) and "__stream__" in result:
+            return self._proxy_stream(replica, result["__stream__"])
+        return result
+
+    async def _proxy_stream(self, replica, stream_id: int):
+        """Relay a decode replica's response stream chunk by chunk
+        (same pull protocol the HTTP proxy uses)."""
+        from ..serve.replica import _STREAM_END
+
+        finished = False
+        try:
+            while True:
+                chunk = await replica.next_chunk.remote(stream_id)
+                if isinstance(chunk, str) and chunk == _STREAM_END:
+                    finished = True
+                    return
+                yield chunk
+        finally:
+            if not finished:
+                try:
+                    await replica.cancel_stream.remote(stream_id)
+                except Exception:  # graftlint: ignore[swallow] — the
+                    # decode replica may already be dead; releasing its
+                    # generator is best-effort and the client's stream
+                    # already ended either way
+                    pass
+
+    async def decode_from_kv(self, payload: Dict[str, Any]):
+        """Decode-pool entry: pull the shipped KV chunks, inject them
+        into this engine (no prompt pass) and generate the remaining
+        tokens. Unusable payloads fall back to recomputing the prefill
+        locally inside the engine — slower, never wrong."""
+        import time
+
+        import numpy as np
+
+        from .. import get
+        from ..serve.replica import current_request_id
+
+        loop = asyncio.get_event_loop()
+        meta = dict(payload["handoff"])
+        refs = list(payload.get("kv_refs") or ())
+        if refs:
+            parts = await loop.run_in_executor(
+                None, lambda: get(refs, timeout=120))
+            ks = [p[0] for p in parts]
+            meta["k"] = ks[0] if len(ks) == 1 else np.concatenate(
+                ks, axis=1)
+            vs = [p[1] for p in parts]
+            meta["v"] = vs[0] if len(vs) == 1 else np.concatenate(
+                vs, axis=1)
+        s = payload.get("sampling") or {}
+        params = SamplingParams(
+            temperature=float(s.get("temperature", 1.0)),
+            top_k=int(s.get("top_k", 0)),
+            top_p=float(s.get("top_p", 1.0)),
+            max_tokens=int(s.get("max_tokens", 64)),
+            stop_token_ids=tuple(s.get("stop_token_ids", ())))
+        rid_in = current_request_id()
+        if rid_in and (rid_in in self._queues
+                       or rid_in in self.engine.requests):
+            rid_in = None
+
+        def _inject():
+            with self._engine_lock:
+                return self.engine.inject_request(meta, params,
+                                                  request_id=rid_in)
+
+        rid = await loop.run_in_executor(None, _inject)
+        pre = [int(t) for t in meta.get("output") or ()]
+        state = self.engine.requests.get(rid)
+        if state is not None and state.finished:
+            # degenerate: already at its token budget after prefill —
+            # finished inside inject, so no pump output will ever come
+            self.engine.requests.pop(rid, None)
+            self._observe_finished(state, time.perf_counter())
+            if payload.get("stream"):
+                return self._stream_decode(pre, None,
+                                           state.finish_reason)
+            body = {"object": "text_completion",
+                    "choices": [{"token_ids": pre,
+                                 "finish_reason": state.finish_reason}]}
+            text = self._detok(pre)
+            if text is not None:
+                body["choices"][0]["text"] = text
+            return body
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[rid] = q
+        self._ensure_pump()
+        if payload.get("stream"):
+            return self._stream_decode(pre, q)
+        tokens = list(pre)
+        finish_reason = None
+        while True:
+            out = await q.get()
+            tokens.append(out.token)
+            if out.finished:
+                finish_reason = out.finish_reason
+                break
+        body = {"object": "text_completion",
+                "choices": [{"token_ids": tokens,
+                             "finish_reason": finish_reason}]}
+        text = self._detok(tokens)
+        if text is not None:
+            body["choices"][0]["text"] = text
+        return body
+
+    async def _stream_decode(self, pre: List[int],
+                             queue: Optional[asyncio.Queue],
+                             finish_reason: Optional[str] = None):
+        """Stream a decode-pool response: replay the prefill-side
+        tokens first (the client never saw them), then live decode."""
+        for i, t in enumerate(pre):
+            last = queue is None and i == len(pre) - 1
+            chunk: Dict[str, Any] = {"token": t, "finished": last}
+            if last:
+                chunk["finish_reason"] = finish_reason
+            yield f"data: {json.dumps(chunk)}\n\n"
+        if queue is not None:
+            async for chunk_str in self._stream_from(queue):
+                yield chunk_str
+
     async def chat(self, payload: Dict[str, Any]):
         """Chat-completions shim: template the messages through the
         tokenizer (requires one) then run completions."""
@@ -295,15 +622,21 @@ class LLMServer:
         return await self.completions(body)
 
     async def stats(self, _payload=None) -> Dict[str, Any]:
-        return self.engine.stats()
+        out = self.engine.stats()
+        out["pool"] = self._pool
+        return out
 
 
 def build_llm_deployment(model: str = "tiny", *, num_replicas: int = 1,
-                         name: str = "llm", **server_kwargs):
+                         name: str = "llm",
+                         pools: Optional[dict] = None, **server_kwargs):
     """An Application running LLMServer replicas (ref: ray.llm
-    build_openai_app)."""
+    build_openai_app). ``pools={"prefill": n, "decode": m}`` deploys
+    disaggregated prefill/decode pools instead of ``num_replicas``
+    monolithic replicas (fleet KV plane)."""
     from .. import serve
 
     dep = serve.deployment(LLMServer, name=name,
-                           num_replicas=num_replicas)
+                           num_replicas=num_replicas,
+                           pools=pools)
     return dep.bind(model, **server_kwargs)

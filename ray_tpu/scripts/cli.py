@@ -264,8 +264,56 @@ def cmd_status(args) -> int:
     print("resources:")
     for key in sorted(total):
         print(f"  {key}: {avail.get(key, 0):g}/{total[key]:g} available")
+    _print_serve_status()
     ray_tpu.shutdown()
     return 0
+
+
+def _print_serve_status() -> None:
+    """Serve deployments + fleet-KV routing counters, shown only when a
+    serve controller is already running (status must never create one)."""
+    import ray_tpu
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    from ray_tpu.util import state as state_api
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return  # no serve controller: nothing to show
+    try:
+        deployments = ray_tpu.get(
+            controller.list_deployments.remote(), timeout=15)
+    except Exception as exc:  # graftlint: ignore[swallow] — `status`
+        # is a diagnostic surface: a dead controller is REPORTED on
+        # stdout (with the cause) and must not crash the whole command
+        print(f"serve: controller unreachable ({exc})")
+        return
+    if not deployments:
+        return
+    print("serve deployments:")
+    for d in deployments:
+        pools = d.get("pools")
+        pool_s = ("  pools " + " ".join(f"{p}={n}"
+                                        for p, n in sorted(pools.items()))
+                  if pools else "")
+        summ = d.get("prefix_summaries")
+        summ_s = f"  prefix-summaries {summ}" if summ else ""
+        print(f"  {d['name']:20s} replicas "
+              f"{d['num_replicas']}/{d['target_replicas']}{pool_s}{summ_s}")
+    rows = []
+    try:
+        for name in ("serve_prefix_route_hits", "serve_prefix_route_misses",
+                     "serve_kv_handoff_bytes_total",
+                     "serve_kv_handoff_retries_total"):
+            rows.extend(state_api.get_metrics(name))
+    except Exception:  # noqa: BLE001 — metrics plane is optional here
+        rows = []
+    if rows:
+        print("fleet KV routing:")
+        for e in rows:
+            tags = e.get("tags") or {}
+            tag_s = " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            print(f"  {e['name']:32s} {e.get('value', 0):g}  {tag_s}")
 
 
 def cmd_health(args) -> int:

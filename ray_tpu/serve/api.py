@@ -34,7 +34,8 @@ class Deployment:
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
                 ray_actor_options: Optional[dict] = None,
-                autoscaling_config: Optional[dict] = None) -> "Deployment":
+                autoscaling_config: Optional[dict] = None,
+                pools: Optional[dict] = None) -> "Deployment":
         config = dict(self.config)
         if num_replicas is not None:
             config["num_replicas"] = num_replicas
@@ -44,7 +45,26 @@ class Deployment:
             config["ray_actor_options"] = ray_actor_options
         if autoscaling_config is not None:
             config["autoscaling_config"] = autoscaling_config
+        if pools is not None:
+            config["pools"] = pools
+        _validate_pools(config)
         return Deployment(self._cls, name or self.name, config)
+
+
+def _validate_pools(config: Dict[str, Any]) -> None:
+    pools = config.get("pools")
+    if not pools:
+        return
+    if config.get("autoscaling_config"):
+        raise ValueError(
+            "pools and autoscaling_config are mutually exclusive: pool "
+            "targets are static per-pool counts")
+    for pool, n in pools.items():
+        if not isinstance(pool, str) or not pool:
+            raise ValueError(f"pool names must be non-empty strings, "
+                             f"got {pool!r}")
+        if int(n) < 1:
+            raise ValueError(f"pool {pool!r} needs at least 1 replica")
 
 
 def deployment(cls: Optional[type] = None, *,
@@ -52,21 +72,33 @@ def deployment(cls: Optional[type] = None, *,
                num_replicas: int = 1,
                max_ongoing_requests: int = 100,
                ray_actor_options: Optional[dict] = None,
-               autoscaling_config: Optional[dict] = None):
+               autoscaling_config: Optional[dict] = None,
+               pools: Optional[dict] = None):
     """@serve.deployment — turn a class into a deployable unit.
 
     ``autoscaling_config`` (ref: serve AutoscalingConfig):
     {"min_replicas", "max_replicas", "target_ongoing_requests",
     "downscale_ticks"} — replica count then tracks live queue lengths
-    instead of num_replicas."""
+    instead of num_replicas.
+
+    ``pools`` (fleet KV plane, disaggregated serving): {"prefill": n,
+    "decode": m} splits the deployment into named replica pools with
+    static per-pool counts; ``num_replicas`` is ignored. Each replica
+    learns its pool through the user class's ``configure_pool(pool,
+    deployment_name)`` hook; plain traffic routes to the entry pool
+    (prefill) and the deployment class hops requests across pools
+    (e.g. LLMServer ships prefilled KV pages to the decode pool)."""
     def _wrap(target: type) -> Deployment:
-        return Deployment(target, name or target.__name__, {
+        config = {
             "num_replicas": num_replicas,
             "max_ongoing_requests": max_ongoing_requests,
             "ray_actor_options": ray_actor_options,
             **({"autoscaling_config": autoscaling_config}
                if autoscaling_config else {}),
-        })
+            **({"pools": pools} if pools else {}),
+        }
+        _validate_pools(config)
+        return Deployment(target, name or target.__name__, config)
 
     if cls is not None:
         return _wrap(cls)
@@ -110,8 +142,9 @@ def run(app: Application, *, name: Optional[str] = None,
     return DeploymentHandle(dep_name)
 
 
-def get_deployment_handle(name: str) -> DeploymentHandle:
-    return DeploymentHandle(name)
+def get_deployment_handle(name: str,
+                          pool: Optional[str] = None) -> DeploymentHandle:
+    return DeploymentHandle(name, pool=pool)
 
 
 def start(http_port: int = 0) -> int:

@@ -11,6 +11,7 @@ refresh their cached replica sets."""
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any, Dict, List, Optional
 
 from .._private.rpc import RpcError
@@ -69,7 +70,8 @@ class ServeController:
             dep = self._deployments.get(name)
             if dep is None:
                 dep = self._deployments[name] = {
-                    "name": name, "replicas": [],  # [(handle, code_version)]
+                    "name": name,
+                    "replicas": [],  # [(handle, code_version, pool)]
                     "next_replica": 0, "code_version": 0,
                 }
             if (dep.get("cls_blob") != cls_blob
@@ -90,13 +92,13 @@ class ServeController:
             dep = self._deployments.pop(name, None)
             if dep is None:
                 return False
-            for replica, _ in dep["replicas"]:
-                await self._stop_replica(replica)
+            for entry in dep["replicas"]:
+                await self._stop_replica(entry[0])
             self._version += 1
             self._publish_version()
             return True
 
-    async def _make_replica(self, dep: dict):
+    async def _make_replica(self, dep: dict, pool: Optional[str] = None):
         from .. import remote
         from .replica import Replica
 
@@ -105,13 +107,15 @@ class ServeController:
         config = dep["config"]
         actor_opts = dict(config.get("ray_actor_options") or {})
         actor_opts.setdefault("num_cpus", 1)
+        tag = f"{pool}-" if pool else ""
         handle = remote(Replica).options(
-            name=f"SERVE::{dep['name']}#{index}",
+            name=f"SERVE::{dep['name']}#{tag}{index}",
             lifetime="detached",
             max_restarts=3,
             **actor_opts,
         ).remote(dep["cls_blob"], dep["init_args_blob"],
-                 config.get("max_ongoing_requests", 100), dep["name"])
+                 config.get("max_ongoing_requests", 100), dep["name"],
+                 pool)
         return handle
 
     async def _stop_replica(self, handle) -> None:
@@ -164,23 +168,31 @@ class ServeController:
         return list(await asyncio.gather(*[_one(e) for e in replicas]))
 
     async def _reconcile_deployment(self, dep: dict) -> None:
-        auto = dep["config"].get("autoscaling_config")
+        # disaggregated serving: a "pools" config splits the deployment
+        # into named replica pools (prefill/decode for LLMs) with static
+        # per-pool targets; pool-less deployments reconcile as the
+        # single anonymous pool None (autoscaling applies only there)
+        pools = dep["config"].get("pools")
+        auto = None if pools else dep["config"].get("autoscaling_config")
         if auto:
             target = await self._autoscale_target(dep, auto)
             dep["_auto_target"] = target
+            targets: Dict[Optional[str], int] = {None: target}
+        elif pools:
+            targets = {str(p): int(n) for p, n in pools.items()}
         else:
-            target = dep["config"].get("num_replicas", 1)
+            targets = {None: dep["config"].get("num_replicas", 1)}
         code_version = dep["code_version"]
 
         # concurrent health checks: one hung replica must not stall the
         # control loop for 15s per replica (NB: awaiting ObjectRefs — a
         # blocking get() would stall this actor's loop)
         async def _check(entry):
-            replica, version = entry
             try:
                 await asyncio.wait_for(
-                    _await_ref(replica.health_check.remote()), 15)
-                return version == code_version  # stale code = replace
+                    _await_ref(entry[0].health_check.remote()), 15)
+                # stale code OR a pool dropped from config = replace
+                return entry[1] == code_version and entry[2] in targets
             except _REMOTE_ERRORS:
                 return False
 
@@ -193,33 +205,82 @@ class ServeController:
             else:
                 await self._stop_replica(entry[0])
         changed = len(alive) != len(dep["replicas"])
-        dep["replicas"] = alive
-        while len(dep["replicas"]) < target:
-            dep["replicas"].append(
-                (await self._make_replica(dep), code_version))
-            changed = True
-        if len(dep["replicas"]) > target:
-            # downscale the IDLEST replicas first: killing a replica
-            # fails its in-flight requests, so rank by queue depth
-            # (sampled this round by _autoscale_target when autoscaling;
-            # unreachable replicas read -1 and drop first)
-            depths = dep.pop("_last_qlens", None)
-            if depths is None or len(depths) != len(dep["replicas"]):
-                depths = await self._queue_lens(dep["replicas"])
-            ranked = sorted(zip(depths, range(len(dep["replicas"]))),
-                            key=lambda p: p[0])
-            drop = {i for _, i in ranked[:len(dep["replicas"]) - target]}
-            keep = []
-            for i, entry in enumerate(dep["replicas"]):
-                if i in drop:
-                    await self._stop_replica(entry[0])
-                else:
-                    keep.append(entry)
-            dep["replicas"] = keep
-            changed = True
+        replicas = []
+        for pool, target in targets.items():
+            entries = [e for e in alive if e[2] == pool]
+            while len(entries) < target:
+                entries.append((await self._make_replica(dep, pool),
+                                code_version, pool))
+                changed = True
+            if len(entries) > target:
+                # downscale the IDLEST replicas first: killing a replica
+                # fails its in-flight requests, so rank by queue depth
+                # (sampled this round by _autoscale_target when
+                # autoscaling; unreachable replicas read -1, drop first)
+                depths = dep.pop("_last_qlens", None)
+                if depths is None or len(depths) != len(entries):
+                    depths = await self._queue_lens(entries)
+                ranked = sorted(zip(depths, range(len(entries))),
+                                key=lambda p: p[0])
+                drop = {i for _, i in ranked[:len(entries) - target]}
+                keep = []
+                for i, entry in enumerate(entries):
+                    if i in drop:
+                        await self._stop_replica(entry[0])
+                    else:
+                        keep.append(entry)
+                entries = keep
+                changed = True
+            replicas.extend(entries)
+        dep["replicas"] = replicas
         if changed:
             self._version += 1
             self._publish_version()
+        await self._gossip_summaries(dep)
+
+    async def _gossip_summaries(self, dep: dict) -> None:
+        """Fleet KV plane: poll replica prefix-cache summaries on the
+        reconcile tick (routing freshness rides the existing heartbeat
+        path — no extra control loop). Handles pull the aggregated
+        table through get_prefix_summaries and score replicas by
+        longest cached-prefix match (serve/kv_router.py)."""
+        from .._private.config import global_config
+
+        cfg = global_config()
+        if not cfg.serve_prefix_routing_enabled or not dep["replicas"]:
+            return
+        # a code version that exposed no summaries is never re-polled:
+        # non-LLM deployments pay one probe per deploy, not per tick
+        if (dep.get("_summary_probe_version") == dep["code_version"]
+                and not dep.get("_prefix_summaries")):
+            return
+        now = time.monotonic()
+        if now - dep.get("_summary_poll_t", 0.0) \
+                < cfg.serve_prefix_summary_interval_s:
+            return
+        dep["_summary_poll_t"] = now
+
+        async def _one(entry):
+            try:
+                return await asyncio.wait_for(
+                    _await_ref(entry[0].prefix_summary.remote()), 5), True
+            except _REMOTE_ERRORS:
+                return None, False
+
+        results = await asyncio.gather(
+            *[_one(e) for e in dep["replicas"]])
+        summaries = dep.setdefault("_prefix_summaries", {})
+        for entry, (summary, _ok) in zip(dep["replicas"], results):
+            if summary:
+                summaries[entry[0]._actor_id] = {
+                    "summary": summary, "t": now}
+        live = {e[0]._actor_id for e in dep["replicas"]}
+        for aid in [a for a in summaries if a not in live]:
+            del summaries[aid]
+        if all(ok for _, ok in results):
+            # only a clean all-replicas probe may conclude "no summary
+            # hook here" — a replica still initializing must be retried
+            dep["_summary_probe_version"] = dep["code_version"]
 
     def _publish_version(self) -> None:
         """Push the new config version to every router/handle over GCS
@@ -258,29 +319,73 @@ class ServeController:
                               file=sys.stderr)
 
     # ------------------------------------------------------------ queries
-    async def get_replicas(self, name: str):
+    async def get_replicas(self, name: str, pool: Optional[str] = None):
         """(version, [replica handles]) — consumers cache until the version
-        moves (the long-poll config-push role, ref: _private/long_poll.py)."""
+        moves (the long-poll config-push role, ref: _private/long_poll.py).
+
+        ``pool`` narrows a pooled deployment to one replica pool. For a
+        pooled deployment with pool=None, plain traffic lands on the
+        ENTRY pool (prefill — requests start with their prompt pass)."""
         dep = self._deployments.get(name)
         if dep is None:
             return self._version, None
-        return self._version, [replica for replica, _ in dep["replicas"]]
+        entries = dep["replicas"]
+        pools = dep["config"].get("pools")
+        if pool is None and pools:
+            pool = "prefill" if "prefill" in pools else next(iter(pools))
+        if pool is not None:
+            entries = [e for e in entries if e[2] == pool]
+        return self._version, [e[0] for e in entries]
+
+    async def get_prefix_summaries(self, name: str) -> dict:
+        """Aggregated prefix-cache summary table for a deployment:
+        {replica actor_id: {"page_size", "digests", "age_s"}}. Ages are
+        controller-side monotonic deltas so consumers judge staleness
+        without cross-process clock agreement."""
+        dep = self._deployments.get(name)
+        if dep is None:
+            return {}
+        now = time.monotonic()
+        out = {}
+        for aid, rec in dep.get("_prefix_summaries", {}).items():
+            summary = rec["summary"]
+            out[aid] = {"page_size": summary.get("page_size"),
+                        "digests": summary.get("digests"),
+                        "age_s": now - rec["t"]}
+        return out
 
     async def get_version(self) -> int:
         return self._version
 
     async def list_deployments(self) -> List[dict]:
-        return [
-            {"name": d["name"],
-             "num_replicas": len(d["replicas"]),
-             # autoscaled deployments report their last computed target,
-             # not the static num_replicas default
-             "target_replicas": (
-                 d.get("_auto_target", len(d["replicas"]))
-                 if d["config"].get("autoscaling_config")
-                 else d["config"].get("num_replicas", 1))}
-            for d in self._deployments.values()
-        ]
+        out = []
+        for d in self._deployments.values():
+            pools = d["config"].get("pools")
+            info = {
+                "name": d["name"],
+                "num_replicas": len(d["replicas"]),
+                # autoscaled deployments report their last computed
+                # target, not the static num_replicas default
+                "target_replicas": (
+                    d.get("_auto_target", len(d["replicas"]))
+                    if d["config"].get("autoscaling_config")
+                    else (sum(int(n) for n in pools.values()) if pools
+                          else d["config"].get("num_replicas", 1)))}
+            if pools:
+                counts: Dict[str, int] = {str(p): 0 for p in pools}
+                for e in d["replicas"]:
+                    if e[2] in counts:
+                        counts[e[2]] += 1
+                info["pools"] = counts
+            if d.get("_prefix_summaries"):
+                # count ROUTABLE summaries only: a digest-less entry
+                # (engine cache still empty) can't steer any request,
+                # and waiters key "routing is live" off this number
+                info["prefix_summaries"] = sum(
+                    1 for rec in d["_prefix_summaries"].values()
+                    if rec["summary"].get("digests"))
+            out.append(info)
+        return out
 
     # -------------------------------------------------------------- proxy
     async def _ensure_ingress(self, slot: str, actor_cls, name: str,

@@ -66,9 +66,14 @@ class DeploymentHandle:
     """Callable handle to a deployment; picklable (it re-resolves the
     controller by name wherever it lands)."""
 
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 pool: Optional[str] = None):
         self._name = deployment_name
         self._method = method_name
+        # pooled (disaggregated) deployments: pool=None routes to the
+        # entry pool (prefill); in-fleet handles pin a specific pool
+        # (e.g. a prefill replica's handle to the decode pool)
+        self._pool = pool
         self._lock = threading.Lock()
         self._replicas: list = []
         self._version = -1
@@ -80,12 +85,16 @@ class DeploymentHandle:
         self._latencies: "collections.deque" = collections.deque(maxlen=256)
         self._requests_total = 0
         self._hedges_launched = 0
+        # fleet KV plane: the controller's aggregated prefix-summary
+        # table, re-pulled at most once per summary interval
+        self._summaries: Dict[Any, dict] = {}
+        self._summaries_t = 0.0
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name, self._method))
+        return (DeploymentHandle, (self._name, self._method, self._pool))
 
     def options(self, *, method_name: str) -> "DeploymentHandle":
-        handle = DeploymentHandle(self._name, method_name)
+        handle = DeploymentHandle(self._name, method_name, self._pool)
         return handle
 
     # ------------------------------------------------------------ routing
@@ -116,7 +125,8 @@ class DeploymentHandle:
                 elif now - self._last_refresh < 2.0:
                     return
         version, replicas = get(
-            self._controller().get_replicas.remote(self._name), timeout=30)
+            self._controller().get_replicas.remote(self._name, self._pool),
+            timeout=30)
         if replicas is None:
             raise ValueError(f"Serve deployment '{self._name}' not found")
         with self._lock:
@@ -151,6 +161,96 @@ class DeploymentHandle:
             na = self._ongoing.get(a._actor_id, 0)
             nb = self._ongoing.get(b._actor_id, 0)
         return a if na <= nb else b
+
+    def _prefix_summaries(self):
+        """(summary table, fetch time): the controller's aggregated
+        prefix-summary table, re-pulled at most once per
+        serve_prefix_summary_interval_s. A failed pull keeps the old
+        table — it ages into staleness and routing falls back to
+        pow-2 rather than failing the request."""
+        from .._private.config import global_config
+
+        interval = max(
+            global_config().serve_prefix_summary_interval_s, 0.1)
+        now = time.monotonic()
+        with self._lock:
+            if now - self._summaries_t < interval:
+                return self._summaries, self._summaries_t
+        from .. import get
+
+        try:
+            table = get(
+                self._controller().get_prefix_summaries.remote(self._name),
+                timeout=10)
+        except Exception:  # noqa: BLE001 — routing hint, never a failure
+            table = None
+        with self._lock:
+            if table is not None:
+                self._summaries = table
+                self._summaries_t = time.monotonic()
+            return self._summaries, self._summaries_t
+
+    def _route_plan(self, args, kwargs):
+        """Pick this request's replica: longest cached-prefix match
+        (fleet KV plane, serve/kv_router.py) with pow-2 load fallback.
+
+        Returns (replica, ranked) where ``ranked`` lists the remaining
+        prefix-matching replicas longest-first (hedges fire at the
+        next-longest-prefix replica) or None when routing fell back to
+        load. Fallback reasons — not prefix-routable, routing disabled,
+        no/stale summaries, no match, or the winner's local queue depth
+        past the spill threshold — count as routing misses."""
+        from .._private.config import global_config
+        from . import kv_router
+
+        cfg = global_config()
+        if not cfg.serve_prefix_routing_enabled:
+            return self._pick(), None
+        prompt_ids = kv_router.extract_prompt_ids(args, kwargs)
+        if prompt_ids is None:
+            return self._pick(), None
+        with self._lock:
+            replicas = list(self._replicas)
+        if len(replicas) < 2:
+            return self._pick(), None
+
+        def _miss(reason: str):
+            kv_router.route_counter("serve_prefix_route_misses").inc(
+                tags={"deployment": self._name, "reason": reason})
+            return self._pick(), None
+
+        table, fetched = self._prefix_summaries()
+        from .controller import HEALTH_PERIOD_S
+
+        # gossip advances at most once per reconcile tick, so entries
+        # legitimately age up to HEALTH_PERIOD_S even with a shorter
+        # configured interval — floor the staleness bound there
+        interval = max(cfg.serve_prefix_summary_interval_s, 0.1,
+                       HEALTH_PERIOD_S)
+        now = time.monotonic()
+        fresh = {}
+        for aid, rec in table.items():
+            if not rec.get("digests"):
+                continue
+            # entry age = controller-side age at fetch + table age here
+            if rec.get("age_s", 0.0) + (now - fetched) <= 3.0 * interval:
+                fresh[aid] = rec
+        if not fresh:
+            return _miss("stale" if table else "no_summary")
+        scored = kv_router.score_replicas(prompt_ids, replicas, fresh)
+        best_tokens, best = scored[0]
+        if best_tokens <= 0:
+            return _miss("no_match")
+        with self._lock:
+            depth = self._ongoing.get(best._actor_id, 0)
+        if depth > cfg.serve_prefix_spill_queue_depth:
+            return _miss("spill")
+        kv_router.route_counter("serve_prefix_route_hits").inc(
+            tags={"deployment": self._name, "reason": "hit"})
+        kv_router.match_histogram().observe(
+            float(best_tokens), tags={"deployment": self._name})
+        ranked = [r for tokens, r in scored[1:] if tokens > 0]
+        return best, ranked or None
 
     def remote(self, *args, **kwargs):
         """Route one request; returns the ObjectRef of the replica call.
@@ -204,9 +304,20 @@ class DeploymentHandle:
         ref.future().add_done_callback(_done)
         return ref
 
-    def _pick_other(self, primary):
-        """Second-choice replica for a hedge: lowest in-flight among the
-        others (pow-2 when there are enough to sample)."""
+    def _pick_other(self, primary, ranked=None):
+        """Backup replica for a hedge. With a prefix ranking from
+        :meth:`_route_plan`, the hedge goes to the NEXT-longest-prefix
+        replica (a straggling primary's warm cache is best approximated
+        by the next-warmest, not a random peer); otherwise lowest
+        in-flight among the others (pow-2 when there are enough to
+        sample)."""
+        with self._lock:
+            live = {r._actor_id for r in self._replicas}
+        if ranked:
+            for r in ranked:
+                if r._actor_id != primary._actor_id \
+                        and r._actor_id in live:
+                    return r
         with self._lock:
             others = [r for r in self._replicas
                       if r._actor_id != primary._actor_id]
@@ -227,7 +338,7 @@ class DeploymentHandle:
         if core is None or delay is None:
             return self.route(*args, **kwargs)[0]
         self._refresh()
-        primary = self._pick()
+        primary, ranked = self._route_plan(args, kwargs)
         # promise ref: a fresh return oid this process owns; the winner's
         # reply is re-serialized into it exactly once. The registered
         # event makes get()/wait() treat it as pending-here meanwhile.
@@ -277,7 +388,7 @@ class DeploymentHandle:
                         * max(1, self._requests_total)):
                     return
                 self._hedges_launched += 1
-            backup = self._pick_other(primary)
+            backup = self._pick_other(primary, ranked)
             if backup is None:
                 with self._lock:
                     self._hedges_launched -= 1
@@ -301,7 +412,7 @@ class DeploymentHandle:
         caller-supplied) rides to the replica for telemetry propagation —
         it is NOT forwarded to the user callable's kwargs."""
         self._refresh()
-        replica = self._pick()
+        replica, _ranked = self._route_plan(args, kwargs)
         ref = self._dispatch(replica, args, kwargs, request_id)
         return ref, replica
 

@@ -1,0 +1,158 @@
+"""Fleet KV plane: prefix-cache-aware routing primitives.
+
+The serve fleet's replicas each run a paged-KV engine with an automatic
+prefix cache (llm/cache.py); this module is the routing-side half that
+makes N replicas act like one engine. Replicas publish compact summaries
+of their cached prefix-page hash chains (truncated SHA-256 digests); the
+controller gossips them on its reconcile tick; DeploymentHandle scores
+candidate replicas by longest cached-prefix match and routes there
+(serve/handle.py), spilling to pow-2 load when nothing matches, the
+summary went stale, or the winner is overloaded.
+
+Everything here is stdlib-only ON PURPOSE: handles and proxies route
+requests without importing jax, so the hash chain is re-derived from
+llm/cache.py's scheme rather than imported from it (cache.py delegates
+to :func:`chained_page_keys` — one source of truth, dependency pointing
+the cheap way).
+
+Digests in summaries are TRUNCATED to ``DIGEST_BYTES``: a collision can
+only misroute a request to a replica that then prefills normally (its
+engine re-verifies against FULL 32-byte keys), so truncation trades a
+perf-only false positive for an 8x smaller gossip payload — never a
+cross-request KV leak.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# truncated digest width used in routing summaries (64-bit)
+DIGEST_BYTES = 8
+
+# matched-prefix-length histogram boundaries, in TOKENS (power-of-2 —
+# prefix lengths, not latencies, so LATENCY_BUCKETS doesn't apply)
+MATCH_TOKEN_BUCKETS = [16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0,
+                       2048.0, 4096.0, 8192.0]
+
+
+def chained_page_keys(tokens: Sequence[int], page_size: int) -> List[bytes]:
+    """Content-addressed keys for each FULL page of a token sequence.
+
+    The hash chain MUST stay byte-identical to what the engines mint
+    (PrefixCache.page_keys delegates here): SHA-256 over (parent digest
+    + the page's tokens packed as fixed-width int64), so no two token
+    sequences share an encoding and a cryptographic-width key can route
+    KV pages across requests without cross-request leaks."""
+    keys: List[bytes] = []
+    parent = b""
+    for start in range(0, (len(tokens) // page_size) * page_size,
+                       page_size):
+        chunk = tokens[start:start + page_size]
+        h = hashlib.sha256(parent)
+        h.update(struct.pack(f"<{len(chunk)}q",
+                             *(int(t) for t in chunk)))
+        parent = h.digest()
+        keys.append(parent)
+    return keys
+
+
+def truncate_keys(keys: Iterable[bytes]) -> List[bytes]:
+    return [k[:DIGEST_BYTES] for k in keys]
+
+
+def make_summary(keys: Iterable[bytes], page_size: int) -> Dict[str, Any]:
+    """The gossip payload a replica publishes: its cached pages' keys,
+    truncated, as a set (membership is all routing needs — the CHAIN
+    structure is implicit in the keys themselves, each one commits to
+    its whole prefix)."""
+    digests = {k[:DIGEST_BYTES] for k in keys}
+    return {"page_size": int(page_size), "digests": digests}
+
+
+def matched_prefix_pages(trunc_keys: Sequence[bytes],
+                         digests: "set") -> int:
+    """Longest cached prefix: walk the prompt's page keys front-to-back
+    and stop at the first page the replica doesn't hold (the engine's
+    own lookup breaks at the first miss too — pages past a gap are
+    unreachable)."""
+    n = 0
+    for key in trunc_keys:
+        if key not in digests:
+            break
+        n += 1
+    return n
+
+
+def extract_prompt_ids(args: tuple, kwargs: dict) -> Optional[List[int]]:
+    """Pull routable tokens out of a serve request's payload. LLM
+    payloads are a dict with 'prompt_ids'; anything else is not
+    prefix-routable (returns None, router falls back to pow-2)."""
+    for payload in list(args) + list(kwargs.values()):
+        if isinstance(payload, dict):
+            ids = payload.get("prompt_ids")
+            if isinstance(ids, (list, tuple)) and ids:
+                try:
+                    return [int(t) for t in ids]
+                except (TypeError, ValueError):
+                    return None
+    return None
+
+
+def score_replicas(prompt_ids: Sequence[int], replicas: Sequence[Any],
+                   summaries: Dict[Any, Dict[str, Any]]
+                   ) -> List[Tuple[int, Any]]:
+    """(matched_tokens, replica) per candidate, sorted longest-match
+    first (stable: ties keep the caller's replica order). Summaries are
+    keyed by replica actor id; replicas without one score 0. Key chains
+    are derived per distinct page_size, so mixed-config fleets still
+    score correctly."""
+    keys_by_page: Dict[int, List[bytes]] = {}
+    scored: List[Tuple[int, Any]] = []
+    for r in replicas:
+        summary = summaries.get(r._actor_id)
+        matched = 0
+        if summary and summary.get("digests"):
+            ps = int(summary["page_size"])
+            if ps > 0:
+                trunc = keys_by_page.get(ps)
+                if trunc is None:
+                    trunc = keys_by_page[ps] = truncate_keys(
+                        chained_page_keys(prompt_ids, ps))
+                matched = matched_prefix_pages(
+                    trunc, summary["digests"]) * ps
+        scored.append((matched, r))
+    scored.sort(key=lambda p: -p[0])
+    return scored
+
+
+# router metrics, created lazily (metric construction starts the flusher
+# thread — only processes that actually route should pay for it; same
+# pattern as serve/handle.py's hedge counters)
+_route_metrics: Dict[str, Any] = {}
+
+
+def route_counter(name: str):
+    c = _route_metrics.get(name)
+    if c is None:
+        from ..util.metrics import Counter
+
+        c = _route_metrics.setdefault(name, Counter(
+            name, "prefix-aware routing counter",
+            tag_keys=("deployment", "reason")))
+    return c
+
+
+def match_histogram():
+    h = _route_metrics.get("serve_prefix_match_tokens")
+    if h is None:
+        from ..util.metrics import Histogram
+
+        h = _route_metrics.setdefault(
+            "serve_prefix_match_tokens", Histogram(
+                "serve_prefix_match_tokens",
+                "Cached-prefix tokens matched on the routed replica",
+                boundaries=MATCH_TOKEN_BUCKETS,
+                tag_keys=("deployment",)))
+    return h

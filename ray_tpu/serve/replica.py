@@ -38,12 +38,22 @@ def current_request_id() -> Optional[str]:
 
 class Replica:
     def __init__(self, cls_blob: bytes, init_args_blob: bytes,
-                 max_ongoing_requests: int, deployment_name: str = ""):
+                 max_ongoing_requests: int, deployment_name: str = "",
+                 pool: Optional[str] = None):
         cls = cloudpickle.loads(cls_blob)
         args, kwargs = cloudpickle.loads(init_args_blob)
         self.user = cls(*args, **kwargs)
         self.max_ongoing = max_ongoing_requests
         self.deployment_name = deployment_name
+        # disaggregated serving (fleet KV plane): a pooled deployment
+        # runs prefill and decode replica pools; the user callable
+        # learns its role through the configure_pool hook before any
+        # request lands (e.g. LLMServer skips decode on prefill
+        # replicas and ships finished KV pages to the decode pool)
+        self.pool = pool
+        hook = getattr(self.user, "configure_pool", None)
+        if hook is not None:
+            hook(pool, deployment_name)
         self._sem = asyncio.Semaphore(max_ongoing_requests)
         self._ongoing = 0
         self._streams: Dict[int, Any] = {}
@@ -143,6 +153,19 @@ class Replica:
 
     async def queue_len(self) -> int:
         return self._ongoing
+
+    async def prefix_summary(self):
+        """Prefix-cache summary for the fleet KV router (serve/
+        kv_router.py), polled by the controller's reconcile tick. None
+        when the user callable doesn't expose one — the controller
+        stops polling that deployment version entirely."""
+        hook = getattr(self.user, "prefix_cache_summary", None)
+        if hook is None:
+            return None
+        out = hook()
+        if asyncio.iscoroutine(out):
+            out = await out
+        return out
 
     async def health_check(self) -> bool:
         check = getattr(self.user, "check_health", None)

@@ -15,6 +15,8 @@ path as serve.run.
         num_replicas: 2      # deployment config overrides
         max_ongoing_requests: 64
         autoscaling_config: {min_replicas: 1, max_replicas: 4}
+        pools: {prefill: 1, decode: 2}   # disaggregated replica pools
+                                         # (replaces num_replicas)
 
     serve.run_config("config.yaml")     # or a dict
 """
@@ -28,7 +30,7 @@ from .api import Application, Deployment, deployment as _deployment_dec
 from .handle import DeploymentHandle
 
 _DEPLOY_OVERRIDES = ("num_replicas", "max_ongoing_requests",
-                     "ray_actor_options", "autoscaling_config")
+                     "ray_actor_options", "autoscaling_config", "pools")
 
 
 def _import_target(path: str) -> Any:

@@ -410,11 +410,15 @@ def test_llm_ttft_tpot_histograms():
     def delta(key):
         return after.get(key, 0.0) - before.get(key, 0.0)
 
-    ttft = "llm_ttft_seconds{__stat__=count,model=tiny}"
-    tpot = "llm_tpot_seconds{__stat__=count,model=tiny}"
-    e2e = "llm_request_e2e_seconds{__stat__=count,model=tiny}"
+    # engine metrics carry a pool tag since the fleet KV plane split
+    # deployments into prefill/decode pools; standalone servers report
+    # as the monolithic pool
+    ttft = "llm_ttft_seconds{__stat__=count,model=tiny,pool=mono}"
+    tpot = "llm_tpot_seconds{__stat__=count,model=tiny,pool=mono}"
+    e2e = "llm_request_e2e_seconds{__stat__=count,model=tiny,pool=mono}"
     assert delta(ttft) >= 1, after
     assert delta(tpot) >= 1, after
     assert delta(e2e) >= 1, after
-    assert delta("llm_prompt_tokens_total{model=tiny}") >= 4
-    assert delta("llm_generation_tokens_total{model=tiny}") >= 4
+    assert delta("llm_prompt_tokens_total{model=tiny,pool=mono}") >= 4
+    assert delta(
+        "llm_generation_tokens_total{model=tiny,pool=mono}") >= 4

@@ -1,0 +1,451 @@
+"""Fleet KV plane: prefix-cache-aware routing + disaggregated
+prefill/decode serving (serve/kv_router.py, serve/handle.py routing,
+llm/serve.py pools).
+
+Coverage: the router's hash chain stays byte-identical to the engine
+prefix cache's; _route_plan picks the longest cached-prefix replica and
+falls back to pow-2 on stale summaries / no match / spill; the
+engine-level KV export->inject round trip reproduces the monolithic
+token stream exactly (and degrades to recompute on a corrupt payload);
+a pooled prefill/decode deployment serves the same tokens as a
+monolithic engine with handoff faults retried and attributed, never
+hung; prefix-aware hedging stays under the hedge budget cap."""
+
+import time
+import types
+
+import jax
+import pytest
+
+import ray_tpu
+from ray_tpu.llm import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.llm.cache import PrefixCache
+from ray_tpu.models import LLAMA_CONFIGS, init_params
+from ray_tpu.serve import kv_router
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.util.metrics import snapshot_local
+
+CFG = LLAMA_CONFIGS["tiny"]
+
+_ECFG = dict(max_num_seqs=2, max_seq_len=128, num_pages=64,
+             page_size=16, enable_prefix_caching=True)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+# ------------------------------------------------------- hash chain unit
+
+def test_router_keys_match_engine_cache():
+    """The router re-derives the engine's page-key chain (it must not
+    import jax); the two implementations must stay byte-identical or
+    routing would steer to replicas whose caches can never hit."""
+    tokens = list(range(7, 71))
+    for page_size in (4, 16):
+        assert kv_router.chained_page_keys(tokens, page_size) == \
+            PrefixCache.page_keys(tokens, page_size)
+    # partial trailing page mints no key
+    assert len(kv_router.chained_page_keys(tokens[:18], 16)) == 1
+    # chain property: a changed token invalidates every later page
+    a = kv_router.chained_page_keys(tokens, 16)
+    mutated = list(tokens)
+    mutated[2] += 1
+    b = kv_router.chained_page_keys(mutated, 16)
+    assert a[0] != b[0] and all(x != y for x, y in zip(a, b))
+
+
+def test_matched_prefix_stops_at_first_gap():
+    keys = kv_router.truncate_keys(
+        kv_router.chained_page_keys(list(range(64)), 16))
+    assert kv_router.matched_prefix_pages(keys, set(keys)) == 4
+    # a missing middle page makes everything after it unreachable
+    assert kv_router.matched_prefix_pages(
+        keys, set(keys) - {keys[1]}) == 1
+    assert kv_router.matched_prefix_pages(keys, set()) == 0
+
+
+def test_extract_prompt_ids_shapes():
+    assert kv_router.extract_prompt_ids(
+        ({"prompt_ids": [1, 2, 3]},), {}) == [1, 2, 3]
+    assert kv_router.extract_prompt_ids(
+        (), {"payload": {"prompt_ids": (4, 5)}}) == [4, 5]
+    assert kv_router.extract_prompt_ids((41,), {}) is None
+    assert kv_router.extract_prompt_ids(({"prompt_ids": []},), {}) is None
+    assert kv_router.extract_prompt_ids(
+        ({"prompt_ids": ["not", "ints"]},), {}) is None
+
+
+# --------------------------------------------------- _route_plan routing
+
+_PAGE = 16
+_SHARED = list(range(2, 130))  # 8 full pages
+
+
+def _summary_for(tokens, n_pages, age_s=0.0):
+    keys = kv_router.truncate_keys(
+        kv_router.chained_page_keys(tokens, _PAGE))[:n_pages]
+    return {"page_size": _PAGE, "digests": set(keys), "age_s": age_s}
+
+
+def _handle_with(summaries, ongoing=None):
+    """A routable handle with seeded replica set + summary table (no
+    cluster: _route_plan only talks RPC when its caches are stale)."""
+    h = DeploymentHandle("kvdep", "completions")
+    now = time.monotonic()
+    h._replicas = [types.SimpleNamespace(_actor_id=aid)
+                   for aid in ("A", "B", "C")]
+    h._last_refresh = now
+    h._summaries = summaries
+    h._summaries_t = now
+    h._ongoing = dict(ongoing or {})
+    return h
+
+
+def _counter_val(name, **tags):
+    key = name + "{" + ",".join(
+        f"{k}={v}" for k, v in sorted(tags.items())) + "}"
+    return snapshot_local(name).get(key, 0.0)
+
+
+def test_route_plan_picks_longest_prefix_and_ranks_rest():
+    h = _handle_with({
+        "A": _summary_for(_SHARED, 2),
+        "B": _summary_for(_SHARED, 8),   # longest match
+        "C": _summary_for(_SHARED, 4),
+    })
+    payload = {"prompt_ids": _SHARED + [999], "max_tokens": 4}
+    hits0 = _counter_val("serve_prefix_route_hits",
+                         deployment="kvdep", reason="hit")
+    replica, ranked = h._route_plan((payload,), {})
+    assert replica._actor_id == "B"
+    # hedges walk the remaining matches longest-first
+    assert [r._actor_id for r in ranked] == ["C", "A"]
+    assert _counter_val("serve_prefix_route_hits",
+                        deployment="kvdep", reason="hit") == hits0 + 1
+
+
+def test_route_plan_stale_summary_falls_back_to_load():
+    h = _handle_with({
+        "A": _summary_for(_SHARED, 8, age_s=999.0),
+        "B": _summary_for(_SHARED, 8, age_s=999.0),
+        "C": _summary_for(_SHARED, 8, age_s=999.0),
+    })
+    payload = {"prompt_ids": _SHARED, "max_tokens": 4}
+    miss0 = _counter_val("serve_prefix_route_misses",
+                         deployment="kvdep", reason="stale")
+    replica, ranked = h._route_plan((payload,), {})
+    assert replica._actor_id in ("A", "B", "C")
+    assert ranked is None
+    assert _counter_val("serve_prefix_route_misses",
+                        deployment="kvdep", reason="stale") == miss0 + 1
+
+
+def test_route_plan_no_match_falls_back():
+    h = _handle_with({"A": _summary_for(list(range(500, 600)), 6)})
+    payload = {"prompt_ids": _SHARED, "max_tokens": 4}
+    miss0 = _counter_val("serve_prefix_route_misses",
+                         deployment="kvdep", reason="no_match")
+    replica, ranked = h._route_plan((payload,), {})
+    assert ranked is None
+    assert _counter_val("serve_prefix_route_misses",
+                        deployment="kvdep", reason="no_match") == miss0 + 1
+
+
+def test_route_plan_spills_overloaded_winner():
+    """A long prefix match must not pile requests onto one replica
+    forever: past the spill queue depth the router reverts to load."""
+    from ray_tpu._private.config import global_config
+
+    depth = global_config().serve_prefix_spill_queue_depth
+    h = _handle_with({"B": _summary_for(_SHARED, 8)},
+                     ongoing={"B": depth + 1})
+    payload = {"prompt_ids": _SHARED, "max_tokens": 4}
+    miss0 = _counter_val("serve_prefix_route_misses",
+                         deployment="kvdep", reason="spill")
+    _replica, ranked = h._route_plan((payload,), {})
+    assert ranked is None
+    assert _counter_val("serve_prefix_route_misses",
+                        deployment="kvdep", reason="spill") == miss0 + 1
+    # below the threshold the match wins again
+    h._ongoing["B"] = depth
+    replica, _ = h._route_plan((payload,), {})
+    assert replica._actor_id == "B"
+
+
+def test_route_plan_disabled_and_unroutable_payloads():
+    from ray_tpu._private.config import global_config
+
+    h = _handle_with({"B": _summary_for(_SHARED, 8)})
+    # non-dict payload: not prefix-routable, silent pow-2 (no miss tick)
+    miss = lambda r: _counter_val(  # noqa: E731
+        "serve_prefix_route_misses", deployment="kvdep", reason=r)
+    before = {r: miss(r) for r in ("stale", "no_match", "spill")}
+    replica, ranked = h._route_plan((41,), {})
+    assert ranked is None
+    assert {r: miss(r) for r in before} == before
+    # kill switch: routing disabled falls back wholesale
+    global_config().apply_overrides(
+        {"serve_prefix_routing_enabled": False})
+    try:
+        _replica, ranked = h._route_plan(
+            ({"prompt_ids": _SHARED},), {})
+        assert ranked is None
+    finally:
+        global_config().apply_overrides(
+            {"serve_prefix_routing_enabled": True})
+
+
+# ------------------------------------------- engine-level KV handoff
+
+def _drain(engine, toks):
+    while engine.has_unfinished():
+        for o in engine.step():
+            toks.append(o.token)
+    return toks
+
+
+def test_engine_kv_handoff_matches_monolithic(tiny_params):
+    """export_kv_request -> inject_request across two engines yields the
+    exact token stream of one monolithic engine (greedy oracle)."""
+    ecfg = EngineConfig(**_ECFG)
+    prompt = list(range(1, 40))
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+
+    mono = LLMEngine(tiny_params, CFG, ecfg)
+    mono.add_request(prompt, sp)
+    want = _drain(mono, [])
+
+    pre = LLMEngine(tiny_params, CFG, ecfg)
+    rid = pre.add_request(prompt, sp)
+    first = []
+    while not first:
+        first = pre.step(skip_decode=True)
+    assert len(first) == 1 and not first[0].finished
+    payload = pre.export_kv_request(rid)
+    state = pre.requests.pop(rid)
+    assert state.finish_reason == "handoff"
+    assert payload["output"] == [first[0].token]
+    assert not pre.has_unfinished()
+
+    dec = LLMEngine(tiny_params, CFG, ecfg)
+    dec.inject_request(payload, sp)
+    got = _drain(dec, list(payload["output"]))
+    assert got == want, (got, want)
+
+
+def test_corrupt_handoff_falls_back_to_recompute(tiny_params):
+    """An unusable payload (wrong page count — e.g. mismatched engine
+    configs) must degrade to a recompute prefill, not wrong tokens."""
+    ecfg = EngineConfig(**_ECFG)
+    prompt = list(range(1, 40))
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+
+    mono = LLMEngine(tiny_params, CFG, ecfg)
+    mono.add_request(prompt, sp)
+    want = _drain(mono, [])
+
+    pre = LLMEngine(tiny_params, CFG, ecfg)
+    rid = pre.add_request(prompt, sp)
+    while not pre.step(skip_decode=True):
+        pass
+    payload = pre.export_kv_request(rid)
+    payload["k"] = payload["k"][:, :1]  # too few pages: unusable
+
+    dec = LLMEngine(tiny_params, CFG, ecfg)
+    dec.inject_request(payload, sp)
+    got = _drain(dec, list(payload["output"]))
+    assert got == want, (got, want)
+
+
+# ---------------------------------------- pooled serving on a cluster
+
+def _oracle_tokens(params, prompt, max_tokens):
+    eng = LLMEngine(params, CFG, EngineConfig(**_ECFG))
+    eng.add_request(list(prompt),
+                    SamplingParams(temperature=0.0, max_tokens=max_tokens))
+    return _drain(eng, [])
+
+
+def _metric_total(name):
+    from ray_tpu.util import state
+
+    return sum(e.get("value", 0.0) for e in state.get_metrics(name))
+
+
+def _wait_metric(name, timeout=30):
+    deadline = time.time() + timeout
+    total = 0.0
+    while time.time() < deadline:
+        total = _metric_total(name)
+        if total > 0:
+            return total
+        time.sleep(0.5)
+    return total
+
+
+def _run_pooled(tiny_params, system_config, n_requests=2):
+    """One prefill + one decode replica; returns (tokens per request,
+    oracle tokens). Callers assert on metrics inside the cluster."""
+    ray_tpu.init(num_cpus=4, _system_config=system_config)
+    try:
+        from ray_tpu import serve
+        from ray_tpu.llm import build_llm_deployment
+
+        app = build_llm_deployment(
+            "tiny", name="llm_kv", pools={"prefill": 1, "decode": 1},
+            engine_config=dict(_ECFG))
+        handle = serve.run(app)
+        completions = handle.options(method_name="completions")
+
+        prompt = list(range(1, 40))
+        want = _oracle_tokens(tiny_params, prompt, 8)
+        payload = {"prompt_ids": prompt, "temperature": 0.0,
+                   "max_tokens": 8}
+        outs = []
+        for _ in range(n_requests):
+            out = ray_tpu.get(completions.remote(dict(payload)),
+                              timeout=300)
+            outs.append(out["choices"][0]["token_ids"])
+        return outs, want
+    finally:
+        from ray_tpu import serve as _serve
+
+        try:
+            _serve.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        ray_tpu.shutdown()
+
+
+def test_pooled_serving_matches_monolithic_oracle(tiny_params):
+    outs, want = _run_pooled(tiny_params, {})
+    assert all(got == want for got in outs), (outs, want)
+
+
+def test_handoff_fault_is_retried_and_attributed(tiny_params, monkeypatch):
+    """Decode-replica failure mid-handoff (injected at the
+    serve.kv_handoff failpoint — armed via env so replica WORKERS
+    inherit it at spawn; config is per-process) surfaces as ONE
+    attributed retry that succeeds — same tokens, retries counter
+    moves, request never hangs."""
+    monkeypatch.setenv("RAY_TPU_FAILPOINTS",
+                       "serve.kv_handoff=raise:0:1")
+    ray_tpu.init(num_cpus=4)
+    try:
+        from ray_tpu import serve
+        from ray_tpu.llm import build_llm_deployment
+
+        app = build_llm_deployment(
+            "tiny", name="llm_kv", pools={"prefill": 1, "decode": 1},
+            engine_config=dict(_ECFG))
+        handle = serve.run(app)
+        completions = handle.options(method_name="completions")
+        prompt = list(range(1, 40))
+        want = _oracle_tokens(tiny_params, prompt, 8)
+        out = ray_tpu.get(completions.remote(
+            {"prompt_ids": prompt, "temperature": 0.0, "max_tokens": 8}),
+            timeout=300)
+        assert out["choices"][0]["token_ids"] == want
+        assert _wait_metric("serve_kv_handoff_retries_total") >= 1
+    finally:
+        from ray_tpu import serve as _serve
+
+        try:
+            _serve.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        ray_tpu.shutdown()
+
+
+def test_handoff_exhaustion_raises_attributed_error(tiny_params,
+                                                    monkeypatch):
+    """With the decode pool persistently failing, the prefill replica
+    gives up after its bounded retries with an error naming the request
+    and deployment — a fault, never a hang."""
+    monkeypatch.setenv("RAY_TPU_FAILPOINTS", "serve.kv_handoff=raise")
+    ray_tpu.init(num_cpus=4)
+    try:
+        from ray_tpu import serve
+        from ray_tpu.llm import build_llm_deployment
+
+        app = build_llm_deployment(
+            "tiny", name="llm_kv", pools={"prefill": 1, "decode": 1},
+            engine_config=dict(_ECFG))
+        handle = serve.run(app)
+        completions = handle.options(method_name="completions")
+        with pytest.raises(Exception, match="failed after 3 attempts"):
+            ray_tpu.get(completions.remote(
+                {"prompt_ids": list(range(1, 40)), "temperature": 0.0,
+                 "max_tokens": 8}), timeout=120)
+    finally:
+        from ray_tpu import serve as _serve
+
+        try:
+            _serve.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------- prefix-aware hedge budget
+
+def test_prefix_routed_hedges_stay_under_budget():
+    """With prefix routing steering requests at a slow replica, hedges
+    still fire at the next-best match and the launch count respects the
+    hard serve_hedge_budget cap."""
+    ray_tpu.init(num_cpus=4, _system_config={
+        "serve_hedge_quantile": 0.5,
+        "serve_hedge_budget": 0.5,
+        "serve_hedge_min_samples": 8,
+        # keep the seeded summary table authoritative for the test
+        "serve_prefix_summary_interval_s": 60.0,
+    })
+    try:
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=2)
+        class Slow:
+            def __call__(self, payload):
+                time.sleep(0.8)
+                return sum(payload["prompt_ids"])
+
+        handle = serve.run(Slow.bind())
+        handle._refresh(force=True)
+        aids = [r._actor_id for r in handle._replicas]
+        assert len(aids) == 2
+        # seed the router: first replica holds the whole shared prefix,
+        # second a shorter match (the hedge target, ranked next)
+        handle._summaries = {
+            aids[0]: _summary_for(_SHARED, 8),
+            aids[1]: _summary_for(_SHARED, 4),
+        }
+        handle._summaries_t = time.monotonic()
+        handle._latencies.extend([0.05] * 16)
+
+        hits0 = _counter_val("serve_prefix_route_hits",
+                             deployment="Slow", reason="hit")
+        launched0 = snapshot_local("serve_hedges_launched").get(
+            "serve_hedges_launched", 0.0)
+        payload = {"prompt_ids": list(_SHARED)}
+        refs = [handle.remote(dict(payload)) for _ in range(10)]
+        outs = ray_tpu.get(refs, timeout=60)
+        assert outs == [sum(_SHARED)] * 10
+
+        # every request routed on the prefix (the slow replica), and at
+        # least one hedge fired off it without busting the budget
+        assert _counter_val("serve_prefix_route_hits",
+                            deployment="Slow", reason="hit") > hits0
+        launched = snapshot_local("serve_hedges_launched").get(
+            "serve_hedges_launched", 0.0) - launched0
+        assert launched >= 1, "no hedge fired despite 0.8s replicas"
+        assert launched <= 0.5 * handle._requests_total + 1
+    finally:
+        from ray_tpu import serve as _serve
+
+        try:
+            _serve.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        ray_tpu.shutdown()
