@@ -20,6 +20,9 @@ Families:
   * serve_prefix — fleet KV plane: prefix-affinity routing TTFT
                 (off/on, cold/warm) + disaggregated prefill/decode
                 handoff overhead and TPOT isolation
+  * slo       — SLO observability plane: open-loop multi-tenant loadgen
+                attainment + time-to-fast-burn-alert under an injected
+                slow replica
 
 Run:  python bench_envelope.py [family ...] [--quick]
 """
@@ -1029,6 +1032,117 @@ def bench_serve_prefix(results):
         isolation_gain_x=mono_x / max(1e-9, pooled_x)))
 
 
+# ------------------------------------------------------------------ slo
+def bench_slo(results):
+    """SLO observability plane envelope (ray_tpu/slo.py + scripts/
+    loadgen.py): open-loop multi-tenant load against a healthy toy
+    deployment records per-tenant SLO attainment; then the same load
+    against a failpoint-degraded deployment must trip the fast
+    burn-rate alert as an ERROR cluster event, and the time-to-alert is
+    the recorded number."""
+    import ray_tpu as ray
+    from ray_tpu import serve
+    from ray_tpu.scripts.loadgen import TenantProfile, run_loadgen
+    from ray_tpu.util import state
+
+    duration = 6.0 if QUICK else 12.0
+    slow_s = 0.6
+    # failpoints ride the env var, not _system_config: replica actors run
+    # in worker processes that read RAY_TPU_FAILPOINTS at spawn (same
+    # idiom as bench_tail) — the driver-side config override never
+    # reaches them. Scoped to the degraded deployment ONLY: every
+    # SloSlow request eats the straggle, healthy SloUnit is untouched.
+    os.environ["RAY_TPU_FAILPOINTS"] = (
+        f"serve.replica.handle@SloSlow=slow:{slow_s}")
+    ray.init(num_cpus=4, _system_config={
+        # tight ticks so attainment/burn react within the bench window
+        "metrics_report_interval_ms": 500,
+        "slo_eval_interval_s": 0.5,
+        "metrics_series_min_interval_s": 0.4,
+        "slo_fast_burn_windows_s": "3,6",
+        "slo_slow_burn_windows_s": "6,12",
+    })
+    try:
+        @serve.deployment(num_replicas=2)
+        class SloUnit:
+            def __call__(self, payload):
+                time.sleep(0.005)
+                return {"ok": True}
+
+        @serve.deployment
+        class SloSlow:
+            def __call__(self, payload):
+                return {"ok": True}
+
+        serve.run(SloUnit.bind())
+        serve.run(SloSlow.bind())
+        port = serve.start()
+        url = f"http://127.0.0.1:{port}"
+
+        # phase 1 — healthy: per-tenant attainment should hold
+        report = run_loadgen(
+            url, "SloUnit",
+            [TenantProfile("acme", 8.0, prompt_mu=3.0),
+             TenantProfile("free", 4.0, prompt_mu=3.0)],
+            duration, seed=0, settle_s=2.0,
+            slo_specs=[
+                "acme-latency: latency_p95 < 300ms "
+                "@ deployment=SloUnit,tenant=acme window=20s",
+                "free-latency: latency_p95 < 300ms "
+                "@ deployment=SloUnit,tenant=free window=20s",
+                "slow-latency: latency_p99 < 200ms "
+                "@ deployment=SloSlow window=20s",
+            ])
+        by_tenant = {
+            t: {"requests": r["requests"], "errors": r["errors"],
+                "p95_ms": (r["latency_s"]["p95"] or 0) * 1e3}
+            for t, r in report["tenants"].items()}
+        att = {s["name"]: s["attainment"]
+               for s in (report["slo"] or {}).get("specs", [])}
+        # the monitor needs two flushed samples of a series before a
+        # windowed delta exists; if the report raced the first tick,
+        # re-poll — the 20s spec window keeps attainment live well past
+        # the end of traffic
+        deadline = time.time() + 10.0
+        while att.get("acme-latency") is None and time.time() < deadline:
+            time.sleep(0.5)
+            att = {s["name"]: s["attainment"]
+                   for s in state.slo_status().get("specs", [])}
+        assert att.get("acme-latency") is not None, \
+            f"no per-tenant attainment recorded: {att}"
+
+        # phase 2 — degraded: every SloSlow request eats slow_s, so the
+        # p99<200ms budget burns at ~100x and the fast alert must fire
+        t_inject = time.time()
+        run_loadgen(
+            url, "SloSlow", [TenantProfile("acme", 6.0, prompt_mu=3.0)],
+            duration, seed=1, settle_s=3.0)
+        alerts = [e for e in state.list_cluster_events(source="slo")
+                  if e.get("kind") == "fast_burn"
+                  and (e.get("timestamp") or 0) >= t_inject]
+        assert alerts, "fast-burn alert never fired under injected slow"
+        time_to_alert = alerts[0]["timestamp"] - t_inject
+        status = state.slo_status()
+        slow_spec = next(s for s in status["specs"]
+                         if s["name"] == "slow-latency")
+        results.append(emit(
+            "envelope_slo", duration_s=duration,
+            tenants=by_tenant,
+            attainment={k: (round(v, 5) if v is not None else None)
+                        for k, v in att.items()},
+            injected_slow_s=slow_s,
+            fast_burn_fired=True,
+            time_to_alert_s=round(time_to_alert, 2),
+            degraded_attainment=slow_spec.get("attainment"),
+            degraded_alert=slow_spec.get("alert")))
+    finally:
+        os.environ.pop("RAY_TPU_FAILPOINTS", None)
+        try:
+            serve.shutdown()
+        finally:
+            ray.shutdown()
+
+
 # in-session families in dict order = default run order: "actors" LAST
 # among them so its creations contend with the task-event backlog the
 # earlier families leave (the regime the r4 bench dodged)
@@ -1046,6 +1160,7 @@ ALL = {
     "shuffle": bench_shuffle,
     "tail": bench_tail,
     "serve_prefix": bench_serve_prefix,
+    "slo": bench_slo,
 }
 
 # families that run inside a ray.init'd single-node session; "actors"

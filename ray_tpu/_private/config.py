@@ -232,6 +232,38 @@ class Config:
     # --- logging / metrics ---
     event_log_enabled: bool = True
     metrics_report_interval_ms: int = 2000
+    # --- SLO observability plane (ray_tpu/slo.py; GCS-side series
+    #     retention + burn-rate monitor) ---
+    # keep per-series ring buffers of the aggregated metrics table,
+    # sampled on the GCS evaluation tick (the in-memory-TSDB layer the
+    # SLO monitor and dashboard sparklines read). Off = last-value-only
+    # metrics table, SLO engine inert.
+    metrics_series_enabled: bool = True
+    # ring length per series; retention ~= max_samples x min_interval
+    metrics_series_max_samples: int = 256
+    # downsampling floor: appends closer together than this are dropped
+    metrics_series_min_interval_s: float = 2.0
+    # total series bound, FIFO-evicted (tenant tags multiply cardinality)
+    metrics_series_max_series: int = 4000
+    # GCS sampling + SLO evaluation tick; 0 disables the loop entirely
+    slo_eval_interval_s: float = 2.0
+    # declarative SLO specs, each "name: indicator op value [@ k=v,...]
+    # [window=60s]" — e.g. "chat-ttft: ttft_p99 < 250ms @ tenant=acme",
+    # "chat-avail: availability >= 99.9% @ deployment=Chat". Also
+    # settable at runtime via state.set_slo_specs / the loadgen.
+    slo_specs: list = field(default_factory=list)
+    # multi-window burn-rate alerting (SRE Workbook ch.5): an alert
+    # fires when the error-budget burn rate exceeds the threshold over
+    # BOTH windows of a pair ("short,long" seconds). Fast pair emits
+    # ERROR events, slow pair WARNING. Defaults are the Workbook's
+    # 5m/1h + 30m/6h shape scaled to this cluster's 2 s ticks.
+    slo_fast_burn_windows_s: str = "30,300"
+    slo_fast_burn_threshold: float = 14.4
+    slo_slow_burn_windows_s: str = "120,600"
+    slo_slow_burn_threshold: float = 6.0
+    # tenant id assumed for requests arriving without an X-Tenant-ID
+    # header (per-tenant accounting; serve/proxy.py)
+    serve_default_tenant: str = "default"
     # raylet clock-sync period against the GCS clock (NTP-style offset
     # piggybacked on ping; raylet.py _clock_sync_loop). 0 disables —
     # timelines then merge raw per-node wall clocks.

@@ -233,6 +233,13 @@ class CoreWorker:
 
         self._lane_events: Dict[ObjectID, threading.Event] = {}
         self._actor_lanes: Dict[ActorID, Any] = {}
+        # serializes lane CREATION only (submission is lock-free):
+        # constructing an ActorLane has side effects (spawns _attach,
+        # registers shm rings named by (actor, worker, pid)) — two
+        # threads racing the first call to an actor must not construct
+        # two lanes whose identically-named rings clobber each other
+        self._actor_lane_create_lock = locking.make_lock(
+            "CoreWorker._actor_lane_create_lock")
         self._actor_lane_blocked: set = set()
         if lanes_enabled():
             # more lanes than cores just adds context-switch thrash: each
@@ -2274,8 +2281,17 @@ class CoreWorker:
                 return False
             from .fastlane import ActorLane
 
-            lane = self._actor_lanes.setdefault(
-                spec.actor_id, ActorLane(self, spec.actor_id))
+            # double-checked under the create lock: ActorLane() is
+            # side-effecting (attach coroutine + shm rings keyed by
+            # (actor, worker, pid)), so a lost setdefault race would
+            # leave an orphan lane attached to the SAME rings as the
+            # winner — its reply thread then steals replies it has no
+            # pending entry for, and the caller's get() times out
+            with self._actor_lane_create_lock:
+                lane = self._actor_lanes.get(spec.actor_id)
+                if lane is None:
+                    lane = self._actor_lanes[spec.actor_id] = ActorLane(
+                        self, spec.actor_id)
         event = threading.Event()
         for oid in return_ids:
             self._lane_events[oid] = event

@@ -148,6 +148,13 @@ class GcsServer:
         # host key (node hex, or reported host name) -> skew aggregates
         self.straggler_stats: Dict[str, dict] = {}
         self._collective_watchdog_task: Optional[asyncio.Task] = None
+        # SLO observability plane (ray_tpu/slo.py): ring-buffered time
+        # series of the aggregated metrics view + burn-rate monitor,
+        # both fed by _slo_loop on the evaluation tick. Built lazily in
+        # start() so config overrides applied at init are honored.
+        self.series_store = None
+        self.slo_monitor = None
+        self._slo_task: Optional[asyncio.Task] = None
         self._next_job = 1
         if self._remote_store is None:
             self._restore_tables()
@@ -198,6 +205,23 @@ class GcsServer:
         if global_config().collective_watchdog_interval_s > 0:
             self._collective_watchdog_task = asyncio.ensure_future(
                 self._collective_watchdog_loop())
+        cfg = global_config()
+        if cfg.metrics_series_enabled and cfg.slo_eval_interval_s > 0:
+            from ..slo import (SeriesStore, SloMonitor, default_policies,
+                               parse_specs)
+
+            self.series_store = SeriesStore(
+                max_samples=cfg.metrics_series_max_samples,
+                min_interval_s=cfg.metrics_series_min_interval_s,
+                max_series=cfg.metrics_series_max_series)
+            try:
+                specs = parse_specs(cfg.slo_specs)
+            except Exception as e:
+                specs = []
+                self._event("slo", "ERROR",
+                            f"invalid slo_specs config, monitor empty: {e}")
+            self.slo_monitor = SloMonitor(specs, default_policies(cfg))
+            self._slo_task = asyncio.ensure_future(self._slo_loop())
         # restored placement groups that never finished reserving resume
         # scheduling now that the loop is live (restart recovery)
         for pg in self.placement_groups.values():
@@ -309,6 +333,8 @@ class GcsServer:
             self._node_health_task.cancel()
         if self._collective_watchdog_task is not None:
             self._collective_watchdog_task.cancel()
+        if self._slo_task is not None:
+            self._slo_task.cancel()
         for client in self._pg_raylet_clients.values():
             try:
                 await client.close()
@@ -1391,11 +1417,10 @@ class GcsServer:
             }
         return True
 
-    async def handle_get_metrics(self, payload, conn):
+    def _aggregate_metrics(self, name_filter=None) -> List[dict]:
         """Aggregated across workers: counters/histogram buckets sum,
         gauges report per-worker last values summed (the common scrape
         semantic for distributed gauges of additive quantities)."""
-        name_filter = payload.get("name")
         out: Dict[tuple, dict] = {}
         for (name, tags, _worker), entry in self.metrics.items():
             if name_filter and name != name_filter:
@@ -1407,6 +1432,81 @@ class GcsServer:
                 out[agg_key] = dict(entry)
                 out[agg_key].pop("worker_id", None)
         return list(out.values())
+
+    async def handle_get_metrics(self, payload, conn):
+        return self._aggregate_metrics(payload.get("name"))
+
+    # ---- SLO observability plane (ray_tpu/slo.py; ROADMAP item 4's
+    #      sensing layer: series retention -> quantiles -> burn alerts) ----
+    async def _slo_loop(self):
+        """Each tick: snapshot the aggregated metrics view into the
+        per-series ring buffers, then evaluate every SLO spec against
+        the fresh series (attainment + multi-window burn rates). Alert
+        transitions land in the cluster-event log through _event, so
+        `cli.py events`/`cli.py slo` and the dashboard see them with no
+        extra plumbing."""
+        from .config import global_config
+
+        period = max(0.25, global_config().slo_eval_interval_s)
+        last_err = None
+        while True:
+            await asyncio.sleep(period)
+            try:
+                now = time.time()
+                self.series_store.sample(self._aggregate_metrics(), now)
+                self.slo_monitor.tick(
+                    self.series_store, now,
+                    emit=lambda severity, message, **fields:
+                        self._event("slo", severity, message, **fields))
+                last_err = None
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # surface once per distinct failure, not once per tick —
+                # a persistent bug must not flood the event deque
+                msg = f"{type(e).__name__}: {e}"
+                if msg != last_err:
+                    last_err = msg
+                    self._event("slo", "ERROR",
+                                f"SLO evaluation tick failed: {msg}")
+
+    async def handle_get_metric_series(self, payload, conn):
+        """Ring-buffered samples for one metric (dashboard sparklines,
+        loadgen reports). Selector is a tag-subset match."""
+        if self.series_store is None:
+            return []
+        return self.series_store.query(
+            payload["name"], payload.get("selector") or {})
+
+    async def handle_slo_status(self, payload, conn):
+        """Per-spec attainment/burn/alert records + the policy windows
+        (so clients can render thresholds without re-reading config)."""
+        if self.slo_monitor is None:
+            return {"enabled": False, "specs": []}
+        return {
+            "enabled": True,
+            "specs": self.slo_monitor.status(),
+            "policies": [
+                {"kind": p.kind, "severity": p.severity,
+                 "short_window_s": p.short_window_s,
+                 "long_window_s": p.long_window_s,
+                 "threshold": p.threshold}
+                for p in self.slo_monitor.policies],
+        }
+
+    async def handle_set_slo_specs(self, payload, conn):
+        """Install/replace SLO specs at runtime (loadgen and tests use
+        this; config slo_specs seeds the initial set). Malformed specs
+        reject the whole batch — never half-install."""
+        if self.slo_monitor is None:
+            raise RuntimeError(
+                "SLO monitor disabled (metrics_series_enabled=False or "
+                "slo_eval_interval_s=0)")
+        from ..slo import parse_specs
+
+        specs = parse_specs(payload.get("specs") or [])
+        self.slo_monitor.set_specs(specs)
+        return [s.describe() for s in specs]
 
     # ---- task events (ref: gcs_task_manager.h — the state API backend) ----
     _TERMINAL_STATES = ("FINISHED", "FAILED")

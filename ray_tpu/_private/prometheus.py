@@ -114,23 +114,34 @@ def render(entries: Iterable[Dict[str, Any]]) -> str:
                     bound = float("inf")
                 return (series, bound)
 
+            # _sum/_count/stray lines sort by series labels too (buckets
+            # already do): scrapes are diffable regardless of table
+            # insertion order
+            def _series_key(pair):
+                tags, _ = pair
+                return sorted(tags.items())
+
             for tags, value in sorted(buckets, key=_le_key):
                 lines.append(f"{pname}_bucket{_fmt_labels(tags)} "
                              f"{_fmt_value(value)}")
-            for tags, value in sums:
+            for tags, value in sorted(sums, key=_series_key):
                 lines.append(f"{pname}_sum{_fmt_labels(tags)} "
                              f"{_fmt_value(value)}")
-            for tags, value in counts:
+            for tags, value in sorted(counts, key=_series_key):
                 lines.append(f"{pname}_count{_fmt_labels(tags)} "
                              f"{_fmt_value(value)}")
-            for tags, value in strays:  # emit as untyped samples
+            # stray samples emit as untyped
+            for tags, value in sorted(strays, key=_series_key):
                 lines.append(f"{pname}{_fmt_labels(tags)} "
                              f"{_fmt_value(value)}")
         else:
-            for e in items:
-                tags = dict(e.get("tags") or {})
+            # counters/gauges: same deterministic series order
+            plain = [(dict(e.get("tags") or {}), e["value"])
+                     for e in items]
+            for tags, value in sorted(
+                    plain, key=lambda p: sorted(p[0].items())):
                 lines.append(f"{pname}{_fmt_labels(tags)} "
-                             f"{_fmt_value(e['value'])}")
+                             f"{_fmt_value(value)}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

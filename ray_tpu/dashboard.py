@@ -7,7 +7,7 @@ the same tables from the JSON API, no build step, no assets).
     GET /                  — HTML UI (auto-refreshing tables)
     GET /api/nodes /api/actors /api/tasks /api/objects /api/jobs
         /api/cluster_status /api/metrics /api/health /api/stacks
-        /api/serve
+        /api/serve /api/slo
     GET /metrics           — Prometheus text scrape endpoint
                              (ref: _private/prometheus_exporter.py)
 """
@@ -53,6 +53,7 @@ _UI_HTML = """<!doctype html>
  <section><h2>Nodes</h2><div id="nodes"></div></section>
  <section><h2>Actors</h2><div id="actors"></div></section>
  <section><h2>Serve</h2><div id="serve"></div></section>
+ <section><h2>SLO</h2><div id="slo"></div></section>
  <section><h2>Jobs</h2><div id="jobs"></div></section>
  <section><h2>Task summary</h2><div id="tasks"></div></section>
  <section><h2>Events</h2><div id="events"></div></section>
@@ -156,6 +157,39 @@ async function refreshServe(){try{
    ['metric','value','tags']);
  document.getElementById('serve').innerHTML=html;
 }catch(e){}}
+async function refreshSlo(){try{
+ const s=await j('/api/slo');
+ if(!s.enabled){document.getElementById('slo').innerHTML=
+  '<i>slo monitor disabled</i>';return;}
+ const specs=s.specs||[];
+ // attainment history renders as a unicode sparkline per spec
+ const bars='▁▂▃▄▅▆▇█';
+ const spark=h=>{const v=(h||[]).map(x=>x.attainment).filter(x=>x!=null);
+  if(!v.length)return'';const lo=Math.min(...v),hi=Math.max(...v);
+  return v.slice(-40).map(x=>bars[hi>lo?
+   Math.round((x-lo)/(hi-lo)*(bars.length-1)):bars.length-1]).join('');};
+ let html=specs.length?table(specs.map(x=>({
+  slo:x.spec,
+  alert:{__html:x.alert==='ok'?'<span class="pill ok">ok</span>'
+   :'<span class="pill bad">'+esc(x.alert)+'</span>'},
+  attainment:x.attainment==null?'-':(x.attainment*100).toFixed(3)+'%',
+  objective:(x.objective*100)+'%',
+  achieved:x.achieved==null?'':(x.achieved*1000).toFixed(1)+'ms',
+  events:x.total||0,
+  burn:Object.entries(x.burns||{}).map(([k,v])=>
+   k+' '+v.short+'x/'+v.long+'x').join(' '),
+  history:spark(x.history)})),
+  ['slo','alert','attainment','objective','achieved','events','burn',
+   'history'])
+  :'<i>no slo specs installed</i>';
+ const ev=s.events||[];
+ if(ev.length)html+='<div style="margin-top:8px">burn-rate alerts</div>'
+  +table(ev.slice().reverse().slice(0,10).map(e=>({
+   time:new Date(e.timestamp*1000).toLocaleTimeString(),
+   severity:e.severity,message:e.message})),
+   ['time','severity','message']);
+ document.getElementById('slo').innerHTML=html;
+}catch(e){}}
 async function refreshTimeline(){try{
  const s=await j('/api/summary');
  const ph=s.phases||{};
@@ -201,9 +235,10 @@ async function tailLog(){
   +'&file='+encodeURIComponent(f)+'&lines=200');
  document.getElementById('logview').textContent=await r.text();}
 refresh();refreshTimeline();refreshLogs();refreshHealth();refreshServe();
+refreshSlo();
 setInterval(refresh,5000);setInterval(refreshTimeline,10000);
 setInterval(refreshLogs,15000);setInterval(refreshHealth,5000);
-setInterval(refreshServe,5000);
+setInterval(refreshServe,5000);setInterval(refreshSlo,5000);
 </script></body></html>
 """
 
@@ -298,6 +333,24 @@ def _routes():
             rows = []
         return _json({"deployments": deployments, "routing": rows})
 
+    async def api_slo(_req):
+        """SLO plane: per-spec attainment/burn/alert records (with the
+        attainment history ring) + recent burn-rate alert events."""
+        status = {}
+        try:
+            status = state_api.slo_status()
+        except Exception:  # noqa: BLE001 — SLO plane is optional
+            status = {"enabled": False, "specs": []}
+        events, events_error = [], None
+        try:
+            events = state_api.list_cluster_events(source="slo", limit=50)
+        except Exception as e:  # noqa: BLE001 — degrade panel, keep page
+            events_error = repr(e)
+        payload = {**status, "events": events}
+        if events_error is not None:
+            payload["events_error"] = events_error
+        return _json(payload)
+
     async def api_stacks(req):
         node = req.query.get("node_id") or None
         return _json(state_api.dump_stacks(node_id=node))
@@ -338,6 +391,7 @@ def _routes():
     app.router.add_get("/api/summary", api_summary)
     app.router.add_get("/api/health", api_health)
     app.router.add_get("/api/serve", api_serve)
+    app.router.add_get("/api/slo", api_slo)
     app.router.add_get("/api/stacks", api_stacks)
     app.router.add_get("/api/logs", api_logs)
     app.router.add_get("/api/logs/tail", api_log_tail)

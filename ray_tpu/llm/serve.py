@@ -90,6 +90,10 @@ class LLMServer:
             self.tokenizer = AutoTokenizer.from_pretrained(tokenizer)
         self._queues: Dict[str, asyncio.Queue] = {}
         self._pump_task: Optional[asyncio.Task] = None
+        # per-tenant accounting: request id -> tenant, stashed at submit
+        # (the serve tenant contextvar is gone by the time the pump
+        # thread observes the finished request) and popped on finish
+        self._tenants: Dict[str, str] = {}
         # fleet KV plane (disaggregated serving): pool role, set by the
         # replica's configure_pool hook before any request lands.
         # "mono" = classic all-in-one replica; "prefill" runs prompt
@@ -118,15 +122,15 @@ class LLMServer:
         self._m_ttft = metrics.Histogram(
             "llm_ttft_seconds", "Time to first token per request",
             boundaries=metrics.LATENCY_BUCKETS,
-            tag_keys=("model", "pool")).set_default_tags(tags)
+            tag_keys=("model", "pool", "tenant")).set_default_tags(tags)
         self._m_tpot = metrics.Histogram(
             "llm_tpot_seconds", "Time per output token (decode) "
             "per request", boundaries=metrics.LATENCY_BUCKETS,
-            tag_keys=("model", "pool")).set_default_tags(tags)
+            tag_keys=("model", "pool", "tenant")).set_default_tags(tags)
         self._m_e2e = metrics.Histogram(
             "llm_request_e2e_seconds", "Arrival-to-finish request latency",
             boundaries=metrics.LATENCY_BUCKETS,
-            tag_keys=("model", "pool")).set_default_tags(tags)
+            tag_keys=("model", "pool", "tenant")).set_default_tags(tags)
         self._m_queue = metrics.Gauge(
             "llm_queue_depth", "Requests waiting for a decode slot",
             tag_keys=("model", "pool")).set_default_tags(tags)
@@ -140,13 +144,13 @@ class LLMServer:
         self._m_cache_hit = metrics.Counter(
             "llm_prefix_cache_hit_tokens_total",
             "Prompt tokens served from the prefix cache",
-            tag_keys=("model", "pool")).set_default_tags(tags)
+            tag_keys=("model", "pool", "tenant")).set_default_tags(tags)
         self._m_prompt = metrics.Counter(
             "llm_prompt_tokens_total", "Prompt tokens received",
-            tag_keys=("model", "pool")).set_default_tags(tags)
+            tag_keys=("model", "pool", "tenant")).set_default_tags(tags)
         self._m_generated = metrics.Counter(
             "llm_generation_tokens_total", "Tokens generated",
-            tag_keys=("model", "pool")).set_default_tags(tags)
+            tag_keys=("model", "pool", "tenant")).set_default_tags(tags)
 
     # --- serve replica hooks (fleet KV plane) ---
 
@@ -260,7 +264,13 @@ class LLMServer:
         """Fold one finished request into the latency histograms.
         Timestamps are engine-side perf_counter marks (RequestState
         arrival_t / first_token_t), so TTFT includes queueing."""
-        tags = ({"model": state.model_id} if state.model_id else None)
+        tags = {}
+        if state.model_id:
+            tags["model"] = state.model_id
+        tenant = self._tenants.pop(state.request_id, None)
+        if tenant:
+            tags["tenant"] = tenant
+        tags = tags or None
         n_out = len(state.output)
         if state.first_token_t:
             self._m_ttft.observe(state.first_token_t - state.arrival_t,
@@ -278,7 +288,7 @@ class LLMServer:
     async def _submit(self, prompt_ids: List[int],
                       params: SamplingParams,
                       model_id: Optional[str] = None):
-        from ..serve.replica import current_request_id
+        from ..serve.replica import current_request_id, current_tenant_id
 
         rid_in = current_request_id()
         if rid_in and (rid_in in self._queues
@@ -287,6 +297,9 @@ class LLMServer:
         rid = self.engine.add_request(prompt_ids, params,
                                       request_id=rid_in,
                                       model_id=model_id)
+        tenant = current_tenant_id()
+        if tenant:
+            self._tenants[rid] = tenant
         q: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = q
         self._ensure_pump()
@@ -463,9 +476,13 @@ class LLMServer:
             try:
                 await failpoints.afire("serve.kv_handoff",
                                        detail=self._dep_name or "")
+                from ..serve.replica import current_tenant_id
+
+                tenant = current_tenant_id()
                 ref, replica = await loop.run_in_executor(
                     None, lambda: self._decode_handle.route(
-                        decode_payload, request_id=rid))
+                        decode_payload, request_id=rid,
+                        tenant_id=tenant))
                 result = await ref
                 break
             except asyncio.CancelledError:
@@ -555,6 +572,11 @@ class LLMServer:
                                                   request_id=rid_in)
 
         rid = await loop.run_in_executor(None, _inject)
+        from ..serve.replica import current_tenant_id
+
+        tenant = current_tenant_id()
+        if tenant:
+            self._tenants[rid] = tenant
         pre = [int(t) for t in meta.get("output") or ()]
         state = self.engine.requests.get(rid)
         if state is not None and state.finished:

@@ -366,6 +366,60 @@ def cmd_health(args) -> int:
     return rc
 
 
+def cmd_slo(args) -> int:
+    """SLO plane view: per-spec attainment, burn rates, alert state and
+    recent burn-rate alert events. rc=1 when any alert is firing."""
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(address=_resolve_address(args))
+    status = state_api.slo_status()
+    rc = 0
+    if not status.get("enabled"):
+        print("SLO monitor disabled (metrics_series_enabled=False or "
+              "slo_eval_interval_s=0)")
+        ray_tpu.shutdown()
+        return 0
+    if args.json:
+        print(json.dumps(status, default=str))
+        ray_tpu.shutdown()
+        return 1 if any(s.get("alert") != "ok"
+                        for s in status.get("specs", [])) else 0
+    specs = status.get("specs", [])
+    print(f"SLO specs: {len(specs)}")
+    for s in specs:
+        att = s.get("attainment")
+        att_s = "-" if att is None else f"{att * 100:.3f}%"
+        ach = s.get("achieved")
+        ach_s = "" if ach is None else f"  achieved {ach * 1000:.1f}ms"
+        alert = s.get("alert", "ok")
+        if alert != "ok":
+            rc = 1
+        burns = s.get("burns") or {}
+        burn_s = " ".join(
+            f"{k}={v.get('short', 0):g}x/{v.get('long', 0):g}x"
+            for k, v in sorted(burns.items()))
+        mark = {"ok": " ", "slow_burn": "!", "fast_burn": "!!"}.get(
+            alert, "?")
+        print(f"  [{mark:2s}] {s.get('spec')}")
+        print(f"       attainment {att_s} (objective "
+              f"{s.get('objective', 0) * 100:g}%){ach_s}  "
+              f"events {s.get('total', 0):g}  alert {alert}  {burn_s}")
+        if args.history:
+            for h in s.get("history", [])[-args.history:]:
+                h_att = h.get("attainment")
+                h_s = "-" if h_att is None else f"{h_att * 100:.2f}%"
+                print(f"       t={h.get('t', 0):.1f} attainment {h_s} "
+                      f"alert {h.get('alert')}")
+    events = state_api.list_cluster_events(source="slo",
+                                           limit=args.events)
+    print(f"recent slo events: {len(events)}")
+    for e in events:
+        print(f"  [{e.get('severity')}] {e.get('message')}")
+    ray_tpu.shutdown()
+    return rc
+
+
 def cmd_stacks(args) -> int:
     """Live Python stacks of every worker in the cluster (or one node
     with --node), annotated with running task ids and time-in-state —
@@ -609,6 +663,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--events", type=int, default=20,
                     help="recent stall_sentinel events to show")
     sp.set_defaults(fn=cmd_health)
+
+    sp = sub.add_parser("slo",
+                        help="SLO plane: per-spec attainment, burn "
+                             "rates, alert state + recent slo events")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--json", action="store_true",
+                    help="dump the raw slo_status payload")
+    sp.add_argument("--history", type=int, default=0,
+                    help="show the last N attainment samples per spec")
+    sp.add_argument("--events", type=int, default=20,
+                    help="recent slo events to show")
+    sp.set_defaults(fn=cmd_slo)
 
     sp = sub.add_parser("stacks",
                         help="live Python stacks of every worker "

@@ -1,0 +1,324 @@
+"""Traffic-replay load harness for the SLO observability plane.
+
+Open-loop load generation against a serve HTTP proxy: arrivals are
+Poisson per tenant (exponential inter-arrival gaps, fired WITHOUT
+waiting for responses — a slow server faces a growing backlog exactly
+like production traffic, the closed-loop self-throttling artifact the
+tail-latency literature warns benchmarks about), prompt/output lengths
+are heavy-tailed lognormal, and every request carries its tenant's
+``X-Tenant-ID`` so cluster-side metrics partition per tenant.
+
+After the run the harness reads the cluster's SLO plane (util/state
+``slo_status`` + ``slo`` cluster events) and writes a JSON report with
+client-side latency percentiles per tenant, per-spec SLO attainment,
+and the burn-rate alert timeline that fired inside the run window.
+
+Importable (``run_loadgen`` — bench_envelope and obs_smoke drive it
+in-process against an initialized cluster) and a standalone CLI::
+
+    python -m ray_tpu.scripts.loadgen --url http://127.0.0.1:8123 \\
+        --deployment Echo --tenant acme:8 --tenant free:4 \\
+        --duration 30 --report /tmp/slo_report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class TenantProfile:
+    """One tenant's offered load: open-loop Poisson arrivals at
+    ``rate_rps``, lognormal prompt/output token lengths (mu/sigma are
+    the underlying normal's parameters — sigma ~1 gives the heavy tail
+    real prompt-length distributions show)."""
+    name: str
+    rate_rps: float
+    prompt_mu: float = 4.0        # exp(4) ~ 55 tokens median
+    prompt_sigma: float = 1.0
+    output_mu: float = 3.0        # exp(3) ~ 20 tokens median
+    output_sigma: float = 0.7
+    max_prompt: int = 4096
+    max_output: int = 512
+
+    @classmethod
+    def parse(cls, text: str) -> "TenantProfile":
+        """CLI shape ``name:rps[:prompt_mu[:prompt_sigma[:out_mu
+        [:out_sigma]]]]``."""
+        parts = text.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"tenant spec needs name:rps, got {text!r}")
+        kwargs: Dict[str, Any] = {"name": parts[0],
+                                  "rate_rps": float(parts[1])}
+        for key, raw in zip(("prompt_mu", "prompt_sigma",
+                             "output_mu", "output_sigma"), parts[2:]):
+            kwargs[key] = float(raw)
+        return cls(**kwargs)
+
+
+def echo_payload(rng: random.Random, prompt_len: int,
+                 output_len: int) -> dict:
+    """Payload for toy (non-LLM) deployments: body size tracks the
+    sampled prompt length so transfer cost scales with it."""
+    return {"prompt": "x" * prompt_len, "max_tokens": output_len}
+
+
+def llm_payload(rng: random.Random, prompt_len: int,
+                output_len: int) -> dict:
+    """OpenAI-completions-shaped payload for LLMServer deployments."""
+    return {"prompt_ids": [rng.randrange(1, 1000)
+                           for _ in range(max(1, prompt_len))],
+            "max_tokens": max(1, output_len)}
+
+
+_PAYLOADS = {"echo": echo_payload, "llm": llm_payload}
+
+
+@dataclass
+class _TenantStats:
+    requests: int = 0
+    completed: int = 0
+    errors: int = 0
+    abandoned: int = 0
+    latencies: List[float] = field(default_factory=list)
+    prompt_tokens: int = 0
+    output_tokens: int = 0
+
+
+def _pctl(samples: List[float], q: float) -> Optional[float]:
+    if not samples:
+        return None
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(q * (len(s) - 1)))]
+
+
+def _sample_len(rng: random.Random, mu: float, sigma: float,
+                cap: int) -> int:
+    return max(1, min(cap, int(rng.lognormvariate(mu, sigma))))
+
+
+async def _drive(url: str, deployment: str,
+                 tenants: List[TenantProfile], duration_s: float,
+                 payload_fn: Callable[..., dict], seed: int,
+                 drain_s: float) -> Dict[str, _TenantStats]:
+    import aiohttp
+
+    stats = {t.name: _TenantStats() for t in tenants}
+    pending: set = set()
+    endpoint = f"{url.rstrip('/')}/{deployment}"
+
+    async with aiohttp.ClientSession() as session:
+
+        async def one(tenant: TenantProfile, rng: random.Random):
+            st = stats[tenant.name]
+            p_len = _sample_len(rng, tenant.prompt_mu,
+                                tenant.prompt_sigma, tenant.max_prompt)
+            o_len = _sample_len(rng, tenant.output_mu,
+                                tenant.output_sigma, tenant.max_output)
+            st.requests += 1
+            st.prompt_tokens += p_len
+            st.output_tokens += o_len
+            t0 = time.monotonic()
+            try:
+                async with session.post(
+                        endpoint,
+                        json=payload_fn(rng, p_len, o_len),
+                        headers={"X-Tenant-ID": tenant.name,
+                                 "X-Request-ID": uuid.uuid4().hex}
+                        ) as resp:
+                    await resp.read()
+                    if resp.status != 200:
+                        st.errors += 1
+            except asyncio.CancelledError:
+                # drain-window straggler: no latency sample — it would
+                # record the cancel time, not a service time
+                st.abandoned += 1
+                raise
+            except Exception:  # noqa: BLE001 — client-side failure
+                st.errors += 1
+            st.latencies.append(time.monotonic() - t0)
+            st.completed += 1
+
+        async def tenant_loop(tenant: TenantProfile):
+            # per-tenant RNG, string-seeded (deterministic across
+            # processes, unlike hash()): arrival process and length
+            # draws are reproducible per seed regardless of response
+            # timing
+            rng = random.Random(f"{seed}:{tenant.name}")
+            deadline = time.monotonic() + duration_s
+            while time.monotonic() < deadline:
+                # open loop: fire and move on — never await the request
+                task = asyncio.ensure_future(one(tenant, rng))
+                pending.add(task)
+                task.add_done_callback(pending.discard)
+                gap = rng.expovariate(max(1e-6, tenant.rate_rps))
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                await asyncio.sleep(min(gap, remain))
+
+        await asyncio.gather(*(tenant_loop(t) for t in tenants))
+        if pending:
+            # bounded drain: in-flight requests get a grace window,
+            # stragglers beyond it count as abandoned (never a hang)
+            done, still = await asyncio.wait(
+                pending, timeout=max(1.0, drain_s))
+            for task in still:
+                task.cancel()
+            if still:
+                await asyncio.gather(*still, return_exceptions=True)
+    return stats
+
+
+def _cluster_slo_view(window: tuple) -> Dict[str, Any]:
+    """Read the SLO plane from the connected cluster: per-spec status +
+    the slo-sourced alert events that fired inside the run window.
+    Empty view when no cluster is connected (pure-HTTP runs)."""
+    try:
+        from ray_tpu.util import state
+        status = state.slo_status()
+        events = state.list_cluster_events(source="slo", limit=500)
+    except Exception:  # noqa: BLE001 — cluster view is optional
+        return {"slo": None, "alerts": []}
+    t0, t1 = window
+    alerts = [
+        {"t": e.get("timestamp"), "severity": e.get("severity"),
+         "kind": e.get("kind"), "slo": e.get("slo"),
+         "message": e.get("message")}
+        for e in events
+        if t0 - 1.0 <= (e.get("timestamp") or 0) <= t1]
+    return {"slo": status, "alerts": alerts}
+
+
+def run_loadgen(url: str, deployment: str,
+                tenants: List[TenantProfile], duration_s: float, *,
+                payload: str = "echo",
+                payload_fn: Optional[Callable[..., dict]] = None,
+                seed: int = 0,
+                slo_specs: Optional[List[str]] = None,
+                settle_s: float = 5.0,
+                drain_s: float = 15.0,
+                report_path: Optional[str] = None) -> Dict[str, Any]:
+    """Run the open-loop harness and assemble the report.
+
+    With ``slo_specs`` the specs are installed on the connected cluster
+    before traffic starts (state.set_slo_specs); ``settle_s`` lets the
+    GCS take a couple of evaluation ticks after the run so windowed
+    attainment covers the tail of the traffic."""
+    if payload_fn is None:
+        payload_fn = _PAYLOADS[payload]
+    installed = None
+    if slo_specs:
+        from ray_tpu.util import state
+        installed = state.set_slo_specs(slo_specs)
+    t0 = time.time()
+    loop = asyncio.new_event_loop()
+    try:
+        stats = loop.run_until_complete(
+            _drive(url, deployment, tenants, duration_s, payload_fn,
+                   seed, drain_s))
+    finally:
+        loop.close()
+    if settle_s > 0:
+        time.sleep(settle_s)
+    t1 = time.time()
+    view = _cluster_slo_view((t0, t1))
+    report: Dict[str, Any] = {
+        "url": url, "deployment": deployment, "seed": seed,
+        "started_t": t0, "duration_s": duration_s,
+        "installed_specs": installed,
+        "tenants": {},
+        "slo": view["slo"],
+        "alerts": view["alerts"],
+    }
+    for t in tenants:
+        st = stats[t.name]
+        lat = st.latencies
+        report["tenants"][t.name] = {
+            "offered_rps": t.rate_rps,
+            "requests": st.requests,
+            "completed": st.completed,
+            "errors": st.errors,
+            "abandoned": st.abandoned,
+            "achieved_rps": st.completed / max(1e-9, duration_s),
+            "prompt_tokens": st.prompt_tokens,
+            "output_tokens": st.output_tokens,
+            "latency_s": {
+                "p50": _pctl(lat, 0.50), "p90": _pctl(lat, 0.90),
+                "p95": _pctl(lat, 0.95), "p99": _pctl(lat, 0.99),
+                "mean": (sum(lat) / len(lat)) if lat else None,
+                "max": max(lat) if lat else None,
+            },
+        }
+    # per-tenant attainment: specs whose selector pins tenant=<name>
+    slo = view["slo"] or {}
+    per_tenant: Dict[str, list] = {}
+    for spec in slo.get("specs", []):
+        tenant = (spec.get("selector") or {}).get("tenant")
+        key = tenant if tenant else "__all__"
+        per_tenant.setdefault(key, []).append({
+            "name": spec.get("name"), "spec": spec.get("spec"),
+            "attainment": spec.get("attainment"),
+            "objective": spec.get("objective"),
+            "compliant": spec.get("compliant"),
+            "alert": spec.get("alert"),
+        })
+    report["attainment"] = per_tenant
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop multi-tenant load harness (SLO plane)")
+    ap.add_argument("--url", required=True,
+                    help="serve proxy base url, e.g. http://127.0.0.1:8123")
+    ap.add_argument("--deployment", required=True)
+    ap.add_argument("--tenant", action="append", required=True,
+                    dest="tenants", metavar="NAME:RPS[:MU[:SIGMA...]]",
+                    help="repeatable tenant profile "
+                         "(name:rps[:prompt_mu[:prompt_sigma"
+                         "[:out_mu[:out_sigma]]]])")
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--payload", choices=sorted(_PAYLOADS),
+                    default="echo")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slo", action="append", default=None,
+                    dest="slo_specs",
+                    help="repeatable SLO spec to install before the run "
+                         "(needs --address)")
+    ap.add_argument("--address", default=None,
+                    help="GCS address; connect so the report includes "
+                         "cluster-side SLO attainment + alerts")
+    ap.add_argument("--report", default=None,
+                    help="write the JSON report here (also printed)")
+    args = ap.parse_args(argv)
+    if args.address:
+        import ray_tpu
+        ray_tpu.init(address=args.address)
+    tenants = [TenantProfile.parse(t) for t in args.tenants]
+    report = run_loadgen(
+        args.url, args.deployment, tenants, args.duration,
+        payload=args.payload, seed=args.seed,
+        slo_specs=args.slo_specs, report_path=args.report)
+    print(json.dumps(report, indent=2, default=str))
+    if args.address:
+        import ray_tpu
+        ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

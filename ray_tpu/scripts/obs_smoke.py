@@ -1,9 +1,12 @@
 """Observability smoke lane (run by ci.sh): exercise the flight
 recorder end to end on a tiny live cluster — task lifecycle transitions
 in GCS, Perfetto timeline export with flow events, critical-path
-summary, the serving histograms on the Prometheus scrape — and the
-stall sentinel: an injected hang must flag, emit a WARNING event with a
-captured stack, and surface through `cli health` / `cli stacks`."""
+summary, the serving histograms on the Prometheus scrape — the stall
+sentinel: an injected hang must flag, emit a WARNING event with a
+captured stack, and surface through `cli health` / `cli stacks` — and
+the SLO plane: runtime-installed specs must show per-tenant attainment
+from live traffic, and an injected slow replica must fire the fast
+burn-rate ERROR alert within a couple of evaluation ticks."""
 
 from __future__ import annotations
 
@@ -84,11 +87,95 @@ def _stall_sentinel_smoke() -> None:
     assert "stalled tasks: 0" in health.stdout, health.stdout
 
 
+def _slo_smoke() -> None:
+    """SLO plane end to end: specs installed at runtime via
+    state.set_slo_specs, per-tenant attainment materializing from live
+    proxy traffic, then an injected slow replica (the SloSlow failpoint
+    set in the environment before ray.init) burning the 200ms p99
+    budget at ~100x — the fast burn-rate ERROR event must land within a
+    couple of evaluation ticks of the 6s long window filling. Every
+    wait here is deadline-bounded: this leg can fail but never hang."""
+    @serve.deployment
+    class SloEcho:
+        def __call__(self, payload):
+            return {"ok": True}
+
+    @serve.deployment
+    class SloSlow:
+        def __call__(self, payload):
+            return {"ok": True}
+
+    serve.run(SloEcho.bind(), name="SloEcho")
+    serve.run(SloSlow.bind(), name="SloSlow")
+    port = serve.start()
+
+    installed = state.set_slo_specs([
+        "smoke-latency: latency_p95 < 2s @ tenant=acme window=20s",
+        "smoke-slow: latency_p99 < 200ms @ deployment=SloSlow window=20s",
+    ])
+    assert len(installed) == 2, installed
+
+    def post(name: str, tenant: str):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/{name}",
+            data=json.dumps("ping").encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Tenant-ID": tenant})
+        return urllib.request.urlopen(req, timeout=30)
+
+    def _attained():
+        for sp in state.slo_status().get("specs", []):
+            if sp["name"] == "smoke-latency" \
+                    and sp["attainment"] is not None:
+                return sp
+        return None
+
+    # keep traffic flowing while polling: a windowed delta needs at
+    # least two flushed samples of the series, so a one-shot burst that
+    # lands inside a single flush tick would never produce attainment
+    spec, deadline = None, time.time() + 30
+    while time.time() < deadline and spec is None:
+        assert post("SloEcho", "acme").status == 200
+        time.sleep(0.2)
+        spec = _attained()
+    assert spec is not None, "per-tenant SLO attainment never appeared"
+    assert spec["attainment"] == 1.0, spec
+    assert spec["alert"] == "ok", spec
+    assert spec["selector"] == {"tenant": "acme"}, spec
+
+    t_inject = time.time()
+    deadline = t_inject + 40
+    fired = []
+    while time.time() < deadline and not fired:
+        post("SloSlow", "acme")
+        fired = [e for e in state.list_cluster_events(
+            source="slo", severity="ERROR")
+            if e.get("kind") == "fast_burn"
+            and (e.get("timestamp") or 0) >= t_inject]
+    assert fired, "fast-burn alert never fired under injected slow"
+    # within two 0.5s ticks of the 6s long burn window filling
+    assert fired[0]["timestamp"] - t_inject < 15, fired[0]
+    assert "smoke-slow" in fired[0]["message"], fired[0]
+    serve.shutdown()
+
+
 def main() -> int:
+    # the SloSlow failpoint must be in the environment BEFORE ray.init:
+    # replica workers read RAY_TPU_FAILPOINTS at spawn (it does not
+    # propagate through _system_config); scoped to the SloSlow
+    # deployment so every other leg is untouched
+    os.environ["RAY_TPU_FAILPOINTS"] = \
+        "serve.replica.handle@SloSlow=slow:0.4"
     ray_tpu.init(num_cpus=4, _system_config={
         # tight stall thresholds so the injected hang flags in seconds
         "task_watchdog_interval_s": 0.5,
         "task_stall_threshold_s": 2.0,
+        # tight SLO cadence so the slo leg sees series and burn alerts
+        # in seconds rather than the production-default minutes
+        "metrics_report_interval_ms": 300,
+        "metrics_series_min_interval_s": 0.25,
+        "slo_eval_interval_s": 0.5,
+        "slo_fast_burn_windows_s": "3,6",
     })
     try:
         # num_cpus=0.5 forces the full lease pipeline (the fastlane
@@ -141,19 +228,23 @@ def main() -> int:
 
         from ray_tpu._private.prometheus import render_cluster
 
-        text = _wait(
-            lambda: (lambda t: t if
-                     "serve_request_e2e_seconds_bucket" in t else "")(
-                         render_cluster()),
+        # replica- and proxy-side metrics flush on independent ticks:
+        # wait for all three, don't assert on whichever landed first
+        wanted = ("serve_request_e2e_seconds_bucket",
+                  "serve_http_request_seconds",
+                  "serve_replica_queue_depth")
+        _wait(
+            lambda: (lambda t: all(w in t for w in wanted))(
+                render_cluster()),
             20, "serve histograms on the Prometheus scrape")
-        assert "serve_http_request_seconds" in text, text[-2000:]
-        assert "serve_replica_queue_depth" in text, text[-2000:]
 
         serve.shutdown()
         _stall_sentinel_smoke()
+        _slo_smoke()
         print("observability smoke ok")
         return 0
     finally:
+        os.environ.pop("RAY_TPU_FAILPOINTS", None)
         ray_tpu.shutdown()
 
 

@@ -285,14 +285,16 @@ class DeploymentHandle:
         return samples[min(len(samples) - 1, int(q * (len(samples) - 1)))]
 
     def _dispatch(self, replica, args, kwargs,
-                  request_id: Optional[str] = None):
+                  request_id: Optional[str] = None,
+                  tenant_id: Optional[str] = None):
         """One attempt: ongoing bookkeeping + latency sample on reply."""
         with self._lock:
             self._requests_total += 1
             self._ongoing[replica._actor_id] = \
                 self._ongoing.get(replica._actor_id, 0) + 1
         t0 = time.monotonic()
-        ref = replica.handle.remote(self._method, args, kwargs, request_id)
+        ref = replica.handle.remote(self._method, args, kwargs, request_id,
+                                    tenant_id)
 
         def _done(_):
             with self._lock:
@@ -405,15 +407,17 @@ class DeploymentHandle:
         timer.start()
         return ObjectRef(oid, core.address)
 
-    def route(self, *args, request_id: Optional[str] = None, **kwargs):
+    def route(self, *args, request_id: Optional[str] = None,
+              tenant_id: Optional[str] = None, **kwargs):
         """Route one request, returning (ref, replica handle). The replica
         is exposed for stream follow-ups that must stay pinned to the
         replica holding the stream state. ``request_id`` (proxy-minted or
-        caller-supplied) rides to the replica for telemetry propagation —
-        it is NOT forwarded to the user callable's kwargs."""
+        caller-supplied) and ``tenant_id`` ride to the replica for
+        telemetry propagation — they are NOT forwarded to the user
+        callable's kwargs."""
         self._refresh()
         replica, _ranked = self._route_plan(args, kwargs)
-        ref = self._dispatch(replica, args, kwargs, request_id)
+        ref = self._dispatch(replica, args, kwargs, request_id, tenant_id)
         return ref, replica
 
     def __repr__(self):

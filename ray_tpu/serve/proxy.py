@@ -47,7 +47,14 @@ class ProxyActor:
             # one; it rides handle.route -> replica -> user callable and
             # is echoed back so clients can correlate traces
             rid = request.headers.get("X-Request-ID") or uuid.uuid4().hex
-            rid_hdr = {"X-Request-ID": rid}
+            # tenant id: honor X-Tenant-ID, else the configured default;
+            # it rides the same path as the request id and tags request/
+            # token metrics for per-tenant SLO accounting
+            from .._private.config import global_config
+
+            tenant = (request.headers.get("X-Tenant-ID")
+                      or global_config().serve_default_tenant)
+            rid_hdr = {"X-Request-ID": rid, "X-Tenant-ID": tenant}
             start = time.time()
 
             def _observe(status: int):
@@ -62,7 +69,8 @@ class ProxyActor:
                     payload = dict(request.query) or None
                 handle = self._handle_for(name)
                 args = () if payload is None else (payload,)
-                result, replica = await self._route(handle, args, rid)
+                result, replica = await self._route(handle, args, rid,
+                                                    tenant)
             except ValueError as e:
                 _observe(404)
                 return web.json_response({"error": str(e)}, status=404,
@@ -90,9 +98,10 @@ class ProxyActor:
         self._port = site._server.sockets[0].getsockname()[1]
         return self._port
 
-    async def _route(self, handle, args, request_id=None):
+    async def _route(self, handle, args, request_id=None, tenant_id=None):
         ref, replica = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: handle.route(*args, request_id=request_id))
+            None, lambda: handle.route(*args, request_id=request_id,
+                                       tenant_id=tenant_id))
         return await ref, replica
 
     async def _stream_response(self, request, replica, stream_id: int,

@@ -36,6 +36,19 @@ def current_request_id() -> Optional[str]:
     return _request_id.get()
 
 
+# Tenant propagation (per-tenant SLO accounting): the proxy honors/mints
+# X-Tenant-ID and it rides the same path as the request id, so replica
+# metrics and downstream LLM token accounting can carry a tenant tag.
+_tenant_id: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_tenant_id", default=None)
+
+
+def current_tenant_id() -> Optional[str]:
+    """The tenant id of the request being handled, or None when called
+    outside a replica request (or for an untagged in-cluster call)."""
+    return _tenant_id.get()
+
+
 class Replica:
     def __init__(self, cls_blob: bytes, init_args_blob: bytes,
                  max_ongoing_requests: int, deployment_name: str = "",
@@ -65,26 +78,33 @@ class Replica:
         tags = {"deployment": deployment_name or "?"}
         self._m_e2e = metrics.Histogram(
             "serve_request_e2e_seconds",
-            "End-to-end replica request latency by deployment/method",
+            "End-to-end replica request latency by deployment/method/tenant",
             boundaries=metrics.LATENCY_BUCKETS,
-            tag_keys=("deployment", "method")).set_default_tags(tags)
+            tag_keys=("deployment", "method", "tenant")).set_default_tags(tags)
         self._m_queue = metrics.Gauge(
             "serve_replica_queue_depth",
             "Requests admitted and executing on this replica",
             tag_keys=("deployment",)).set_default_tags(tags)
         self._m_errors = metrics.Counter(
             "serve_request_errors_total",
-            "Replica requests that raised, by deployment/method",
-            tag_keys=("deployment", "method")).set_default_tags(tags)
+            "Replica requests that raised, by deployment/method/tenant",
+            tag_keys=("deployment", "method", "tenant")).set_default_tags(tags)
 
     async def handle(self, method_name: str, args: tuple, kwargs: dict,
-                     request_id: Optional[str] = None):
+                     request_id: Optional[str] = None,
+                     tenant_id: Optional[str] = None):
         """One request. Returns the call result, or {"__stream__": id} when
         the user callable produced an async generator."""
+        from .._private.config import global_config
+
+        # in-cluster calls that skipped the proxy still account under
+        # the default tenant, so per-tenant series partition ALL traffic
+        tenant = tenant_id or global_config().serve_default_tenant
         async with self._sem:
             self._ongoing += 1
             self._m_queue.set(self._ongoing)
             token = _request_id.set(request_id)
+            tenant_token = _tenant_id.set(tenant)
             start = time.time()
             try:
                 # tail-tolerance harness: an armed "slow" rule models a
@@ -112,17 +132,20 @@ class Replica:
                     return {"__stream__": stream_id}
                 return result
             except BaseException:
-                self._m_errors.inc(tags={"method": method_name})
+                self._m_errors.inc(tags={"method": method_name,
+                                         "tenant": tenant})
                 raise
             finally:
                 end = time.time()
                 self._m_e2e.observe(end - start,
-                                    tags={"method": method_name})
+                                    tags={"method": method_name,
+                                          "tenant": tenant})
                 from ..util.tracing import record_lane_event
 
                 record_lane_event(
                     "serve", f"{self.deployment_name}.{method_name}",
                     start, end, request_id=request_id or "")
+                _tenant_id.reset(tenant_token)
                 _request_id.reset(token)
                 self._ongoing -= 1
                 self._m_queue.set(self._ongoing)

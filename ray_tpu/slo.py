@@ -1,0 +1,496 @@
+"""SLO engine: declarative service-level objectives evaluated against
+the GCS metric time series, with multi-window burn-rate alerting.
+
+Three pieces, all head-side and control-plane only:
+
+* :class:`SeriesStore` — bounded per-series ring buffers of downsampled
+  (timestamp, value) samples. The GCS samples its aggregated metrics
+  table into one of these on its evaluation tick (the in-memory-TSDB
+  role Monarch plays for Google's alerting; see PAPERS.md).
+* :class:`SloSpec` / :func:`parse_specs` — declarative objectives like
+  ``"chat-ttft: ttft_p99 < 250ms @ tenant=acme"`` or
+  ``"chat-avail: availability >= 99.9% @ deployment=Chat"``.
+* :class:`SloMonitor` — evaluates every spec each tick: windowed
+  attainment (interpolated over histogram bucket deltas), plus fast and
+  slow multi-window burn-rate alerts (Google SRE Workbook ch. 5: alert
+  when the error-budget burn rate exceeds a threshold over BOTH a short
+  and a long window — the short window gives speed, the long window
+  stops a transient blip from paging). Fast-burn fires an ERROR cluster
+  event, slow-burn a WARNING, recovery an INFO; state transitions only,
+  never a re-fire per tick.
+
+The math (windowed counter increase, interpolated histogram quantiles /
+good-fractions) lives in ``ray_tpu/util/metrics.py`` so it is shared
+with local introspection and unit-testable without a cluster.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .util.metrics import (histogram_good_fraction, histogram_quantile,
+                           windowed_increase)
+
+# indicator aliases: the short names specs use -> (metric, kind). A full
+# metric name is also accepted (e.g. "serve_http_request_seconds_p95").
+INDICATOR_ALIASES: Dict[str, str] = {
+    "ttft": "llm_ttft_seconds",
+    "tpot": "llm_tpot_seconds",
+    "e2e": "llm_request_e2e_seconds",
+    "latency": "serve_request_e2e_seconds",
+    "http_latency": "serve_http_request_seconds",
+}
+# availability is derived: errors / total requests under the selector
+AVAILABILITY_ERRORS_METRIC = "serve_request_errors_total"
+AVAILABILITY_TOTAL_METRIC = "serve_request_e2e_seconds"
+
+_QUANTILE_RE = re.compile(r"^(?P<base>.+)_p(?P<q>\d+(?:\.\d+)?)$")
+_VALUE_RE = re.compile(
+    r"^(?P<num>\d+(?:\.\d+)?)\s*(?P<unit>ms|us|s|%)?$")
+
+
+class SpecError(ValueError):
+    """A malformed SLO spec string/dict (named so config typos surface
+    as one attributed error, not a tick-loop crash)."""
+
+
+def parse_value(text: str) -> float:
+    """``250ms`` -> 0.25, ``1.5s`` -> 1.5, ``99.9%`` -> 0.999, bare
+    floats pass through."""
+    m = _VALUE_RE.match(str(text).strip())
+    if not m:
+        raise SpecError(f"unparseable threshold {text!r}")
+    num = float(m.group("num"))
+    unit = m.group("unit")
+    if unit == "ms":
+        return num / 1e3
+    if unit == "us":
+        return num / 1e6
+    if unit == "%":
+        return num / 100.0
+    return num
+
+
+@dataclass
+class SloSpec:
+    name: str                      # display name ("chat-ttft")
+    indicator: str                 # as written ("ttft_p99", "availability")
+    kind: str                      # "quantile" | "availability"
+    metric: str                    # resolved histogram/counter metric
+    quantile: float                # target quantile (quantile kind)
+    op: str                        # "<", "<=", ">=", ">"
+    threshold: float               # seconds (quantile) or ratio (avail.)
+    window_s: float = 60.0         # attainment window
+    selector: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def objective(self) -> float:
+        """Target good-event ratio: p99 -> 0.99; availability -> the
+        threshold itself. 1 - objective is the error budget burn rates
+        are measured against."""
+        return self.quantile if self.kind == "quantile" else self.threshold
+
+    def describe(self) -> str:
+        sel = ",".join(f"{k}={v}" for k, v in sorted(self.selector.items()))
+        return (f"{self.name}: {self.indicator} {self.op} "
+                f"{self.threshold:g}" + (f" @ {sel}" if sel else ""))
+
+
+def _parse_one(entry: Any) -> SloSpec:
+    if isinstance(entry, SloSpec):
+        return entry
+    if isinstance(entry, dict):
+        d = dict(entry)
+        text = (f"{d.pop('name')}: {d.pop('indicator')} "
+                f"{d.pop('op', '<')} {d.pop('threshold')}")
+        spec = _parse_str(text)
+        if "window_s" in d:
+            spec.window_s = float(d.pop("window_s"))
+        if "selector" in d:
+            spec.selector = {str(k): str(v)
+                             for k, v in d.pop("selector").items()}
+        return spec
+    return _parse_str(str(entry))
+
+
+def _parse_str(text: str) -> SloSpec:
+    """Grammar: ``name: indicator op value [@ k=v,k=v] [window=30s]``."""
+    head, sep, rest = text.partition(":")
+    if not sep or not rest.strip():
+        raise SpecError(f"SLO spec needs 'name: objective': {text!r}")
+    name = head.strip()
+    rest = rest.strip()
+    window_s = 60.0
+    wm = re.search(r"\bwindow\s*=\s*(\S+)", rest)
+    if wm:
+        window_s = parse_value(wm.group(1))
+        rest = (rest[:wm.start()] + rest[wm.end():]).strip()
+    selector: Dict[str, str] = {}
+    body, at, sel = rest.partition("@")
+    if at:
+        for pair in sel.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "=" not in pair:
+                raise SpecError(f"selector needs k=v pairs: {text!r}")
+            k, _, v = pair.partition("=")
+            selector[k.strip()] = v.strip()
+    m = re.match(r"^(?P<ind>\S+)\s*(?P<op><=|>=|<|>)\s*(?P<val>\S+)$",
+                 body.strip())
+    if not m:
+        raise SpecError(f"SLO spec needs 'indicator op value': {text!r}")
+    indicator, op, value = m.group("ind"), m.group("op"), m.group("val")
+    threshold = parse_value(value)
+    if indicator == "availability":
+        if op not in (">=", ">"):
+            raise SpecError(f"availability wants '>=': {text!r}")
+        if not 0.0 < threshold <= 1.0:
+            raise SpecError(f"availability target out of (0,1]: {text!r}")
+        return SloSpec(name=name, indicator=indicator,
+                       kind="availability",
+                       metric=AVAILABILITY_TOTAL_METRIC,
+                       quantile=threshold, op=op, threshold=threshold,
+                       window_s=window_s, selector=selector)
+    qm = _QUANTILE_RE.match(indicator)
+    if not qm:
+        raise SpecError(
+            f"unknown indicator {indicator!r} (want availability or "
+            f"<metric>_p<q>): {text!r}")
+    base = qm.group("base")
+    metric = INDICATOR_ALIASES.get(base, base)
+    q = float(qm.group("q")) / 100.0
+    if not 0.0 < q < 1.0:
+        raise SpecError(f"quantile out of (0,100): {text!r}")
+    if op not in ("<", "<="):
+        raise SpecError(f"latency quantile wants '<': {text!r}")
+    return SloSpec(name=name, indicator=indicator, kind="quantile",
+                   metric=metric, quantile=q, op=op, threshold=threshold,
+                   window_s=window_s, selector=selector)
+
+
+def parse_specs(entries: Any) -> List[SloSpec]:
+    """Parse a config-shaped spec list (list of strings/dicts, or one
+    ``|``-separated string). Duplicate names keep the last entry."""
+    if entries is None:
+        return []
+    if isinstance(entries, str):
+        entries = [e for e in entries.split("|") if e.strip()]
+    out: Dict[str, SloSpec] = {}
+    for entry in entries:
+        spec = _parse_one(entry)
+        out[spec.name] = spec
+    return list(out.values())
+
+
+# ---------------------------------------------------------- series store
+class SeriesStore:
+    """Bounded per-series ring buffers of downsampled samples.
+
+    Keyed like the GCS aggregated metrics view: (metric name, sorted
+    tag tuple). Appends closer together than ``min_interval_s`` are
+    dropped (downsampling), each series keeps at most ``max_samples``
+    points (retention = max_samples x sample interval), and the store
+    holds at most ``max_series`` series with FIFO eviction — the same
+    bound discipline as the GCS last-value metrics table."""
+
+    def __init__(self, max_samples: int = 256,
+                 min_interval_s: float = 2.0,
+                 max_series: int = 4000):
+        self.max_samples = max(2, int(max_samples))
+        self.min_interval_s = float(min_interval_s)
+        self.max_series = max(1, int(max_series))
+        self._series: "collections.OrderedDict[Tuple[str, tuple], dict]" = \
+            collections.OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._series)
+
+    def sample(self, entries: Sequence[Dict[str, Any]],
+               t: Optional[float] = None) -> int:
+        """Append one sampling tick of aggregated metric entries
+        (handle_get_metrics layout). Returns how many series advanced."""
+        if t is None:
+            t = time.time()
+        appended = 0
+        for e in entries:
+            key = (e["name"], tuple(sorted((e.get("tags") or {}).items())))
+            rec = self._series.get(key)
+            if rec is None:
+                while len(self._series) >= self.max_series:
+                    self._series.popitem(last=False)
+                rec = self._series[key] = {
+                    "kind": e.get("kind", "gauge"), "last_t": -1e18,
+                    "samples": collections.deque(maxlen=self.max_samples),
+                }
+            if t - rec["last_t"] < self.min_interval_s:
+                continue
+            rec["last_t"] = t
+            rec["samples"].append((t, float(e["value"])))
+            appended += 1
+        return appended
+
+    @staticmethod
+    def _matches(tags: Dict[str, str], selector: Dict[str, str]) -> bool:
+        return all(tags.get(k) == v for k, v in selector.items())
+
+    def query(self, name: str,
+              selector: Optional[Dict[str, str]] = None
+              ) -> List[Dict[str, Any]]:
+        """Series for one metric whose tags satisfy the selector
+        (internal ``le``/``__stat__`` keys never participate in
+        matching)."""
+        selector = selector or {}
+        out = []
+        for (n, tag_t), rec in self._series.items():
+            if n != name:
+                continue
+            tags = dict(tag_t)
+            plain = {k: v for k, v in tags.items()
+                     if k not in ("le", "__stat__")}
+            if not self._matches(plain, selector):
+                continue
+            out.append({"name": n, "tags": tags, "kind": rec["kind"],
+                        "samples": list(rec["samples"])})
+        return out
+
+    def bucket_increases(self, name: str, selector: Dict[str, str],
+                         window_s: float, now: float
+                         ) -> List[Tuple[float, float]]:
+        """Windowed histogram bucket deltas: per ``le`` bound, the
+        summed increase over the trailing window across every matching
+        series. The per-``le`` counts are cumulative-by-bound, so the
+        result feeds histogram_quantile/good_fraction directly."""
+        by_bound: Dict[float, float] = {}
+        for rec in self.query(name, selector):
+            le = rec["tags"].get("le")
+            if le is None:
+                continue
+            bound = float("inf") if le == "+Inf" else float(le)
+            inc = windowed_increase(rec["samples"], window_s, now)
+            by_bound[bound] = by_bound.get(bound, 0.0) + inc
+        return sorted(by_bound.items())
+
+    def stat_increase(self, name: str, selector: Dict[str, str],
+                      window_s: float, now: float,
+                      stat: str = "count") -> float:
+        """Windowed increase of a histogram's ``__stat__`` series
+        (count/sum) summed across matching series."""
+        total = 0.0
+        for rec in self.query(name, selector):
+            if rec["tags"].get("__stat__") != stat:
+                continue
+            total += windowed_increase(rec["samples"], window_s, now)
+        return total
+
+    def counter_increase(self, name: str, selector: Dict[str, str],
+                         window_s: float, now: float) -> float:
+        """Windowed increase of a plain counter summed across matching
+        series."""
+        total = 0.0
+        for rec in self.query(name, selector):
+            if "le" in rec["tags"] or "__stat__" in rec["tags"]:
+                continue
+            total += windowed_increase(rec["samples"], window_s, now)
+        return total
+
+
+# ------------------------------------------------------------ evaluation
+def error_ratio(spec: SloSpec, store: SeriesStore, window_s: float,
+                now: float) -> Tuple[Optional[float], float]:
+    """(bad-event ratio over the window, total events). None ratio means
+    no traffic in the window (vacuously compliant, burn 0)."""
+    if spec.kind == "availability":
+        total = store.stat_increase(AVAILABILITY_TOTAL_METRIC,
+                                    spec.selector, window_s, now)
+        if total <= 0:
+            return None, 0.0
+        errors = store.counter_increase(AVAILABILITY_ERRORS_METRIC,
+                                        spec.selector, window_s, now)
+        return min(1.0, errors / total), total
+    buckets = store.bucket_increases(spec.metric, spec.selector,
+                                     window_s, now)
+    if not buckets:
+        return None, 0.0
+    total = max((c for _, c in buckets), default=0.0)
+    good = histogram_good_fraction(spec.threshold, buckets)
+    if good is None:
+        return None, 0.0
+    return 1.0 - good, total
+
+
+def burn_rate(spec: SloSpec, store: SeriesStore, window_s: float,
+              now: float) -> float:
+    """Error-budget burn rate over a window: error_ratio / (1 - objective).
+    1.0 = burning exactly the budget; 14.4 over 5m/1h is the SRE
+    Workbook's classic page threshold."""
+    budget = max(1e-9, 1.0 - spec.objective)
+    ratio, _total = error_ratio(spec, store, window_s, now)
+    if ratio is None:
+        return 0.0
+    return ratio / budget
+
+
+@dataclass
+class BurnPolicy:
+    """One multi-window burn alert: fires when the burn rate exceeds
+    ``threshold`` over BOTH windows (short = fast detection, long =
+    transient suppression)."""
+    severity: str          # "ERROR" (fast) / "WARNING" (slow)
+    kind: str              # "fast_burn" / "slow_burn"
+    short_window_s: float
+    long_window_s: float
+    threshold: float
+
+    def firing(self, spec: SloSpec, store: SeriesStore,
+               now: float) -> Tuple[bool, float, float]:
+        short = burn_rate(spec, store, self.short_window_s, now)
+        long = burn_rate(spec, store, self.long_window_s, now)
+        return (short >= self.threshold and long >= self.threshold,
+                short, long)
+
+
+def default_policies(cfg) -> List[BurnPolicy]:
+    """Fast+slow pair from config knobs (SRE Workbook table 5-3 scaled
+    to this cluster's 2 s sampling tick)."""
+
+    def _pair(text: str, fallback: Tuple[float, float]):
+        try:
+            a, b = (float(x) for x in str(text).split(","))
+            return a, b
+        except Exception:
+            return fallback
+
+    fs, fl = _pair(cfg.slo_fast_burn_windows_s, (30.0, 300.0))
+    ss, sl = _pair(cfg.slo_slow_burn_windows_s, (120.0, 600.0))
+    return [
+        BurnPolicy("ERROR", "fast_burn", fs, fl,
+                   float(cfg.slo_fast_burn_threshold)),
+        BurnPolicy("WARNING", "slow_burn", ss, sl,
+                   float(cfg.slo_slow_burn_threshold)),
+    ]
+
+
+_STATE_RANK = {"ok": 0, "slow_burn": 1, "fast_burn": 2}
+
+
+class SloMonitor:
+    """Per-spec evaluation state: attainment history ring + burn-alert
+    state machine. The GCS owns one and ticks it on its evaluation
+    loop; events go out through the supplied emitter (the GCS _event
+    hook) only on state TRANSITIONS."""
+
+    def __init__(self, specs: Sequence[SloSpec],
+                 policies: Sequence[BurnPolicy],
+                 history_len: int = 240):
+        self.policies = list(policies)
+        self.history_len = int(history_len)
+        self._state: Dict[str, dict] = {}
+        self.set_specs(specs)
+
+    def set_specs(self, specs: Sequence[SloSpec]) -> None:
+        self.specs = list(specs)
+        live = {s.name for s in self.specs}
+        for name in [n for n in self._state if n not in live]:
+            del self._state[name]
+        for spec in self.specs:
+            self._state.setdefault(spec.name, {
+                "alert": "ok",
+                "since": None,
+                "history": collections.deque(maxlen=self.history_len),
+            })
+
+    def tick(self, store: SeriesStore, now: Optional[float] = None,
+             emit: Optional[Callable[..., None]] = None) -> None:
+        """Evaluate every spec; ``emit(severity, message, **fields)``
+        receives alert transitions."""
+        if now is None:
+            now = time.time()
+        for spec in self.specs:
+            st = self._state[spec.name]
+            ratio, total = error_ratio(spec, store, spec.window_s, now)
+            attainment = None if ratio is None else 1.0 - ratio
+            achieved = None
+            if spec.kind == "quantile":
+                buckets = store.bucket_increases(
+                    spec.metric, spec.selector, spec.window_s, now)
+                achieved = histogram_quantile(spec.quantile, buckets)
+            compliant = (attainment is None
+                         or attainment >= spec.objective)
+            alert, burns = "ok", {}
+            for pol in self.policies:
+                firing, short, long = pol.firing(spec, store, now)
+                burns[pol.kind] = {"short": round(short, 3),
+                                   "long": round(long, 3),
+                                   "threshold": pol.threshold,
+                                   "firing": firing}
+                if firing and _STATE_RANK[pol.kind] > _STATE_RANK[alert]:
+                    alert = pol.kind
+            prev = st["alert"]
+            if alert != prev:
+                st["alert"] = alert
+                st["since"] = now
+                if emit is not None:
+                    if alert == "ok":
+                        emit("INFO", f"SLO '{spec.name}' recovered "
+                             f"({spec.describe()})",
+                             kind="slo_recovered", slo=spec.name,
+                             burns=burns)
+                    else:
+                        pol = next(p for p in self.policies
+                                   if p.kind == alert)
+                        emit(pol.severity,
+                             f"SLO '{spec.name}' {alert.replace('_', '-')}"
+                             f": burning error budget at "
+                             f"{burns[alert]['short']:g}x over "
+                             f"{pol.short_window_s:g}s and "
+                             f"{burns[alert]['long']:g}x over "
+                             f"{pol.long_window_s:g}s "
+                             f"({spec.describe()})",
+                             kind=alert, slo=spec.name,
+                             attainment=attainment, burns=burns)
+            st["history"].append({
+                "t": now,
+                "attainment": (None if attainment is None
+                               else round(attainment, 6)),
+                "achieved": (None if achieved is None
+                             else round(achieved, 6)),
+                "total": round(total, 1),
+                "alert": alert,
+            })
+            st["last"] = {
+                "attainment": attainment, "achieved": achieved,
+                "total": total, "compliant": compliant, "burns": burns,
+            }
+
+    def status(self) -> List[Dict[str, Any]]:
+        """API-shaped view: one record per spec with current attainment,
+        burn rates, alert state, and the attainment history ring."""
+        out = []
+        for spec in self.specs:
+            st = self._state[spec.name]
+            last = st.get("last", {})
+            out.append({
+                "name": spec.name,
+                "spec": spec.describe(),
+                "indicator": spec.indicator,
+                "metric": spec.metric,
+                "kind": spec.kind,
+                "objective": spec.objective,
+                "threshold": spec.threshold,
+                "window_s": spec.window_s,
+                "selector": dict(spec.selector),
+                "attainment": last.get("attainment"),
+                "achieved": last.get("achieved"),
+                "total": last.get("total", 0.0),
+                "compliant": last.get("compliant", True),
+                "burns": last.get("burns", {}),
+                "alert": st["alert"],
+                "alert_since": st["since"],
+                "history": list(st["history"]),
+            })
+        return out

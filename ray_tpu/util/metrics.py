@@ -12,9 +12,14 @@ from __future__ import annotations
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 _FLUSH_PERIOD_S = 2.0
+# delta flusher: unchanged series are skipped, but every Nth flush ships
+# the full registry anyway so a series the GCS evicted (FIFO bound) or a
+# restarted head re-learns steady-state gauges without waiting for the
+# next mutation
+_FULL_RESYNC_EVERY = 15
 
 # Latency-histogram preset (ref: prometheus client default buckets,
 # extended down to sub-ms): request latencies span cache-hit TTFTs well
@@ -42,6 +47,10 @@ class _Metric:
         self.tag_keys = tuple(tag_keys)
         self._default_tags: Dict[str, str] = {}
         self._values: Dict[Tuple, float] = defaultdict(float)
+        # series keys mutated since the last successful flush; the
+        # flusher ships only these (aliased together with _values so
+        # deduped instances share one dirty view)
+        self._dirty: set = set()
         self._lock = threading.Lock()
         with _registry_lock:
             # dedupe by identity key: re-creating a metric (e.g. inside a
@@ -54,6 +63,7 @@ class _Metric:
                         and getattr(existing, "boundaries", None)
                         == getattr(self, "boundaries", None)):
                     self._values = existing._values
+                    self._dirty = existing._dirty
                     self._lock = existing._lock
                     break
             else:
@@ -69,14 +79,29 @@ class _Metric:
         merged.update(tags or {})
         return merged
 
+    def _entry(self, key: Tuple, value: float) -> dict:
+        return {"name": self.name, "kind": self.kind,
+                "tags": dict(key), "value": value,
+                "description": self.description}
+
     def _snapshot(self) -> List[dict]:
         with self._lock:
-            return [
-                {"name": self.name, "kind": self.kind,
-                 "tags": dict(key), "value": value,
-                 "description": self.description}
-                for key, value in self._values.items()
-            ]
+            return [self._entry(key, value)
+                    for key, value in self._values.items()]
+
+    def _drain_dirty(self, force: bool = False) -> Tuple[List[dict], List]:
+        """Entries for series mutated since the last drain (everything
+        with ``force``), clearing the dirty set. Returns (entries, keys)
+        so a failed flush can re-mark exactly what it dropped."""
+        with self._lock:
+            keys = (list(self._values) if force
+                    else [k for k in self._dirty if k in self._values])
+            self._dirty.clear()
+            return [self._entry(k, self._values[k]) for k in keys], keys
+
+    def _mark_dirty(self, keys: Iterable) -> None:
+        with self._lock:
+            self._dirty.update(keys)
 
 
 class Counter(_Metric):
@@ -87,7 +112,9 @@ class Counter(_Metric):
         if value < 0:
             raise ValueError("Counter can only increase")
         with self._lock:
-            self._values[_tag_key(self._merged(tags))] += value
+            key = _tag_key(self._merged(tags))
+            self._values[key] += value
+            self._dirty.add(key)
 
 
 class Gauge(_Metric):
@@ -95,7 +122,9 @@ class Gauge(_Metric):
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
-            self._values[_tag_key(self._merged(tags))] = value
+            key = _tag_key(self._merged(tags))
+            self._values[key] = value
+            self._dirty.add(key)
 
 
 class Histogram(_Metric):
@@ -118,10 +147,16 @@ class Histogram(_Metric):
         with self._lock:
             for bound in self.boundaries:
                 if value <= bound:
-                    self._values[_tag_key({**merged, "le": str(bound)})] += 1
-            self._values[_tag_key({**merged, "le": "+Inf"})] += 1
-            self._values[_tag_key({**merged, "__stat__": "sum"})] += value
-            self._values[_tag_key({**merged, "__stat__": "count"})] += 1
+                    key = _tag_key({**merged, "le": str(bound)})
+                    self._values[key] += 1
+                    self._dirty.add(key)
+            for key in (_tag_key({**merged, "le": "+Inf"}),
+                        _tag_key({**merged, "__stat__": "count"})):
+                self._values[key] += 1
+                self._dirty.add(key)
+            key = _tag_key({**merged, "__stat__": "sum"})
+            self._values[key] += value
+            self._dirty.add(key)
 
 
 def snapshot_local(prefix: str = "") -> Dict[str, float]:
@@ -143,7 +178,17 @@ def snapshot_local(prefix: str = "") -> Dict[str, float]:
     return out
 
 
-def _flush_once() -> bool:
+_flush_seq = 0
+
+
+def _flush_once(force: bool = False) -> bool:
+    """Ship mutated series to the GCS (deltas, as the module docstring
+    promises): only series dirtied since the last successful flush go on
+    the wire, so high-cardinality histograms (× tenant tags) cost flush
+    bytes proportional to activity, not to total series ever seen. Every
+    ``_FULL_RESYNC_EVERY``-th flush (and ``force=True``) ships the whole
+    registry as eviction/restart insurance."""
+    global _flush_seq
     from .. import _worker_api
 
     core = _worker_api._core
@@ -151,9 +196,15 @@ def _flush_once() -> bool:
         return False
     with _registry_lock:
         metrics = list(_registry)
+    _flush_seq += 1
+    full = force or (_flush_seq % _FULL_RESYNC_EVERY == 0)
     batch: List[dict] = []
+    pending: List[Tuple[_Metric, List]] = []
     for metric in metrics:
-        batch.extend(metric._snapshot())
+        entries, keys = metric._drain_dirty(force=full)
+        batch.extend(entries)
+        if keys:
+            pending.append((metric, keys))
     if not batch:
         return True
     try:
@@ -161,6 +212,9 @@ def _flush_once() -> bool:
             "worker_id": core.worker_id.hex(), "metrics": batch}))
         return True
     except Exception:
+        # nothing went out: re-mark so the next flush retries the delta
+        for metric, keys in pending:
+            metric._mark_dirty(keys)
         return False
 
 
@@ -181,3 +235,118 @@ def _ensure_flusher() -> None:
 
     threading.Thread(target=_loop, daemon=True,
                      name="ray_tpu_metrics_flush").start()
+
+
+# ---- windowed series math (SLO observability plane) -------------------
+# Pure functions over (timestamp, value) samples and histogram bucket
+# counts: the GCS series ring buffers (_private/gcs.py) feed these, and
+# ray_tpu/slo.py evaluates SLO specs with them. Kept here so the math is
+# unit-testable against known distributions with no cluster running.
+
+def windowed_increase(samples: Sequence[Tuple[float, float]],
+                      window_s: float,
+                      now: Optional[float] = None) -> float:
+    """Counter increase over the trailing window: the sum of POSITIVE
+    deltas between consecutive samples whose interval ends inside the
+    window (the Prometheus ``increase()`` semantic — a counter reset on
+    worker restart contributes 0, not a huge negative step). ``samples``
+    are (t, cumulative_value) in append order."""
+    if window_s <= 0 or len(samples) < 2:
+        return 0.0
+    if now is None:
+        now = samples[-1][0]
+    lo = now - window_s
+    total = 0.0
+    prev_t, prev_v = samples[0]
+    for t, v in samples[1:]:
+        if t > prev_t and t >= lo:
+            delta = v - prev_v
+            if delta > 0:
+                if prev_t < lo:
+                    # partial interval: pro-rate the covered fraction so
+                    # the window edge doesn't swallow a whole flush tick
+                    delta *= (t - lo) / (t - prev_t)
+                total += delta
+        prev_t, prev_v = t, v
+    return total
+
+
+def windowed_rate(samples: Sequence[Tuple[float, float]],
+                  window_s: float,
+                  now: Optional[float] = None) -> float:
+    """Per-second rate over the trailing window (increase / window)."""
+    if window_s <= 0:
+        return 0.0
+    return windowed_increase(samples, window_s, now) / window_s
+
+
+def _sorted_cumulative(buckets: Iterable[Tuple[float, float]]
+                       ) -> List[Tuple[float, float]]:
+    """Normalize [(upper_bound, count)] to ascending bounds with
+    monotone non-decreasing cumulative counts (clamps the small
+    negative wiggles windowed deltas of skewed flushes can produce)."""
+    out = sorted(((float(b), max(0.0, float(c))) for b, c in buckets),
+                 key=lambda p: p[0])
+    mono: List[Tuple[float, float]] = []
+    running = 0.0
+    for bound, count in out:
+        running = max(running, count)
+        mono.append((bound, running))
+    return mono
+
+
+def histogram_quantile(q: float,
+                       buckets: Iterable[Tuple[float, float]]
+                       ) -> Optional[float]:
+    """Interpolated quantile over CUMULATIVE histogram bucket counts
+    [(upper_bound, cumulative_count), ...] — the Prometheus
+    ``histogram_quantile`` estimator. Linear interpolation inside the
+    bucket where the target rank lands; a rank landing in the +Inf
+    bucket answers with the highest finite bound (the estimate is a
+    floor there, as in Prometheus). Returns None on an empty histogram."""
+    bs = _sorted_cumulative(buckets)
+    if not bs:
+        return None
+    total = bs[-1][1]
+    if total <= 0:
+        return None
+    q = min(1.0, max(0.0, q))
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    last_finite = 0.0
+    for bound, cum in bs:
+        if bound != float("inf"):
+            last_finite = bound
+        if cum >= rank and cum > prev_cum:
+            if bound == float("inf"):
+                return last_finite
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = (bound if bound != float("inf")
+                                else prev_bound), cum
+    return last_finite
+
+
+def histogram_good_fraction(threshold: float,
+                            buckets: Iterable[Tuple[float, float]]
+                            ) -> Optional[float]:
+    """Fraction of observations <= threshold, interpolating inside the
+    bucket the threshold straddles — the latency-SLO attainment read
+    (``ttft_p99 < 250ms`` holds iff good_fraction(0.25) >= 0.99).
+    Returns None on an empty histogram."""
+    bs = _sorted_cumulative(buckets)
+    if not bs:
+        return None
+    total = bs[-1][1]
+    if total <= 0:
+        return None
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in bs:
+        if threshold <= bound:
+            if bound == float("inf") or bound == prev_bound:
+                return cum / total
+            frac = (threshold - prev_bound) / (bound - prev_bound)
+            frac = min(1.0, max(0.0, frac))
+            return (prev_cum + (cum - prev_cum) * frac) / total
+        prev_bound, prev_cum = bound, cum
+    return 1.0

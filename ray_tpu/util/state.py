@@ -219,6 +219,32 @@ def get_metrics(name: Optional[str] = None) -> List[Dict[str, Any]]:
     return core.io.run(core.gcs.call("get_metrics", {"name": name}))
 
 
+def get_metric_series(name: str,
+                      selector: Optional[Dict[str, str]] = None
+                      ) -> List[Dict[str, Any]]:
+    """Ring-buffered time series for one metric from the GCS SLO plane
+    (samples are (timestamp, value) pairs; selector is a tag-subset
+    match). Empty when metrics_series_enabled is off."""
+    core = _core()
+    return core.io.run(core.gcs.call("get_metric_series", {
+        "name": name, "selector": selector or {}}))
+
+
+def slo_status() -> Dict[str, Any]:
+    """Per-spec SLO attainment, burn rates, alert state, and attainment
+    history, plus the burn-rate policy windows (ray_tpu/slo.py)."""
+    core = _core()
+    return core.io.run(core.gcs.call("slo_status", {}))
+
+
+def set_slo_specs(specs: List[Any]) -> List[str]:
+    """Install/replace the cluster's SLO specs at runtime. Each entry is
+    a spec string like ``"chat-ttft: ttft_p99 < 250ms @ tenant=acme"``
+    (or an equivalent dict); returns the parsed descriptions."""
+    core = _core()
+    return core.io.run(core.gcs.call("set_slo_specs", {"specs": specs}))
+
+
 def list_cluster_events(source: Optional[str] = None,
                         severity: Optional[str] = None,
                         limit: int = 1000) -> List[Dict[str, Any]]:
