@@ -23,6 +23,9 @@ Families:
   * slo       — SLO observability plane: open-loop multi-tenant loadgen
                 attainment + time-to-fast-burn-alert under an injected
                 slow replica
+  * submit    — driver submit-path per-stage latency breakdown (the
+                submit_stage_seconds histogram) + always-on sampling
+                profiler overhead at profiling_sample_hz=1
 
 Run:  python bench_envelope.py [family ...] [--quick]
 """
@@ -1143,6 +1146,76 @@ def bench_slo(results):
             ray.shutdown()
 
 
+def bench_submit(results):
+    """Driver submit-path stage breakdown + always-on profiler overhead
+    (ROADMAP item 2: "profile the 6k/s submit path" — this is the
+    baseline that work is measured against). Two sessions, NOT
+    in-session: profiling off (per-stage sums from submit_stage_seconds,
+    checked against the measured submit wall) and always-on sampling at
+    1 Hz (the throughput delta is the cost of leaving it on)."""
+    import ray_tpu as ray
+
+    n = 2_000 if QUICK else (20_000 if MODERATE else 50_000)
+
+    def _stage_sums(snap, base):
+        """{stage: seconds} deltas from two snapshot_local() reads of
+        the submit_stage_seconds histogram (__stat__=sum entries)."""
+        out = {}
+        for key, v in snap.items():
+            if "__stat__=sum" not in key or "{" not in key:
+                continue
+            tags = dict(p.split("=", 1)
+                        for p in key[key.index("{") + 1:-1].split(","))
+            stage = tags.get("stage")
+            if stage:
+                out[stage] = v - base.get(key, 0.0)
+        return out
+
+    def _run(sample_hz):
+        from ray_tpu.util import metrics
+
+        ray.init(num_cpus=4, _system_config={
+            "profiling_sample_hz": sample_hz})
+        try:
+            @ray.remote
+            def nop():
+                return None
+
+            # warmup: export the function, spin up workers, fill caches
+            ray.get([nop.remote() for _ in range(200)])
+            base = metrics.snapshot_local("submit_stage_seconds")
+            t0 = time.perf_counter()
+            refs = [nop.remote() for _ in range(n)]
+            t_submit = time.perf_counter() - t0
+            snap = metrics.snapshot_local("submit_stage_seconds")
+            for i in range(0, n, 10_000):
+                ray.get(refs[i:i + 10_000])
+            return n / t_submit, t_submit, _stage_sums(snap, base)
+        finally:
+            ray.shutdown()
+
+    tput_off, wall_off, sums = _run(0.0)
+    tput_on, _, _ = _run(1.0)
+    # the sync stages partition submit_task exactly; async/side stages
+    # (lease_acquire, lane_push, lane_queue) report alongside
+    sync = [s for s in sums
+            if s not in ("total", "lease_acquire", "lane_push",
+                         "lane_queue")]
+    stage_sum = sum(sums[s] for s in sync)
+    total = sums.get("total", 0.0)
+    overhead_pct = (100.0 * (tput_off - tput_on) / tput_off
+                    if tput_off else 0.0)
+    results.append(emit(
+        "envelope_submit", depth=n,
+        submit_per_s=tput_off,
+        stage_us={s: round(v / n * 1e6, 3) for s, v in sums.items()},
+        stage_sum_vs_total=(round(stage_sum / total, 3) if total else None),
+        stage_total_vs_wall=(round(total / wall_off, 3)
+                             if wall_off else None),
+        sampling_on_submit_per_s=tput_on,
+        sampling_overhead_pct=round(overhead_pct, 2)))
+
+
 # in-session families in dict order = default run order: "actors" LAST
 # among them so its creations contend with the task-event backlog the
 # earlier families leave (the regime the r4 bench dodged)
@@ -1161,6 +1234,7 @@ ALL = {
     "tail": bench_tail,
     "serve_prefix": bench_serve_prefix,
     "slo": bench_slo,
+    "submit": bench_submit,
 }
 
 # families that run inside a ray.init'd single-node session; "actors"

@@ -90,13 +90,16 @@ timeout "${CI_SMOKE_TIMEOUT_S:-600}" \
     python -m pytest tests/test_object_transfer.py tests/test_spilling.py \
         tests/test_data_shuffle.py -q
 
-echo "== [4/9] observability smoke: lifecycle + timeline + serve metrics + stall sentinel + slo =="
+echo "== [4/9] observability smoke: lifecycle + timeline + serve metrics + stall sentinel + profiling + slo =="
 # the flight recorder (task state transitions, Perfetto export, serving
 # histograms) gets a live end-to-end check: a silent telemetry
 # regression would otherwise only show up as weaker dashboards, not a
 # test failure. The stall-injection leg hangs a task on purpose and
 # requires the sentinel to flag it (WARNING event + captured stack)
-# through `cli health` and `cli stacks` with no human action. The slo
+# through `cli health` and `cli stacks` with no human action. The
+# profiling leg requires `cli profile` to name a known-hot function in
+# the merged cluster flamegraph and `cli memory` to flag a deliberately
+# pinned ownerless object as a leak suspect. The slo
 # leg installs specs at runtime, requires per-tenant attainment from
 # live traffic, and injects a slow replica that must fire the fast
 # burn-rate ERROR alert — every wait is deadline-bounded (never a hang)

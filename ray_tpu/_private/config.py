@@ -229,6 +229,30 @@ class Config:
     # default: it trades a duplicate execution for tail latency.
     straggler_drain_enabled: bool = False
     straggler_drain_after_factor: float = 2.0
+    # --- profiling & memory attribution plane (util/stacks.py,
+    #     util/hbm.py, state.memory_report; ref: Google-Wide Profiling —
+    #     always-on sampling at <1% overhead) ---
+    # always-on per-worker sampling profiler rate (folded wall/CPU
+    # stacks, drained by `cli profile` / the GCS merge). 0 disables the
+    # ambient sampler entirely; on-demand bursts still work at any rate.
+    profiling_sample_hz: float = 0.0
+    # frames kept per sampled stack (deeper frames are truncated)
+    profiling_max_stack_depth: int = 64
+    # submit-path stage timers (core_worker.submit_task histograms, the
+    # ROADMAP item-2 baseline instrument). Off = zero perf_counter reads
+    # on the submit hot path.
+    submit_stage_timers_enabled: bool = True
+    # start tracemalloc in every worker so memory_report can attribute
+    # per-worker Python heap deltas (tracemalloc costs ~2x allocation
+    # overhead — opt-in)
+    tracemalloc_enabled: bool = False
+    # HBM gauge publication period (per-chip live-buffer/fragmentation
+    # gauges read from the jax backend, piggybacked on the stall-probe
+    # tick). 0 disables.
+    hbm_gauge_interval_s: float = 10.0
+    # memory_report flags a pinned, ownerless object older than this as
+    # a leak suspect
+    memory_leak_age_s: float = 60.0
     # --- logging / metrics ---
     event_log_enabled: bool = True
     metrics_report_interval_ms: int = 2000

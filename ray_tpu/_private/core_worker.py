@@ -81,6 +81,15 @@ class _ActorState:
 
 _STREAM_DONE = object()
 
+
+def _rss_bytes() -> int:
+    """Resident set size (the heap stat when tracemalloc is off)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # graftlint: ignore[swallow] — non-Linux /proc
+        return 0       # miss: heap stat degrades to 0, never a fault
+
 # tail-tolerance hedge counters, created lazily: metric construction
 # spins up the flusher thread, which only processes that actually hedge
 # should pay for
@@ -94,6 +103,51 @@ def _hedge_counter(name: str):
         c = _hedge_counters.setdefault(name, Counter(
             name, "tail-tolerance hedged-execution counter"))
     return c
+
+
+# Submit-path stage timers (ROADMAP item 2's measured baseline): one
+# histogram family, submit_stage_seconds{stage=...}, µs-resolution
+# buckets (the stages live in the 1µs-1ms range — LATENCY_BUCKETS'
+# 0.5ms floor would flatten them all into one bucket). Created lazily
+# like the hedge counters so non-submitting processes never spin up
+# the metrics flusher.
+SUBMIT_STAGE_BUCKETS = [
+    1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 0.1, 1.0]
+_stage_hist_box: list = []
+
+
+def _stage_hist():
+    if not _stage_hist_box:
+        from ..util.metrics import Histogram
+        _stage_hist_box.append(Histogram(
+            "submit_stage_seconds",
+            "driver submit hot-path stage latency",
+            boundaries=SUBMIT_STAGE_BUCKETS))
+    return _stage_hist_box[0]
+
+
+class _StageClock:
+    """Consecutive perf_counter marks PARTITIONING submit_task into
+    submit_stage_seconds{stage=...} observations — no gaps between
+    marks, so the per-stage sums add up to the `total` stage minus
+    observe overhead (the invariant tests/test_profiling.py and the
+    bench_envelope submit family hold this family to)."""
+
+    __slots__ = ("hist", "t0", "t")
+
+    def __init__(self, hist):
+        self.hist = hist
+        self.t0 = self.t = time.perf_counter()
+
+    def mark(self, stage: str) -> None:
+        now = time.perf_counter()
+        self.hist.observe(now - self.t, tags={"stage": stage})
+        self.t = now
+
+    def total(self) -> None:
+        self.hist.observe(time.perf_counter() - self.t0,
+                          tags={"stage": "total"})
 
 
 @dataclass
@@ -252,6 +306,18 @@ class CoreWorker:
         else:
             self._lane_pool = None
 
+        # always-on sampling profiler for the DRIVER process (workers
+        # start theirs in worker_main with task annotation); drained by
+        # state.profile_cluster into the merged profile as "driver"
+        self._driver_sampler = None
+        if mode == "driver" and self.cfg.profiling_sample_hz > 0:
+            from ..util import stacks as _stacks
+
+            self._driver_sampler = _stacks.StackSampler(
+                self.cfg.profiling_sample_hz,
+                max_depth=self.cfg.profiling_max_stack_depth,
+                name="stack_sampler").start()
+
         _set_ref_registry(self)
 
     def _on_reclaim_lease(self, payload):
@@ -368,6 +434,9 @@ class CoreWorker:
         return {"status": "gone", "data": None}
 
     def shutdown(self):
+        if self._driver_sampler is not None:
+            self._driver_sampler.stop(timeout=2.0)
+            self._driver_sampler = None
         if self._lane_pool is not None:
             self._lane_pool.close()
         for lane in list(self._actor_lanes.values()):
@@ -559,6 +628,80 @@ class CoreWorker:
             await self.raylet.call("free_objects", {"object_ids": oids})
         except Exception:
             pass
+
+    # -------------------------------------------------- memory attribution
+    def local_memory_report(self) -> dict:
+        """This process's object-reference claims + heap stats: the
+        per-process half of state.memory_report (the GCS merges claims
+        from every worker — plus the driver's, passed through the call
+        payload — against each node's store inventory to attribute
+        bytes per owner/ref-type)."""
+        import sys as _sys
+        import tracemalloc
+
+        claims: Dict[str, dict] = {}
+
+        def _claim(oid: ObjectID) -> dict:
+            rec = claims.get(oid.hex())
+            if rec is None:
+                rec = claims[oid.hex()] = {
+                    "local_refs": 0, "task_deps": 0, "owned": False,
+                    "borrowed_from": None}
+            return rec
+
+        with self._ref_lock:
+            owned = set(self._owned_in_plasma)
+            borrowed = dict(self._borrowed)
+            local_refs = dict(self._local_refs)
+            task_deps = dict(self._task_deps)
+        for oid in owned:
+            _claim(oid)["owned"] = True
+        for oid, owner in borrowed.items():
+            _claim(oid)["borrowed_from"] = owner
+        if self._rc is not None:
+            # native RefTable: counts are queryable per oid but the
+            # table is not enumerable — owned/borrowed sets bound the
+            # plasma-relevant oids (everything else is memory-store)
+            for oid in set(owned) | set(borrowed):
+                rec = _claim(oid)
+                try:
+                    rec["local_refs"] = self._rc.local_count(oid.binary())
+                    if rec["local_refs"] == 0 and \
+                            self._rc.contains(oid.binary()):
+                        # alive with zero local refs: held by a task-dep
+                        # pin (the table has no per-kind count getter)
+                        rec["task_deps"] = 1
+                except Exception:  # graftlint: ignore[swallow] — native
+                    pass           # table probe is advisory enrichment
+        else:
+            for oid, n in local_refs.items():
+                _claim(oid)["local_refs"] = n
+            for oid, n in task_deps.items():
+                _claim(oid)["task_deps"] = n
+        report = {
+            "address": self.address,
+            "worker_id": self.worker_id.hex(),
+            "pid": os.getpid(),
+            "mode": self.mode,
+            "num_inflight_tasks": len(self._inflight),
+            "memory_store": self.memory_store.usage_report(),
+            "claims": claims,
+        }
+        if tracemalloc.is_tracing():
+            current, peak = tracemalloc.get_traced_memory()
+            report["heap"] = {"kind": "tracemalloc",
+                              "current_bytes": current,
+                              "peak_bytes": peak}
+        else:
+            report["heap"] = {"kind": "rss", "current_bytes": _rss_bytes()}
+        try:
+            from ..util import hbm
+
+            report["hbm"] = (hbm.collect_hbm_stats()
+                             if "jax" in _sys.modules else [])
+        except Exception:
+            report["hbm"] = []
+        return report
 
     # ----------------------------------------------------------- task events
     def _record_task_event(self, task_id: TaskID, **fields) -> None:
@@ -1263,6 +1406,8 @@ class CoreWorker:
         return wire
 
     def submit_task(self, func: Any, args: tuple, kwargs: dict, opts: dict):
+        clock = (_StageClock(_stage_hist())
+                 if self.cfg.submit_stage_timers_enabled else None)
         # validate options BEFORE packing args: _pack_args pins dependencies
         # that are only released through the submit coroutine's finally
         strategy = self._resolve_strategy(opts)
@@ -1271,7 +1416,11 @@ class CoreWorker:
                 f"speculation must be 'auto' or 'off', got "
                 f"{opts.get('speculation')!r}")
         descriptor = self.export_function(func)
+        if clock:
+            clock.mark("export_fn")
         packed, deps = self._pack_args(args, kwargs)
+        if clock:
+            clock.mark("serialize")
         num_returns = opts.get("num_returns", 1)
         streaming = num_returns == "streaming"
         spec = TaskSpec(
@@ -1299,23 +1448,38 @@ class CoreWorker:
         from ..util.tracing import inject_trace_ctx
 
         inject_trace_ctx(spec)
+        if clock:
+            clock.mark("spec_mint")
         # registered before the submit coroutine runs, so an immediate
         # cancel() cannot race past the bookkeeping
         self._inflight[spec.task_id] = {"canceled": False, "worker_address": None}
         if self.cfg.lineage_pinning_enabled and not streaming:
             self._lineage[spec.task_id] = spec
+        if clock:
+            clock.mark("bookkeeping")
         submit_t = time.time()
         self._record_transition(spec.task_id, "SUBMITTED", ts=submit_t,
                                 name=spec.function.repr_name,
                                 state="SUBMITTED", start_time=submit_t)
+        if clock:
+            clock.mark("task_event")
         if streaming:
             self._streams[spec.task_id] = _StreamState()
             self.io.spawn(self._submit_normal(spec, deps))
+            if clock:
+                clock.mark("dispatch")
+                clock.total()
             return ObjectRefGenerator(spec.task_id, self)
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
         if self._lane_eligible(spec, deps) and self._lane_submit(spec):
+            if clock:
+                clock.mark("dispatch")
+                clock.total()
             return refs
         self.io.spawn(self._submit_normal(spec, deps))
+        if clock:
+            clock.mark("dispatch")
+            clock.total()
         return refs
 
     def _lane_eligible(self, spec: TaskSpec, deps: List[ObjectID]) -> bool:
@@ -1544,7 +1708,15 @@ class CoreWorker:
         sched_class = spec.scheduling_class()
         pool = self._lease_pools.setdefault(sched_class, _LeasePool())
         self._record_transition(spec.task_id, "PENDING_NODE_ASSIGNMENT")
+        # lease-queue stage: async-side (pool pop or raylet round trip +
+        # spillback chain), so it reports alongside — not inside — the
+        # synchronous submit partition
+        timed = self.cfg.submit_stage_timers_enabled
+        t_lease = time.perf_counter() if timed else 0.0
         grant = await self._acquire_lease(pool, spec, avoid_node=avoid_node)
+        if timed:
+            _stage_hist().observe(time.perf_counter() - t_lease,
+                                  tags={"stage": "lease_acquire"})
         keep = False
         try:
             if publish_state is not None and publish_state["published"]:

@@ -133,6 +133,8 @@ class _Lane:
             info = self.core._inflight.get(spec.task_id)
             if info is not None:
                 info["worker_address"] = self.worker_address
+        timed = self.core.cfg.submit_stage_timers_enabled
+        t_frame = time.perf_counter() if timed else 0.0
         frame = pickle.dumps(batch, protocol=5)
         try:
             with self._push_lock:
@@ -157,6 +159,17 @@ class _Lane:
                 self.outstanding -= len(batch)
             self._mark_dead()
             return 0
+        if timed:
+            from .core_worker import _stage_hist  # lazy: import cycle
+
+            hist = _stage_hist()
+            now = time.perf_counter()
+            # per-frame cost (one pickle + one ring push per batch)
+            hist.observe(now - t_frame, tags={"stage": "lane_push"})
+            for _, event in items:
+                enq = getattr(event, "_lane_enq_t", None)
+                if enq is not None:
+                    hist.observe(now - enq, tags={"stage": "lane_queue"})
         return len(batch)
 
     # ---- reply path ----
@@ -360,6 +373,11 @@ class LanePool:
     def try_submit(self, spec: TaskSpec, event: threading.Event) -> bool:
         if self.closed:
             return False
+        if self.core.cfg.submit_stage_timers_enabled:
+            # feeder-queue wait stamp, read by _Lane.submit_many (rides
+            # the event object so the queue tuple shape stays unchanged
+            # through the requeue/cancel paths)
+            event._lane_enq_t = time.perf_counter()
         with self._qlock:
             self._queue.append((spec, event))
         self._qevent.set()

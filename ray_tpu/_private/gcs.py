@@ -689,6 +689,243 @@ class GcsServer:
             out.append(dump)
         return out
 
+    async def handle_profile_cluster(self, payload, conn):
+        """Cluster-wide sampling burst (cli profile / dashboard
+        flamegraph): start per-worker samplers on every matching alive
+        raylet, sleep the window on the GCS loop, stop them, and merge
+        the folded stacks — overall, per node, and per scheduling class
+        (the ``task:<fn>`` roots the workers annotate)."""
+        duration_s = float(payload.get("duration_s", 5.0))
+        hz = float(payload.get("hz", 100.0))
+        prefix = str(payload.get("node_id") or "")
+        errors: List[dict] = []
+        started = []
+        for info in list(self.nodes.values()):
+            if not info.alive:
+                continue
+            if prefix and not info.node_id.hex().startswith(prefix):
+                continue
+            try:
+                client = await self._raylet_client(info.address)
+                res = await client.call("profile_start_workers",
+                                        {"hz": hz}, timeout=10)
+                errors.extend({"node_id": info.node_id.hex(), **err}
+                              for err in res.get("errors", []))
+                started.append(info)
+            except Exception as e:
+                errors.append({"node_id": info.node_id.hex(),
+                               "error": str(e) or repr(e)})
+        await asyncio.sleep(max(0.0, duration_s))
+        wall: Dict[str, int] = {}
+        cpu: Dict[str, int] = {}
+        per_node: Dict[str, Dict[str, int]] = {}
+        samples = 0
+        workers = 0
+        for info in started:
+            try:
+                client = await self._raylet_client(info.address)
+                dump = await client.call("profile_stop_workers", {},
+                                         timeout=15)
+            except Exception as e:
+                errors.append({"node_id": info.node_id.hex(),
+                               "error": str(e) or repr(e)})
+                continue
+            node_hex = dump.get("node_id", info.node_id.hex())
+            node_wall = per_node.setdefault(node_hex, {})
+            for snap in dump.get("workers", []):
+                if snap.get("error"):
+                    errors.append({"node_id": node_hex,
+                                   "pid": snap.get("pid"),
+                                   "error": snap["error"]})
+                    continue
+                workers += 1
+                samples += int(snap.get("samples", 0))
+                w = snap.get("wall", {})
+                for key, n in w.items():
+                    wall[key] = wall.get(key, 0) + n
+                    node_wall[key] = node_wall.get(key, 0) + n
+                for key, n in snap.get("cpu", {}).items():
+                    cpu[key] = cpu.get(key, 0) + n
+        # scheduling-class rollup: the worker annotates task-executing
+        # threads with a ``task:<fn>`` root frame; everything else is
+        # runtime/idle machinery.
+        by_class: Dict[str, int] = {}
+        for key, n in wall.items():
+            root = key.split(";", 1)[0]
+            cls = root[5:] if root.startswith("task:") else "(runtime)"
+            by_class[cls] = by_class.get(cls, 0) + n
+        return {"duration_s": duration_s, "hz": hz, "samples": samples,
+                "workers": workers, "wall": wall, "cpu": cpu,
+                "per_node": per_node, "by_class": by_class,
+                "errors": errors}
+
+    async def handle_memory_report(self, payload, conn):
+        """Cluster memory attribution: fan ``node_memory_report`` to
+        every alive raylet, merge the per-worker reference claims (plus
+        the driver's, passed in the payload — the driver is not raylet-
+        registered), and classify every live store object by ref-type:
+        spilled > pending_task_arg > pinned > local_ref > borrowed >
+        unreferenced. Pinned objects nobody claims that have out-aged
+        ``memory_leak_age_s`` are flagged as leak suspects."""
+        from .config import global_config
+
+        leak_age_s = float(payload.get(
+            "leak_age_s", global_config().memory_leak_age_s))
+        limit = int(payload.get("limit", 200))
+        errors: List[dict] = []
+        node_reports = []
+        for info in list(self.nodes.values()):
+            if not info.alive:
+                continue
+            try:
+                client = await self._raylet_client(info.address)
+                rep = await client.call("node_memory_report", {},
+                                        timeout=15)
+                node_reports.append(rep)
+            except Exception as e:
+                errors.append({"node_id": info.node_id.hex(),
+                               "error": str(e) or repr(e)})
+
+        # ---- merge reference claims across every worker + the driver
+        merged: Dict[str, dict] = {}
+
+        def _absorb(label: str, claims: dict):
+            for oid, c in (claims or {}).items():
+                m = merged.setdefault(oid, {
+                    "local_refs": 0, "task_deps": 0,
+                    "owners": [], "borrowers": 0})
+                m["local_refs"] += int(c.get("local_refs", 0))
+                m["task_deps"] += int(c.get("task_deps", 0))
+                if c.get("owned"):
+                    m["owners"].append(label)
+                if c.get("borrowed_from"):
+                    m["borrowers"] += 1
+
+        worker_summaries = []
+        for rep in node_reports:
+            node_hex = rep.get("node_id", "")
+            for wrep in rep.get("workers", []):
+                if wrep.get("error"):
+                    errors.append({"node_id": node_hex,
+                                   "pid": wrep.get("pid"),
+                                   "error": wrep["error"]})
+                label = (wrep.get("address")
+                         or "pid:%s" % wrep.get("pid"))
+                _absorb(label, wrep.get("claims"))
+                worker_summaries.append({
+                    "node_id": node_hex,
+                    "worker_id": wrep.get("worker_id", ""),
+                    "address": wrep.get("address", ""),
+                    "pid": wrep.get("pid"),
+                    "mode": wrep.get("mode", ""),
+                    "num_inflight_tasks": wrep.get(
+                        "num_inflight_tasks", 0),
+                    "heap": wrep.get("heap", {}),
+                    "hbm": wrep.get("hbm", []),
+                    "memory_store": wrep.get("memory_store", {}),
+                })
+        driver = payload.get("driver") or {}
+        if driver:
+            _absorb("driver", driver.get("claims"))
+            worker_summaries.append({
+                "node_id": "", "worker_id": driver.get("worker_id", ""),
+                "address": driver.get("address", "driver"),
+                "pid": driver.get("pid"), "mode": "driver",
+                "num_inflight_tasks": driver.get("num_inflight_tasks", 0),
+                "heap": driver.get("heap", {}),
+                "hbm": driver.get("hbm", []),
+                "memory_store": driver.get("memory_store", {}),
+            })
+
+        # ---- classify every store object
+        def _ref_type(meta: dict, claim: Optional[dict]) -> str:
+            if meta.get("spilled"):
+                return "spilled"
+            if claim and claim.get("task_deps", 0) > 0:
+                return "pending_task_arg"
+            if meta.get("pinned", 0) > 0:
+                return "pinned"
+            if claim and claim.get("local_refs", 0) > 0:
+                return "local_ref"
+            if claim and claim.get("borrowers", 0) > 0:
+                return "borrowed"
+            return "unreferenced"
+
+        nodes_out = []
+        objects: List[dict] = []
+        leak_suspects: List[dict] = []
+        cluster_by_type: Dict[str, int] = {}
+        cluster_used = 0
+        cluster_spill = 0
+        cluster_attr = 0
+        for rep in node_reports:
+            node_hex = rep.get("node_id", "")
+            store = rep.get("store", {})
+            by_type: Dict[str, int] = {}
+            for oid, meta in store.get("objects", {}).items():
+                claim = merged.get(oid)
+                rtype = _ref_type(meta, claim)
+                size = int(meta.get("size", 0))
+                by_type[rtype] = by_type.get(rtype, 0) + size
+                entry = {
+                    "object_id": oid, "node_id": node_hex,
+                    "size": size,
+                    "age_s": round(float(meta.get("age_s", 0.0)), 1),
+                    "pinned": int(meta.get("pinned", 0)),
+                    "spilled": bool(meta.get("spilled")),
+                    "ref_type": rtype,
+                    "owners": list(claim["owners"]) if claim else [],
+                }
+                # leak suspect: pinned by the control plane, claimed by
+                # nobody, and older than the leak threshold — the owner
+                # likely died or dropped the ref without unpinning.
+                unclaimed = (not claim
+                             or (claim["local_refs"] == 0
+                                 and claim["task_deps"] == 0))
+                if (entry["pinned"] > 0 and unclaimed
+                        and not entry["spilled"]
+                        and entry["age_s"] > leak_age_s):
+                    entry["leak_suspect"] = True
+                    leak_suspects.append(entry)
+                else:
+                    entry["leak_suspect"] = False
+                objects.append(entry)
+            used = int(store.get("used_bytes", 0))
+            spill = int(store.get("spill_bytes", 0))
+            attr = sum(b for t, b in by_type.items()
+                       if t not in ("unreferenced", "spilled"))
+            cluster_used += used
+            cluster_spill += spill
+            cluster_attr += attr
+            for t, b in by_type.items():
+                cluster_by_type[t] = cluster_by_type.get(t, 0) + b
+            nodes_out.append({
+                "node_id": node_hex,
+                "used_bytes": used,
+                "capacity_bytes": int(store.get("capacity_bytes", 0)),
+                "spill_bytes": spill,
+                "num_objects": int(store.get("num_objects", 0)),
+                "by_ref_type": by_type,
+            })
+        objects.sort(key=lambda o: o["size"], reverse=True)
+        return {
+            "nodes": nodes_out,
+            "workers": worker_summaries,
+            "objects": objects[:limit] if limit > 0 else objects,
+            "leak_suspects": leak_suspects,
+            "cluster": {
+                "used_bytes": cluster_used,
+                "spill_bytes": cluster_spill,
+                "attributed_bytes": cluster_attr,
+                "by_ref_type": cluster_by_type,
+                "num_objects": len(objects),
+                "attributed_fraction": (
+                    cluster_attr / cluster_used
+                    if cluster_used > 0 else 1.0),
+            },
+            "errors": errors,
+        }
+
     # ---- pubsub ----
     async def _publish(self, channel: str, payload: Any):
         for conn in list(self._subs.get(channel, ())):

@@ -297,6 +297,11 @@ class SharedObjectStore:
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._lock = locking.make_lock("SharedObjectStore._lock")
         self._used = 0
+        # control-plane pin counts THIS process issued (memory
+        # attribution needs them readable; _entries only tracks pins of
+        # objects this process has mapped, and the native index records
+        # pins but does not expose per-object counts)
+        self._pins: Dict[ObjectID, int] = {}
         # streaming creations (cut-through watermark), per process
         self._inprogress: Dict[ObjectID, InProgress] = {}
         # per-oid single-flight gate for spill restores (threads get()ing
@@ -863,6 +868,7 @@ class SharedObjectStore:
             self._idx.pin(oid.binary())  # node-global: protects from
             # evictions by ANY process sharing the store
         with self._lock:
+            self._pins[oid] = self._pins.get(oid, 0) + 1
             entry = self._entries.get(oid)
             if entry is not None:
                 entry.pin_count += 1
@@ -871,12 +877,18 @@ class SharedObjectStore:
         if self._idx is not None:
             self._idx.unpin(oid.binary())
         with self._lock:
+            count = self._pins.get(oid, 0) - 1
+            if count <= 0:
+                self._pins.pop(oid, None)
+            else:
+                self._pins[oid] = count
             entry = self._entries.get(oid)
             if entry is not None and entry.pin_count > 0:
                 entry.pin_count -= 1
 
     def delete(self, oid: ObjectID) -> None:
         with self._lock:
+            self._pins.pop(oid, None)
             entry = self._entries.pop(oid, None)
             if entry is not None:
                 if self._idx is None:
@@ -906,6 +918,63 @@ class SharedObjectStore:
         if self._idx is not None:
             return self._idx.used()
         return self._used
+
+    def usage_report(self) -> dict:
+        """Node-global object inventory for memory attribution
+        (state.memory_report): scans the shared store directory — the
+        substrate every process on the node writes — rather than this
+        process's ``_entries``, so objects created by sibling processes
+        count too (both native-index and fallback modes). Pin counts
+        merge this process's control-plane pins (the raylet, which
+        serves this per node, is the process that executes owner
+        pin/unpin RPCs) with mapped-entry pins."""
+        now = time.time()
+        hex_len = ObjectID.SIZE * 2
+        objects: Dict[str, dict] = {}
+
+        def _scan(directory: str, spilled: bool) -> None:
+            try:
+                with os.scandir(directory) as it:
+                    for de in it:
+                        name = de.name
+                        if len(name) != hex_len:
+                            continue
+                        try:
+                            bytes.fromhex(name)
+                            st = de.stat()
+                        except (ValueError, OSError):
+                            continue
+                        objects[name] = {
+                            "size": st.st_size,
+                            "age_s": max(0.0, now - st.st_mtime),
+                            "pinned": 0,
+                            "sealed": True,
+                            "spilled": spilled,
+                        }
+            except OSError:
+                pass
+
+        _scan(self.dir, spilled=False)
+        if self.spill_dir:
+            _scan(self.spill_dir, spilled=True)
+        with self._lock:
+            for oid, entry in self._entries.items():
+                rec = objects.get(oid.hex())
+                if rec is not None:
+                    rec["pinned"] = max(rec["pinned"], entry.pin_count)
+                    rec["sealed"] = entry.sealed
+            for oid, count in self._pins.items():
+                rec = objects.get(oid.hex())
+                if rec is not None and count > 0:
+                    rec["pinned"] = max(rec["pinned"], count)
+        return {
+            "used_bytes": self.used_bytes(),
+            "capacity_bytes": self.capacity,
+            "spill_bytes": sum(r["size"] for r in objects.values()
+                               if r["spilled"]),
+            "num_objects": len(objects),
+            "objects": objects,
+        }
 
     def _maybe_evict(self, incoming: int) -> None:
         # caller holds self._lock
@@ -994,6 +1063,13 @@ class MemoryStore:
     def contains(self, oid: ObjectID) -> bool:
         with self._lock:
             return oid in self._objects
+
+    def usage_report(self) -> dict:
+        """In-process (inlined small objects) usage for memory_report."""
+        with self._lock:
+            return {"num_objects": len(self._objects),
+                    "used_bytes": sum(len(v) for v
+                                      in self._objects.values())}
 
     def wait_handle(self, oid: ObjectID) -> threading.Event:
         ev = threading.Event()

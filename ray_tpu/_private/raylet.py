@@ -723,6 +723,65 @@ class Raylet:
             out.append(dump)
         return {"node_id": self.node_id.hex(), "workers": out}
 
+    async def handle_profile_start_workers(self, payload, conn):
+        """Fan profile_start (burst sampler at ``hz``) across this
+        node's live workers. Per-worker failures are reported, not
+        raised — one dead worker must not kill a cluster profile."""
+        hz = float(payload.get("hz", 100.0))
+        started, errors = 0, []
+        for worker in list(self._workers.values()):
+            if not worker.alive:
+                continue
+            try:
+                client = await self._peer_client(worker.address)
+                if await client.call("profile_start", {"hz": hz},
+                                     timeout=5):
+                    started += 1
+            except Exception as e:
+                errors.append({"pid": worker.pid,
+                               "error": str(e) or repr(e)})
+        return {"node_id": self.node_id.hex(), "started": started,
+                "errors": errors}
+
+    async def handle_profile_stop_workers(self, payload, conn):
+        """Collect each worker's folded-stack snapshot (burst if one is
+        running, else the ambient accumulation)."""
+        out = []
+        for worker in list(self._workers.values()):
+            if not worker.alive:
+                continue
+            try:
+                client = await self._peer_client(worker.address)
+                snap = await client.call("profile_stop", {}, timeout=10)
+            except Exception as e:
+                snap = {"pid": worker.pid, "error": str(e) or repr(e),
+                        "wall": {}, "cpu": {}, "samples": 0}
+            snap["node_id"] = self.node_id.hex()
+            out.append(snap)
+        return {"node_id": self.node_id.hex(), "workers": out}
+
+    async def handle_node_memory_report(self, payload, conn):
+        """This node's memory-attribution inputs: the shared store's
+        object inventory (directory scan — node-global in both index
+        modes) plus every live worker's reference claims / heap stats."""
+        workers = []
+        for worker in list(self._workers.values()):
+            if not worker.alive:
+                continue
+            try:
+                client = await self._peer_client(worker.address)
+                rep = await client.call("memory_report", {}, timeout=10)
+            except Exception as e:
+                rep = {"pid": worker.pid, "error": str(e) or repr(e),
+                       "claims": {}}
+            rep["worker_id"] = worker.worker_id.hex()
+            workers.append(rep)
+        return {
+            "node_id": self.node_id.hex(),
+            "store": self.store.usage_report(),
+            "workers": workers,
+        }
+
     async def stop(self):
         for task in list(self._token_conn_watchers.values()):
             task.cancel()
@@ -2136,6 +2195,7 @@ class Raylet:
             "num_pending_leases": len(self._pending_leases),
             "num_objects": len(self._sealed),
             "store_used_bytes": self.store.used_bytes(),
+            "store_capacity_bytes": self.store.capacity,
             # per-lease detail: who holds this node's resources (the
             # `ray memory`-style leak-hunting view)
             "leases": [{

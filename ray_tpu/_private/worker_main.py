@@ -24,7 +24,6 @@ import queue
 import sys
 import threading
 import time
-import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional, Tuple
 
@@ -40,6 +39,7 @@ from .rpc import RpcClient, RpcServer
 from . import serialization as ser
 from .task_spec import ArgKind, TaskSpec
 from .. import exceptions as exc
+from ..util import stacks
 
 
 def _cheap_size_bound(value, limit: int, _depth: int = 2) -> bool:
@@ -203,6 +203,13 @@ class TaskExecutor:
         # (fn name, duration) of completions since the last stall_probe
         self._completed_durations: List[Tuple[str, float]] = []
         self._durations_lock = locking.make_lock("TaskExecutor._durations_lock")
+        # profiling plane (util/stacks.py): an always-on ambient sampler
+        # (profiling_sample_hz > 0) plus an on-demand burst sampler the
+        # profile_start/profile_stop RPCs drive; task-thread samples are
+        # rooted "task:<fn>" so the GCS can merge per scheduling class
+        self._ambient_sampler: Optional[stacks.StackSampler] = None
+        self._burst_sampler: Optional[stacks.StackSampler] = None
+        self._hbm_last_report = 0.0
 
     def _register_running(self, task_id, fn_name: str = "") -> None:
         """Bind the executing thread; honor a cancel that raced startup."""
@@ -241,40 +248,90 @@ class TaskExecutor:
             {"task_id": tid.hex(), "fn": fn, "age_s": now - t0}
             for tid, (_, fn, t0) in list(self._running_since.items())
         ]
+        self._maybe_report_hbm()
         return {"pid": os.getpid(), "running": running,
                 "completed": completed}
 
     def dump_stacks(self) -> dict:
         """sys._current_frames() snapshot, each thread annotated with the
         task it is executing (if any) and its time-in-state. The remote
-        half of `cli.py stacks` and the watchdogs' hang forensics."""
+        half of `cli.py stacks` and the watchdogs' hang forensics.
+        Capture/annotation lives in util/stacks.py, shared with the
+        sampling profiler (one format, one annotation path)."""
         now = time.time()
-        by_ident = {ident: (tid, fn, t0)
-                    for tid, (ident, fn, t0) in
-                    list(self._running_since.items())}
-        names = {t.ident: t.name for t in threading.enumerate()}
-        threads = []
-        for ident, frame in sys._current_frames().items():
-            tid_fn = by_ident.get(ident)
-            threads.append({
-                "thread_id": ident,
-                "name": names.get(ident, "?"),
-                "task_id": tid_fn[0].hex() if tid_fn else None,
-                "fn": tid_fn[1] if tid_fn else None,
-                "running_for_s": (now - tid_fn[2]) if tid_fn else None,
-                "stack": "".join(traceback.format_stack(frame)),
-            })
-        # running task threads first, then by thread id — the hung one
-        # is what the reader came for
-        threads.sort(key=lambda t: (t["task_id"] is None,
-                                    t["thread_id"]))
         return {
             "pid": os.getpid(),
             "worker_id": self.core.worker_id.hex(),
             "actor_id": self.actor_id.hex() if self.actor_id else None,
             "time": now,
-            "threads": threads,
+            "threads": stacks.capture_threads(self._running_since, now=now),
         }
+
+    # -------------------------------------------------- sampling profiler
+    def _annotate_thread(self, ident: int) -> Optional[str]:
+        """Root label for a sampled thread: the task it is executing (the
+        sampler's per-scheduling-class merge handle), None otherwise."""
+        for _tid, (tident, fn, _t0) in list(self._running_since.items()):
+            if tident == ident:
+                return f"task:{fn or '?'}"
+        return None
+
+    def start_ambient_sampler(self, hz: float) -> None:
+        """Always-on low-rate mode (profiling_sample_hz knob)."""
+        if hz <= 0 or self._ambient_sampler is not None:
+            return
+        self._ambient_sampler = stacks.StackSampler(
+            hz, annotate=self._annotate_thread,
+            max_depth=global_config().profiling_max_stack_depth,
+            name="stack_sampler").start()
+
+    def profile_start(self, hz: float) -> bool:
+        """On-demand burst capture; a second start supersedes the first
+        (the previous burst's thread is joined, its samples dropped)."""
+        if self._burst_sampler is not None:
+            self._burst_sampler.stop(timeout=1.0)
+        self._burst_sampler = stacks.StackSampler(
+            hz, annotate=self._annotate_thread,
+            max_depth=global_config().profiling_max_stack_depth,
+            name="stack_sampler_burst").start()
+        return True
+
+    def profile_stop(self) -> dict:
+        """End the burst (or drain the ambient accumulation when no
+        burst is running) and return the folded-stack snapshot."""
+        burst, self._burst_sampler = self._burst_sampler, None
+        if burst is not None:
+            burst.stop(timeout=2.0)
+            snap = burst.snapshot()
+        elif self._ambient_sampler is not None:
+            snap = self._ambient_sampler.snapshot(reset=True)
+        else:
+            snap = {"pid": os.getpid(), "hz": 0.0, "samples": 0,
+                    "duration_s": 0.0, "wall": {}, "cpu": {}}
+        snap["worker_id"] = self.core.worker_id.hex()
+        snap["actor_id"] = self.actor_id.hex() if self.actor_id else None
+        return snap
+
+    def _maybe_report_hbm(self) -> None:
+        """Rate-limited HBM gauge publication, piggybacked on the
+        watchdog's stall_probe tick (no extra thread, no RPC). Inert
+        until task code actually initializes jax in this process."""
+        if "jax" not in sys.modules:
+            return
+        interval = global_config().hbm_gauge_interval_s
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        if now - self._hbm_last_report < interval:
+            return
+        self._hbm_last_report = now
+        try:
+            from ..util import hbm
+
+            hbm.publish_hbm_gauges(node=self.core.node_id.hex()[:12])
+        except Exception:  # graftlint: ignore[swallow] — HBM gauges are
+            pass           # best-effort; a backend hiccup can't kill
+            # the worker main loop that publishes them
 
     # ---------------------------------------------------------- arg loading
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
@@ -707,6 +764,14 @@ async def _amain():
     await raylet.connect()
 
     executor = TaskExecutor(core, raylet)
+    # read AFTER _connect(): _system_config overrides land there
+    if cfg.profiling_sample_hz > 0:
+        executor.start_ambient_sampler(cfg.profiling_sample_hz)
+    if cfg.tracemalloc_enabled:
+        import tracemalloc
+
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
     server = RpcServer(my_socket, name=f"worker-{worker_id.hex()[:8]}")
     shutdown_event = asyncio.Event()
 
@@ -879,6 +944,17 @@ async def _amain():
     async def handle_stall_probe(payload, conn):
         return executor.stall_probe()
 
+    async def handle_profile_start(payload, conn):
+        return executor.profile_start(float(payload.get("hz", 100.0)))
+
+    async def handle_profile_stop(payload, conn):
+        # like dump_stacks: served from the event loop so a cluster
+        # profile still answers while every executor thread is busy
+        return executor.profile_stop()
+
+    async def handle_memory_report(payload, conn):
+        return core.local_memory_report()
+
     server.register("push_task", handle_push_task)
     server.register("cancel_task", handle_cancel_task)
     server.register("generator_ack", handle_generator_ack)
@@ -886,6 +962,9 @@ async def _amain():
     server.register("health", handle_health)
     server.register("dump_stacks", handle_dump_stacks)
     server.register("stall_probe", handle_stall_probe)
+    server.register("profile_start", handle_profile_start)
+    server.register("profile_stop", handle_profile_stop)
+    server.register("memory_report", handle_memory_report)
     server.register("fastlane_attach", handle_fastlane_attach)
     # owner-serve: this worker's owned small objects (nested submissions)
     server.register("fetch_object", core._handle_fetch_object)

@@ -7,7 +7,7 @@ the same tables from the JSON API, no build step, no assets).
     GET /                  — HTML UI (auto-refreshing tables)
     GET /api/nodes /api/actors /api/tasks /api/objects /api/jobs
         /api/cluster_status /api/metrics /api/health /api/stacks
-        /api/serve /api/slo
+        /api/serve /api/slo /api/profile /api/memory
     GET /metrics           — Prometheus text scrape endpoint
                              (ref: _private/prometheus_exporter.py)
 """
@@ -51,6 +51,13 @@ _UI_HTML = """<!doctype html>
  <section><h2>Cluster</h2><div id="cluster"></div></section>
  <section><h2>Health</h2><div id="health"></div></section>
  <section><h2>Nodes</h2><div id="nodes"></div></section>
+ <section><h2>Memory</h2><div id="memory"></div></section>
+ <section><h2>Profile</h2>
+  <div style="margin-bottom:6px">duration <input id="profdur" value="2"
+   size="3">s&nbsp; hz <input id="profhz" value="50" size="4">
+   <button onclick="runProfile()">sample</button>
+   <span id="profstatus"></span></div>
+  <div id="flame"></div></section>
  <section><h2>Actors</h2><div id="actors"></div></section>
  <section><h2>Serve</h2><div id="serve"></div></section>
  <section><h2>SLO</h2><div id="slo"></div></section>
@@ -82,6 +89,10 @@ function table(rows,cols){if(!rows||!rows.length)return'<i>none</i>';
   h+='<tr>'+cols.map(c=>'<td>'+fmt(r[c]??'')+'</td>').join('')+'</tr>';
  return h+'</table>';}
 async function j(u){const r=await fetch(u);return r.json();}
+const fmtB=n=>{const u=['B','KiB','MiB','GiB','TiB'];let i=0;
+ while(Math.abs(n)>=1024&&i<u.length-1){n/=1024;i++;}
+ return (i?n.toFixed(1):Math.round(n))+u[i];};
+let memNodes={},memHbm={};
 async function refresh(){try{
  const cs=await j('/api/cluster_status');
  document.getElementById('cluster').innerHTML=table([{
@@ -96,8 +107,13 @@ async function refresh(){try{
                        :'<span class="pill bad">dead</span>'},
   heartbeat:n.HeartbeatAgeS==null?'never':n.HeartbeatAgeS.toFixed(1)+'s ago',
   clock_offset:((n.ClockOffset||0)>=0?'+':'')+(n.ClockOffset||0).toFixed(4)+'s',
+  store:(s=>s?fmtB(s.used_bytes||0)+'/'+fmtB(s.capacity_bytes||0)
+   +' ('+(s.num_objects||0)+' obj)':'')(memNodes[n.NodeID]),
+  hbm:(h=>h?fmtB(h.use)+' on '+h.n+' chip(s)':'')
+   (memHbm[(n.NodeID||'').slice(0,12)]),
   resources:n.Resources||{},labels:n.Labels||{}})),
-  ['id','address','alive','heartbeat','clock_offset','resources','labels']);
+  ['id','address','alive','heartbeat','clock_offset','store','hbm',
+   'resources','labels']);
  const actors=await j('/api/actors');
  document.getElementById('actors').innerHTML=table(actors.map(a=>({
   id:(a.actor_id||'').slice(0,12),class:a.class_name,state:a.state,
@@ -213,6 +229,63 @@ async function refreshTimeline(){try{
   error:e.args&&e.args.error||''})),
   ['task','start','dur_ms','node','worker','phase','state','error']);
 }catch(e){}}
+async function refreshMemory(){try{
+ const m=await j('/api/memory');
+ memNodes={};for(const nd of m.nodes||[])memNodes[nd.node_id]=nd;
+ const cl=m.cluster||{};
+ let html=table([{live:fmtB(cl.used_bytes||0),
+  spilled:fmtB(cl.spill_bytes||0),objects:cl.num_objects||0,
+  attributed:((cl.attributed_fraction||0)*100).toFixed(1)+'%'}]);
+ const bt=Object.entries(cl.by_ref_type||{}).sort((a,b)=>b[1]-a[1]);
+ if(bt.length)html+='<div style="margin-top:8px">by ref-type</div>'
+  +table(bt.map(([t,b])=>({ref_type:t,bytes:fmtB(b)})));
+ const ls=m.leak_suspects||[];
+ if(ls.length)html+='<div style="margin-top:8px"><span class="pill bad">'
+  +ls.length+' leak suspect(s)</span></div>'
+  +table(ls.map(o=>({object:(o.object_id||'').slice(0,16),
+   size:fmtB(o.size||0),pinned:o.pinned,age_s:o.age_s,
+   node:(o.node_id||'').slice(0,12)})));
+ const ws=m.workers||[];
+ if(ws.length)html+='<div style="margin-top:8px">worker heap</div>'
+  +table(ws.map(w=>({pid:w.pid,mode:w.mode||'',
+   heap:fmtB((w.heap||{}).current_bytes||0)
+    +' ('+((w.heap||{}).kind||'?')+')',
+   inflight:w.num_inflight_tasks||0,
+   hbm:(w.hbm||[]).length?fmtB((w.hbm||[]).reduce(
+    (a,d)=>a+(d.bytes_in_use||0),0)):''})),
+   ['pid','mode','heap','inflight','hbm']);
+ document.getElementById('memory').innerHTML=html;
+ memHbm={};
+ const mts=await j('/api/metrics');
+ for(const e of mts||[]){if(e.name!=='hbm_bytes_in_use')continue;
+  const t=(e.tags||{}).node||'';const h=memHbm[t]||{use:0,n:0};
+  h.use+=e.value||0;h.n+=1;memHbm[t]=h;}
+}catch(e){}}
+async function runProfile(){
+ const d=document.getElementById('profdur').value||2;
+ const hz=document.getElementById('profhz').value||50;
+ document.getElementById('profstatus').textContent='sampling '+d+'s...';
+ try{
+  const p=await j('/api/profile?duration='+encodeURIComponent(d)
+   +'&hz='+encodeURIComponent(hz));
+  document.getElementById('profstatus').textContent=
+   (p.samples||0)+' samples from '+(p.workers||0)+' worker(s)';
+  const rows=Object.entries(p.wall||{}).sort((a,b)=>b[1]-a[1]).slice(0,25);
+  const max=rows.length?rows[0][1]:1;
+  let html='';
+  const bc=Object.entries(p.by_class||{}).sort((a,b)=>b[1]-a[1]);
+  if(bc.length)html+=table(bc.map(([c,v])=>({class:c,samples:v})))
+   +'<div style="margin-top:8px">top stacks (wall, bar = share)</div>';
+  for(const[k,v]of rows){
+   const leaf=k.split(';').pop();
+   html+='<div style="margin:1px 0;background:#ffe0b2;white-space:nowrap;'
+    +'overflow:hidden;text-overflow:ellipsis;'
+    +'font:11px ui-monospace,monospace;padding:1px 4px;width:'
+    +Math.max(2,Math.round(100*v/max))+'%" title="'+esc(k)+'">'
+    +esc(leaf)+' ('+v+')</div>';}
+  document.getElementById('flame').innerHTML=html||'<i>no samples</i>';
+ }catch(e){
+  document.getElementById('profstatus').textContent='error: '+e;}}
 async function refreshLogs(){try{
  const nodes=await j('/api/nodes');
  const sel=document.getElementById('lognode');
@@ -235,10 +308,11 @@ async function tailLog(){
   +'&file='+encodeURIComponent(f)+'&lines=200');
  document.getElementById('logview').textContent=await r.text();}
 refresh();refreshTimeline();refreshLogs();refreshHealth();refreshServe();
-refreshSlo();
+refreshSlo();refreshMemory();
 setInterval(refresh,5000);setInterval(refreshTimeline,10000);
 setInterval(refreshLogs,15000);setInterval(refreshHealth,5000);
 setInterval(refreshServe,5000);setInterval(refreshSlo,5000);
+setInterval(refreshMemory,10000);
 </script></body></html>
 """
 
@@ -355,6 +429,21 @@ def _routes():
         node = req.query.get("node_id") or None
         return _json(state_api.dump_stacks(node_id=node))
 
+    async def api_profile(req):
+        """On-demand cluster sampling burst → merged folded stacks (the
+        flamegraph panel's data). Blocks this handler for the sampling
+        window, so the duration is clamped."""
+        duration = min(float(req.query.get("duration", 2.0)), 30.0)
+        hz = float(req.query.get("hz", 50.0))
+        node = req.query.get("node_id") or None
+        return _json(state_api.profile_cluster(
+            duration_s=duration, hz=hz, node_id=node))
+
+    async def api_memory(_req):
+        """Cluster memory attribution: store bytes by ref-type, leak
+        suspects, per-worker heap, per-chip HBM."""
+        return _json(state_api.memory_report())
+
     async def api_logs(req):
         node = req.query.get("node_id") or None
         return _json(state_api.list_logs(node))
@@ -393,6 +482,8 @@ def _routes():
     app.router.add_get("/api/serve", api_serve)
     app.router.add_get("/api/slo", api_slo)
     app.router.add_get("/api/stacks", api_stacks)
+    app.router.add_get("/api/profile", api_profile)
+    app.router.add_get("/api/memory", api_memory)
     app.router.add_get("/api/logs", api_logs)
     app.router.add_get("/api/logs/tail", api_log_tail)
     return app

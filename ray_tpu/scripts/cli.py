@@ -55,6 +55,14 @@ def _resolve_address(args) -> str:
                      "RAY_TPU_ADDRESS, or `start --head` on this host")
 
 
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0:
+            return f"{int(n)}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
 # ------------------------------------------------------------------ start
 
 def cmd_kv_server(args) -> int:
@@ -254,13 +262,53 @@ def cmd_status(args) -> int:
     nodes = state_api.list_nodes()
     total = ray_tpu.cluster_resources()
     avail = ray_tpu.available_resources()
+    # per-node object-store + HBM columns (profiling & memory plane):
+    # store figures come from each raylet's node_stats; HBM from the
+    # hbm_* gauges workers publish off the stall-probe tick
+    store_cols = {}
+    for n in nodes:
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            st = state_api._raylet_call(n["node_id"], "node_stats", {})
+            store_cols[n["node_id"]] = (
+                st.get("store_used_bytes", 0),
+                st.get("store_capacity_bytes", 0),
+                st.get("num_objects", 0))
+        except Exception:  # graftlint: ignore[swallow] — one dead
+            continue       # raylet must not blank the whole status table
+    hbm_cols: dict = {}
+    try:
+        rows = (state_api.get_metrics("hbm_bytes_in_use")
+                + state_api.get_metrics("hbm_bytes_limit"))
+    except Exception:  # noqa: BLE001 — metrics plane is optional here
+        rows = []
+    for e in rows:
+        node_tag = (e.get("tags") or {}).get("node", "")
+        use, lim, ndev = hbm_cols.get(node_tag, (0, 0, 0))
+        if e["name"] == "hbm_bytes_in_use":
+            hbm_cols[node_tag] = (use + e.get("value", 0), lim, ndev + 1)
+        else:
+            hbm_cols[node_tag] = (use, lim + e.get("value", 0), ndev)
     print(f"nodes: {len(nodes)}")
     for n in nodes:
         hb = n.get("heartbeat_age_s")
         hb_s = f"hb {hb:.1f}s ago" if hb is not None else "hb never"
         off = n.get("clock_offset") or 0.0
+        store_s = ""
+        if n["node_id"] in store_cols:
+            used, cap, nobj = store_cols[n["node_id"]]
+            pct = 100.0 * used / cap if cap else 0.0
+            store_s = (f"  store {_fmt_bytes(used)}/{_fmt_bytes(cap)}"
+                       f" ({pct:.0f}%, {nobj} obj)")
+        hbm_s = ""
+        if n["node_id"][:12] in hbm_cols:
+            use, lim, ndev = hbm_cols[n["node_id"][:12]]
+            hbm_s = (f"  hbm {_fmt_bytes(use)}/{_fmt_bytes(lim)}"
+                     f" on {ndev} chip(s)")
         print(f"  {n['node_id'][:16]}  {n['state']:5s}  {hb_s:14s}  "
-              f"clock {off:+.4f}s  {n['resources_total']}")
+              f"clock {off:+.4f}s  {n['resources_total']}"
+              f"{store_s}{hbm_s}")
     print("resources:")
     for key in sorted(total):
         print(f"  {key}: {avail.get(key, 0):g}/{total[key]:g} available")
@@ -455,6 +503,62 @@ def cmd_stacks(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Cluster flamegraph (ref: Google-Wide Profiling): sample every
+    worker's stacks for --duration at --hz, merge the folded stacks on
+    the GCS, and print/export the result (collapsed-stack text for
+    flamegraph.pl, speedscope JSON for speedscope.app)."""
+    import ray_tpu
+    from ray_tpu.util import stacks
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(address=_resolve_address(args))
+    prof = state_api.profile_cluster(
+        duration_s=args.duration, hz=args.hz, node_id=args.node)
+    folded = prof.get("cpu" if args.cpu else "wall", {}) or {}
+    if args.deployment:
+        # keep samples whose annotation root names the deployment
+        # (task-executing threads are rooted ``task:<fn>``)
+        folded = {k: v for k, v in folded.items()
+                  if args.deployment in k.split(";", 1)[0]}
+    if args.json:
+        print(json.dumps(prof, default=str))
+        ray_tpu.shutdown()
+        return 0
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(stacks.collapse_lines(folded) + "\n")
+        print(f"wrote {len(folded)} folded stacks to {args.output}")
+    if args.speedscope:
+        doc = stacks.speedscope(
+            folded, name=f"ray_tpu {'cpu' if args.cpu else 'wall'} "
+                         f"profile", hz=prof.get("hz", args.hz))
+        with open(args.speedscope, "w") as f:
+            json.dump(doc, f)
+        print(f"wrote speedscope profile to {args.speedscope} "
+              f"(open at https://www.speedscope.app)")
+    view = "cpu" if args.cpu else "wall"
+    print(f"profiled {prof.get('workers', 0)} worker(s): "
+          f"{prof.get('samples', 0)} samples over "
+          f"{prof.get('duration_s', 0.0):.1f}s @ "
+          f"{prof.get('hz', 0.0):g} Hz ({view} view)")
+    by_class = prof.get("by_class", {})
+    if by_class:
+        total = sum(by_class.values()) or 1
+        print("by scheduling class:")
+        for cls, n in sorted(by_class.items(), key=lambda kv: -kv[1]):
+            print(f"  {cls:40s} {n:8.0f}  {100.0 * n / total:5.1f}%")
+    rows = sorted(folded.items(), key=lambda kv: (-kv[1], kv[0]))
+    if rows:
+        print(f"top {min(args.top, len(rows))} stacks:")
+        for key, n in rows[:args.top]:
+            print(f"  {int(n):6d}  {key}")
+    for err in prof.get("errors", []):
+        print(f"  <error: {err}>")
+    ray_tpu.shutdown()
+    return 0
+
+
 # ------------------------------------------------------------------ jobs
 
 def cmd_job(args) -> int:
@@ -551,26 +655,78 @@ def cmd_summary(args) -> int:
 
 
 def cmd_memory(args) -> int:
-    """Per-node store usage + per-lease resource holdings + object
-    directory (ref: `ray memory` — the leak-hunting view)."""
+    """Memory attribution (ref: `ray memory` — the leak-hunting view):
+    object-store bytes per node broken down by ref-type (who is keeping
+    each byte alive), leak suspects, per-worker heap, per-chip HBM."""
     import ray_tpu
     from ray_tpu.util import state as state_api
 
     ray_tpu.init(address=_resolve_address(args))
-    for node in ray_tpu.nodes():
-        if not node.get("Alive"):
-            continue
-        stats = state_api._raylet_call(node["NodeID"], "node_stats", {})
-        print(json.dumps({
-            "node_id": node["NodeID"],
-            "store_used_bytes": stats["store_used_bytes"],
-            "num_objects": stats["num_objects"],
-            "workers": stats["num_workers"],
-            "leases": stats["leases"],
-            "resources_available": stats["resources_available"],
-        }, default=str))
-    for row in state_api.list_objects():
-        print(json.dumps({"object": row}, default=str))
+    rep = state_api.memory_report(leak_age_s=args.leak_age,
+                                  limit=args.top)
+    if args.json:
+        print(json.dumps(rep, default=str))
+        ray_tpu.shutdown()
+        return 0
+    cl = rep.get("cluster", {})
+    used = cl.get("used_bytes", 0)
+    print(f"object store: {_fmt_bytes(used)} live + "
+          f"{_fmt_bytes(cl.get('spill_bytes', 0))} spilled in "
+          f"{cl.get('num_objects', 0)} object(s); "
+          f"{100.0 * cl.get('attributed_fraction', 0.0):.1f}% "
+          f"attributed to a holder")
+    by_type = cl.get("by_ref_type", {})
+    if by_type:
+        print("by ref-type:")
+        for t, b in sorted(by_type.items(), key=lambda kv: -kv[1]):
+            pct = 100.0 * b / used if used else 0.0
+            print(f"  {t:18s} {_fmt_bytes(b):>10s}  {pct:5.1f}%")
+    print("nodes:")
+    for nd in rep.get("nodes", []):
+        cap = nd.get("capacity_bytes", 0)
+        pct = 100.0 * nd.get("used_bytes", 0) / cap if cap else 0.0
+        print(f"  {nd['node_id'][:16]}  "
+              f"{_fmt_bytes(nd.get('used_bytes', 0))}/"
+              f"{_fmt_bytes(cap)} ({pct:.0f}%)  "
+              f"{nd.get('num_objects', 0)} obj  "
+              f"spill {_fmt_bytes(nd.get('spill_bytes', 0))}")
+    suspects = rep.get("leak_suspects", [])
+    if suspects:
+        print(f"leak suspects ({len(suspects)}; pinned, unclaimed, "
+              f"old):")
+        for o in suspects:
+            print(f"  {o['object_id'][:16]}  "
+                  f"{_fmt_bytes(o['size']):>10s}  pinned x{o['pinned']}"
+                  f"  age {o['age_s']:.0f}s  node {o['node_id'][:12]}")
+    objs = rep.get("objects", [])
+    if objs and args.verbose:
+        print(f"top {min(args.top, len(objs))} objects:")
+        for o in objs[:args.top]:
+            owners = ",".join(o.get("owners", [])) or "-"
+            print(f"  {o['object_id'][:16]}  "
+                  f"{_fmt_bytes(o['size']):>10s}  {o['ref_type']:16s}  "
+                  f"age {o['age_s']:6.0f}s  owner {owners}")
+    workers = rep.get("workers", [])
+    if workers:
+        print("worker heap:")
+        for w in workers:
+            heap = w.get("heap", {})
+            cur = heap.get("current_bytes", 0)
+            peak = heap.get("peak_bytes")
+            peak_s = (f" (peak {_fmt_bytes(peak)})"
+                      if peak is not None else "")
+            hbm = w.get("hbm", [])
+            hbm_s = ""
+            if hbm:
+                hbm_use = sum(d.get("bytes_in_use", 0) for d in hbm)
+                hbm_s = (f"  hbm {_fmt_bytes(hbm_use)} on "
+                         f"{len(hbm)} chip(s)")
+            print(f"  pid {w.get('pid')} ({w.get('mode', '?'):8s}) "
+                  f"{heap.get('kind', '?'):11s} "
+                  f"{_fmt_bytes(cur):>10s}{peak_s}"
+                  f"  inflight {w.get('num_inflight_tasks', 0)}{hbm_s}")
+    for err in rep.get("errors", []):
+        print(f"  <error: {err}>")
     ray_tpu.shutdown()
     return 0
 
@@ -685,6 +841,31 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_stacks)
 
+    sp = sub.add_parser("profile",
+                        help="cluster flamegraph: sample every worker's "
+                             "stacks, merge folded stacks on the GCS")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--duration", type=float, default=5.0,
+                    help="sampling window in seconds")
+    sp.add_argument("--hz", type=float, default=100.0,
+                    help="samples per second per worker")
+    sp.add_argument("--node", default=None,
+                    help="node id hex prefix (default: all nodes)")
+    sp.add_argument("--deployment", default=None,
+                    help="keep only stacks of tasks whose name "
+                         "contains this string")
+    sp.add_argument("--cpu", action="store_true",
+                    help="CPU view (drop samples parked in waits)")
+    sp.add_argument("--top", type=int, default=15,
+                    help="folded stacks to print")
+    sp.add_argument("--output", default=None,
+                    help="write collapsed-stack text (flamegraph.pl)")
+    sp.add_argument("--speedscope", default=None,
+                    help="write speedscope JSON")
+    sp.add_argument("--json", action="store_true",
+                    help="dump the raw merged profile")
+    sp.set_defaults(fn=cmd_profile)
+
     sp = sub.add_parser("job")
     sp.add_argument("--address", default=None)
     jsub = sp.add_subparsers(dest="job_cmd", required=True)
@@ -720,8 +901,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_summary)
 
     sp = sub.add_parser("memory",
-                        help="store usage, leases, object directory")
+                        help="memory attribution: store bytes by "
+                             "ref-type, leak suspects, heap, HBM")
     sp.add_argument("--address", default=None)
+    sp.add_argument("--leak-age", type=float, default=None,
+                    help="age (s) after which a pinned unclaimed "
+                         "object is a leak suspect")
+    sp.add_argument("--top", type=int, default=20,
+                    help="objects to include, largest first")
+    sp.add_argument("--verbose", action="store_true",
+                    help="print the per-object table")
+    sp.add_argument("--json", action="store_true",
+                    help="dump the raw memory report")
     sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("lint",

@@ -3,10 +3,13 @@ recorder end to end on a tiny live cluster — task lifecycle transitions
 in GCS, Perfetto timeline export with flow events, critical-path
 summary, the serving histograms on the Prometheus scrape — the stall
 sentinel: an injected hang must flag, emit a WARNING event with a
-captured stack, and surface through `cli health` / `cli stacks` — and
-the SLO plane: runtime-installed specs must show per-tenant attainment
-from live traffic, and an injected slow replica must fire the fast
-burn-rate ERROR alert within a couple of evaluation ticks."""
+captured stack, and surface through `cli health` / `cli stacks` — the
+profiling plane: `cli profile` must name a known-hot function in its
+merged folded stacks and `cli memory` must flag a deliberately pinned
+ownerless object as a leak suspect — and the SLO plane:
+runtime-installed specs must show per-tenant attainment from live
+traffic, and an injected slow replica must fire the fast burn-rate
+ERROR alert within a couple of evaluation ticks."""
 
 from __future__ import annotations
 
@@ -159,6 +162,77 @@ def _slo_smoke() -> None:
     serve.shutdown()
 
 
+def _profile_smoke() -> None:
+    """Profiling & memory plane end to end: `cli profile` on the live
+    cluster must name a known-hot function in its merged folded stacks
+    and write a valid speedscope document; `cli memory` must attribute
+    a deliberately pinned ownerless object as a leak suspect; the
+    in-process memory_report must attribute a driver-held object."""
+    from ray_tpu import _worker_api
+    from ray_tpu._private.ids import ObjectID
+
+    addr = _worker_api.node().gcs_address
+
+    @ray_tpu.remote
+    def smoke_spin(sec):
+        t_end = time.time() + sec
+        x = 0
+        while time.time() < t_end:
+            x += 1
+        return x
+
+    ref = smoke_spin.remote(8.0)
+    time.sleep(0.5)  # let a worker pick it up
+    prof = _cli(addr, "profile", "--duration", "1.5", "--hz", "50",
+                "--speedscope", "/tmp/rtpu_obs_smoke_profile.json")
+    assert prof.returncode == 0, (prof.returncode, prof.stdout,
+                                  prof.stderr)
+    assert "smoke_spin" in prof.stdout, prof.stdout
+    with open("/tmp/rtpu_obs_smoke_profile.json") as f:
+        doc = json.load(f)
+    assert doc["profiles"][0]["type"] == "sampled", doc["profiles"][0]
+    assert any("smoke_spin" in fr["name"]
+               for fr in doc["shared"]["frames"]), \
+        "hot function missing from speedscope frames"
+    assert ray_tpu.get(ref, timeout=60) > 0
+
+    # memory attribution: a pinned object nobody claims is a leak
+    # suspect through `cli memory`; a driver-held ref is attributed
+    # local_ref/driver through the in-process report (the CLI is its
+    # own driver — it cannot see THIS process's claims)
+    core = _worker_api.core()
+    leak = ObjectID.from_random()
+    core.store.put(leak, b"L" * 8192)  # ownerless: bypasses ref tables
+    state._raylet_call(None, "pin_objects", {"object_ids": [leak]})
+    held = ray_tpu.put(os.urandom(256 * 1024))
+    try:
+        mem = _cli(addr, "memory", "--leak-age=-1", "--json")
+        assert mem.returncode == 0, (mem.returncode, mem.stdout,
+                                     mem.stderr)
+        rep = json.loads(mem.stdout)
+        suspects = {o["object_id"] for o in rep["leak_suspects"]}
+        assert leak.hex() in suspects, rep["leak_suspects"]
+        entry = next(o for o in rep["objects"]
+                     if o["object_id"] == leak.hex())
+        assert entry["ref_type"] == "pinned", entry
+
+        local = state.memory_report()
+        mine = next(o for o in local["objects"]
+                    if o["object_id"] == held.hex())
+        assert mine["ref_type"] == "local_ref", mine
+        assert "driver" in mine["owners"], mine
+        assert local["cluster"]["attributed_fraction"] > 0, local
+    finally:
+        state._raylet_call(None, "unpin_objects", {"object_ids": [leak]})
+        core.store.delete(leak)
+        del held
+
+    # status gains store-utilization columns from the same plane
+    status = _cli(addr, "status")
+    assert status.returncode == 0, (status.returncode, status.stderr)
+    assert "store " in status.stdout, status.stdout
+
+
 def main() -> int:
     # the SloSlow failpoint must be in the environment BEFORE ray.init:
     # replica workers read RAY_TPU_FAILPOINTS at spawn (it does not
@@ -239,6 +313,7 @@ def main() -> int:
             20, "serve histograms on the Prometheus scrape")
 
         serve.shutdown()
+        _profile_smoke()
         _stall_sentinel_smoke()
         _slo_smoke()
         print("observability smoke ok")

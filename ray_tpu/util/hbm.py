@@ -1,0 +1,89 @@
+"""Per-chip HBM accounting, read from the JAX backend's allocator
+(``device.memory_stats()`` — the PJRT live-buffer view) and published
+as ordinary Gauges so the bytes ride the existing metrics pipeline:
+worker flusher -> GCS metrics table -> Prometheus scrape + SeriesStore
+(SLO specs can therefore target them like any other series).
+
+Everything here is defensively gated: ``memory_stats()`` returns None
+on the CPU backend (and on old runtimes), and this module must never
+initialize jax itself — callers only invoke it once ``jax`` is already
+in ``sys.modules`` (worker_main piggybacks on the stall-probe tick)."""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+from . import metrics
+
+_gauges: Dict[str, metrics.Gauge] = {}
+
+
+def _gauge(name: str, desc: str) -> metrics.Gauge:
+    g = _gauges.get(name)
+    if g is None:
+        g = _gauges[name] = metrics.Gauge(name, desc)
+    return g
+
+
+def collect_hbm_stats(devices: Optional[list] = None) -> List[dict]:
+    """Per-device live-buffer stats: ``[{device, platform, bytes_in_use,
+    bytes_limit, peak_bytes_in_use, fragmentation}, ...]``. Empty when
+    jax is absent/uninitialized or the backend exposes no stats (CPU).
+    ``devices`` is the test injection point — objects exposing
+    ``memory_stats()`` / ``platform`` / ``id`` duck-type fine."""
+    if devices is None:
+        if "jax" not in sys.modules:
+            return []
+        try:
+            devices = sys.modules["jax"].local_devices()
+        except Exception:
+            return []
+    out: List[dict] = []
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        in_use = int(stats.get("bytes_in_use", 0))
+        limit = int(stats.get("bytes_limit", 0) or 0)
+        peak = int(stats.get("peak_bytes_in_use", in_use))
+        # fragmentation: fraction of FREE memory not usable as one
+        # contiguous block (0 when the allocator doesn't report it)
+        free = max(0, limit - in_use)
+        largest = int(stats.get("largest_free_block_bytes", free) or 0)
+        frag = (1.0 - largest / free) if free > 0 else 0.0
+        out.append({
+            "device": str(getattr(dev, "id", len(out))),
+            "platform": str(getattr(dev, "platform", "?")),
+            "bytes_in_use": in_use,
+            "bytes_limit": limit,
+            "peak_bytes_in_use": peak,
+            "fragmentation": max(0.0, min(1.0, frag)),
+        })
+    return out
+
+
+def publish_hbm_gauges(node: str = "",
+                       devices: Optional[list] = None) -> List[dict]:
+    """Set the hbm_* gauge family from the current backend state and
+    return the collected stats. Tags carry the node (hex prefix) and
+    device ordinal so the cluster aggregate stays per-chip."""
+    stats = collect_hbm_stats(devices)
+    for st in stats:
+        tags = {"node": node, "device": st["device"],
+                "platform": st["platform"]}
+        _gauge("hbm_bytes_in_use",
+               "live HBM buffer bytes per chip").set(
+                   st["bytes_in_use"], tags=tags)
+        _gauge("hbm_bytes_limit",
+               "HBM capacity per chip").set(st["bytes_limit"], tags=tags)
+        _gauge("hbm_peak_bytes_in_use",
+               "peak live HBM bytes per chip").set(
+                   st["peak_bytes_in_use"], tags=tags)
+        _gauge("hbm_fragmentation",
+               "fraction of free HBM not in the largest free block").set(
+                   st["fragmentation"], tags=tags)
+    return stats

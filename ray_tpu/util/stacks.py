@@ -1,0 +1,255 @@
+"""Shared stack-capture plumbing: one frame-snapshot/annotation path for
+the stall sentinel's ``dump_stacks`` AND the cluster sampling profiler
+(ref: Google-Wide Profiling, Ren et al., IEEE Micro 2010 — always-on
+sampling at <1% overhead; capture path ref: py-spy/ray `ray stack`).
+
+Three layers, all pure-Python and cluster-agnostic so they unit-test
+with no cluster running:
+
+* ``capture_threads`` — the ``sys._current_frames()`` snapshot with
+  per-thread task annotation that ``worker_main.TaskExecutor`` used to
+  inline (extracted here so dump_stacks and the sampler share one
+  format and one annotation path).
+* folded-stack utilities — ``fold_frame`` (root-first ``a;b;c`` key in
+  the Brendan Gregg collapsed format), ``merge_folded`` (count-sum
+  merge the GCS uses to aggregate per-node/per-class profiles), and
+  ``speedscope`` (conversion to the speedscope JSON file format).
+* ``StackSampler`` — the named daemon sampling thread: every 1/hz it
+  walks ``sys._current_frames()`` and accumulates folded wall-stack
+  counts, splitting out a CPU view by filtering samples whose leaf is a
+  known idle/wait primitive (the py-spy ``--idle`` heuristic).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional, Tuple
+
+# folded keys join frames with ';' (collapsed-stack format); a frame is
+# "function (basename.py:lineno)" — stable enough to merge across
+# workers, specific enough to find the code
+_FRAME_SEP = ";"
+
+# leaf functions that mean "this thread is parked, not burning CPU":
+# the wall view keeps them, the cpu view drops the sample (py-spy
+# --idle analog; a heuristic, documented as such)
+_IDLE_LEAF_FNS = frozenset({
+    "wait", "sleep", "select", "poll", "epoll", "kqueue", "accept",
+    "recv", "recv_into", "recvfrom", "read", "readinto", "get",
+    "acquire", "join", "settimeout", "dowait", "flush",
+})
+_IDLE_LEAF_FILES = ("threading.py", "selectors.py", "queue.py",
+                    "socket.py", "ssl.py")
+
+
+def capture_threads(running_since: Optional[dict] = None,
+                    now: Optional[float] = None) -> List[dict]:
+    """Snapshot every thread's stack, annotated with the task it is
+    executing (if any) from a ``{task_id: (thread_ident, fn, t0)}``
+    running-table. Returns the record list ``dump_stacks`` ships:
+    running-task threads sort first (the hung one is what the reader
+    came for)."""
+    if now is None:
+        now = time.time()
+    by_ident = {ident: (tid, fn, t0)
+                for tid, (ident, fn, t0) in
+                list((running_since or {}).items())}
+    names = {t.ident: t.name for t in threading.enumerate()}
+    threads = []
+    for ident, frame in sys._current_frames().items():
+        tid_fn = by_ident.get(ident)
+        threads.append({
+            "thread_id": ident,
+            "name": names.get(ident, "?"),
+            "task_id": tid_fn[0].hex() if tid_fn else None,
+            "fn": tid_fn[1] if tid_fn else None,
+            "running_for_s": (now - tid_fn[2]) if tid_fn else None,
+            "stack": "".join(traceback.format_stack(frame)),
+        })
+    threads.sort(key=lambda t: (t["task_id"] is None, t["thread_id"]))
+    return threads
+
+
+def _frame_label(frame) -> str:
+    code = frame.f_code
+    return (f"{code.co_name} "
+            f"({os.path.basename(code.co_filename)}:{frame.f_lineno})")
+
+
+def fold_frame(frame, max_depth: int = 64,
+               root: Optional[str] = None) -> str:
+    """Root-first collapsed-stack key for one thread's current frame:
+    ``root;outer (file:line);...;leaf (file:line)``. ``root`` prefixes
+    an annotation frame (e.g. ``task:fn_name`` — the scheduling-class
+    handle the GCS merges by)."""
+    labels: List[str] = []
+    f = frame
+    while f is not None and len(labels) < max_depth:
+        labels.append(_frame_label(f))
+        f = f.f_back
+    labels.reverse()
+    if root:
+        labels.insert(0, root)
+    return _FRAME_SEP.join(labels)
+
+
+def leaf_is_idle(frame) -> bool:
+    """Idle heuristic for the CPU view: the leaf frame is a known wait
+    primitive (or lives in the stdlib wait modules)."""
+    code = frame.f_code
+    if code.co_name in _IDLE_LEAF_FNS:
+        return True
+    base = os.path.basename(code.co_filename)
+    return base in _IDLE_LEAF_FILES
+
+
+def merge_folded(*folded_maps: Dict[str, float]) -> Dict[str, float]:
+    """Sum collapsed-stack count maps (the GCS aggregation primitive:
+    per-node and per-scheduling-class merges are both just this)."""
+    out: Dict[str, float] = {}
+    for m in folded_maps:
+        for key, count in (m or {}).items():
+            out[key] = out.get(key, 0.0) + count
+    return out
+
+
+def collapse_lines(folded: Dict[str, float]) -> str:
+    """Render a folded map in the canonical collapsed-stack text format
+    (``frame;frame;frame count`` per line, descending count) that
+    flamegraph.pl / speedscope / pprof importers all read."""
+    rows = sorted(folded.items(), key=lambda kv: (-kv[1], kv[0]))
+    return "\n".join(f"{key} {int(count)}" for key, count in rows)
+
+
+def speedscope(folded: Dict[str, float], name: str = "ray_tpu profile",
+               hz: float = 0.0) -> dict:
+    """Convert a folded map into a speedscope sampled-profile document
+    (https://www.speedscope.app/file-format-schema.json): each folded
+    stack becomes one sample weighted by its count."""
+    frame_index: Dict[str, int] = {}
+    samples: List[List[int]] = []
+    weights: List[float] = []
+    for key, count in sorted(folded.items(),
+                             key=lambda kv: (-kv[1], kv[0])):
+        stack = []
+        for label in key.split(_FRAME_SEP):
+            if label not in frame_index:
+                frame_index[label] = len(frame_index)
+            stack.append(frame_index[label])
+        samples.append(stack)
+        weights.append(float(count))
+    unit = "seconds" if hz else "none"
+    scale = (1.0 / hz) if hz else 1.0
+    total = sum(weights) * scale
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": [{"name": label} for label in frame_index]},
+        "profiles": [{
+            "type": "sampled",
+            "name": name,
+            "unit": unit,
+            "startValue": 0,
+            "endValue": total,
+            "samples": samples,
+            "weights": [w * scale for w in weights],
+        }],
+        "exporter": "ray_tpu",
+        "name": name,
+    }
+
+
+class StackSampler:
+    """Per-process sampling profiler thread. Accumulates folded
+    wall/CPU stack counts at ``hz``; ``snapshot()`` drains or peeks the
+    aggregate. ``annotate(thread_ident) -> label | None`` roots samples
+    of annotated threads (task executors report ``task:<fn>`` so the
+    GCS can merge per scheduling class).
+
+    Thread hygiene (graftlint leak pass): the thread is named and
+    daemon — it must never block interpreter exit, and ``stop()`` joins
+    it bounded for the on-demand burst case."""
+
+    def __init__(self, hz: float,
+                 annotate: Optional[Callable[[int], Optional[str]]] = None,
+                 max_depth: int = 64, name: str = "stack_sampler"):
+        self.hz = max(0.01, float(hz))
+        self._annotate = annotate
+        self._max_depth = max_depth
+        self._wall: Dict[str, float] = {}
+        self._cpu: Dict[str, float] = {}
+        self._samples = 0
+        self._started_at: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True)
+
+    # ---- lifecycle ----
+    def start(self) -> "StackSampler":
+        self._started_at = time.time()
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    @property
+    def running(self) -> bool:
+        return self._thread.is_alive() and not self._stop.is_set()
+
+    # ---- capture ----
+    def _loop(self) -> None:
+        interval = 1.0 / self.hz
+        own = threading.get_ident()
+        while not self._stop.wait(interval):
+            try:
+                self.sample_once(skip_idents=(own,))
+            except Exception:  # graftlint: ignore[swallow]
+                # a torn frame walk must never kill the sampler; drop
+                # the tick and keep sampling
+                continue
+
+    def sample_once(self, skip_idents: Tuple[int, ...] = ()) -> None:
+        """One sampling tick (also the injection point tests use)."""
+        wall_batch: List[str] = []
+        cpu_batch: List[str] = []
+        for ident, frame in sys._current_frames().items():
+            if ident in skip_idents:
+                continue
+            root = self._annotate(ident) if self._annotate else None
+            key = fold_frame(frame, self._max_depth, root=root)
+            wall_batch.append(key)
+            if not leaf_is_idle(frame):
+                cpu_batch.append(key)
+        with self._lock:
+            self._samples += 1
+            for key in wall_batch:
+                self._wall[key] = self._wall.get(key, 0.0) + 1.0
+            for key in cpu_batch:
+                self._cpu[key] = self._cpu.get(key, 0.0) + 1.0
+
+    # ---- read ----
+    def snapshot(self, reset: bool = False) -> dict:
+        now = time.time()
+        with self._lock:
+            out = {
+                "pid": os.getpid(),
+                "hz": self.hz,
+                "samples": self._samples,
+                "duration_s": (now - self._started_at
+                               if self._started_at else 0.0),
+                "wall": dict(self._wall),
+                "cpu": dict(self._cpu),
+            }
+            if reset:
+                self._wall = {}
+                self._cpu = {}
+                self._samples = 0
+                self._started_at = now
+        return out

@@ -213,6 +213,58 @@ def dump_stacks(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
     return core.io.run(core.gcs.call("dump_all_stacks", {}))
 
 
+def profile_cluster(duration_s: float = 5.0, hz: float = 100.0,
+                    node_id: Optional[str] = None) -> Dict[str, Any]:
+    """Cluster-wide sampling burst (ref: Google-Wide Profiling): every
+    worker on every (matching) alive node samples its stacks at ``hz``
+    for ``duration_s``; the GCS merges the folded wall/CPU stacks
+    overall, per node, and per scheduling class. The driver samples
+    itself during the same window (it is not raylet-registered, so the
+    GCS fan-out cannot reach it) and merges in as ``driver``."""
+    from .._private.config import global_config
+    from . import stacks
+
+    core = _core()
+    sampler = stacks.StackSampler(
+        hz, annotate=lambda ident: "driver",
+        max_depth=global_config().profiling_max_stack_depth,
+        name="stack_sampler_driver").start()
+    try:
+        prof = core.io.run(core.gcs.call("profile_cluster", {
+            "duration_s": duration_s, "hz": hz, "node_id": node_id}))
+    finally:
+        sampler.stop(timeout=2.0)
+    snap = sampler.snapshot()
+    if snap["samples"]:
+        prof["samples"] = prof.get("samples", 0) + snap["samples"]
+        prof["workers"] = prof.get("workers", 0) + 1
+        drv = prof.setdefault("per_node", {}).setdefault("driver", {})
+        for key, n in snap["wall"].items():
+            prof["wall"][key] = prof["wall"].get(key, 0) + n
+            drv[key] = drv.get(key, 0) + n
+            prof["by_class"]["driver"] = (
+                prof["by_class"].get("driver", 0) + n)
+        for key, n in snap["cpu"].items():
+            prof["cpu"][key] = prof["cpu"].get(key, 0) + n
+    return prof
+
+
+def memory_report(leak_age_s: Optional[float] = None,
+                  limit: int = 200) -> Dict[str, Any]:
+    """Cluster memory attribution: object-store bytes per node broken
+    down by ref-type (pending_task_arg / pinned / local_ref / borrowed /
+    spilled / unreferenced), leak suspects (pinned, unclaimed, old),
+    per-worker heap (tracemalloc or RSS), and per-chip HBM stats. The
+    driver's own reference claims ride the request payload so the GCS
+    can attribute objects only the driver still holds."""
+    core = _core()
+    payload: Dict[str, Any] = {"limit": limit,
+                               "driver": core.local_memory_report()}
+    if leak_age_s is not None:
+        payload["leak_age_s"] = leak_age_s
+    return core.io.run(core.gcs.call("memory_report", payload))
+
+
 def get_metrics(name: Optional[str] = None) -> List[Dict[str, Any]]:
     """Aggregated application metrics (see ray_tpu.util.metrics)."""
     core = _core()

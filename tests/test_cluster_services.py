@@ -305,8 +305,9 @@ def test_autoscaler_scales_up_and_down():
 
 
 def test_cli_timeline_and_memory(tmp_path):
-    """`timeline` dumps chrome-trace JSON; `memory` dumps per-node
-    store/lease state (ref: `ray timeline` / `ray memory`)."""
+    """`timeline` dumps chrome-trace JSON; `memory` reports per-node
+    store usage + object attribution (ref: `ray timeline` / `ray
+    memory`)."""
     env = {**os.environ}
     env.pop("RAY_TPU_ADDRESS", None)
     head = subprocess.run(CLI + ["start", "--head", "--num-cpus", "2"],
@@ -340,12 +341,22 @@ def test_cli_timeline_and_memory(tmp_path):
         events = json.loads(out_json.read_text())
         assert any(e["name"].startswith("f") for e in events), events[:3]
 
-        mem = subprocess.run(CLI + ["memory", "--address", address],
+        mem = subprocess.run(CLI + ["memory", "--address", address,
+                                    "--json"],
                              capture_output=True, text=True, timeout=120,
                              env=env)
         assert mem.returncode == 0, mem.stderr
-        first = json.loads(mem.stdout.splitlines()[0])
-        assert "store_used_bytes" in first and "leases" in first
+        rep = json.loads(mem.stdout)
+        assert rep["nodes"], rep
+        node = rep["nodes"][0]
+        assert "used_bytes" in node and "by_ref_type" in node, node
+        assert "attributed_fraction" in rep["cluster"], rep["cluster"]
+        # human-readable view renders the same report
+        mem2 = subprocess.run(CLI + ["memory", "--address", address],
+                              capture_output=True, text=True, timeout=120,
+                              env=env)
+        assert mem2.returncode == 0, mem2.stderr
+        assert "attributed" in mem2.stdout, mem2.stdout
     finally:
         subprocess.run(CLI + ["stop"], capture_output=True, timeout=60,
                        env=env)
