@@ -20,6 +20,9 @@ Families:
   * serve_prefix — fleet KV plane: prefix-affinity routing TTFT
                 (off/on, cold/warm) + disaggregated prefill/decode
                 handoff overhead and TPOT isolation
+  * serve_spec — speculative decoding plane: generated tok/s and TPOT
+                p99 under concurrent greedy loadgen, sequential decode
+                vs draft/verify with aligned and adversarial drafters
   * slo       — SLO observability plane: open-loop multi-tenant loadgen
                 attainment + time-to-fast-burn-alert under an injected
                 slow replica
@@ -1035,6 +1038,116 @@ def bench_serve_prefix(results):
         isolation_gain_x=mono_x / max(1e-9, pooled_x)))
 
 
+# ----------------------------------------------------------- serve_spec
+def bench_serve_spec(results):
+    """Speculative-decoding envelope (llm/spec_decode.py): generated
+    tok/s and TPOT p99 for one serve replica under concurrent greedy
+    loadgen, sequential decode vs draft/verify decode. Three regimes:
+
+      * base    — no speculation (the sequential-decode baseline the
+                  8b serve number has been pinned at),
+      * spec    — drafter initialized from the SAME seed as the target
+                  (the high-acceptance regime: k accepted tokens per
+                  verify forward),
+      * adverse — drafter from a different seed (rejection-heavy: the
+                  floor, paying draft+verify for ~1 token/round).
+
+    Acceptance ratios come from the engine's own SpecDecoder counters
+    (handle stats — no flush lag); TPOT p99 interpolates the
+    llm_tpot_seconds histogram buckets the replica exported."""
+    import ray_tpu as ray
+
+    ecfg = {"max_num_seqs": 2, "max_seq_len": 256, "num_pages": 128,
+            "page_size": 16}
+    gen = 24
+    waves = 3 if QUICK else 6
+    conc = 2                      # matches max_num_seqs: full batch
+    # prompt mix: short / medium / long, distinct contents
+    mix = [list(range(3, 11)),
+           [(i * 5) % 251 + 1 for i in range(48)],
+           [(i * 11) % 251 + 1 for i in range(96)]]
+
+    def _tpot_p99_ms():
+        from ray_tpu.util import state as state_api
+        from ray_tpu.util.metrics import histogram_quantile
+
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            buckets = {}
+            for e in state_api.get_metrics("llm_tpot_seconds"):
+                tags = e.get("tags") or {}
+                le = tags.get("le")
+                if le is None:
+                    continue
+                bound = float(le)
+                buckets[bound] = buckets.get(bound, 0.0) \
+                    + e.get("value", 0.0)
+            q = histogram_quantile(0.99, buckets.items())
+            if q is not None:
+                return q * 1000.0
+            time.sleep(0.5)     # periodic replica-side flusher
+        raise AssertionError("llm_tpot_seconds never flushed")
+
+    def run_regime(name, speculation):
+        ray.init(num_cpus=4)
+        try:
+            from ray_tpu import serve
+            from ray_tpu.llm.serve import build_llm_deployment
+
+            kwargs = {"engine_config": ecfg}
+            if speculation:
+                kwargs["speculation"] = speculation
+            app = build_llm_deployment("tiny", name=name, **kwargs)
+            comp = serve.run(app).options(method_name="completions")
+            # shape warmup: prefill buckets + decode (+ verify) compiles
+            for p in mix:
+                ray.get(comp.remote({"prompt_ids": list(p),
+                                     "temperature": 0.0,
+                                     "max_tokens": 4}), timeout=600)
+            t0 = time.perf_counter()
+            toks = 0
+            for w in range(waves):
+                refs = [comp.remote({
+                    "prompt_ids": list(mix[(w * conc + i) % len(mix)]),
+                    "temperature": 0.0, "max_tokens": gen})
+                    for i in range(conc)]
+                for out in ray.get(refs, timeout=600):
+                    toks += len(out["choices"][0]["token_ids"])
+            wall = time.perf_counter() - t0
+            stats = ray.get(
+                serve.get_deployment_handle(name).options(
+                    method_name="stats").remote(), timeout=60)
+            p99 = _tpot_p99_ms()
+            return toks / max(1e-9, wall), p99, stats.get("spec") or {}
+        finally:
+            serve.shutdown()
+            ray.shutdown()
+
+    base_tps, base_p99, _ = run_regime("llm_specbase", None)
+    spec_tps, spec_p99, spec_stats = run_regime(
+        "llm_spec", {"draft_config": "tiny", "num_draft_tokens": 3,
+                     "draft_seed": 0})
+    adv_tps, adv_p99, adv_stats = run_regime(
+        "llm_specadv", {"draft_config": "tiny", "num_draft_tokens": 3,
+                        "draft_seed": 1})
+    total = waves * conc * gen
+    results.append(emit(
+        "envelope_serve_spec",
+        requests=waves * conc, gen_tokens=total,
+        base_tok_s=base_tps, base_tpot_p99_ms=base_p99,
+        spec_tok_s=spec_tps, spec_tpot_p99_ms=spec_p99,
+        spec_accept_ratio=round(
+            spec_stats.get("acceptance_ratio", 0.0), 4),
+        spec_accepted_tok_s=(
+            spec_stats.get("accepted_tokens", 0)
+            / max(1e-9, total / max(1e-9, spec_tps))),
+        spec_speedup_x=spec_tps / max(1e-9, base_tps),
+        adverse_tok_s=adv_tps, adverse_tpot_p99_ms=adv_p99,
+        adverse_accept_ratio=round(
+            adv_stats.get("acceptance_ratio", 0.0), 4),
+        adverse_speedup_x=adv_tps / max(1e-9, base_tps)))
+
+
 # ------------------------------------------------------------------ slo
 def bench_slo(results):
     """SLO observability plane envelope (ray_tpu/slo.py + scripts/
@@ -1233,6 +1346,7 @@ ALL = {
     "shuffle": bench_shuffle,
     "tail": bench_tail,
     "serve_prefix": bench_serve_prefix,
+    "serve_spec": bench_serve_spec,
     "slo": bench_slo,
     "submit": bench_submit,
 }

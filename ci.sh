@@ -107,11 +107,13 @@ JAX_PLATFORMS=cpu \
 timeout "${CI_OBS_TIMEOUT_S:-300}" \
     python -m ray_tpu.scripts.obs_smoke
 
-echo "== [5/9] serve smoke: disaggregated prefill/decode + fleet KV routing =="
+echo "== [5/9] serve smoke: disaggregated prefill/decode + fleet KV routing + spec decode =="
 # the fleet KV plane gets its own live lane: 1 prefill + 1 decode
 # replica on the tiny model, shared-prefix traffic — tokens must match
 # a local monolithic engine exactly, KV pages must move through the
-# object store, and prefix summaries must gossip to the controller
+# object store, and prefix summaries must gossip to the controller;
+# a spec-decode replica (adversarial drafter) must stay token-identical
+# to the plain greedy oracle with llm_spec_* counters on the scrape
 JAX_PLATFORMS=cpu \
 timeout "${CI_SERVE_TIMEOUT_S:-600}" \
     python -m ray_tpu.scripts.serve_smoke

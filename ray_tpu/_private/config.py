@@ -216,6 +216,14 @@ class Config:
     # object-store puts of at most this many bytes so one long prompt's
     # KV doesn't serialize as a single giant object
     serve_kv_handoff_chunk_bytes: int = 8 * 1024**2
+    # speculative decoding, fleet verify mode: decode-pool replicas
+    # corroborate their local draft verification against the prefill
+    # pool (which batch-verifies on otherwise-idle decode-phase chips).
+    # Off by default — the local verify is always authoritative; fleet
+    # verify adds cross-pool agreement counters and warms the path for
+    # drafter-on-decode / verifier-on-prefill placements.
+    llm_spec_fleet_verify: bool = False
+    llm_spec_fleet_verify_timeout_s: float = 2.0
     # straggler-aware scheduling: the raylet refreshes per-node straggler
     # scores (GCS lateness EMA relative to cluster mean) on its watchdog
     # tick and deprioritizes nodes scoring >= this threshold in spread /

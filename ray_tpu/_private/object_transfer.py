@@ -81,18 +81,31 @@ async def _recv_into_exactly(loop, sock, view) -> None:
 class TransferServer:
     """Serves ranges of sealed local objects over the raw protocol.
 
-    Request:  [u32 len][msgpack {"oid": bytes, "offset": u64, "len": u64}]
+    Request:  [u32 len][msgpack {"oid": bytes, "offset": u64, "len": u64,
+                                 "puller": hex (optional)}]
     Response: [u64 total_size][u64 payload_len][payload bytes]
               total_size == 2**64-1 -> object not present here.
     One request at a time per connection; pullers parallelize by opening
     several connections (ref: push_manager.h chunking — the unit of
-    interleaving is the chunk, here the connection)."""
+    interleaving is the chunk, here the connection).
+
+    A request that names its puller ties that (object, puller) pair to
+    the data-plane connections carrying it: when the LAST such
+    connection closes, `on_puller_gone(oid, puller)` fires. The raylet
+    uses this to expire the puller's sender-slot grant the moment its
+    transfer ends (or its process dies mid-pull) instead of pinning one
+    of the capped slots until the 120 s TTL sweep — the control-RPC
+    release can be lost exactly when the puller crashes."""
 
     def __init__(self, store, address_hint: str,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 on_puller_gone: Optional[Callable] = None):
         self.store = store
         self._hint = address_hint
         self._advertise_host = advertise_host
+        self._on_puller_gone = on_puller_gone
+        # (oid bytes, puller hex) -> count of open data conns claiming it
+        self._puller_conns: Dict[Tuple[bytes, str], int] = {}
         self._listener: Optional[socket.socket] = None
         self._accept_task: Optional[asyncio.Task] = None
         self._conn_tasks: set = set()
@@ -152,6 +165,7 @@ class TransferServer:
         from . import wire
 
         loop = asyncio.get_event_loop()
+        claimed: set = set()   # (oid bytes, puller hex) seen on THIS conn
         try:
             while True:
                 try:
@@ -163,6 +177,13 @@ class TransferServer:
                     return  # malformed
                 req = wire._unpack(await _recv_exactly(loop, conn, req_len))
                 oid = ObjectID(req["oid"])
+                puller = req.get("puller")
+                if puller and self._on_puller_gone is not None:
+                    key = (req["oid"], puller)
+                    if key not in claimed:
+                        claimed.add(key)
+                        self._puller_conns[key] = (
+                            self._puller_conns.get(key, 0) + 1)
                 view = self.store.get(oid)
                 if view is None:
                     if await self._serve_inprogress(loop, conn, oid, req):
@@ -189,6 +210,16 @@ class TransferServer:
             pass  # peer went away mid-serve: its puller retries elsewhere
         finally:
             conn.close()
+            for key in claimed:
+                left = self._puller_conns.get(key, 0) - 1
+                if left > 0:
+                    self._puller_conns[key] = left
+                    continue
+                self._puller_conns.pop(key, None)
+                try:
+                    self._on_puller_gone(ObjectID(key[0]), key[1])
+                except Exception:  # graftlint: ignore[swallow] — grant
+                    pass  # expiry is best-effort; the TTL still backstops
 
     async def _serve_inprogress(self, loop, conn, oid: ObjectID,
                                 req) -> bool:
@@ -220,10 +251,13 @@ class TransferServer:
 
 
 class _Stream:
-    """One connection to a peer transfer server."""
+    """One connection to a peer transfer server. `puller` (the pulling
+    node's hex id) rides every request so the holder can tie its
+    sender-slot grant to this connection's lifetime."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, puller: Optional[str] = None):
         self.address = address
+        self.puller = puller
         self.sock: Optional[socket.socket] = None
 
     async def connect(self, timeout: float = 10.0) -> None:
@@ -248,8 +282,10 @@ class _Stream:
         from . import wire
 
         loop = asyncio.get_event_loop()
-        req = wire._pack({"oid": oid.binary(), "offset": offset,
-                          "len": length})
+        body = {"oid": oid.binary(), "offset": offset, "len": length}
+        if self.puller:
+            body["puller"] = self.puller
+        req = wire._pack(body)
         await loop.sock_sendall(self.sock,
                                 _REQ_LEN.pack(len(req)) + req)
         header = await _recv_exactly(loop, self.sock, _RESP.size)
@@ -271,18 +307,21 @@ class _Stream:
 async def fetch_object(address: str, oid: ObjectID, create_buf,
                        *, streams: int, chunk_bytes: int,
                        seal: Callable, abort: Callable,
-                       admit_bytes=None, on_progress=None) -> Optional[int]:
+                       admit_bytes=None, on_progress=None,
+                       puller: Optional[str] = None) -> Optional[int]:
     """Pull one object from `address` with up to `streams` parallel
     connections. `create_buf(size) -> memoryview` allocates the
     destination once the size is known; `admit_bytes(size)` (async,
     optional) runs first — the PullManager's byte-budget gate.
     `on_progress(watermark)` (optional) fires as the CONTIGUOUS received
     prefix grows — the cut-through watermark a relaying node publishes
-    so its own pullers can stream behind this pull. Returns the object
-    size, or None when the holder no longer has it. Raises on transport
-    failure (the caller owns retry/fallback policy)."""
+    so its own pullers can stream behind this pull. `puller` (this
+    node's hex id) is stamped on every request so the holder can expire
+    this pull's sender-slot grant when the connections close. Returns
+    the object size, or None when the holder no longer has it. Raises on
+    transport failure (the caller owns retry/fallback policy)."""
     pull_t0 = time.time()
-    first = _Stream(address)
+    first = _Stream(address, puller)
     await first.connect()
     buf = None
     opened: List[_Stream] = [first]
@@ -334,7 +373,7 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
         async def run_stream(stream: Optional[_Stream]):
             nonlocal next_i
             if stream is None:
-                stream = _Stream(address)
+                stream = _Stream(address, puller)
                 await asyncio.wait_for(stream.connect(), _IO_TIMEOUT_S)
                 opened.append(stream)
             retries = 0
@@ -364,7 +403,7 @@ async def fetch_object(address: str, oid: ObjectID, create_buf,
                         retries += 1
                         if retries > 2:
                             raise
-                        stream = _Stream(address)
+                        stream = _Stream(address, puller)
                         await asyncio.wait_for(stream.connect(),
                                                _IO_TIMEOUT_S)
                         opened.append(stream)

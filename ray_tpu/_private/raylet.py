@@ -26,6 +26,7 @@ Design deltas from the reference, driven by the TPU runtime model:
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import os
 import random
@@ -250,6 +251,12 @@ class Raylet:
         self._pull_sources: Dict[ObjectID, NodeID] = {}   # observability
         # cluster view (for spillback) — node_id -> (address, available)
         self._remote_nodes: Dict[NodeID, Tuple[str, ResourceSet]] = {}
+        # hub-declared-dead nodes (node channel "removed"): the gossip
+        # syncer cross-checks applied entries against this so a laggard
+        # peer can't resurrect a dead node after its tombstone TTL
+        # lapses; bounded so unbounded churn can't grow it forever
+        self._dead_node_hexes: "collections.OrderedDict[str, None]" = (
+            collections.OrderedDict())
         # node_id -> labels (incl. this node), for label-match scheduling
         self._node_labels: Dict[NodeID, Dict[str, str]] = {}
         self._worker_conns: Dict[ServerConnection, WorkerID] = {}
@@ -298,13 +305,15 @@ class Raylet:
         kind = _parse_addr(self.server.address)
         if kind[0] == "unix":
             self.transfer = TransferServer(
-                self.store, self.server.address + ".xfer")
+                self.store, self.server.address + ".xfer",
+                on_puller_gone=self._on_transfer_puller_gone)
         else:
             # bind-all, advertise the node's routable IP — same split the
             # control server uses (NAT/container hosts can't bind the
             # address they advertise)
             self.transfer = TransferServer(
-                self.store, "0.0.0.0:0", advertise_host=kind[1])
+                self.store, "0.0.0.0:0", advertise_host=kind[1],
+                on_puller_gone=self._on_transfer_puller_gone)
         await self.transfer.start()
         self.gcs = RpcClient(self.gcs_address)
         await self.gcs.connect()
@@ -895,11 +904,17 @@ class Raylet:
             if info.node_id != self.node_id:
                 self._remote_nodes[info.node_id] = (info.address, ResourceSet(info.resources_available))
                 self._node_labels[info.node_id] = dict(info.labels or {})
+                # a re-registered node is alive again by hub decree
+                self._dead_node_hexes.pop(info.node_id.hex(), None)
                 if self._pending_leases:  # a new node may fit queued work
                     background(self._pump_pending())
         elif payload["event"] == "removed":
             node_id = payload.get("node_id")
             self._remote_nodes.pop(node_id, None)
+            if node_id is not None:
+                self._dead_node_hexes[node_id.hex()] = None
+                while len(self._dead_node_hexes) > 4096:
+                    self._dead_node_hexes.popitem(last=False)
             if self.syncer is not None and node_id is not None:
                 self.syncer.evict(node_id.hex())
 
@@ -1984,6 +1999,19 @@ class Raylet:
                     self._transfer_tokens.pop(oid, None)
         self._token_conn_watchers.pop(conn, None)
 
+    def _on_transfer_puller_gone(self, oid: ObjectID, puller: str) -> None:
+        """Data-plane conn-close hook (TransferServer on_puller_gone):
+        the puller's last transfer connection for `oid` closed, so its
+        sender-slot grant is over — whether the transfer finished or the
+        puller crashed. Releasing here means a crashed puller (whose
+        release RPC never arrives) frees the slot immediately instead of
+        pinning it for the 120 s TTL."""
+        grants = self._transfer_tokens.get(oid)
+        if grants is not None:
+            grants.pop(puller, None)
+            if not grants:
+                self._transfer_tokens.pop(oid, None)
+
     async def handle_transfer_token_release(self, payload, conn):
         grants = self._transfer_tokens.get(payload["object_id"])
         if grants is not None:
@@ -2029,7 +2057,8 @@ class Raylet:
                     seal=lambda: self.store.seal(oid),
                     abort=lambda: self.store.abort(oid),
                     admit_bytes=lambda n: self.pulls.acquire_bytes(oid, n),
-                    on_progress=lambda wm: holder["entry"].advance(wm))
+                    on_progress=lambda wm: holder["entry"].advance(wm),
+                    puller=self.node_id.hex())
             except Exception:
                 if "entry" in holder:
                     # the early advertisement is stale — retract it

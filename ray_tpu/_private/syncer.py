@@ -98,11 +98,25 @@ class ResourceSyncer:
         spillback view the hub pushes maintained."""
         applied = 0
         my_hex = self.raylet.node_id.hex()
+        # hub-authoritative membership: node death outlives any TTL, so
+        # entries for nodes the hub declared dead are dropped (and
+        # re-tombstoned) no matter how late the laggard peer gossips
+        dead = getattr(self.raylet, "_dead_node_hexes", None) or ()
         for node, entry in entries.items():
             if node == my_hex:
                 continue  # own state is authoritative locally
+            if node in dead:
+                # the TTL may have expired: refresh it so OUR next
+                # rounds don't relay the zombie onward either
+                self.evict(node)
+                continue
             if self._tombstoned(node):
-                continue  # evicted: a laggard peer must not resurrect it
+                # a laggard peer must not resurrect it — and its
+                # staleness proves the death hasn't reached everyone
+                # yet, so restart the TTL clock
+                self._tombstones[node] = (time.monotonic()
+                                          + self._TOMBSTONE_TTL_S)
+                continue
             cur = self.view.get(node)
             if cur is not None and cur["seq"] >= entry["seq"]:
                 continue

@@ -401,7 +401,10 @@ def _routes():
                          "serve_prefix_route_misses",
                          "serve_kv_handoff_bytes_total",
                          "serve_kv_handoff_retries_total",
-                         "serve_hedges_launched", "serve_hedges_won"):
+                         "serve_hedges_launched", "serve_hedges_won",
+                         "llm_spec_draft_tokens_total",
+                         "llm_spec_accepted_tokens_total",
+                         "llm_spec_acceptance_ratio"):
                 rows.extend(state_api.get_metrics(name))
         except Exception:  # noqa: BLE001 — metrics plane is optional
             rows = []

@@ -35,7 +35,8 @@ from ..models.llama import LlamaConfig
 from ..ops import rope_frequencies
 from .cache import (KVCache, PageAllocator, PrefixCache, SequenceTable,
                     init_kv_cache)
-from .runner import decode_burst, prefill_bucket, prefill_sample
+from .runner import (decode_burst, prefill_bucket, prefill_sample,
+                     verify_step)
 from .sampling import SamplingParams
 
 
@@ -73,6 +74,12 @@ class EngineConfig:
     # pages would mix adapters) and chunked prefill for now.
     lora_rank: int = 0
     max_loras: int = 8
+    # speculative decoding (llm/spec_decode.py — Leviathan et al.): a
+    # dict {"draft_config": ..., "num_draft_tokens": k} or SpecConfig.
+    # Greedy requests get k draft tokens verified per round in one
+    # batched forward; output stays token-identical to plain greedy
+    # decode. None = off. Incompatible with lora_rank > 0.
+    speculation: Any = None
     # automatic prefix caching (vLLM --enable-prefix-caching analog):
     # full prompt pages are content-addressed and SHARED across
     # sequences via page refcounts; a request whose prompt prefix is
@@ -171,6 +178,14 @@ class LLMEngine:
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
                                     cfg.rope_theta)
         self.cos, self.sin = jax.device_put(cos), jax.device_put(sin)
+        # speculative decoding (drafter + verify window; spec_decode.py)
+        self.spec = None
+        # fleet verify hook (llm/serve.py): (payload, draft) ->
+        # Optional[List[int]] — ships a KV snapshot to a prefill-class
+        # verifier racing the local verify; None/exception = local only
+        self._spec_remote_verify = None
+        if self.ecfg.speculation:
+            self.enable_speculation(self.ecfg.speculation)
         self.waiting: Deque[RequestState] = collections.deque()
         # admitted (slot+pages held) but not yet fully prefilled; one
         # prefill work unit runs per step — a whole prompt, or one chunk
@@ -212,6 +227,21 @@ class LLMEngine:
         self.waiting.append(state)
         self.requests[rid] = state
         return rid
+
+    def enable_speculation(self, spec, draft_params=None) -> None:
+        """Attach a drafter (spec_decode.SpecDecoder). ``spec`` is the
+        ``speculation`` dict/SpecConfig; ``draft_params`` overrides the
+        drafter's random init (a trained 400m draft checkpoint)."""
+        from .spec_decode import SpecDecoder
+
+        if self.lora_pool is not None:
+            raise ValueError("speculation is incompatible with "
+                             "lora_rank > 0 (drafter has no adapters)")
+        self.spec = SpecDecoder(self.cfg, self.ecfg, spec,
+                                draft_params=draft_params)
+
+    def disable_speculation(self) -> None:
+        self.spec = None
 
     def abort_request(self, request_id: str) -> None:
         state = self.requests.get(request_id)
@@ -497,6 +527,8 @@ class LLMEngine:
         """Recompute-preemption (vLLM style): release the sequence's
         pages and put it back at the head of the waiting queue; its
         generated-so-far tokens re-prefill on readmission."""
+        if self.spec is not None:
+            self.spec.drop(state.slot)   # drafter KV dies with the pages
         self.allocator.free(self.seq_table.pages_of(state.slot))
         self.seq_table.clear(state.slot)
         self.slots[state.slot] = None
@@ -550,6 +582,10 @@ class LLMEngine:
             self._preempt(victim)
 
     def _run_decode(self) -> List[StepOutput]:
+        if self.spec is not None:
+            outs = self._run_spec_decode()
+            if outs is not None:
+                return outs
         B = self.ecfg.max_num_seqs
         K = self._burst_width()
         for s in [s for s in self.slots
@@ -599,6 +635,191 @@ class LLMEngine:
                     break
         return outs
 
+    # --- speculative decoding (spec_decode.py; Leviathan et al.) ---
+
+    def _spec_eligible(self, s: RequestState) -> bool:
+        """Greedy-only speculation: accept-prefix semantics reproduce
+        the greedy oracle exactly. Sampled/LoRA requests coexist in the
+        same verify window (position 0 only) unsped."""
+        return s.params.temperature == 0.0 and s.model_id is None
+
+    def _run_spec_decode(self) -> Optional[List[StepOutput]]:
+        """One draft+verify round over the whole slot batch: the drafter
+        proposes k tokens per eligible slot, verify_step scores every
+        slot's window in ONE dispatch (non-drafted slots are a 1-token
+        window — they advance one token, like a plain decode step), and
+        accept-prefix emits 1..k+1 tokens per drafted slot. Returns None
+        when no slot can draft this round (caller falls back to the
+        plain decode burst)."""
+        from .spec_decode import accept_prefix
+
+        spec = self.spec
+        kd = spec.k
+
+        def can_draft(s: RequestState) -> bool:
+            # the window [p .. p+k] must fit under max_seq_len, and a
+            # request one token from its budget gains nothing
+            return (self._spec_eligible(s)
+                    and s.ctx_len + kd <= self.ecfg.max_seq_len - 1
+                    and s.params.max_tokens - len(s.output) >= 2)
+
+        if not any(s is not None and s.ctx_len > 0 and can_draft(s)
+                   for s in self.slots):
+            return None
+        # provision BEFORE array assembly — may preempt victims, so
+        # drafted/active sets are derived again afterwards
+        for s in [s for s in self.slots
+                  if s is not None and s.ctx_len > 0]:
+            if s.slot < 0:
+                continue  # preempted as a victim earlier this round
+            upto = s.ctx_len + (kd + 1 if can_draft(s) else 1)
+            self._provision_pages(s, upto)
+        active_states = [s for s in self.slots
+                         if s is not None and s.ctx_len > 0]
+        if not active_states:
+            return []
+        drafted_states = [s for s in active_states if can_draft(s)]
+        if not drafted_states:
+            return None
+        # lazy drafter warm-up: first drafted round for a slot (or the
+        # first after a drop) prefills the draft KV for its sequence
+        for s in drafted_states:
+            if s.slot not in spec.ready:
+                seq = s.prompt + s.output
+                spec.prefill(seq[:s.ctx_len],
+                             self.seq_table.block_tables[
+                                 s.slot:s.slot + 1])
+                spec.ready.add(s.slot)
+        span = self._active_span()
+        bt = self._bt(span)
+        items = []
+        for s in drafted_states:
+            seq = s.prompt + s.output
+            p = s.ctx_len
+            items.append((s.slot, seq[p - 1], seq[p], p))
+        drafts = spec.draft(items, bt)
+        # fleet mode: ship (KV snapshot, draft) to a prefill-class
+        # verifier racing the local verify below; by the greedy-
+        # continuation equivalence both compute the same emission, so
+        # the remote result is corroboration + placement, never truth
+        remote: Dict[int, List[int]] = {}
+        if self._spec_remote_verify is not None:
+            for s in drafted_states:
+                try:
+                    payload = self.snapshot_kv_request(s.request_id)
+                    res = self._spec_remote_verify(payload,
+                                                   drafts[s.slot])
+                except Exception:
+                    res = None
+                if res is not None:
+                    remote[s.slot] = [int(t) for t in res]
+        B = self.ecfg.max_num_seqs
+        S = kd + 1
+        tok = np.zeros((B, S), np.int32)
+        pos = np.full((B, S), -1, np.int32)
+        for s in active_states:
+            tok[s.slot, 0] = s.output[-1] if s.output else s.prompt[-1]
+            pos[s.slot, 0] = s.ctx_len
+            d = drafts.get(s.slot)
+            if d:
+                tok[s.slot, 1:1 + len(d)] = d
+                pos[s.slot, 1:1 + len(d)] = (
+                    s.ctx_len + 1 + np.arange(len(d)))
+        seed, temp, top_k, top_p, greedy = self._sampling_arrays(
+            self.slots, advance=1)
+        t0 = time.perf_counter()
+        tgt, samp0, ck, cv = verify_step(
+            self.params, self.cache.k, self.cache.v, jnp.asarray(tok),
+            jnp.asarray(pos), bt, self.cos, self.sin, seed, temp,
+            top_k, top_p, cfg=self.cfg, greedy=greedy)
+        self.cache = KVCache(ck, cv)
+        tgt = np.asarray(tgt)
+        samp0 = np.asarray(samp0)
+        spec.verify_times.append(time.perf_counter() - t0)
+        outs: List[StepOutput] = []
+        for s in active_states:
+            if s.slot < 0 or s.finished:
+                continue
+            d = drafts.get(s.slot)
+            if d:
+                emitted = accept_prefix(d, tgt[s.slot].tolist())
+                spec.on_round(len(d), len(emitted) - 1)
+                r = remote.get(s.slot)
+                if r is not None:
+                    spec.remote_rounds_total += 1
+                    if r == emitted:
+                        spec.remote_agree_total += 1
+            else:
+                emitted = [int(samp0[s.slot])]
+            for t in emitted:
+                s.ctx_len += 1
+                outs.append(self._append_token(s, t))
+                if s.finished:
+                    break
+        return outs
+
+    def verify_request(self, request_id: str,
+                       draft: List[int]) -> List[int]:
+        """Run ONE verification round for a single request against an
+        externally-supplied draft (the fleet verifier: the draft came
+        from a decode-class replica's drafter, the KV arrived via
+        inject_request). Applies and returns the emission — identical
+        to the monolithic round by accept-prefix semantics. An empty
+        draft degenerates to one plain greedy step. Greedy-only; other
+        slots in the batch are untouched."""
+        from .spec_decode import accept_prefix
+
+        state = self.requests.get(request_id)
+        if state is None:
+            raise ValueError(f"unknown request {request_id!r}")
+        if state.finished or state.slot < 0 or state.ctx_len <= 0:
+            raise ValueError(
+                f"request {request_id!r} is not verifiable "
+                f"(finished={state.finished}, ctx_len={state.ctx_len})")
+        if state.params.temperature != 0.0:
+            raise ValueError("speculative verification is greedy-only")
+        if state.model_id is not None:
+            raise ValueError("speculative verification does not "
+                             "support LoRA requests")
+        draft = [int(t) for t in draft]
+        # clamp the window to the sequence budget (mirrors the
+        # monolithic round's eligibility rule near max_seq_len)
+        while draft and (state.ctx_len + len(draft)
+                         > self.ecfg.max_seq_len - 1):
+            draft.pop()
+        kd = len(draft)
+        self._provision_pages(state, state.ctx_len + kd + 1)
+        B = self.ecfg.max_num_seqs
+        tok = np.zeros((B, kd + 1), np.int32)
+        pos = np.full((B, kd + 1), -1, np.int32)
+        seq = state.prompt + state.output
+        tok[state.slot, 0] = seq[-1]
+        pos[state.slot, 0] = state.ctx_len
+        if kd:
+            tok[state.slot, 1:] = draft
+            pos[state.slot, 1:] = state.ctx_len + 1 + np.arange(kd)
+        seed, temp, top_k, top_p, _g = self._sampling_arrays(
+            self.slots, advance=1)
+        span = self._span_bucket(int(self.seq_table.n_pages[state.slot]))
+        t0 = time.perf_counter()
+        tgt, _s0, ck, cv = verify_step(
+            self.params, self.cache.k, self.cache.v, jnp.asarray(tok),
+            jnp.asarray(pos), self._bt(span), self.cos, self.sin,
+            seed, temp, top_k, top_p, cfg=self.cfg, greedy=True)
+        self.cache = KVCache(ck, cv)
+        row = np.asarray(tgt)[state.slot].tolist()
+        if self.spec is not None:
+            self.spec.verify_times.append(time.perf_counter() - t0)
+        emitted = accept_prefix(draft, row)
+        if self.spec is not None and kd:
+            self.spec.on_round(kd, len(emitted) - 1)
+        for t in emitted:
+            state.ctx_len += 1
+            self._append_token(state, t)
+            if state.finished:
+                break
+        return emitted
+
     def _append_token(self, state: RequestState, token: int) -> StepOutput:
         state.output.append(token)
         reason = None
@@ -618,6 +839,8 @@ class LLMEngine:
         state.finished = True
         state.finish_reason = reason
         if state.slot >= 0:
+            if self.spec is not None:
+                self.spec.drop(state.slot)
             self.allocator.free(self.seq_table.pages_of(state.slot))
             self.seq_table.clear(state.slot)
             self.slots[state.slot] = None
@@ -644,6 +867,16 @@ class LLMEngine:
         finishes the request locally (reason "handoff" — its slot and
         pages free immediately for the next prompt) and returns a
         payload :meth:`inject_request` accepts on the decode engine."""
+        payload = self.snapshot_kv_request(request_id)
+        self._finish(self.requests[request_id], "handoff")
+        return payload
+
+    def snapshot_kv_request(self, request_id: str) -> Dict[str, Any]:
+        """Non-destructive :meth:`export_kv_request`: same payload, but
+        the request keeps running HERE. The fleet spec-verify path ships
+        snapshots to a prefill-class verifier while local decode
+        continues — both compute the identical emission (spec_decode.py
+        module docstring), so nothing is handed off."""
         state = self.requests.get(request_id)
         if state is None:
             raise ValueError(f"unknown request {request_id!r}")
@@ -654,7 +887,7 @@ class LLMEngine:
         n_kv = self.allocator.pages_needed(state.ctx_len)
         pages = self.seq_table.pages_of(state.slot)[:n_kv]
         idx = jnp.asarray(pages, jnp.int32)
-        payload = {
+        return {
             "prompt": list(state.prompt),
             "output": list(state.output),
             "ctx_len": state.ctx_len,
@@ -663,8 +896,6 @@ class LLMEngine:
             "k": np.asarray(self.cache.k[:, idx]),
             "v": np.asarray(self.cache.v[:, idx]),
         }
-        self._finish(state, "handoff")
-        return payload
 
     def inject_request(self, payload: Dict[str, Any],
                        params: Optional[SamplingParams] = None,
@@ -788,9 +1019,12 @@ class LLMEngine:
     # --- metrics ---
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "running": sum(s is not None for s in self.slots),
             "waiting": len(self.waiting),
             "free_pages": self.allocator.free_pages,
             "total_pages": self.allocator.num_pages - 1,
         }
+        if self.spec is not None:
+            out["spec"] = self.spec.stats()
+        return out

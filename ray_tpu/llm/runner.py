@@ -216,6 +216,85 @@ def prefill_chunk(params, cache_k, cache_v, tokens, start_pos, chunk_len,
     return logits, cache_k, cache_v
 
 
+@partial(jax.jit, static_argnames=("cfg", "greedy"),
+         donate_argnames=("cache_k", "cache_v"))
+def verify_step(params, cache_k, cache_v, tokens, positions, block_tables,
+                cos, sin, seed, temperature, top_k, top_p, *,
+                cfg: LlamaConfig, greedy: bool = False):
+    """Batched multi-token verification forward (speculative decoding,
+    Leviathan et al. ICML'23 — PAPERS.md): score a whole k-token draft
+    window in ONE dispatch, like a short prefill over the paged cache.
+
+    tokens: [B, S] window tokens (row = [last_emitted, d_1 .. d_k]);
+    positions: [B, S] absolute per-token positions, -1 = padding (rows
+    with shorter windows, undrafted slots) — padded writes land on dump
+    page 0. Every valid window token's KV is WRITTEN first, then
+    attention gathers the pages, masked by key_pos <= query_pos: the
+    window's own keys are visible through the pages (write-then-gather,
+    same discipline as prefill_chunk), stale rows from a previous
+    rejected window sit at positions > query_pos and never score.
+
+    Returns (argmax tokens [B, S] — index j predicts the token AFTER
+    window position j, sampled position-0 token [B] for rows that
+    aren't greedy, cache_k, cache_v).
+    """
+    from .sampling import sample_from_logits
+
+    B, S = tokens.shape
+    page_size = cache_k.shape[2]
+    Sall = block_tables.shape[1] * page_size
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
+    rep = cfg.n_heads // kvh
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
+    qpos = jnp.maximum(positions, 0)                       # [B, S]
+    # unused table slots are 0 (dump page) but sit past the row's
+    # provisioned span, so their key positions exceed every query's
+    kmask = (jnp.arange(Sall)[None, None, :]
+             <= qpos[:, :, None])                          # [B, S, Sall]
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = weight_einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = weight_einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = weight_einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = apply_rotary(q, cos, sin, positions=qpos)
+        k = apply_rotary(k, cos, sin, positions=qpos)
+        ck = _write_pages(ck, k, block_tables, positions, page_size)
+        cv = _write_pages(cv, v, block_tables, positions, page_size)
+        pk = jnp.take(ck, block_tables, axis=0).reshape(B, Sall, kvh, hd)
+        pv = jnp.take(cv, block_tables, axis=0).reshape(B, Sall, kvh, hd)
+        qg = q.reshape(B, S, kvh, rep, hd)
+        s = jnp.einsum("bsgrd,btgd->bsgrt", qg, pk,
+                       preferred_element_type=jnp.float32)
+        s = jnp.where(kmask[:, :, None, None, :], s * (hd ** -0.5),
+                      -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(pk.dtype)
+        o = jnp.einsum("bsgrt,btgd->bsgrd", p, pv,
+                       preferred_element_type=jnp.float32)
+        o = o.reshape(B, S, cfg.n_heads, hd).astype(x.dtype)
+        x = x + weight_einsum("bshk,hkd->bsd", o, lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(h, lp, cfg)
+        return x, (ck, cv)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache_k, cache_v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    lm = params["lm_head"]
+    if not is_quantized(lm):
+        lm = lm.astype(cfg.dtype)
+    logits = weight_einsum("bsd,dv->bsv", x.astype(cfg.dtype), lm,
+                           preferred_element_type=jnp.float32)
+    tgt = jnp.argmax(logits, axis=-1)                      # [B, S]
+    if greedy:
+        samp0 = tgt[:, 0]
+    else:
+        samp0 = sample_from_logits(logits[:, 0], seed, temperature,
+                                   top_k, top_p)
+    return tgt, samp0, cache_k, cache_v
+
+
 @jax.jit
 def sample_logits(logits, seed, temperature, top_k, top_p):
     """Standalone sampler dispatch (the chunked-prefill tail — the

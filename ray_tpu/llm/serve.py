@@ -33,7 +33,8 @@ class LLMServer:
                  params_path: Optional[str] = None,
                  engine_config: Optional[dict] = None,
                  tokenizer: Optional[str] = None, seed: int = 0,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 speculation: Optional[dict] = None):
         import jax
 
         self.model_name = model
@@ -151,6 +152,32 @@ class LLMServer:
         self._m_generated = metrics.Counter(
             "llm_generation_tokens_total", "Tokens generated",
             tag_keys=("model", "pool", "tenant")).set_default_tags(tags)
+        # speculative decoding (llm/spec_decode.py): per-round counters
+        # drained from the engine's SpecDecoder by the pump. The
+        # acceptance ratio is THE health signal — a drafter that stops
+        # agreeing with the target turns every verify into one-token
+        # decode plus wasted draft FLOPs.
+        self._m_spec_drafted = metrics.Counter(
+            "llm_spec_draft_tokens_total",
+            "Draft tokens proposed by the speculation drafter",
+            tag_keys=("model", "pool")).set_default_tags(tags)
+        self._m_spec_accepted = metrics.Counter(
+            "llm_spec_accepted_tokens_total",
+            "Draft tokens accepted by target verification",
+            tag_keys=("model", "pool")).set_default_tags(tags)
+        self._m_spec_ratio = metrics.Gauge(
+            "llm_spec_acceptance_ratio",
+            "Cumulative accepted/drafted token ratio",
+            tag_keys=("model", "pool")).set_default_tags(tags)
+        self._m_spec_verify = metrics.Histogram(
+            "llm_spec_verify_seconds",
+            "Target-model batched verify forward latency",
+            boundaries=metrics.LATENCY_BUCKETS,
+            tag_keys=("model", "pool")).set_default_tags(tags)
+        self._spec_seen = {"drafted": 0, "accepted": 0}
+        self._verify_handle = None
+        if speculation:
+            self.configure_speculation(speculation)
 
     # --- serve replica hooks (fleet KV plane) ---
 
@@ -165,8 +192,12 @@ class LLMServer:
         tags = {"model": self.model_name, "pool": self._pool}
         for m in (self._m_ttft, self._m_tpot, self._m_e2e, self._m_queue,
                   self._m_occupancy, self._m_kv_util, self._m_cache_hit,
-                  self._m_prompt, self._m_generated):
+                  self._m_prompt, self._m_generated, self._m_spec_drafted,
+                  self._m_spec_accepted, self._m_spec_ratio,
+                  self._m_spec_verify):
             m.set_default_tags(tags)
+        if pool == "decode":
+            self._configure_fleet_verify(deployment_name)
         if pool == "prefill":
             from ..serve.handle import DeploymentHandle
             from ..util import metrics
@@ -218,6 +249,99 @@ class LLMServer:
                 (), self.engine.ecfg.page_size)
         return self._last_summary
 
+    # --- speculative decoding (llm/spec_decode.py) ---
+
+    def configure_speculation(self, spec) -> None:
+        """Enable draft/verify speculative decoding on this replica's
+        engine. Reached two ways: the LLMServer ``speculation`` kwarg
+        (build_llm_deployment) and the serve deployment-config override
+        (the Replica hook), so YAML deploys can toggle it without
+        re-pickling init args."""
+        if not spec:
+            return
+        with self._engine_lock:
+            self.engine.enable_speculation(spec)
+
+    def _configure_fleet_verify(self, deployment_name: str) -> None:
+        """Decode-pool replica in fleet-verify mode: drafting happens
+        here (decode chips idle between target forwards); the prefill
+        pool batch-verifies each drafted window against a KV snapshot
+        shipped through the object store. The local verify stays
+        authoritative — the remote result corroborates it (agreement
+        counters on the engine's SpecDecoder), so a lagging or dead
+        prefill pool can never wrong or wedge a decode round."""
+        from .._private.config import global_config
+
+        if self.engine.spec is None \
+                or not global_config().llm_spec_fleet_verify:
+            return
+        from ..serve.handle import DeploymentHandle
+
+        self._verify_handle = DeploymentHandle(
+            deployment_name, "verify_draft", pool="prefill")
+
+        def _fleet_verify(payload, draft):
+            # runs on the pump's executor thread inside the engine's
+            # spec round: bounded by the fleet-verify timeout so a slow
+            # prefill pool degrades to local-only, never a stall
+            from .. import get, put
+
+            k = payload.pop("k")
+            v = payload.pop("v")
+            ref = put((k, v))
+            out_ref, _replica = self._verify_handle.route(
+                {"handoff": payload, "kv_ref": ref,
+                 "draft": [int(t) for t in draft]})
+            out = get(out_ref,
+                      timeout=global_config().llm_spec_fleet_verify_timeout_s)
+            return None if out is None else [int(t) for t in out]
+
+        self.engine._spec_remote_verify = _fleet_verify
+
+    async def verify_draft(self, payload: Dict[str, Any]):
+        """Prefill-pool (or any) replica endpoint: verify one drafted
+        window against this replica's target weights. The KV snapshot
+        rides the object store; an unusable snapshot falls back to
+        recomputing the prefix inside remote_verify — slower, never
+        wrong. Returns the emitted tokens (accepted prefix + the
+        target's correction/bonus token)."""
+        from .. import get
+        from .spec_decode import remote_verify
+
+        loop = asyncio.get_event_loop()
+        meta = dict(payload["handoff"])
+        if payload.get("kv_ref") is not None:
+            k, v = await loop.run_in_executor(
+                None, lambda: get(payload["kv_ref"], timeout=30))
+            meta["k"] = k
+            meta["v"] = v
+        draft = [int(t) for t in payload["draft"]]
+
+        def _run():
+            with self._engine_lock:
+                return remote_verify(self.engine, meta, draft)
+
+        return await loop.run_in_executor(None, _run)
+
+    def _drain_spec_stats(self) -> None:
+        """Fold the engine SpecDecoder's cumulative counters into the
+        serve metrics as deltas (the pump calls this every step)."""
+        spec = self.engine.spec
+        if spec is None:
+            return
+        d = spec.drafted_total - self._spec_seen["drafted"]
+        a = spec.accepted_total - self._spec_seen["accepted"]
+        if d:
+            self._m_spec_drafted.inc(d)
+            self._spec_seen["drafted"] = spec.drafted_total
+        if a:
+            self._m_spec_accepted.inc(a)
+            self._spec_seen["accepted"] = spec.accepted_total
+        if spec.drafted_total:
+            self._m_spec_ratio.set(spec.acceptance_ratio)
+        for t in spec.take_verify_times():
+            self._m_spec_verify.observe(t)
+
     # --- engine pump: one thread-hop per step, fan-out to request queues ---
 
     def _ensure_pump(self) -> None:
@@ -252,6 +376,7 @@ class LLMServer:
                         self._observe_finished(state,
                                                time.perf_counter())
             stats = self.engine.stats()
+            self._drain_spec_stats()
             self._m_queue.set(stats["waiting"])
             self._m_occupancy.set(
                 stats["running"] / max(1, self.engine.ecfg.max_num_seqs))

@@ -362,6 +362,20 @@ def _print_serve_status() -> None:
             tags = e.get("tags") or {}
             tag_s = " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
             print(f"  {e['name']:32s} {e.get('value', 0):g}  {tag_s}")
+    spec_rows = []
+    try:
+        for name in ("llm_spec_draft_tokens_total",
+                     "llm_spec_accepted_tokens_total",
+                     "llm_spec_acceptance_ratio"):
+            spec_rows.extend(state_api.get_metrics(name))
+    except Exception:  # noqa: BLE001 — metrics plane is optional here
+        spec_rows = []
+    if spec_rows:
+        print("speculative decoding:")
+        for e in spec_rows:
+            tags = e.get("tags") or {}
+            tag_s = " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            print(f"  {e['name']:32s} {e.get('value', 0):g}  {tag_s}")
 
 
 def cmd_health(args) -> int:

@@ -8,7 +8,10 @@ the round passes only if
  * KV pages actually moved through the object store
    (serve_kv_handoff_bytes_total > 0, latency histogram populated),
  * the controller gossips prefix summaries for the deployment and
-   `cli status` renders the serve section.
+   `cli status` renders the serve section,
+ * a spec-decode replica (adversarial drafter, llm/spec_decode.py)
+   stays token-identical to the plain greedy oracle and its
+   llm_spec_* counters reach a metrics scrape.
 """
 
 from __future__ import annotations
@@ -122,8 +125,37 @@ def main() -> int:
         assert "llm_smoke" in res.stdout, res.stdout
         assert "prefill=1" in res.stdout, res.stdout
 
+        # --- speculative decoding leg (llm/spec_decode.py): the drafter
+        # is initialized from a DIFFERENT seed than the target weights,
+        # so most drafts reject — the strictest oracle gate: accept-
+        # prefix emission must be token-identical to plain greedy decode
+        # even when the drafter is wrong
+        spec_app = build_llm_deployment(
+            "tiny", name="llm_spec_smoke", engine_config=_ECFG,
+            speculation={"draft_config": "tiny", "num_draft_tokens": 3,
+                         "draft_seed": 1})
+        spec_completions = serve.run(spec_app).options(
+            method_name="completions")
+        out = ray_tpu.get(spec_completions.remote(dict(payload)),
+                          timeout=300)
+        got = out["choices"][0]["token_ids"]
+        assert got == want, (
+            f"spec-decode tokens diverge from greedy oracle: "
+            f"{got} != {want}")
+        deadline = time.time() + 30
+        drafted = 0.0
+        while time.time() < deadline:
+            drafted = _metric_total("llm_spec_draft_tokens_total")
+            if drafted > 0:
+                break
+            time.sleep(0.5)
+        assert drafted > 0, "no llm_spec_draft_tokens_total in any scrape"
+        accepted = _metric_total("llm_spec_accepted_tokens_total")
+        assert 0 <= accepted <= drafted, (accepted, drafted)
+
         print(f"serve smoke ok: {int(moved)} handoff bytes, "
-              f"{dep['prefix_summaries']} prefix summaries")
+              f"{dep['prefix_summaries']} prefix summaries, "
+              f"spec {int(accepted)}/{int(drafted)} tokens accepted")
         serve.shutdown()
         return 0
     finally:

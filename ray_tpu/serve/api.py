@@ -35,7 +35,8 @@ class Deployment:
                 max_ongoing_requests: Optional[int] = None,
                 ray_actor_options: Optional[dict] = None,
                 autoscaling_config: Optional[dict] = None,
-                pools: Optional[dict] = None) -> "Deployment":
+                pools: Optional[dict] = None,
+                speculation: Optional[dict] = None) -> "Deployment":
         config = dict(self.config)
         if num_replicas is not None:
             config["num_replicas"] = num_replicas
@@ -47,6 +48,12 @@ class Deployment:
             config["autoscaling_config"] = autoscaling_config
         if pools is not None:
             config["pools"] = pools
+        if speculation is not None:
+            if not isinstance(speculation, dict):
+                raise ValueError(
+                    "speculation must be a dict ({'draft_config': ..., "
+                    "'num_draft_tokens': k})")
+            config["speculation"] = speculation
         _validate_pools(config)
         return Deployment(self._cls, name or self.name, config)
 
@@ -73,7 +80,8 @@ def deployment(cls: Optional[type] = None, *,
                max_ongoing_requests: int = 100,
                ray_actor_options: Optional[dict] = None,
                autoscaling_config: Optional[dict] = None,
-               pools: Optional[dict] = None):
+               pools: Optional[dict] = None,
+               speculation: Optional[dict] = None):
     """@serve.deployment — turn a class into a deployable unit.
 
     ``autoscaling_config`` (ref: serve AutoscalingConfig):
@@ -87,7 +95,16 @@ def deployment(cls: Optional[type] = None, *,
     learns its pool through the user class's ``configure_pool(pool,
     deployment_name)`` hook; plain traffic routes to the entry pool
     (prefill) and the deployment class hops requests across pools
-    (e.g. LLMServer ships prefilled KV pages to the decode pool)."""
+    (e.g. LLMServer ships prefilled KV pages to the decode pool).
+
+    ``speculation`` (speculative decoding, llm/spec_decode.py):
+    {"draft_config": ..., "num_draft_tokens": k} reaches each replica
+    through the user class's ``configure_speculation(spec)`` hook — a
+    deployment-config knob, so YAML deploys toggle draft/verify
+    decoding without touching the pickled init args."""
+    if speculation is not None and not isinstance(speculation, dict):
+        raise ValueError("speculation must be a dict "
+                         "({'draft_config': ..., 'num_draft_tokens': k})")
     def _wrap(target: type) -> Deployment:
         config = {
             "num_replicas": num_replicas,
@@ -96,6 +113,7 @@ def deployment(cls: Optional[type] = None, *,
             **({"autoscaling_config": autoscaling_config}
                if autoscaling_config else {}),
             **({"pools": pools} if pools else {}),
+            **({"speculation": speculation} if speculation else {}),
         }
         _validate_pools(config)
         return Deployment(target, name or target.__name__, config)

@@ -115,7 +115,7 @@ class ServeController:
             **actor_opts,
         ).remote(dep["cls_blob"], dep["init_args_blob"],
                  config.get("max_ongoing_requests", 100), dep["name"],
-                 pool)
+                 pool, config.get("speculation"))
         return handle
 
     async def _stop_replica(self, handle) -> None:

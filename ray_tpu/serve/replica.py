@@ -52,12 +52,22 @@ def current_tenant_id() -> Optional[str]:
 class Replica:
     def __init__(self, cls_blob: bytes, init_args_blob: bytes,
                  max_ongoing_requests: int, deployment_name: str = "",
-                 pool: Optional[str] = None):
+                 pool: Optional[str] = None,
+                 speculation: Optional[dict] = None):
         cls = cloudpickle.loads(cls_blob)
         args, kwargs = cloudpickle.loads(init_args_blob)
         self.user = cls(*args, **kwargs)
         self.max_ongoing = max_ongoing_requests
         self.deployment_name = deployment_name
+        # speculative decoding: a deployment-config override (YAML /
+        # serve.deployment(speculation=...)) reaches the user callable
+        # through its configure_speculation hook. Before configure_pool:
+        # a decode replica's fleet-verify wiring needs speculation
+        # already enabled on its engine.
+        if speculation is not None:
+            spec_hook = getattr(self.user, "configure_speculation", None)
+            if spec_hook is not None:
+                spec_hook(speculation)
         # disaggregated serving (fleet KV plane): a pooled deployment
         # runs prefill and decode replica pools; the user callable
         # learns its role through the configure_pool hook before any

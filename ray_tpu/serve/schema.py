@@ -30,7 +30,8 @@ from .api import Application, Deployment, deployment as _deployment_dec
 from .handle import DeploymentHandle
 
 _DEPLOY_OVERRIDES = ("num_replicas", "max_ongoing_requests",
-                     "ray_actor_options", "autoscaling_config", "pools")
+                     "ray_actor_options", "autoscaling_config", "pools",
+                     "speculation")
 
 
 def _import_target(path: str) -> Any:

@@ -290,6 +290,89 @@ def test_fetch_on_progress_reports_contiguous_watermark(
     assert view is not None and bytes(view) == payload
 
 
+def test_puller_gone_fires_when_last_data_conn_closes(two_stores, tmp_path):
+    """A request that names its puller ties the (object, puller) pair to
+    its data connections: the on_puller_gone hook must fire exactly once,
+    when the LAST such connection closes — not while sibling streams of
+    the same pull are still open."""
+    from ray_tpu._private.object_transfer import _Stream
+
+    src, _ = two_stores
+    oid = ObjectID.from_random()
+    src.put(oid, b"x" * (1 << 20))
+    puller_hex = "ab" * 16
+    gone = []
+
+    async def go():
+        server = TransferServer(
+            src, str(tmp_path / "pg.sock"),
+            on_puller_gone=lambda o, p: gone.append((o, p)))
+        address = await server.start()
+        try:
+            s1 = _Stream(address, puller=puller_hex)
+            s2 = _Stream(address, puller=puller_hex)
+            await s1.connect()
+            await s2.connect()
+            out = bytearray(64 << 10)
+            await s1.fetch_range(oid, 0, len(out), memoryview(out))
+            await s2.fetch_range(oid, 0, len(out), memoryview(out))
+            s1.close()                      # one sibling stream down...
+            await asyncio.sleep(0.1)
+            assert gone == [], "fired while a data conn was still open"
+            s2.close()                      # ...puller crashes: NO release
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if gone:
+                    break
+            assert gone == [(oid, puller_hex)]
+        finally:
+            await server.stop()
+
+    _run(go())
+
+
+def test_crashed_puller_frees_sender_slot_promptly():
+    """Regression: a puller whose release RPC is lost (crash mid-pull)
+    used to pin one of the capped sender slots for the full 120 s TTL.
+    The grant must now expire as soon as the puller's transfer-plane
+    connection closes."""
+    import time
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.object_transfer import _Stream
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        cluster.connect()
+        head = cluster.head_node.raylet
+        ref = ray_tpu.put(np.arange(1 << 20, dtype=np.uint8))
+        oid = ref.id()
+        fake_puller = "fe" * 16
+        # the grant a crashed puller acquired but never released
+        head._transfer_tokens[oid] = {
+            fake_puller: time.monotonic() + 120.0}
+
+        async def pull_and_die():
+            s = _Stream(head.transfer.address, puller=fake_puller)
+            await s.connect()
+            out = bytearray(64 << 10)
+            total, n = await s.fetch_range(oid, 0, len(out),
+                                           memoryview(out))
+            assert total > 0 and n == len(out)
+            s.close()   # crash: the transfer_token_release RPC never comes
+
+        _run(pull_and_die())
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                fake_puller in head._transfer_tokens.get(oid, {}):
+            time.sleep(0.05)
+        assert fake_puller not in head._transfer_tokens.get(oid, {}), \
+            "sender slot still pinned after the data conn closed"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def test_pull_manager_concurrency_and_priority():
     """Concurrency gate admits highest class first and honors priority
     upgrades of already-queued pulls."""

@@ -1,0 +1,388 @@
+"""Speculative decoding plane (llm/spec_decode.py): accept-prefix
+semantics vs the greedy oracle, drafted/undrafted coexistence, draft
+state resets, the pooled draft->verify handoff, and counters reaching a
+Prometheus scrape."""
+
+import jax
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.llm import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.llm.spec_decode import (SpecConfig, accept_prefix,
+                                     remote_verify)
+from ray_tpu.models import LLAMA_CONFIGS, init_params
+
+CFG = LLAMA_CONFIGS["tiny"]
+
+# drafter == target params: every draft agrees (full-accept path)
+SPEC_AGREE = {"draft_config": "tiny", "num_draft_tokens": 3,
+              "draft_seed": 0}
+# differently-initialized drafter: drafts nearly always reject
+SPEC_REJECT = {"draft_config": "tiny", "num_draft_tokens": 3,
+               "draft_seed": 1}
+
+ECFG = dict(max_num_seqs=4, page_size=4, num_pages=64, max_seq_len=64)
+
+PROMPTS = [[5, 17, 99, 3, 42], [7, 8, 9], [20, 21, 22, 23, 24, 25, 26]]
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _greedy_oracle(params, prompts, n, **ecfg):
+    eng = LLMEngine(params, CFG, EngineConfig(**{**ECFG, **ecfg}))
+    return eng.generate(prompts,
+                        SamplingParams(temperature=0.0, max_tokens=n))
+
+
+# --- accept-prefix unit semantics ---
+
+def test_accept_prefix_semantics():
+    # full accept: whole draft + bonus token
+    assert accept_prefix([1, 2, 3], [1, 2, 3, 9]) == [1, 2, 3, 9]
+    # partial accept: agreeing prefix + correction
+    assert accept_prefix([1, 2, 3], [1, 2, 7, 9]) == [1, 2, 7]
+    # immediate reject: correction only
+    assert accept_prefix([1, 2, 3], [5, 2, 3, 9]) == [5]
+    # empty draft degenerates to one greedy token
+    assert accept_prefix([], [4]) == [4]
+
+
+def test_spec_config_parse_rejects_junk():
+    with pytest.raises(ValueError):
+        SpecConfig.parse({"num_draft_tokens": 2})     # no draft_config
+    with pytest.raises(ValueError):
+        SpecConfig.parse({"draft_config": "tiny", "bogus": 1})
+    with pytest.raises(TypeError):
+        SpecConfig.parse("tiny")
+    sc = SpecConfig.parse({"draft_config": "tiny", "num_draft_tokens": 5})
+    assert sc.num_draft_tokens == 5
+
+
+def test_spec_rejects_lora_and_bad_draft(tiny_params):
+    with pytest.raises(ValueError):
+        LLMEngine(tiny_params, CFG, EngineConfig(
+            lora_rank=4, speculation=SPEC_AGREE, **ECFG))
+    with pytest.raises(ValueError):
+        LLMEngine(tiny_params, CFG, EngineConfig(
+            speculation={"draft_config": "no-such-model"}, **ECFG))
+
+
+# --- oracle equivalence across accept regimes and prompt mixes ---
+
+@pytest.mark.parametrize("spec,regime", [(SPEC_AGREE, "full-accept"),
+                                         (SPEC_REJECT, "reject")])
+def test_spec_matches_greedy_oracle(tiny_params, spec, regime):
+    want = _greedy_oracle(tiny_params, PROMPTS, 16)
+    eng = LLMEngine(tiny_params, CFG,
+                    EngineConfig(speculation=spec, **ECFG))
+    got = eng.generate(PROMPTS,
+                       SamplingParams(temperature=0.0, max_tokens=16))
+    assert got == want, f"{regime} diverged from greedy oracle"
+    st = eng.spec.stats()
+    assert st["draft_tokens"] > 0 and st["rounds"] > 0
+    if regime == "full-accept":
+        # identical drafter => every draft token accepted
+        assert st["acceptance_ratio"] == 1.0
+        # speculation actually sped things up: fewer verify rounds than
+        # tokens emitted per request
+        assert st["rounds"] < 16 * len(PROMPTS)
+    else:
+        # disagreeing drafter: rejection resets draft state every
+        # round, and output above proves the resets are clean
+        assert st["acceptance_ratio"] < 0.5
+
+
+def test_spec_various_k_match_oracle(tiny_params):
+    want = _greedy_oracle(tiny_params, PROMPTS, 12)
+    for k in (1, 2, 5):
+        eng = LLMEngine(tiny_params, CFG, EngineConfig(
+            speculation={"draft_config": "tiny", "num_draft_tokens": k},
+            **ECFG))
+        got = eng.generate(
+            PROMPTS, SamplingParams(temperature=0.0, max_tokens=12))
+        assert got == want, f"k={k} diverged"
+
+
+def test_spec_page_boundaries_and_prefix_cache(tiny_params):
+    """Windows straddling page boundaries + shared prefix pages: the
+    drafter mirrors the target's block tables, including pages shared
+    through the prefix cache."""
+    shared = list(range(1, 14))
+    prompts = [shared + [50], shared + [60]]
+    ecfg = dict(ECFG, max_num_seqs=2, enable_prefix_caching=True)
+    want = _greedy_oracle(tiny_params, prompts, 16, **ecfg)
+    eng = LLMEngine(tiny_params, CFG, EngineConfig(
+        speculation=SPEC_AGREE, **ecfg))
+    got = eng.generate(prompts,
+                       SamplingParams(temperature=0.0, max_tokens=16))
+    assert got == want
+
+
+def test_spec_survives_preemption_pressure(tiny_params):
+    """A page pool tight enough to force recompute-preemption mid-spec:
+    drops must reset drafter state (spec.drop) and output must still
+    match the oracle."""
+    ecfg = dict(max_num_seqs=3, page_size=4, num_pages=18, max_seq_len=48)
+    prompts = [[1, 2, 3, 4, 5, 6, 7], [11, 12, 13], [21, 22, 23, 24, 25]]
+    want = _greedy_oracle(tiny_params, prompts, 20, **ecfg)
+    eng = LLMEngine(tiny_params, CFG, EngineConfig(
+        speculation=SPEC_REJECT, **ecfg))
+    got = eng.generate(prompts,
+                       SamplingParams(temperature=0.0, max_tokens=20))
+    assert got == want
+
+
+# --- drafted and non-drafted requests in ONE batch ---
+
+def test_mixed_drafted_undrafted_batch(tiny_params):
+    """A sampled (spec-ineligible) request rides the same verify window
+    as drafted greedy ones; greedy output must equal the oracle and the
+    sampled request must run to completion."""
+    want = _greedy_oracle(tiny_params, [PROMPTS[0]], 10)[0]
+    eng = LLMEngine(tiny_params, CFG,
+                    EngineConfig(speculation=SPEC_AGREE, **ECFG))
+    g = eng.add_request(list(PROMPTS[0]),
+                        SamplingParams(temperature=0.0, max_tokens=10))
+    s = eng.add_request([9, 9, 9],
+                        SamplingParams(temperature=0.8, max_tokens=10))
+    col = {g: [], s: []}
+    while eng.has_unfinished():
+        for o in eng.step():
+            col[o.request_id].append(o.token)
+    assert col[g] == want
+    assert len(col[s]) == 10
+    assert eng.spec.stats()["draft_tokens"] > 0
+
+
+def test_drafting_stops_near_budget_and_seq_end(tiny_params):
+    """max_tokens=1 and slots near max_seq_len are undrafted (the
+    window wouldn't fit / couldn't pay for itself) yet still emit the
+    oracle token."""
+    want = _greedy_oracle(tiny_params, [PROMPTS[0]], 1)
+    eng = LLMEngine(tiny_params, CFG,
+                    EngineConfig(speculation=SPEC_AGREE, **ECFG))
+    got = eng.generate([list(PROMPTS[0])],
+                       SamplingParams(temperature=0.0, max_tokens=1))
+    assert got == want
+    assert eng.spec.stats()["rounds"] == 0  # nothing was draftable
+    # run INTO the max_seq_len wall: tail tokens fall back to 1/round
+    ecfg = dict(ECFG, max_seq_len=24)
+    want = _greedy_oracle(tiny_params, [PROMPTS[0]], 40, **ecfg)
+    eng = LLMEngine(tiny_params, CFG, EngineConfig(
+        speculation=SPEC_AGREE, **ecfg))
+    got = eng.generate([list(PROMPTS[0])],
+                       SamplingParams(temperature=0.0, max_tokens=40))
+    assert got == want
+
+
+# --- pooled draft->verify handoff (fleet mode) ---
+
+def _prefilled_engine(params, spec, prompt, max_tokens=30):
+    eng = LLMEngine(params, CFG,
+                    EngineConfig(speculation=spec, **ECFG))
+    rid = eng.add_request(list(prompt), SamplingParams(
+        temperature=0.0, max_tokens=max_tokens))
+    while eng.requests[rid].ctx_len <= 0:
+        eng.step(skip_decode=True)
+    return eng, rid
+
+
+def test_pooled_verify_matches_monolithic(tiny_params):
+    """snapshot_kv_request -> remote_verify on a second engine returns
+    the exact emission the monolithic verify_request computes, for
+    full-accept / partial / immediate-reject drafts."""
+    cont = _greedy_oracle(tiny_params, [PROMPTS[0]], 6)[0]
+    drafts = [cont[1:4],            # full accept
+              [cont[1], 0, 0],      # partial
+              [255, 255, 255],      # immediate reject
+              []]                   # degenerate: plain greedy step
+    for draft in drafts:
+        engA, rid = _prefilled_engine(tiny_params, SPEC_AGREE, PROMPTS[0])
+        snap = engA.snapshot_kv_request(rid)
+        snap = {k: (np.array(v, copy=True) if hasattr(v, "shape") else v)
+                for k, v in snap.items()}
+        mono = engA.verify_request(rid, list(draft))
+        engB = LLMEngine(tiny_params, CFG, EngineConfig(**ECFG))
+        rem = remote_verify(engB, snap, list(draft))
+        assert rem == mono, f"draft={draft}"
+        assert not engB.has_unfinished()  # scratch request cleaned up
+
+
+def test_pooled_verify_corrupt_payload_recompute(tiny_params):
+    """A mangled payload must fall back to local recompute and STILL
+    produce the monolithic emission (greedy-continuation equivalence)."""
+    cont = _greedy_oracle(tiny_params, [PROMPTS[0]], 6)[0]
+    for draft in [cont[1:4], [cont[1], 0, 0], [255, 255, 255]]:
+        engA, rid = _prefilled_engine(tiny_params, SPEC_AGREE, PROMPTS[0])
+        snap = engA.snapshot_kv_request(rid)
+        mono = engA.verify_request(rid, list(draft))
+        for corrupt in ({"k": None},
+                        {"k": np.zeros((1, 2, 3), np.float32)},
+                        {"page_size": 7}):
+            engB = LLMEngine(tiny_params, CFG, EngineConfig(**ECFG))
+            bad = dict(snap)
+            bad.update(corrupt)
+            rem = remote_verify(engB, bad, list(draft))
+            assert rem == mono, f"draft={draft} corrupt={corrupt}"
+            assert not engB.has_unfinished()
+
+
+def test_snapshot_is_non_destructive(tiny_params):
+    """snapshot_kv_request leaves the request running (unlike
+    export_kv_request), so local decode continues while the fleet
+    verifier races."""
+    eng, rid = _prefilled_engine(tiny_params, SPEC_AGREE, PROMPTS[0],
+                                 max_tokens=8)
+    snap = eng.snapshot_kv_request(rid)
+    assert snap["ctx_len"] == eng.requests[rid].ctx_len
+    assert not eng.requests[rid].finished
+    want = _greedy_oracle(tiny_params, [PROMPTS[0]], 8)[0]
+    got = list(eng.requests[rid].output)
+    while eng.has_unfinished():
+        for o in eng.step():
+            got.append(o.token)
+    assert got == want
+
+
+def test_fleet_verify_hook_races_local(tiny_params):
+    """The engine's remote-verify hook receives (snapshot, draft) per
+    drafted round; its result corroborates the local emission (always
+    equal — greedy-continuation equivalence), and a hook that fails
+    never affects output."""
+    want = _greedy_oracle(tiny_params, [PROMPTS[0]], 12)[0]
+    engV = LLMEngine(tiny_params, CFG, EngineConfig(**ECFG))
+    calls = []
+
+    def hook(payload, draft):
+        calls.append(len(draft))
+        return remote_verify(engV, payload, draft)
+
+    eng = LLMEngine(tiny_params, CFG,
+                    EngineConfig(speculation=SPEC_AGREE, **ECFG))
+    eng._spec_remote_verify = hook
+    got = eng.generate([list(PROMPTS[0])],
+                       SamplingParams(temperature=0.0, max_tokens=12))
+    assert got == [want]
+    assert calls and all(n == 3 for n in calls)
+    assert eng.spec.remote_rounds_total == len(calls)
+    assert eng.spec.remote_agree_total == eng.spec.remote_rounds_total
+
+    def bad_hook(payload, draft):
+        raise RuntimeError("verifier down")
+
+    eng2 = LLMEngine(tiny_params, CFG,
+                     EngineConfig(speculation=SPEC_AGREE, **ECFG))
+    eng2._spec_remote_verify = bad_hook
+    got2 = eng2.generate([list(PROMPTS[0])],
+                         SamplingParams(temperature=0.0, max_tokens=12))
+    assert got2 == [want]
+
+
+# --- serving: counters reach a Prometheus scrape ---
+
+def test_fleet_verify_pools_corroborate_and_match_oracle(tiny_params):
+    """Disaggregated spec serving: decode-pool replicas draft locally
+    and (with llm_spec_fleet_verify on) corroborate every drafted
+    window against the prefill pool's verify_draft endpoint. The
+    output must still match the monolithic greedy oracle and the
+    decode engine's remote agreement counters must show the cross-pool
+    verifies happened — and agreed (identical weights everywhere)."""
+    import os
+
+    from ray_tpu._private.config import reset_global_config
+
+    # env vars (not _system_config): replica workers re-read the config
+    # from their inherited environment at process start
+    os.environ["RAY_TPU_LLM_SPEC_FLEET_VERIFY"] = "1"
+    # first cross-pool verify pays the verify_step jit compile on the
+    # prefill replica; don't let it eat the corroboration
+    os.environ["RAY_TPU_LLM_SPEC_FLEET_VERIFY_TIMEOUT_S"] = "60"
+    reset_global_config()
+    ray_tpu.init(num_cpus=6)
+    try:
+        from ray_tpu import serve
+        from ray_tpu.llm import build_llm_deployment
+
+        ecfg = {"max_num_seqs": 2, "page_size": 4, "num_pages": 64,
+                "max_seq_len": 64}
+        app = build_llm_deployment(
+            "tiny", name="llm_fleet", engine_config=ecfg,
+            pools={"prefill": 1, "decode": 1},
+            speculation=SPEC_AGREE)
+        handle = serve.run(app)
+        eng = LLMEngine(tiny_params, CFG, EngineConfig(**ecfg))
+        want = eng.generate([[5, 17, 99, 3]], SamplingParams(
+            temperature=0.0, max_tokens=12))[0]
+        out = ray_tpu.get(handle.options(method_name="completions").remote(
+            {"prompt_ids": [5, 17, 99, 3], "temperature": 0.0,
+             "max_tokens": 12}), timeout=300)
+        assert out["choices"][0]["token_ids"] == want
+
+        decode = serve.get_deployment_handle("llm_fleet", pool="decode")
+        stats = ray_tpu.get(
+            decode.options(method_name="stats").remote(), timeout=60)
+        spec = stats.get("spec") or {}
+        assert spec.get("rounds", 0) > 0, stats
+        assert spec.get("remote_rounds", 0) > 0, \
+            f"no cross-pool verify ever corroborated: {spec}"
+        assert spec["remote_agree"] == spec["remote_rounds"], spec
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_LLM_SPEC_FLEET_VERIFY", None)
+        os.environ.pop("RAY_TPU_LLM_SPEC_FLEET_VERIFY_TIMEOUT_S", None)
+        reset_global_config()
+
+
+def test_spec_counters_reach_metrics_scrape(tiny_params):
+    """A spec-enabled deployment serves greedy traffic; the
+    llm_spec_* series must land in the cluster metrics pipeline and
+    the output must match the local oracle."""
+    import time
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        from ray_tpu import serve
+        from ray_tpu.llm import build_llm_deployment
+        from ray_tpu.util import state
+
+        ecfg = {"max_num_seqs": 2, "page_size": 4, "num_pages": 64,
+                "max_seq_len": 64}
+        app = build_llm_deployment(
+            "tiny", name="llm_spec", engine_config=ecfg,
+            speculation={"draft_config": "tiny", "num_draft_tokens": 3,
+                         "draft_seed": 0})
+        handle = serve.run(app)
+        eng = LLMEngine(tiny_params, CFG, EngineConfig(**ecfg))
+        want = eng.generate([[5, 17, 99, 3]], SamplingParams(
+            temperature=0.0, max_tokens=10))[0]
+        out = ray_tpu.get(handle.options(method_name="completions").remote(
+            {"prompt_ids": [5, 17, 99, 3], "temperature": 0.0,
+             "max_tokens": 10}), timeout=300)
+        assert out["choices"][0]["token_ids"] == want
+
+        def total(name):
+            return sum(e.get("value", 0.0)
+                       for e in state.get_metrics(name))
+
+        deadline = time.time() + 30
+        drafted = accepted = 0.0
+        while time.time() < deadline:
+            drafted = total("llm_spec_draft_tokens_total")
+            accepted = total("llm_spec_accepted_tokens_total")
+            if drafted > 0 and accepted > 0:
+                break
+            time.sleep(0.5)
+        assert drafted > 0, "no drafted-token counter reached a scrape"
+        assert accepted > 0, "no accepted-token counter reached a scrape"
+        assert accepted <= drafted
+        ratios = [e.get("value") for e in
+                  state.get_metrics("llm_spec_acceptance_ratio")]
+        assert ratios and all(0.0 <= r <= 1.0 for r in ratios)
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()

@@ -271,3 +271,67 @@ def test_gossip_eviction_under_churn():
     laggard.evict(dead)
     _run_rounds(syncers, 2)
     assert all(dead not in s.view for s in syncers.values())
+
+
+def test_tombstone_refreshes_on_stale_receipt():
+    """Receiving a tombstoned entry proves the death hasn't reached the
+    sender yet: the TTL clock must RESTART, not keep running out."""
+    import time as _t
+
+    from ray_tpu._private.syncer import ResourceSyncer
+
+    class FakeRaylet:
+        class node_id:
+            @staticmethod
+            def hex():
+                return "aa" * 16
+        _remote_nodes = {}
+
+        @staticmethod
+        def _apply_peer_resources(node, available):
+            pass
+
+    sync = ResourceSyncer(FakeRaylet, interval_s=99)
+    dead = "bb" * 16
+    sync.evict(dead)
+    exp0 = sync._tombstones[dead]
+    _t.sleep(0.01)
+    assert sync.apply({dead: {"seq": 99, "available": {"CPU": 1.0}}}) == 0
+    assert sync._tombstones[dead] > exp0, "stale receipt did not refresh"
+    assert dead not in sync.view
+
+
+def test_delayed_peer_after_tombstone_expiry():
+    """Regression (ADVICE r5): a laggard that gossips a dead node AFTER
+    the 60 s tombstone expired used to resurrect it permanently. The
+    hub-authoritative membership cross-check (_dead_node_hexes) must
+    drop the entry and re-tombstone it instead."""
+    import time as _t
+
+    syncers, stats, ids = _make_sim(8)
+    _run_rounds(syncers, 6)
+    dead = ids[3]
+    laggard = syncers[ids[5]]
+    for h, s in syncers.items():
+        if s is laggard:
+            continue
+        # instance TTL shadows the class constant: tombstones expire
+        # almost immediately, simulating a >60 s delayed peer
+        s._TOMBSTONE_TTL_S = 0.05
+        s.evict(dead)
+        s.raylet._dead_node_hexes = {dead}   # hub death event landed
+    _t.sleep(0.1)                            # ... TTL lapses
+    _run_rounds(syncers, 4)                  # laggard still gossips it
+    resurrected = [h for h, s in syncers.items()
+                   if s is not laggard and dead in s.view]
+    assert not resurrected, (
+        f"dead node resurrected after TTL expiry on {resurrected}")
+    # a direct stale receipt re-arms the tombstone (deterministically
+    # observable, unlike the randomized gossip rounds above)
+    target = next(s for s in syncers.values() if s is not laggard)
+    before = _t.monotonic()
+    assert target.apply(
+        {dead: {"seq": 999, "available": {"CPU": 1.0}}}) == 0
+    exp = target._tombstones.get(dead)
+    assert exp is not None and exp > before
+    assert dead not in target.view
