@@ -1,0 +1,550 @@
+"""Black-box flight recorder: per-process flight rings + crash bundles.
+
+Every long-lived process (driver, worker, raylet, GCS) owns a
+``FlightRecorder`` — a bounded in-memory ring of the last N cluster
+events, log records, ambient stack samples, metric snapshots and
+in-flight task/request ids. The ring is flushed to a per-process
+*flight file* in the session dir on a slow background tick, and is
+promoted to a versioned *crash bundle* either by the dying process
+itself (SIGTERM/SIGABRT handlers, ``faulthandler`` for SIGSEGV, atexit
+on an unclean interpreter exit) or — for deaths no handler can see
+(SIGKILL, OOM-kill, machine loss) — by a survivor sweeping the corpse's
+flight file when the raylet/GCS detects the death (worker disconnect,
+heartbeat loss). The reference has no analog below the event log; the
+design follows the flight-data-recorder shape MegaScale describes for
+after-the-fact forensics of processes that are already gone
+(PAPERS.md), and `cli postmortem` is the reader.
+
+Layout under ``<session_dir>/blackbox/``:
+
+    flight/<role>-<pid>.json        live flight ring, rewritten each tick
+    bundles/<role>-<pid>-<ms>.json  promoted crash bundles (versioned)
+    fault-<role>-<pid>.log          faulthandler C-level tracebacks
+    events.jsonl                    the GCS's persisted event journal
+    incidents/<ms>/                 self-diagnosis artifacts (profile
+                                    burst, stack sweep, memory report)
+"""
+
+from __future__ import annotations
+
+import atexit
+import faulthandler
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+BUNDLE_VERSION = 1
+
+_BLACKBOX_DIRNAME = "blackbox"
+_FLIGHT_DIRNAME = "flight"
+_BUNDLE_DIRNAME = "bundles"
+_INCIDENT_DIRNAME = "incidents"
+_EVENTS_JOURNAL = "events.jsonl"
+
+
+def blackbox_dir(session_dir: str) -> str:
+    return os.path.join(session_dir, _BLACKBOX_DIRNAME)
+
+
+def flight_dir(session_dir: str) -> str:
+    return os.path.join(blackbox_dir(session_dir), _FLIGHT_DIRNAME)
+
+
+def bundle_dir(session_dir: str) -> str:
+    return os.path.join(blackbox_dir(session_dir), _BUNDLE_DIRNAME)
+
+
+def incident_dir(session_dir: str) -> str:
+    return os.path.join(blackbox_dir(session_dir), _INCIDENT_DIRNAME)
+
+
+def events_journal_path(session_dir: str) -> str:
+    return os.path.join(blackbox_dir(session_dir), _EVENTS_JOURNAL)
+
+
+# ------------------------------------------------------------ wire records
+# RPC-visible summaries (cli/state API rows; the full bundle JSON never
+# rides the control plane — only these). Registered in wire.py as struct
+# tags 16/17; append fields only (schema-evolution rule).
+
+@dataclass
+class CrashBundleInfo:
+    """One crash bundle, as listed over the state API."""
+    role: str = ""
+    pid: int = 0
+    node_id: str = ""
+    reason: str = ""
+    signal_name: str = ""
+    bundled_at: float = 0.0
+    written_at: float = 0.0
+    path: str = ""
+    inflight: list = field(default_factory=list)
+
+
+@dataclass
+class ObsCheckpointInfo:
+    """Durable-observability checkpoint metadata (GCS restart handoff)."""
+    version: int = BUNDLE_VERSION
+    written_at: float = 0.0
+    series: int = 0
+    slo_specs: int = 0
+    task_events: int = 0
+    metrics: int = 0
+
+
+# ---------------------------------------------------------- flight recorder
+
+class FlightRecorder:
+    """Bounded flight ring for one process, flushed to a flight file.
+
+    Ring appends are lock-guarded deque ops (O(1), off any hot path —
+    events/logs only); the flush thread serializes the ring every
+    ``flush_interval_s`` so a SIGKILL'd corpse still leaves a recent
+    snapshot for the survivor sweep. Providers are called only at flush
+    or dump time, never per-append.
+    """
+
+    def __init__(self, role: str, session_dir: str, *,
+                 ident: str = "", node_id: str = "",
+                 ring_size: int = 256, flush_interval_s: float = 2.0,
+                 inflight_provider: Optional[Callable[[], list]] = None,
+                 stacks_provider: Optional[Callable[[], Any]] = None,
+                 metrics_provider: Optional[Callable[[], Any]] = None):
+        self.role = role
+        self.session_dir = session_dir
+        self.ident = ident
+        self.node_id = node_id
+        self.pid = os.getpid()
+        self.started_at = time.time()
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=ring_size)
+        self._logs: deque = deque(maxlen=ring_size)
+        self._notes: Dict[str, Any] = {}
+        self._inflight_provider = inflight_provider
+        self._stacks_provider = stacks_provider
+        self._metrics_provider = metrics_provider
+        self._flush_interval_s = max(0.2, flush_interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._dumped = False
+        self._closed = False
+        os.makedirs(flight_dir(session_dir), exist_ok=True)
+        os.makedirs(bundle_dir(session_dir), exist_ok=True)
+
+    # ---- ring appends (cheap, any thread) ----
+    def record_event(self, record: dict) -> None:
+        with self._lock:
+            self._events.append(record)
+
+    def record_log(self, line: str) -> None:
+        with self._lock:
+            self._logs.append(line)
+
+    def note(self, key: str, value: Any) -> None:
+        """Small sticky annotations (current request id, job id, ...)."""
+        with self._lock:
+            if value is None:
+                self._notes.pop(key, None)
+            else:
+                self._notes[key] = value
+
+    # ---- snapshot / flush ----
+    def _call(self, provider):
+        if provider is None:
+            return None
+        try:
+            return provider()
+        except Exception as e:  # a broken provider must not kill a flush
+            return {"error": repr(e)}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            events = list(self._events)
+            logs = list(self._logs)
+            notes = dict(self._notes)
+        return {
+            "version": BUNDLE_VERSION,
+            "role": self.role,
+            "pid": self.pid,
+            "node_id": self.node_id,
+            "ident": self.ident,
+            "started_at": self.started_at,
+            "written_at": time.time(),
+            "notes": notes,
+            "events": events,
+            "logs": logs,
+            "inflight": self._call(self._inflight_provider) or [],
+            "stacks": self._call(self._stacks_provider),
+            "metrics": self._call(self._metrics_provider),
+        }
+
+    @property
+    def flight_path(self) -> str:
+        return os.path.join(flight_dir(self.session_dir),
+                            f"{self.role}-{self.pid}.json")
+
+    def flush(self) -> None:
+        try:
+            _write_json_atomic(self.flight_path, self.snapshot())
+        except Exception:  # graftlint: ignore[swallow] — disk-full etc:
+            pass  # the in-memory ring itself remains the record
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(self._flush_interval_s):
+            self.flush()
+
+    def start(self) -> "FlightRecorder":
+        self.flush()  # a flight file exists from t=0, not first tick
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True,
+            name=f"ray_tpu_blackbox_{self.role}")
+        self._thread.start()
+        _register(self)
+        return self
+
+    # ---- bundle promotion / teardown ----
+    def dump_bundle(self, reason: str,
+                    signal_name: str = "") -> Optional[str]:
+        """Promote the ring to a crash bundle (idempotent per process
+        death — the first cause wins)."""
+        if self._dumped:
+            return None
+        self._dumped = True
+        snap = self.snapshot()
+        snap["reason"] = reason
+        snap["signal"] = signal_name
+        snap["bundled_at"] = time.time()
+        snap["bundled_by"] = f"{self.role}-{self.pid}"
+        path = os.path.join(
+            bundle_dir(self.session_dir),
+            f"{self.role}-{self.pid}-{int(snap['bundled_at'] * 1000)}.json")
+        try:
+            _write_json_atomic(path, snap)
+        except Exception:  # graftlint: ignore[swallow] — dying process:
+            return None  # a failed bundle write must not mask the exit
+        try:
+            os.unlink(self.flight_path)  # promoted: no double sweep
+        except OSError:
+            pass
+        return path
+
+    def close(self, clean: bool = True) -> None:
+        """Stop flushing; a clean close removes the flight file so the
+        survivor sweep never bundles a graceful exit."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        _unregister(self)
+        if clean:
+            try:
+                os.unlink(self.flight_path)
+            except OSError:
+                pass
+        else:
+            self.flush()
+
+
+class RingLogHandler(logging.Handler):
+    """logging → flight ring bridge (last N formatted records)."""
+
+    def __init__(self, recorder: FlightRecorder,
+                 level: int = logging.INFO):
+        super().__init__(level=level)
+        self._recorder = recorder
+        self.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._recorder.record_log(self.format(record))
+        except Exception:  # graftlint: ignore[swallow] — a log handler
+            pass  # must never raise into the caller's logging call
+
+
+# ------------------------------------------------ process-level hooks
+
+_recorders: List[FlightRecorder] = []
+_recorders_lock = threading.Lock()
+_hooks_installed = False
+_fault_file = None
+_prev_handlers: Dict[int, Any] = {}
+
+
+def _register(recorder: FlightRecorder) -> None:
+    with _recorders_lock:
+        _recorders.append(recorder)
+    _install_process_hooks(recorder.session_dir, recorder.role)
+
+
+def _unregister(recorder: FlightRecorder) -> None:
+    with _recorders_lock:
+        try:
+            _recorders.remove(recorder)
+        except ValueError:
+            pass
+
+
+def recorders() -> List[FlightRecorder]:
+    with _recorders_lock:
+        return list(_recorders)
+
+
+def dump_all(reason: str, signal_name: str = "") -> List[str]:
+    out = []
+    for rec in recorders():
+        path = rec.dump_bundle(reason, signal_name)
+        if path:
+            out.append(path)
+    return out
+
+
+def _on_signal(signum, frame):
+    name = signal.Signals(signum).name
+    dump_all(f"signal:{name}", name)
+    # restore the pre-install disposition and re-deliver so the exit
+    # status stays what the sender expects (killed-by-signal)
+    prev = _prev_handlers.get(signum, signal.SIG_DFL)
+    try:
+        signal.signal(signum, prev if callable(prev) or prev in (
+            signal.SIG_DFL, signal.SIG_IGN) else signal.SIG_DFL)
+    except (ValueError, OSError, TypeError):
+        pass
+    if callable(prev) and prev not in (signal.default_int_handler,):
+        try:
+            prev(signum, frame)
+            return
+        except Exception:  # graftlint: ignore[swallow] — a broken prior
+            pass  # handler must not stop the re-delivery below
+    os.kill(os.getpid(), signum)
+
+
+def _on_atexit():
+    # normal interpreter exit after close(clean=True) is a no-op (the
+    # registry is empty); recorders still registered here belong to a
+    # process dying without a graceful shutdown — bundle them
+    if recorders():
+        dump_all("atexit")
+
+
+def _install_process_hooks(session_dir: str, role: str) -> None:
+    """Once per process: faulthandler file for C-level deaths
+    (SIGSEGV/SIGFPE/SIGBUS), Python handlers for the catchable abnormal
+    exits (SIGTERM/SIGABRT), and an atexit bundle for unclean exits.
+    Signal installation silently degrades off the main thread (raylet/
+    GCS run inside a node's event-loop thread; the sweep path covers
+    them)."""
+    global _hooks_installed, _fault_file
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    try:
+        os.makedirs(blackbox_dir(session_dir), exist_ok=True)
+        _fault_file = open(
+            os.path.join(blackbox_dir(session_dir),
+                         f"fault-{role}-{os.getpid()}.log"), "w")
+        faulthandler.enable(file=_fault_file)
+    except Exception:
+        _fault_file = None
+    atexit.register(_on_atexit)
+    for sig in (signal.SIGTERM, signal.SIGABRT):
+        try:
+            _prev_handlers[sig] = signal.getsignal(sig)
+            signal.signal(sig, _on_signal)
+        except (ValueError, OSError):
+            pass  # not the main thread / restricted env
+
+
+def reset_for_tests() -> None:
+    """Drop process-level state so one pytest process can host many
+    recorder lifecycles (hooks re-arm on the next start())."""
+    global _hooks_installed, _fault_file
+    with _recorders_lock:
+        _recorders.clear()
+    _hooks_installed = False
+    if _fault_file is not None:
+        try:
+            faulthandler.disable()
+            _fault_file.close()
+        except Exception:  # graftlint: ignore[swallow] — test-only
+            pass  # teardown; a closed file is fine either way
+        _fault_file = None
+
+
+# ------------------------------------------------------------- survivors
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def discard_flight(session_dir: str, pid: int) -> None:
+    """An expected exit (graceful worker shutdown) leaves no corpse."""
+    d = flight_dir(session_dir)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for name in names:
+        if name.endswith(f"-{pid}.json"):
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+
+
+def sweep(session_dir: str, *, reason: str, bundled_by: str,
+          pids: Optional[List[int]] = None,
+          node_id: Optional[str] = None,
+          require_dead: bool = True) -> List[dict]:
+    """Promote dead processes' flight files into crash bundles.
+
+    Called by the raylet on worker disconnect (``pids``) and by the GCS
+    on heartbeat loss (``node_id`` — every corpse on the dead node).
+    Returns the promoted bundle dicts (with ``path`` set) so the caller
+    can emit events naming the in-flight work.
+    """
+    fdir = flight_dir(session_dir)
+    try:
+        names = sorted(os.listdir(fdir))
+    except OSError:
+        return []
+    if names:
+        os.makedirs(bundle_dir(session_dir), exist_ok=True)
+    promoted = []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        src = os.path.join(fdir, name)
+        try:
+            with open(src, "r") as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue  # mid-rewrite or corrupt: next sweep retries
+        pid = int(snap.get("pid") or 0)
+        if pids is not None and pid not in pids:
+            continue
+        if node_id is not None and snap.get("node_id") != node_id:
+            continue
+        if pids is None and node_id is None and require_dead \
+                and _pid_alive(pid):
+            continue
+        snap["reason"] = reason
+        snap["signal"] = snap.get("signal") or ""
+        snap["bundled_at"] = time.time()
+        snap["bundled_by"] = bundled_by
+        dst = os.path.join(
+            bundle_dir(session_dir),
+            f"{snap.get('role', 'proc')}-{pid}-"
+            f"{int(snap['bundled_at'] * 1000)}.json")
+        try:
+            _write_json_atomic(dst, snap)
+            os.unlink(src)
+        except OSError:
+            continue
+        snap["path"] = dst
+        promoted.append(snap)
+    return promoted
+
+
+def read_bundles(session_dir: str) -> List[dict]:
+    """All crash bundles in a session, oldest first. A corrupt or
+    truncated bundle is skipped with a WARNING — a half-written file
+    must never take the postmortem reader down with it."""
+    bdir = bundle_dir(session_dir)
+    try:
+        names = sorted(os.listdir(bdir))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(bdir, name)
+        try:
+            with open(path, "r") as f:
+                snap = json.load(f)
+            if not isinstance(snap, dict) or "pid" not in snap:
+                raise ValueError("not a bundle record")
+        except (OSError, ValueError) as e:
+            logger.warning("skipping corrupt crash bundle %s: %r",
+                           path, e)
+            continue
+        snap["path"] = path
+        out.append(snap)
+    return out
+
+
+def bundle_infos(session_dir: str) -> List[CrashBundleInfo]:
+    """read_bundles() projected to the wire-registered summary rows."""
+    out = []
+    for snap in read_bundles(session_dir):
+        out.append(CrashBundleInfo(
+            role=str(snap.get("role", "")),
+            pid=int(snap.get("pid") or 0),
+            node_id=str(snap.get("node_id", "")),
+            reason=str(snap.get("reason", "")),
+            signal_name=str(snap.get("signal", "")),
+            bundled_at=float(snap.get("bundled_at") or 0.0),
+            written_at=float(snap.get("written_at") or 0.0),
+            path=str(snap.get("path", "")),
+            inflight=list(snap.get("inflight") or []),
+        ))
+    return out
+
+
+def read_events_journal(session_dir: str,
+                        severity: Optional[str] = None,
+                        source: Optional[str] = None,
+                        limit: int = 0,
+                        offset: int = 0) -> List[dict]:
+    """Parse the persisted event journal (works against a dead
+    cluster). Malformed lines (torn writes) are dropped silently —
+    the journal is append-only JSONL."""
+    path = events_journal_path(session_dir)
+    out = []
+    try:
+        with open(path, "r") as f:
+            if offset:
+                f.seek(offset)
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if severity and rec.get("severity") != severity:
+                    continue
+                if source and rec.get("source") != source:
+                    continue
+                out.append(rec)
+    except OSError:
+        return []
+    if limit and len(out) > limit:
+        out = out[-limit:]
+    return out
+
+
+def _write_json_atomic(path: str, obj: Any) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -296,6 +296,28 @@ class Config:
     # tenant id assumed for requests arriving without an X-Tenant-ID
     # header (per-tenant accounting; serve/proxy.py)
     serve_default_tenant: str = "default"
+    # --- black-box plane (_private/blackbox.py: flight rings, crash
+    #     bundles, durable observability state; read by cli postmortem) ---
+    # per-process flight recorder: bounded ring of recent events/logs/
+    # stacks/in-flight ids, flushed to a session-dir flight file and
+    # promoted to a crash bundle on abnormal exit or survivor sweep.
+    blackbox_enabled: bool = True
+    # ring length (events and log records each keep this many entries)
+    blackbox_ring_size: int = 256
+    # flight-file rewrite period; bounds how stale a SIGKILL'd corpse's
+    # bundle can be. Appends are off the submit hot path either way.
+    blackbox_flush_interval_s: float = 2.0
+    # GCS durable-observability checkpoint period (SeriesStore rings,
+    # SLO monitor state, aggregated metrics table, task-event table →
+    # gcs_storage). 0 disables checkpointing; restore still runs if a
+    # prior checkpoint exists in the journal.
+    obs_checkpoint_interval_s: float = 10.0
+    # persist cluster events as JSONL next to the bundles so
+    # `cli events --follow` works against a dead cluster
+    event_journal_enabled: bool = True
+    # after restoring SLO state on head restart, suppress NEW burn-rate
+    # alert transitions for this long — the restart gap must not page
+    slo_restore_grace_s: float = 30.0
     # raylet clock-sync period against the GCS clock (NTP-style offset
     # piggybacked on ping; raylet.py _clock_sync_loop). 0 disables —
     # timelines then merge raw per-node wall clocks.

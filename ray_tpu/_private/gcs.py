@@ -155,6 +155,28 @@ class GcsServer:
         self.series_store = None
         self.slo_monitor = None
         self._slo_task: Optional[asyncio.Task] = None
+        # black-box plane (_private/blackbox.py): session dir derived
+        # from the journal location (flight files / bundles / event
+        # journal live next to it); the GCS keeps its own flight ring,
+        # checkpoints durable observability state, and sweeps corpse
+        # flight files when it declares a node dead.
+        self.session_dir: Optional[str] = (
+            os.path.dirname(journal_path) if journal_path else None)
+        self.started_at = time.time()
+        self._blackbox = None
+        self._events_journal = None
+        self._obs_task: Optional[asyncio.Task] = None
+        # per-(node, role, reason, signal) crash counter — the
+        # process_crashes_total Prometheus series
+        self.crash_counts: Dict[tuple, int] = {}
+        # clock offsets recovered from the last obs checkpoint (nodes
+        # are not restored across restarts; postmortem still needs the
+        # dead fleet's offsets to clock-correct its timeline)
+        self._restored_clock_offsets: Dict[str, float] = {}
+        self._last_diag_t = 0.0
+        # node registration times (process_uptime_seconds source; a
+        # raylet restart re-registers and resets its clock)
+        self._node_first_seen: Dict[str, float] = {}
         self._next_job = 1
         if self._remote_store is None:
             self._restore_tables()
@@ -222,11 +244,175 @@ class GcsServer:
                             f"invalid slo_specs config, monitor empty: {e}")
             self.slo_monitor = SloMonitor(specs, default_policies(cfg))
             self._slo_task = asyncio.ensure_future(self._slo_loop())
+        # durable observability: reload the last checkpoint (series
+        # rings, SLO alert state, cumulative metrics table, task events)
+        # so `cli slo`/`cli timeline` span the restart, then start
+        # checkpointing ourselves
+        self._restore_obs_checkpoint(cfg)
+        if cfg.obs_checkpoint_interval_s > 0:
+            self._obs_task = asyncio.ensure_future(
+                self._obs_checkpoint_loop())
+        if cfg.blackbox_enabled and self.session_dir:
+            from . import blackbox
+
+            self._blackbox = blackbox.FlightRecorder(
+                "gcs", self.session_dir,
+                ident=self.server.address,
+                ring_size=cfg.blackbox_ring_size,
+                flush_interval_s=cfg.blackbox_flush_interval_s,
+                inflight_provider=self._blackbox_inflight,
+            ).start()
         # restored placement groups that never finished reserving resume
         # scheduling now that the loop is live (restart recovery)
         for pg in self.placement_groups.values():
             if pg["state"] in ("PENDING", "RESCHEDULING"):
                 self._kick_pg_scheduler(pg["pg_id"])
+
+    # ---- black-box plane: flight ring + durable observability ----
+    def _blackbox_inflight(self) -> list:
+        """The GCS's in-flight view for its own flight ring: RUNNING
+        tasks and non-terminal actors (what a head-death postmortem
+        needs to implicate)."""
+        out = []
+        for rec in self.task_events.values():
+            if rec.get("state") == "RUNNING":
+                out.append({"kind": "task",
+                            "task_id": str(rec.get("task_id")),
+                            "name": rec.get("name", "")})
+        for actor in self.actors.values():
+            if actor.state in (ALIVE, PENDING_CREATION, RESTARTING):
+                out.append({"kind": "actor",
+                            "actor_id": actor.actor_id.hex(),
+                            "class_name": actor.class_name,
+                            "state": actor.state})
+        return out[:200]
+
+    def _restore_obs_checkpoint(self, cfg) -> None:
+        raw = self.storage.get("__obs", "checkpoint")
+        if not raw:
+            return
+        try:
+            snap = pickle.loads(raw)
+        except Exception as e:
+            self._event("blackbox", "WARNING",
+                        f"obs checkpoint unreadable, starting cold: {e!r}")
+            return
+        now = time.time()
+        # cumulative per-worker metric values: restoring them means the
+        # next worker report lands as a normal delta on top, so the
+        # aggregated counters never step backwards across the restart
+        # (no windowed_increase reset artifact)
+        for key, entry in (snap.get("metrics") or {}).items():
+            if len(self.metrics) >= self.MAX_METRICS:
+                break
+            self.metrics.setdefault(key, entry)
+        for task_id, rec in (snap.get("task_events") or {}).items():
+            if len(self.task_events) >= self.MAX_TASK_EVENTS:
+                break
+            self.task_events.setdefault(task_id, rec)
+        self._restored_clock_offsets = dict(
+            snap.get("clock_offsets") or {})
+        restored_series = 0
+        if self.series_store is not None and snap.get("series"):
+            restored_series = self.series_store.load(snap["series"])
+        if self.slo_monitor is not None and snap.get("slo"):
+            self.slo_monitor.load(snap["slo"], now=now,
+                                  grace_s=cfg.slo_restore_grace_s)
+        self._event(
+            "blackbox", "INFO",
+            f"observability state restored from checkpoint "
+            f"(written {now - snap.get('written_at', now):.1f}s ago: "
+            f"{restored_series} series, "
+            f"{len(snap.get('task_events') or {})} task events)",
+            kind="obs_restore", written_at=snap.get("written_at"))
+
+    def _obs_checkpoint_once(self):
+        """Persist the observability plane through the storage seam
+        (journal or remote store — whatever the GCS already trusts)."""
+        from .blackbox import ObsCheckpointInfo
+
+        now = time.time()
+        snap = {
+            "version": 1,
+            "written_at": now,
+            "series": (self.series_store.dump()
+                       if self.series_store is not None else None),
+            "slo": (self.slo_monitor.dump()
+                    if self.slo_monitor is not None else None),
+            "metrics": dict(self.metrics),
+            "task_events": dict(self.task_events),
+            "clock_offsets": {
+                info.node_id.hex(): info.clock_offset
+                for info in self.nodes.values()},
+        }
+        self.storage.put("__obs", "checkpoint", pickle.dumps(snap))
+        return ObsCheckpointInfo(
+            written_at=now,
+            series=len(self.series_store or ()),
+            slo_specs=(len(self.slo_monitor.specs)
+                       if self.slo_monitor is not None else 0),
+            task_events=len(self.task_events),
+            metrics=len(self.metrics))
+
+    async def _obs_checkpoint_loop(self):
+        from .config import global_config
+
+        period = max(1.0, global_config().obs_checkpoint_interval_s)
+        while True:
+            await asyncio.sleep(period)
+            try:
+                self._obs_checkpoint_once()
+            except Exception:  # graftlint: ignore[swallow] — a failed
+                pass  # checkpoint must not kill the periodic loop
+
+    async def handle_obs_checkpoint(self, payload, conn):
+        """Force a checkpoint now (tests, pre-restart flushes)."""
+        return self._obs_checkpoint_once()
+
+    async def handle_list_incidents(self, payload, conn):
+        """Crash-bundle summaries + recent crash/blackbox events (the
+        dashboard Incidents panel / `cli postmortem --live` source)."""
+        from . import blackbox
+
+        bundles = (blackbox.bundle_infos(self.session_dir)
+                   if self.session_dir else [])
+        limit = int(payload.get("limit", 100))
+        events = [e for e in self.events
+                  if e.get("source") in ("blackbox", "NODE")
+                  or e.get("kind") in ("fast_burn", "slow_burn")]
+        return {
+            "session_dir": self.session_dir or "",
+            "bundles": bundles[-limit:],
+            "events": events[-limit:],
+            "crash_counts": [
+                {"node": k[0], "role": k[1], "reason": k[2],
+                 "signal": k[3], "count": n}
+                for k, n in self.crash_counts.items()],
+        }
+
+    async def handle_report_crash(self, payload, conn):
+        """A raylet swept a worker corpse: count it, log it, and name
+        the in-flight work in the event stream."""
+        node = str(payload.get("node_id", ""))[:12]
+        key = (node, payload.get("role", "worker"),
+               payload.get("reason", "unknown"),
+               payload.get("signal", ""))
+        self.crash_counts[key] = self.crash_counts.get(key, 0) + 1
+        inflight = payload.get("inflight") or []
+        names = ", ".join(
+            f"{str(r.get('task_id') or r.get('request_id') or '?')[:12]}"
+            f" ({r.get('fn') or r.get('kind') or '?'})"
+            for r in inflight[:5]) or "nothing in flight"
+        self._event(
+            "blackbox", "ERROR",
+            f"{payload.get('role', 'worker')} pid "
+            f"{payload.get('pid')} on node {node} crashed "
+            f"({payload.get('reason', 'unknown')}): {names}",
+            kind="process_crash", **{
+                k: payload.get(k) for k in
+                ("role", "pid", "node_id", "reason", "signal",
+                 "bundle_path", "inflight")})
+        return True
 
     async def _node_health_loop(self):
         """ACTIVE node liveness probing (ref: gcs_health_check_manager.h:45
@@ -335,6 +521,21 @@ class GcsServer:
             self._collective_watchdog_task.cancel()
         if self._slo_task is not None:
             self._slo_task.cancel()
+        if self._obs_task is not None:
+            self._obs_task.cancel()
+            try:
+                self._obs_checkpoint_once()  # final flush before exit
+            except Exception:  # graftlint: ignore[swallow] — shutdown
+                pass  # path: best-effort durability only
+        if self._blackbox is not None:
+            self._blackbox.close(clean=True)
+            self._blackbox = None
+        if self._events_journal is not None:
+            try:
+                self._events_journal.close()
+            except Exception:  # graftlint: ignore[swallow] — shutdown
+                pass  # path: journal fd close is best-effort
+            self._events_journal = None
         for client in self._pg_raylet_clients.values():
             try:
                 await client.close()
@@ -354,8 +555,35 @@ class GcsServer:
         rec = {"timestamp": time.time(), "source": source,
                "severity": severity, "message": message, **fields}
         self.events.append(rec)
+        self._journal_event(rec)
+        if self._blackbox is not None:
+            self._blackbox.record_event(rec)
         # streamed to subscribers too (dashboard live tail)
         background(self._publish("events", rec))
+
+    def _journal_event(self, rec: dict) -> None:
+        """Append-only JSONL event journal in the session dir: the
+        dead-cluster source for `cli events --follow` and postmortem."""
+        if self._events_journal is None:
+            from .config import global_config
+
+            if (not global_config().event_journal_enabled
+                    or not self.session_dir):
+                return
+            from . import blackbox
+
+            try:
+                path = blackbox.events_journal_path(self.session_dir)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                self._events_journal = open(path, "a")
+            except OSError:
+                return
+        try:
+            self._events_journal.write(
+                json.dumps(rec, default=str) + "\n")
+            self._events_journal.flush()
+        except (OSError, ValueError):
+            pass  # closed mid-shutdown / disk full: in-memory deque wins
 
     async def handle_list_events(self, payload, conn):
         source = payload.get("source")
@@ -1049,6 +1277,8 @@ class GcsServer:
     async def handle_register_node(self, payload, conn):
         info = NodeInfo(**payload)
         info.last_heartbeat_t = time.time()
+        # re-registration (raylet restart) resets the uptime clock
+        self._node_first_seen[info.node_id.hex()] = info.last_heartbeat_t
         self.nodes[info.node_id] = info
         self._node_conns[conn] = info.node_id
         await self._publish("node", {"event": "added", "node": info})
@@ -1088,6 +1318,7 @@ class GcsServer:
         self._event("NODE", "ERROR" if "died" in reason or "lost" in reason
                     else "INFO", f"node dead: {reason}",
                     node_id=node_id.hex())
+        self._sweep_node_corpses(node_id, reason)
         # Fail actors on the dead node (ref: gcs_actor_manager OnNodeDead)
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION):
@@ -1114,6 +1345,41 @@ class GcsServer:
                     pg["state"] = "RESCHEDULING"
                 await self._publish("placement_group", pg)
                 self._kick_pg_scheduler(pg["pg_id"])
+
+    def _sweep_node_corpses(self, node_id: NodeID, reason: str) -> None:
+        """Heartbeat loss / disconnect declared a node dead: promote
+        every flight file the corpse's processes left into crash bundles
+        (a SIGKILL'd or silently-lost process dumps nothing itself —
+        the survivor does it). Same-host sessions share the session
+        dir, so the head can read the corpse's files directly."""
+        if not self.session_dir:
+            return
+        from . import blackbox
+
+        node_hex = node_id.hex()
+        try:
+            promoted = blackbox.sweep(
+                self.session_dir, reason=f"node_death: {reason}",
+                bundled_by="gcs", node_id=node_hex)
+        except Exception:  # graftlint: ignore[swallow] — a failed sweep
+            return  # must not break node-death handling
+        for snap in promoted:
+            key = (node_hex[:12], snap.get("role", "proc"),
+                   "node_death", str(snap.get("signal", "")))
+            self.crash_counts[key] = self.crash_counts.get(key, 0) + 1
+            inflight = snap.get("inflight") or []
+            names = ", ".join(
+                str(r.get("task_id", r.get("request_id", "?")))[:12]
+                for r in inflight[:5]) or "nothing in flight"
+            self._event(
+                "blackbox", "ERROR",
+                f"swept crash bundle for {snap.get('role')} pid "
+                f"{snap.get('pid')} on dead node {node_hex[:12]} "
+                f"(in flight: {names})",
+                kind="process_crash", role=snap.get("role"),
+                pid=snap.get("pid"), node_id=node_hex,
+                reason="node_death", bundle_path=snap.get("path"),
+                inflight=inflight)
 
     # ---- jobs ----
     async def handle_register_job(self, payload, conn):
@@ -1668,7 +1934,46 @@ class GcsServer:
             else:
                 out[agg_key] = dict(entry)
                 out[agg_key].pop("worker_id", None)
-        return list(out.values())
+        result = list(out.values())
+        result.extend(self._process_metrics(name_filter))
+        return result
+
+    def _process_metrics(self, name_filter=None) -> List[dict]:
+        """Synthetic per-process liveness series the GCS mints itself:
+        process_uptime_seconds (head + every alive raylet, from
+        registration time) and process_crashes_total (per node, with
+        reason/signal labels, fed by the crash sweeps). They ride the
+        normal aggregation so Prometheus, the series store and `cli
+        status` all see them with no extra plumbing."""
+        now = time.time()
+        entries: List[dict] = []
+        if not name_filter or name_filter == "process_uptime_seconds":
+            entries.append({
+                "name": "process_uptime_seconds", "kind": "gauge",
+                "tags": {"role": "gcs", "node": "head"},
+                "value": now - self.started_at,
+                "description": "seconds since this process came up"})
+            for info in self.nodes.values():
+                if not info.alive:
+                    continue
+                first = self._node_first_seen.get(info.node_id.hex())
+                if first is None:
+                    continue
+                entries.append({
+                    "name": "process_uptime_seconds", "kind": "gauge",
+                    "tags": {"role": "raylet",
+                             "node": info.node_id.hex()[:12]},
+                    "value": now - first,
+                    "description": "seconds since this process came up"})
+        if not name_filter or name_filter == "process_crashes_total":
+            for (node, role, reason, sig), n in self.crash_counts.items():
+                entries.append({
+                    "name": "process_crashes_total", "kind": "counter",
+                    "tags": {"node": node, "role": role,
+                             "reason": reason, "signal": sig},
+                    "value": float(n),
+                    "description": "abnormal process exits (bundled)"})
+        return entries
 
     async def handle_get_metrics(self, payload, conn):
         return self._aggregate_metrics(payload.get("name"))
@@ -1691,10 +1996,8 @@ class GcsServer:
             try:
                 now = time.time()
                 self.series_store.sample(self._aggregate_metrics(), now)
-                self.slo_monitor.tick(
-                    self.series_store, now,
-                    emit=lambda severity, message, **fields:
-                        self._event("slo", severity, message, **fields))
+                self.slo_monitor.tick(self.series_store, now,
+                                      emit=self._slo_emit)
                 last_err = None
             except asyncio.CancelledError:
                 raise
@@ -1706,6 +2009,59 @@ class GcsServer:
                     last_err = msg
                     self._event("slo", "ERROR",
                                 f"SLO evaluation tick failed: {msg}")
+
+    def _slo_emit(self, severity: str, message: str, **fields) -> None:
+        """SLO alert-transition sink: the event lands in the stream as
+        before, and a fast-burn ERROR additionally self-diagnoses —
+        profile burst + stack sweep + memory report captured NOW, while
+        the burn is live, with the artifact paths attached to the alert
+        event (the on-call reads the page and the evidence together)."""
+        self._event("slo", severity, message, **fields)
+        if severity != "ERROR" or fields.get("kind") != "fast_burn":
+            return
+        now = time.time()
+        if now - self._last_diag_t < 30.0:
+            return  # one burst per page storm, not one per spec
+        self._last_diag_t = now
+        alert_rec = self.events[-1]  # the event just appended above
+        background(self._self_diagnose(alert_rec))
+
+    async def _self_diagnose(self, alert_rec: dict) -> None:
+        """Capture the three forensic views and attach their paths to
+        the triggering alert (mutating the deque'd record: later
+        list_events readers see the artifacts on the alert itself)."""
+        if not self.session_dir:
+            return
+        from . import blackbox
+
+        out_dir = os.path.join(blackbox.incident_dir(self.session_dir),
+                               str(int(time.time() * 1000)))
+        artifacts: Dict[str, str] = {}
+
+        async def _capture(name, coro):
+            try:
+                result = await coro
+            except Exception as e:
+                result = {"error": repr(e)}
+            path = os.path.join(out_dir, f"{name}.json")
+            try:
+                os.makedirs(out_dir, exist_ok=True)
+                with open(path, "w") as f:
+                    json.dump(result, f, default=str)
+                artifacts[name] = path
+            except OSError:
+                pass
+
+        await _capture("profile", self.handle_profile_cluster(
+            {"duration_s": 1.0, "hz": 50.0}, None))
+        await _capture("stacks", self.handle_dump_all_stacks({}, None))
+        await _capture("memory", self.handle_memory_report({}, None))
+        alert_rec["artifacts"] = dict(artifacts)
+        self._event("blackbox", "INFO",
+                    f"self-diagnosis captured for '{alert_rec.get('slo')}'"
+                    f" fast-burn: {', '.join(sorted(artifacts))}",
+                    kind="self_diagnosis", slo=alert_rec.get("slo"),
+                    artifacts=artifacts)
 
     async def handle_get_metric_series(self, payload, conn):
         """Ring-buffered samples for one metric (dashboard sparklines,

@@ -60,15 +60,30 @@ class RemoteStoreClient:
         self._wake.set()
 
     async def ping(self, timeout: float = 2.0) -> bool:
+        from .rpc import RpcClient
+
+        # Dedicated throwaway probe connection per ping: the shared
+        # client's reconnect lock is held for seconds at a time by the
+        # durability writer's retries during a store outage, which would
+        # stretch each probe far past its budget and stall the failure
+        # detector's strike clock — the health probe must never share
+        # fate with bulk writes. A fresh connect also recovers naturally
+        # once the store comes back (no sticky closed=True transport).
+        probe = RpcClient(self.address)
         try:
-            # retrying: a plain call() fails permanently once the
-            # transport dropped (closed=True) even after the store
-            # recovered — an idle GCS would then false-trip its failure
-            # detector and die against a healthy store
-            return bool(await self._client.call_retrying(
-                "store_ping", {}, attempts=2, per_try_timeout=timeout))
+            async def _probe() -> bool:
+                await probe.connect(timeout=timeout)
+                return bool(await probe.call(
+                    "store_ping", {}, timeout=timeout))
+
+            return bool(await asyncio.wait_for(_probe(), timeout))
         except Exception:
             return False
+        finally:
+            try:
+                await probe.close()
+            except Exception:  # graftlint: ignore[swallow] — probe conn
+                pass  # teardown; there is nothing to salvage
 
     async def flush(self, timeout: float = 10.0) -> None:
         """Wait until every enqueued write has been ACKED by the store
@@ -158,6 +173,20 @@ class Storage:
             self._replay(journal_path)
             self._compact(journal_path)
             self._journal = open(journal_path, "ab")
+
+    @classmethod
+    def open_readonly(cls, journal_path: str) -> "Storage":
+        """Replay a journal into memory WITHOUT compacting it or opening
+        an append handle — the postmortem reader's path: inspecting a
+        dead (or still-running — another process may own the file)
+        cluster's tables must never mutate them."""
+        st = cls.__new__(cls)
+        st._kv = {}
+        st._journal_path = None
+        st._journal = None
+        st._remote = None
+        st._replay(journal_path)
+        return st
 
     # ---- local journal ----
     def _compact(self, path: str) -> None:

@@ -293,6 +293,14 @@ class Raylet:
         # watchdog tick; scheduling deprioritizes nodes past threshold
         self._straggler_scores: Dict[str, float] = {}
         self._drained_workers: Set[int] = set()  # pids killed for draining
+        # black-box plane: this raylet's own flight ring, plus the pids
+        # whose exit we ORDERED (graceful shutdown pushes) — their
+        # disconnect discards the flight file instead of bundling it
+        self._blackbox = None
+        self._expected_exits: Set[int] = set()
+        from .config import TEMP_ROOT
+
+        self._session_dir = os.path.join(TEMP_ROOT, session_name)
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -365,6 +373,40 @@ class Raylet:
             background(self._clock_sync_loop())
         if self.cfg.task_watchdog_interval_s > 0:
             background(self._task_watchdog_loop())
+        if self.cfg.blackbox_enabled:
+            from . import blackbox
+
+            self._blackbox = blackbox.FlightRecorder(
+                "raylet", self._session_dir,
+                ident=self.server.address,
+                node_id=self.node_id.hex(),
+                ring_size=self.cfg.blackbox_ring_size,
+                flush_interval_s=self.cfg.blackbox_flush_interval_s,
+                inflight_provider=self._blackbox_inflight)
+            self._blackbox.start()
+
+    def _blackbox_inflight(self):
+        """Flight-ring view of what this raylet is holding right now:
+        granted leases (the tasks a postmortem must implicate) plus the
+        worker pool. Kept cheap — it runs on every flight flush."""
+        items = []
+        for lease_id, lease in list(self._leases.items())[:200]:
+            items.append({
+                "kind": "lease",
+                "lease_id": lease_id,
+                "worker_pid": lease.worker.pid,
+                "actor_id": lease.worker.actor_id.hex()
+                if lease.worker.actor_id else None,
+                "owner": lease.owner_address,
+            })
+        for w in list(self._workers.values())[:200]:
+            items.append({
+                "kind": "worker",
+                "worker_id": w.worker_id.hex(),
+                "pid": w.pid,
+                "alive": w.alive,
+            })
+        return items
 
     async def _clock_sync_loop(self):
         """Estimate this node's clock offset against the GCS clock by
@@ -796,8 +838,12 @@ class Raylet:
             task.cancel()
         self._token_conn_watchers.clear()
         for worker in self._workers.values():
+            self._expected_exits.add(worker.pid)
             if worker.conn is not None:
                 await worker.conn.push("shutdown", {})
+        if self._blackbox is not None:
+            self._blackbox.close(clean=True)
+            self._blackbox = None
         if self.syncer is not None:
             self.syncer.stop()
         await self.server.stop()
@@ -1194,6 +1240,45 @@ class Raylet:
         await self._report_resources()
         return True
 
+    async def _blackbox_worker_gone(self, worker: "WorkerHandle"):
+        """Black-box disposition for a vanished worker: an exit this
+        raylet ORDERED (shutdown push, drain kill marked expected)
+        discards the flight file quietly; an unexpected death promotes
+        it to a crash bundle — carrying the worker's own last-flushed
+        in-flight tasks — and reports the crash to the GCS incident
+        log. SIGKILL leaves no in-process hook, so the survivor doing
+        the sweep is the only way those deaths get flight data."""
+        if not self.cfg.blackbox_enabled:
+            return
+        from . import blackbox
+
+        if worker.pid in self._expected_exits:
+            self._expected_exits.discard(worker.pid)
+            blackbox.discard_flight(self._session_dir, worker.pid)
+            return
+        reason = ("drain_kill" if worker.pid in self._drained_workers
+                  else "worker_disconnect")
+        try:
+            promoted = blackbox.sweep(
+                self._session_dir, reason=reason,
+                bundled_by=f"raylet-{self.node_id.hex()[:12]}",
+                pids=[worker.pid])
+        except Exception:  # graftlint: ignore[swallow] — a failed sweep
+            return  # must not break disconnect handling
+        for snap in promoted:
+            try:
+                await self.gcs.call("report_crash", {
+                    "role": snap.get("role", "worker"),
+                    "pid": worker.pid,
+                    "node_id": self.node_id.hex(),
+                    "reason": reason,
+                    "signal": snap.get("signal_name"),
+                    "bundle_path": snap.get("path"),
+                    "inflight": (snap.get("inflight") or [])[:5],
+                }, timeout=5)
+            except Exception:  # graftlint: ignore[swallow] — the bundle
+                pass  # is on disk; losing the GCS event is tolerable
+
     async def _on_disconnect(self, conn):
         # reap exited worker subprocesses and drop them from tracking (dead
         # workers would otherwise linger as zombies until node stop)
@@ -1205,6 +1290,7 @@ class Raylet:
         if worker is None:
             return
         worker.alive = False
+        await self._blackbox_worker_gone(worker)
         # a gone worker's pid may be recycled by the kernel — never keep
         # it on the factory kill list
         try:
@@ -1244,6 +1330,7 @@ class Raylet:
             # disconnect rather than reuse: the orphaned worker may have
             # a lane-serve thread still polling the dead owner's ring
             held.alive = False
+            self._expected_exits.add(held.pid)
             if held.conn is not None:
                 try:
                     await held.conn.push("shutdown", {})
@@ -1484,6 +1571,7 @@ class Raylet:
         worker.lease = None
         if payload.get("disconnect_worker"):
             worker.alive = False
+            self._expected_exits.add(worker.pid)
             if worker.conn is not None:
                 await worker.conn.push("shutdown", {})
         elif worker.alive and worker.actor_id is None:
@@ -1768,6 +1856,7 @@ class Raylet:
                 worker = lease.worker
                 worker.lease = None
                 worker.alive = False
+                self._expected_exits.add(worker.pid)
                 if worker.conn is not None:
                     await worker.conn.push("shutdown", {})
         self.resources.release(reserved.total)

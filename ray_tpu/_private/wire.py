@@ -114,6 +114,11 @@ def _register_all() -> None:
     register_struct(9, _gcs.NodeInfo)
     register_struct(10, _gcs.ActorInfo)
 
+    from . import blackbox as _bb
+
+    register_struct(16, _bb.CrashBundleInfo)
+    register_struct(17, _bb.ObsCheckpointInfo)
+
     register_exception(1, _exc.RayTpuError)
     register_exception(2, _exc.TaskError)
     register_exception(3, _exc.TaskCancelledError)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import logging
 import os
 import queue
 import sys
@@ -172,6 +173,10 @@ class TaskExecutor:
         self.core = core
         self.raylet = raylet
         self.seal_batcher: Optional[SealBatcher] = None
+        # the worker's flight recorder (blackbox.py), if enabled — the
+        # deliberate-exit paths close it so an ORDERED kill (force
+        # cancel, kill_self) never masquerades as a crash bundle
+        self.blackbox_rec = None
         self.pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="task_exec")
         self._applied_env: dict = {}  # runtime-env hash this worker adopted
         # actor runtime
@@ -499,6 +504,8 @@ class TaskExecutor:
         still in startup (function load / arg fetch) is marked so it raises
         the moment it registers."""
         if force:
+            if self.blackbox_rec is not None:
+                self.blackbox_rec.close(clean=True)
             threading.Timer(0.02, lambda: os._exit(1)).start()
             return True
         thread = self._running.get(task_id)
@@ -767,6 +774,39 @@ async def _amain():
     # read AFTER _connect(): _system_config overrides land there
     if cfg.profiling_sample_hz > 0:
         executor.start_ambient_sampler(cfg.profiling_sample_hz)
+    blackbox_rec = None
+    if cfg.blackbox_enabled:
+        # black-box flight ring: running on the MAIN thread here, so the
+        # SIGTERM/SIGABRT dump handlers actually install (unlike raylet/
+        # GCS, which live on an event-loop thread and rely on the
+        # survivor sweep); a SIGKILL'd worker leaves its last flushed
+        # flight file for the raylet to promote on disconnect
+        from .config import TEMP_ROOT
+        from . import blackbox
+        from ..util import metrics as _metrics
+
+        def _bb_inflight():
+            now = time.time()
+            return [
+                {"kind": "task", "task_id": tid.hex(), "fn": fn,
+                 "age_s": round(now - t0, 3)}
+                for tid, (_, fn, t0) in
+                list(executor._running_since.items())
+            ]
+
+        blackbox_rec = blackbox.FlightRecorder(
+            "worker", os.path.join(TEMP_ROOT, session),
+            ident=worker_id.hex(), node_id=node_id.hex(),
+            ring_size=cfg.blackbox_ring_size,
+            flush_interval_s=cfg.blackbox_flush_interval_s,
+            inflight_provider=_bb_inflight,
+            stacks_provider=lambda: stacks.flight_snapshot(
+                executor._running_since),
+            metrics_provider=lambda: _metrics.snapshot_local())
+        blackbox_rec.start()
+        executor.blackbox_rec = blackbox_rec
+        logging.getLogger("ray_tpu").addHandler(
+            blackbox.RingLogHandler(blackbox_rec))
     if cfg.tracemalloc_enabled:
         import tracemalloc
 
@@ -830,6 +870,8 @@ async def _amain():
         return True
 
     async def handle_kill_self(payload, conn):
+        if executor.blackbox_rec is not None:
+            executor.blackbox_rec.close(clean=True)
         loop.call_later(0.05, lambda: os._exit(0))
         return True
 
@@ -996,6 +1038,8 @@ async def _amain():
         # a failed registration must still unbind the socket before the
         # process exits, or a fast raylet retry can hit a stale address
         await server.stop()
+    if blackbox_rec is not None:
+        blackbox_rec.close(clean=True)  # ordered shutdown: no corpse
     os._exit(0)
 
 

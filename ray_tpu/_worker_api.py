@@ -18,6 +18,39 @@ from . import exceptions as exc
 _lock = threading.RLock()
 _node: Optional[Node] = None
 _core: Optional[CoreWorker] = None
+_driver_blackbox = None  # the driver's FlightRecorder (blackbox.py)
+
+
+def _start_driver_blackbox(session_dir: Optional[str]) -> None:
+    """Black-box flight ring for the driver process. Drivers usually run
+    on the main thread, so the SIGTERM/SIGABRT dump handlers install; a
+    SIGKILL'd driver leaves its flight file for the GCS node-death
+    sweep. Skipped when the session dir is unknown (TCP-attached
+    drivers on a different host than the head)."""
+    global _driver_blackbox
+    cfg = global_config()
+    if (not cfg.blackbox_enabled or _driver_blackbox is not None
+            or not session_dir or not os.path.isdir(session_dir)):
+        return
+    from ._private import blackbox
+
+    def _inflight():
+        c = _core
+        if c is None:
+            return []
+        # the driver's owned in-flight submissions (core_worker._inflight)
+        return [{"kind": "owned_task", "task_id": tid.hex()}
+                for tid in list(getattr(c, "_inflight", {}))[:200]]
+
+    try:
+        _driver_blackbox = blackbox.FlightRecorder(
+            "driver", session_dir,
+            ident=f"pid-{os.getpid()}",
+            ring_size=cfg.blackbox_ring_size,
+            flush_interval_s=cfg.blackbox_flush_interval_s,
+            inflight_provider=_inflight).start()
+    except Exception:
+        _driver_blackbox = None
 
 
 def is_initialized() -> bool:
@@ -138,6 +171,9 @@ def _connect_to_address(gcs_address: str) -> Dict[str, Any]:
     _core.job_id = job_id
     _core.current_task_id = TaskID.for_driver(job_id)
     _core.io.run(_core.gcs.call("register_driver", {"job_id": job_id}))
+    # same-host attach: the raylet's unix socket lives in the session dir
+    if "/" in head.address:
+        _start_driver_blackbox(os.path.dirname(head.address))
     return {"gcs_address": gcs_address, "node_id": head.node_id.hex()}
 
 
@@ -165,6 +201,7 @@ def _connect_to_node(started_node: Node) -> Dict[str, Any]:
         from ._private.ids import TaskID
 
         _core.current_task_id = TaskID.for_driver(job_id)
+        _start_driver_blackbox(getattr(_node, "session_dir", None))
         return {
             "session_name": _node.session_name,
             "node_id": _node.node_id.hex(),
@@ -178,8 +215,11 @@ def shutdown() -> None:
     half-dead core that makes the next init() refuse to run."""
     import sys
 
-    global _node, _core
+    global _node, _core, _driver_blackbox
     with _lock:
+        if _driver_blackbox is not None:
+            _driver_blackbox.close(clean=True)
+            _driver_blackbox = None
         if _core is not None:
             # reap live streaming_split coordinators NOW, while the RPC
             # plane is still up — leaving them to __del__ at interpreter

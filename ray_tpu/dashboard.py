@@ -7,7 +7,7 @@ the same tables from the JSON API, no build step, no assets).
     GET /                  — HTML UI (auto-refreshing tables)
     GET /api/nodes /api/actors /api/tasks /api/objects /api/jobs
         /api/cluster_status /api/metrics /api/health /api/stacks
-        /api/serve /api/slo /api/profile /api/memory
+        /api/serve /api/slo /api/profile /api/memory /api/incidents
     GET /metrics           — Prometheus text scrape endpoint
                              (ref: _private/prometheus_exporter.py)
 """
@@ -61,6 +61,7 @@ _UI_HTML = """<!doctype html>
  <section><h2>Actors</h2><div id="actors"></div></section>
  <section><h2>Serve</h2><div id="serve"></div></section>
  <section><h2>SLO</h2><div id="slo"></div></section>
+ <section><h2>Incidents</h2><div id="incidents"></div></section>
  <section><h2>Jobs</h2><div id="jobs"></div></section>
  <section><h2>Task summary</h2><div id="tasks"></div></section>
  <section><h2>Events</h2><div id="events"></div></section>
@@ -206,6 +207,34 @@ async function refreshSlo(){try{
    ['time','severity','message']);
  document.getElementById('slo').innerHTML=html;
 }catch(e){}}
+async function refreshIncidents(){try{
+ const inc=await j('/api/incidents');
+ const bundles=inc.bundles||[];
+ let html=bundles.length?table(bundles.slice().reverse().slice(0,15).map(b=>({
+  time:new Date((b.written_at||0)*1000).toLocaleTimeString(),
+  role:{__html:'<span class="pill bad">'+esc(b.role||'?')
+   +' pid '+esc(b.pid||'?')+'</span>'},
+  reason:(b.reason||'')+(b.signal_name?' ('+b.signal_name+')':''),
+  node:(b.node_id||'').slice(0,12),
+  inflight:(b.inflight||[]).slice(0,3).map(r=>
+   (r.task_id||r.request_id||r.lease_id||r.kind||'?')
+    .toString().slice(0,12)).join(' ')||'',
+  bundle:b.path||''})),
+  ['time','role','reason','node','inflight','bundle'])
+  :'<span class="pill ok">no crash bundles</span>';
+ const cc=inc.crash_counts||[];
+ if(cc.length)html+='<div style="margin-top:8px">crash totals</div>'
+  +table(cc.map(c=>({node:(c.node||'').slice(0,12),role:c.role||'',
+   reason:c.reason||'',count:c.count||0})));
+ const ev=inc.events||[];
+ if(ev.length)html+='<div style="margin-top:8px">incident events</div>'
+  +table(ev.slice().reverse().slice(0,10).map(e=>({
+   time:new Date(e.timestamp*1000).toLocaleTimeString(),
+   severity:e.severity,message:e.message,
+   artifacts:(e.artifacts||[]).join(' ')})),
+   ['time','severity','message','artifacts']);
+ document.getElementById('incidents').innerHTML=html;
+}catch(e){}}
 async function refreshTimeline(){try{
  const s=await j('/api/summary');
  const ph=s.phases||{};
@@ -308,11 +337,11 @@ async function tailLog(){
   +'&file='+encodeURIComponent(f)+'&lines=200');
  document.getElementById('logview').textContent=await r.text();}
 refresh();refreshTimeline();refreshLogs();refreshHealth();refreshServe();
-refreshSlo();refreshMemory();
+refreshSlo();refreshMemory();refreshIncidents();
 setInterval(refresh,5000);setInterval(refreshTimeline,10000);
 setInterval(refreshLogs,15000);setInterval(refreshHealth,5000);
 setInterval(refreshServe,5000);setInterval(refreshSlo,5000);
-setInterval(refreshMemory,10000);
+setInterval(refreshMemory,10000);setInterval(refreshIncidents,10000);
 </script></body></html>
 """
 
@@ -428,6 +457,15 @@ def _routes():
             payload["events_error"] = events_error
         return _json(payload)
 
+    async def api_incidents(_req):
+        """Black-box plane: crash bundles swept from dead processes,
+        incident events (process_crash / node death / burn alerts with
+        self-diagnosis artifacts), per-node crash totals."""
+        try:
+            return _json(state_api.list_incidents())
+        except Exception:  # noqa: BLE001 — black-box plane is optional
+            return _json({"bundles": [], "events": [], "crash_counts": []})
+
     async def api_stacks(req):
         node = req.query.get("node_id") or None
         return _json(state_api.dump_stacks(node_id=node))
@@ -484,6 +522,7 @@ def _routes():
     app.router.add_get("/api/health", api_health)
     app.router.add_get("/api/serve", api_serve)
     app.router.add_get("/api/slo", api_slo)
+    app.router.add_get("/api/incidents", api_incidents)
     app.router.add_get("/api/stacks", api_stacks)
     app.router.add_get("/api/profile", api_profile)
     app.router.add_get("/api/memory", api_memory)

@@ -312,6 +312,32 @@ def cmd_status(args) -> int:
     print("resources:")
     for key in sorted(total):
         print(f"  {key}: {avail.get(key, 0):g}/{total[key]:g} available")
+    # black-box plane liveness: per-process uptime + crash counters
+    # (gcs._process_metrics synthesizes these into the metrics pipeline)
+    try:
+        up_rows = state_api.get_metrics("process_uptime_seconds")
+        crash_rows = state_api.get_metrics("process_crashes_total")
+    except Exception:  # noqa: BLE001 — metrics plane is optional here
+        up_rows, crash_rows = [], []
+    if up_rows:
+        print("process uptime:")
+        for e in sorted(up_rows, key=lambda r: sorted(
+                (r.get("tags") or {}).items())):
+            tags = e.get("tags") or {}
+            v = e.get("value", 0.0)
+            up_s = (f"{v / 3600:.1f}h" if v >= 3600
+                    else f"{v / 60:.1f}m" if v >= 60 else f"{v:.0f}s")
+            print(f"  {tags.get('role', '?'):7s} "
+                  f"{tags.get('node', '?'):12s} up {up_s}")
+    if crash_rows:
+        print("process crashes:")
+        for e in crash_rows:
+            tags = e.get("tags") or {}
+            sig = tags.get("signal") or "-"
+            print(f"  {tags.get('role', '?'):7s} "
+                  f"{tags.get('node', '?'):12s} "
+                  f"{tags.get('reason', '?')} (signal {sig}): "
+                  f"{e.get('value', 0):g}")
     _print_serve_status()
     ray_tpu.shutdown()
     return 0
@@ -745,6 +771,276 @@ def cmd_memory(args) -> int:
     return 0
 
 
+# ------------------------------------------------------- black-box plane
+
+def _resolve_session_dir(args) -> str:
+    """A session dir for the offline black-box readers: --session wins;
+    otherwise the most recently touched rtpu_* dir under /tmp/ray_tpu
+    (a cleanly stopped head removes its dir, so what survives is the
+    crashed/running session the postmortem wants)."""
+    explicit = getattr(args, "session", None)
+    if explicit:
+        path = (explicit if os.path.isdir(explicit)
+                else os.path.join(_RUN_DIR, explicit))
+        if not os.path.isdir(path):
+            raise SystemExit(f"no session dir at {explicit!r}")
+        return path
+    try:
+        cands = [os.path.join(_RUN_DIR, d) for d in os.listdir(_RUN_DIR)
+                 if d.startswith("rtpu_")
+                 and os.path.isdir(os.path.join(_RUN_DIR, d))]
+    except OSError:
+        cands = []
+    if not cands:
+        raise SystemExit(f"no rtpu_* session dirs under {_RUN_DIR}; "
+                         "pass --session PATH")
+    return max(cands, key=os.path.getmtime)
+
+
+def cmd_events(args) -> int:
+    """Cluster event stream from the PERSISTED journal
+    (<session>/blackbox/events.jsonl) — works against a dead cluster,
+    and --follow tails it live like `tail -f`."""
+    from ray_tpu._private import blackbox
+
+    session_dir = _resolve_session_dir(args)
+    path = blackbox.events_journal_path(session_dir)
+
+    def _emit(rec: dict) -> None:
+        t = rec.get("timestamp") or 0.0
+        ts = time.strftime("%H:%M:%S", time.localtime(t)) if t else "--"
+        print(f"{ts} [{rec.get('severity', '?'):7s}] "
+              f"[{rec.get('source', '?')}] {rec.get('message', '')}",
+              flush=True)
+
+    def _match(rec: dict) -> bool:
+        if args.severity and rec.get("severity") != args.severity:
+            return False
+        if args.source and rec.get("source") != args.source:
+            return False
+        return True
+
+    recs = blackbox.read_events_journal(
+        session_dir, severity=args.severity, source=args.source,
+        limit=args.limit)
+    if not recs and not args.follow and not os.path.exists(path):
+        print(f"no event journal at {path} "
+              "(event_journal_enabled off, or the session never started)")
+        return 1
+    for rec in recs:
+        _emit(rec)
+    if not args.follow:
+        return 0
+    # tail mode: poll for appended bytes, emit complete lines only
+    # (a torn trailing line stays buffered until its newline lands)
+    pos = os.path.getsize(path) if os.path.exists(path) else 0
+    buf = b""
+    try:
+        while True:
+            time.sleep(0.5)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size < pos:  # journal rotated/truncated: restart
+                pos, buf = 0, b""
+            if size == pos:
+                continue
+            with open(path, "rb") as f:
+                f.seek(pos)
+                buf += f.read()
+                pos = f.tell()
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if _match(rec):
+                    _emit(rec)
+    except KeyboardInterrupt:  # graftlint: ignore[swallow] — quiet ^C
+        return 0
+
+
+def _load_obs_checkpoint(session_dir: str) -> dict:
+    """The durable-observability checkpoint straight off the dead
+    cluster's journal (read-only replay; no compaction, no append)."""
+    import pickle
+
+    from ray_tpu._private.gcs_storage import Storage
+
+    journal = os.path.join(session_dir, "gcs_journal.bin")
+    if not os.path.exists(journal):
+        return {}
+    try:
+        raw = Storage.open_readonly(journal).get("__obs", "checkpoint")
+        return pickle.loads(raw) if raw else {}
+    except Exception as e:  # noqa: BLE001 — a torn journal still leaves
+        print(f"  <obs checkpoint unreadable: {e!r}>")  # bundles readable
+        return {}
+
+
+def _postmortem_report(session_dir: str) -> dict:
+    """Assemble the cross-process incident report: crash bundles +
+    persisted event journal + obs checkpoint, with per-node clock
+    offsets applied so one timeline composes across processes."""
+    from ray_tpu._private import blackbox
+
+    bundles = blackbox.read_bundles(session_dir)
+    events = blackbox.read_events_journal(session_dir)
+    ckpt = _load_obs_checkpoint(session_dir)
+    offsets = {str(k): float(v or 0.0)
+               for k, v in (ckpt.get("clock_offsets") or {}).items()}
+
+    timeline = []
+    for e in events:
+        t = e.get("timestamp") or 0.0
+        timeline.append({"t": t, "source": e.get("source", "?"),
+                         "severity": e.get("severity", "?"),
+                         "what": e.get("message", ""), "event": e})
+    for b in bundles:
+        # bundle timestamps are the corpse's LOCAL clock: correct them
+        # onto the GCS timebase before merging with journal events
+        off = offsets.get(str(b.get("node_id") or ""), 0.0)
+        timeline.append({
+            "t": float(b.get("written_at") or 0.0) + off,
+            "source": "blackbox", "severity": "ERROR",
+            "what": (f"{b.get('role', '?')} pid {b.get('pid')} died "
+                     f"({b.get('reason', '?')}"
+                     f"{', ' + b['signal'] if b.get('signal') else ''}) — "
+                     f"last flight data written here"),
+            "bundle": b})
+    timeline.sort(key=lambda r: r["t"])
+
+    # SLO state at the end of the world (checkpointed alert state)
+    slo_state = ((ckpt.get("slo") or {}).get("state")
+                 or {}) if ckpt else {}
+    alerts = [e for e in events
+              if e.get("source") == "slo"
+              or e.get("kind") in ("fast_burn", "slow_burn")]
+    crashes = [e for e in events if e.get("kind") == "process_crash"]
+    return {"session_dir": session_dir, "bundles": bundles,
+            "events": events, "timeline": timeline, "alerts": alerts,
+            "crash_events": crashes, "checkpoint": ckpt,
+            "clock_offsets": offsets, "slo_state": slo_state}
+
+
+def _perfetto_export(report: dict, path: str) -> int:
+    """Chrome-trace (Perfetto) export of the incident timeline: one
+    track per process (bundle deaths + their in-flight work as slices),
+    journal events as instants on a 'cluster' track."""
+    events = []
+    for row in report["timeline"]:
+        if "bundle" in row:
+            b = row["bundle"]
+            pid = int(b.get("pid") or 0)
+            name = f"{b.get('role', 'proc')}-{pid}"
+            events.append({
+                "name": f"death: {b.get('reason', '?')}",
+                "ph": "i", "s": "p", "pid": pid, "tid": 0,
+                "ts": row["t"] * 1e6, "cat": "crash",
+                "args": {"signal": b.get("signal", ""),
+                         "bundled_by": b.get("bundled_by", "")}})
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": name}})
+            for item in (b.get("inflight") or []):
+                dur = float(item.get("age_s") or 0.0)
+                events.append({
+                    "name": (item.get("fn") or item.get("kind")
+                             or "inflight"),
+                    "ph": "X", "pid": pid, "tid": 1,
+                    "ts": (row["t"] - dur) * 1e6, "dur": dur * 1e6,
+                    "cat": "inflight",
+                    "args": {k: v for k, v in item.items()
+                             if isinstance(v, (str, int, float))}})
+        else:
+            events.append({
+                "name": f"[{row['severity']}] {row['what'][:120]}",
+                "ph": "i", "s": "g", "pid": 0, "tid": 0,
+                "ts": row["t"] * 1e6, "cat": row["source"]})
+    events.append({"name": "process_name", "ph": "M", "pid": 0,
+                   "args": {"name": "cluster events"}})
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f, default=str)
+    return len(events)
+
+
+def cmd_postmortem(args) -> int:
+    """Cross-process incident report for a dead (or dying) cluster:
+    crash bundles, clock-corrected timeline, implicated in-flight work,
+    last alerts, final stacks — assembled purely from session-dir files
+    (<session>/blackbox/* + the GCS journal), no cluster required."""
+    session_dir = _resolve_session_dir(args)
+    report = _postmortem_report(session_dir)
+    if args.json:
+        print(json.dumps(report, default=str))
+        return 0 if report["bundles"] else 1
+    bundles = report["bundles"]
+    print(f"postmortem: {session_dir}")
+    print(f"crash bundles: {len(bundles)}")
+    for b in bundles:
+        age = ""
+        if b.get("bundled_at") and b.get("written_at"):
+            age = (f", flight data {b['bundled_at'] - b['written_at']:.1f}s"
+                   f" old at sweep")
+        print(f"  {b.get('role', '?'):7s} pid {b.get('pid')} on node "
+              f"{str(b.get('node_id') or '?')[:12]}: "
+              f"{b.get('reason', '?')}"
+              f"{' sig ' + b['signal'] if b.get('signal') else ''}"
+              f" (bundled by {b.get('bundled_by', '?')}{age})")
+        inflight = b.get("inflight") or []
+        if inflight:
+            print(f"    in flight ({len(inflight)}):")
+            for item in inflight[: args.top]:
+                bits = [f"{k}={v}" for k, v in item.items()
+                        if v not in (None, "") and k != "kind"]
+                print(f"      {item.get('kind', '?'):10s} "
+                      + "  ".join(bits))
+        if args.stacks and b.get("stacks"):
+            print("    final stacks:")
+            for th in b["stacks"][: args.top]:
+                if isinstance(th, dict):
+                    print(f"      {th.get('name', '?')}: "
+                          f"{th.get('stack', '')[-200:]}")
+        logs = b.get("logs") or []
+        if logs:
+            print(f"    last log lines:")
+            for line in logs[-3:]:
+                print(f"      {line}")
+    crashes = report["crash_events"]
+    if crashes:
+        print(f"crash events ({len(crashes)}):")
+        for e in crashes[-args.top:]:
+            print(f"  [{e.get('severity')}] {e.get('message')}")
+    alerts = report["alerts"]
+    if alerts:
+        print(f"last alerts ({min(len(alerts), args.top)}):")
+        for e in alerts[-args.top:]:
+            extra = ""
+            if e.get("artifacts"):
+                extra = ("  artifacts: "
+                         + ", ".join(sorted(e["artifacts"])))
+            print(f"  [{e.get('severity')}] {e.get('message')}{extra}")
+    slo_state = report["slo_state"]
+    if slo_state:
+        print("SLO state at last checkpoint:")
+        for name, st in sorted(slo_state.items()):
+            print(f"  {name}: alert={st.get('alert', '?')} "
+                  f"({len(st.get('history') or [])} history samples)")
+    n_timeline = len(report["timeline"])
+    shown = report["timeline"][-args.timeline:]
+    print(f"timeline (clock-corrected, last {len(shown)}/{n_timeline}):")
+    for row in shown:
+        ts = time.strftime("%H:%M:%S", time.localtime(row["t"]))
+        print(f"  {ts} [{row['severity']:7s}] [{row['source']}] "
+              f"{row['what']}")
+    if args.perfetto:
+        n = _perfetto_export(report, args.perfetto)
+        print(f"wrote {n} trace events to {args.perfetto} "
+              f"(open at https://ui.perfetto.dev)")
+    return 0 if bundles else 1
+
+
 def cmd_up(args) -> int:
     """ref: python/ray/scripts/scripts.py:1378 `up`."""
     from ..autoscaler.launcher import load_cluster_config, up
@@ -928,6 +1224,45 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="dump the raw memory report")
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("events",
+                        help="cluster events from the persisted journal "
+                             "(works against a dead cluster); --follow "
+                             "tails it")
+    sp.add_argument("--session", default=None,
+                    help="session dir (path or rtpu_* name; default: "
+                         "most recent under /tmp/ray_tpu)")
+    sp.add_argument("--severity", default=None,
+                    choices=["INFO", "WARNING", "ERROR"],
+                    help="only events at this severity")
+    sp.add_argument("--source", default=None,
+                    help="only events from this source (slo, blackbox, "
+                         "NODE, stall_sentinel, ...)")
+    sp.add_argument("--limit", type=int, default=200,
+                    help="history lines to print before following")
+    sp.add_argument("--follow", "-f", action="store_true",
+                    help="keep tailing the journal (tail -f)")
+    sp.set_defaults(fn=cmd_events)
+
+    sp = sub.add_parser("postmortem",
+                        help="black-box incident report for a dead "
+                             "cluster: crash bundles, clock-corrected "
+                             "timeline, in-flight work, final stacks")
+    sp.add_argument("--session", default=None,
+                    help="session dir (path or rtpu_* name; default: "
+                         "most recent under /tmp/ray_tpu)")
+    sp.add_argument("--stacks", action="store_true",
+                    help="print each corpse's final thread stacks")
+    sp.add_argument("--top", type=int, default=8,
+                    help="in-flight / alert rows per section")
+    sp.add_argument("--timeline", type=int, default=25,
+                    help="timeline rows to print")
+    sp.add_argument("--perfetto", default=None,
+                    help="write the incident timeline as chrome-trace "
+                         "JSON (open at ui.perfetto.dev)")
+    sp.add_argument("--json", action="store_true",
+                    help="dump the raw report")
+    sp.set_defaults(fn=cmd_postmortem)
 
     sp = sub.add_parser("lint",
                         help="graftlint: concurrency- and error-plane-"

@@ -9,12 +9,15 @@ merged folded stacks and `cli memory` must flag a deliberately pinned
 ownerless object as a leak suspect — and the SLO plane:
 runtime-installed specs must show per-tenant attainment from live
 traffic, and an injected slow replica must fire the fast burn-rate
-ERROR alert within a couple of evaluation ticks."""
+ERROR alert within a couple of evaluation ticks — and the black-box
+plane: a kill -9'd worker mid-task must leave a crash bundle that
+`cli postmortem` resolves to the dead pid and its in-flight task."""
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -233,6 +236,73 @@ def _profile_smoke() -> None:
     assert "store " in status.stdout, status.stdout
 
 
+def _postmortem_smoke() -> None:
+    """Black-box plane end to end: kill -9 a worker mid-task under
+    background traffic; the raylet sweeps the corpse's flight file into
+    a crash bundle, `cli postmortem` (file-based — works against dead
+    clusters too) must name the dead pid and the in-flight task id, and
+    the crash accounting must land on `cli status` + the Prometheus
+    scrape."""
+    from ray_tpu import _worker_api
+    from ray_tpu._private import blackbox
+    from ray_tpu._private.prometheus import render_cluster
+
+    session_dir = _worker_api.node().session_dir
+    addr = _worker_api.node().gcs_address
+    pid_path = os.path.join(session_dir, "postmortem_victim_pid")
+
+    @ray_tpu.remote
+    def pm_victim(path):
+        with open(path, "w") as f:
+            f.write(str(os.getpid()))
+        time.sleep(120)
+
+    @ray_tpu.remote
+    def pm_background(x):
+        time.sleep(0.01)
+        return x
+
+    pm_victim.remote(pid_path)
+    _wait(lambda: os.path.exists(pid_path), 30, "victim pid file")
+    pid = int(open(pid_path).read())
+    # background load keeps the rest of the cluster busy mid-incident
+    refs = [pm_background.remote(i) for i in range(16)]
+    time.sleep(1.0)  # >= one flight flush with the task in flight
+    os.kill(pid, signal.SIGKILL)
+
+    bundles = _wait(
+        lambda: [b for b in blackbox.read_bundles(session_dir)
+                 if b.get("pid") == pid],
+        30, "crash bundle for the killed worker")
+    task_ids = [r.get("task_id", "") for r in bundles[0]["inflight"]
+                if r.get("task_id")]
+    assert task_ids, bundles[0]
+
+    pm = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "postmortem",
+         "--session", session_dir],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert pm.returncode == 0, (pm.returncode, pm.stdout, pm.stderr)
+    assert str(pid) in pm.stdout, pm.stdout
+    assert any(t[:12] in pm.stdout for t in task_ids), pm.stdout
+
+    ev = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "events",
+         "--session", session_dir, "--severity", "ERROR"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert ev.returncode == 0, (ev.returncode, ev.stdout, ev.stderr)
+    assert str(pid) in ev.stdout, ev.stdout
+
+    _wait(lambda: "process_crashes_total" in render_cluster(), 20,
+          "crash counter on the Prometheus scrape")
+    status = _cli(addr, "status")
+    assert status.returncode == 0, (status.returncode, status.stderr)
+    assert "process crashes" in status.stdout, status.stdout
+    assert ray_tpu.get(refs, timeout=60) == list(range(16))
+
+
 def main() -> int:
     # the SloSlow failpoint must be in the environment BEFORE ray.init:
     # replica workers read RAY_TPU_FAILPOINTS at spawn (it does not
@@ -240,7 +310,11 @@ def main() -> int:
     # deployment so every other leg is untouched
     os.environ["RAY_TPU_FAILPOINTS"] = \
         "serve.replica.handle@SloSlow=slow:0.4"
+    # fast flight-ring flushes so the postmortem leg's SIGKILL'd worker
+    # leaves a fresh corpse (workers read config from env at spawn)
+    os.environ["RAY_TPU_BLACKBOX_FLUSH_INTERVAL_S"] = "0.25"
     ray_tpu.init(num_cpus=4, _system_config={
+        "blackbox_flush_interval_s": 0.25,
         # tight stall thresholds so the injected hang flags in seconds
         "task_watchdog_interval_s": 0.5,
         "task_stall_threshold_s": 2.0,
@@ -316,10 +390,12 @@ def main() -> int:
         _profile_smoke()
         _stall_sentinel_smoke()
         _slo_smoke()
+        _postmortem_smoke()
         print("observability smoke ok")
         return 0
     finally:
         os.environ.pop("RAY_TPU_FAILPOINTS", None)
+        os.environ.pop("RAY_TPU_BLACKBOX_FLUSH_INTERVAL_S", None)
         ray_tpu.shutdown()
 
 

@@ -258,6 +258,46 @@ class SeriesStore:
                         "samples": list(rec["samples"])})
         return out
 
+    def dump(self) -> Dict[str, Any]:
+        """Checkpointable snapshot (plain lists/tuples — pickles and
+        JSON-encodes; the GCS persists this through gcs_storage so the
+        rings survive a head restart)."""
+        return {
+            "version": 1,
+            "max_samples": self.max_samples,
+            "min_interval_s": self.min_interval_s,
+            "series": [
+                {"name": n, "tags": list(tag_t), "kind": rec["kind"],
+                 "last_t": rec["last_t"],
+                 "samples": [list(s) for s in rec["samples"]]}
+                for (n, tag_t), rec in self._series.items()
+            ],
+        }
+
+    def load(self, state: Dict[str, Any]) -> int:
+        """Restore a dump() snapshot into this (empty or live) store.
+        Restored samples land BEHIND anything already present-by-key;
+        current bounds win over the checkpoint's. Returns the number of
+        series restored."""
+        loaded = 0
+        for ser in state.get("series", []):
+            key = (ser["name"],
+                   tuple(tuple(p) for p in ser.get("tags", [])))
+            if key in self._series:
+                continue  # live data is newer than the checkpoint
+            while len(self._series) >= self.max_series:
+                self._series.popitem(last=False)
+            samples = collections.deque(
+                (tuple(s) for s in ser.get("samples", [])),
+                maxlen=self.max_samples)
+            self._series[key] = {
+                "kind": ser.get("kind", "gauge"),
+                "last_t": float(ser.get("last_t", -1e18)),
+                "samples": samples,
+            }
+            loaded += 1
+        return loaded
+
     def bucket_increases(self, name: str, selector: Dict[str, str],
                          window_s: float, now: float
                          ) -> List[Tuple[float, float]]:
@@ -390,6 +430,10 @@ class SloMonitor:
         self.policies = list(policies)
         self.history_len = int(history_len)
         self._state: Dict[str, dict] = {}
+        # restore grace: after a head restart reloads this monitor, new
+        # ok->firing transitions are suppressed until the window refills
+        # with live samples (the gap itself must never page)
+        self._grace_until: float = 0.0
         self.set_specs(specs)
 
     def set_specs(self, specs: Sequence[SloSpec]) -> None:
@@ -431,6 +475,9 @@ class SloMonitor:
                 if firing and _STATE_RANK[pol.kind] > _STATE_RANK[alert]:
                     alert = pol.kind
             prev = st["alert"]
+            if (alert != prev and now < self._grace_until
+                    and _STATE_RANK[alert] > _STATE_RANK[prev]):
+                alert = prev  # restore grace: escalations wait it out
             if alert != prev:
                 st["alert"] = alert
                 st["since"] = now
@@ -466,6 +513,39 @@ class SloMonitor:
                 "attainment": attainment, "achieved": achieved,
                 "total": total, "compliant": compliant, "burns": burns,
             }
+
+    def dump(self) -> Dict[str, Any]:
+        """Checkpointable snapshot of the alert state machine + history
+        rings (specs themselves ride config / the GCS KV, not this)."""
+        return {
+            "version": 1,
+            "state": {
+                name: {"alert": st["alert"], "since": st["since"],
+                       "history": [dict(h) for h in st["history"]]}
+                for name, st in self._state.items()
+            },
+        }
+
+    def load(self, state: Dict[str, Any], now: Optional[float] = None,
+             grace_s: float = 0.0) -> int:
+        """Restore a dump() snapshot for the specs currently installed;
+        unknown names are dropped. ``grace_s`` suppresses new alert
+        escalations for that long after ``now`` (head-restart gap)."""
+        if now is None:
+            now = time.time()
+        restored = 0
+        for name, saved in (state.get("state") or {}).items():
+            st = self._state.get(name)
+            if st is None:
+                continue
+            st["alert"] = saved.get("alert", "ok")
+            st["since"] = saved.get("since")
+            st["history"] = collections.deque(
+                saved.get("history", []), maxlen=self.history_len)
+            restored += 1
+        if grace_s > 0:
+            self._grace_until = max(self._grace_until, now + grace_s)
+        return restored
 
     def status(self) -> List[Dict[str, Any]]:
         """API-shaped view: one record per spec with current attainment,

@@ -74,6 +74,35 @@ def capture_threads(running_since: Optional[dict] = None,
     return threads
 
 
+def flight_snapshot(running_since: Optional[dict] = None,
+                    now: Optional[float] = None,
+                    max_depth: int = 24) -> List[dict]:
+    """Compact per-thread stack view for the black-box flight ring
+    (_private/blackbox.py): one folded ``a;b;c`` line per thread instead
+    of ``capture_threads``'s full formatted tracebacks, so a 2-second
+    flush cadence stays cheap and the flight file stays small while a
+    crash bundle still shows where every thread died."""
+    if now is None:
+        now = time.time()
+    by_ident = {ident: (tid, fn, t0)
+                for tid, (ident, fn, t0) in
+                list((running_since or {}).items())}
+    names = {t.ident: t.name for t in threading.enumerate()}
+    threads = []
+    for ident, frame in sys._current_frames().items():
+        tid_fn = by_ident.get(ident)
+        threads.append({
+            "name": names.get(ident, "?"),
+            "task_id": tid_fn[0].hex() if tid_fn else None,
+            "running_for_s": round(now - tid_fn[2], 3) if tid_fn else None,
+            "stack": fold_frame(
+                frame, max_depth=max_depth,
+                root=f"task:{tid_fn[1] or '?'}" if tid_fn else None),
+        })
+    threads.sort(key=lambda t: (t["task_id"] is None, t["name"]))
+    return threads
+
+
 def _frame_label(frame) -> str:
     code = frame.f_code
     return (f"{code.co_name} "

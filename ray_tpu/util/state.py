@@ -317,6 +317,32 @@ def record_event(message: str, *, severity: str = "INFO",
         "fields": fields}))
 
 
+def list_incidents(limit: int = 100) -> Dict[str, Any]:
+    """Black-box incident view (live cluster): crash bundles swept so
+    far, crash/blackbox/SLO-alert events, and per-process crash counts
+    (_private/blackbox.py). For a DEAD cluster use `cli postmortem`,
+    which reads the session dir directly."""
+    import dataclasses
+
+    core = _core()
+    out = core.io.run(core.gcs.call("list_incidents", {"limit": limit}))
+    out["bundles"] = [dataclasses.asdict(b) if dataclasses.is_dataclass(b)
+                      else b for b in out.get("bundles", [])]
+    return out
+
+
+def obs_checkpoint() -> Dict[str, Any]:
+    """Force a durable-observability checkpoint (series rings, SLO
+    state, task table, metric counters) through the GCS storage seam and
+    return its summary — the restart-survivability handle."""
+    import dataclasses
+
+    core = _core()
+    info = core.io.run(core.gcs.call("obs_checkpoint", {}))
+    return (dataclasses.asdict(info) if dataclasses.is_dataclass(info)
+            else info)
+
+
 def _raylet_call(node_id: Optional[str], method: str, payload: dict):
     """RPC a node's raylet (this node's by default) — the log-monitor
     access path (ref: util/state log APIs backed by per-node agents)."""

@@ -35,6 +35,7 @@ def add_one(x):
     return x + 1
 
 
+@pytest.mark.slow
 def test_lease_request_drops(chaos_env):
     """First 4 lease requests vanish: retries must land the leases."""
     chaos_env("request_worker_lease=4:1.0:0.0")
@@ -42,6 +43,7 @@ def test_lease_request_drops(chaos_env):
     assert out == [i + 1 for i in range(8)]
 
 
+@pytest.mark.slow
 def test_lease_response_drops_do_not_leak_workers(chaos_env):
     """Replies to granted leases vanish: the retried request must get the
     SAME grant back (request-id dedup), not leak a worker + resources."""

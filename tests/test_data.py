@@ -120,6 +120,7 @@ def test_streaming_split_to_device_prefetch(ray_cluster):
     assert total == sum(range(32))
 
 
+@pytest.mark.slow
 def test_streaming_split_into_train_worker(ray_cluster, tmp_path):
     """End-to-end Data -> Train: iterators are pickled into gang workers
     which pull their own split (ref: train get_dataset_shard flow)."""

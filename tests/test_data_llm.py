@@ -43,6 +43,7 @@ def test_concat_blocks_ragged_across_blocks():
     assert list(out["ids"][3]) == [9, 10]
 
 
+@pytest.mark.slow
 def test_batch_inference_matches_oracle(ray_cluster):
     params = init_params(jax.random.PRNGKey(0), CFG)
     prompts = [[5, 17, 99], [3, 42, 7, 1], [2, 9, 4, 4, 8]]

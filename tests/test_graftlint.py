@@ -472,14 +472,25 @@ _WIRE_FIXTURE_CLEAN = textwrap.dedent("""
     def register_id(tag, cls):
         pass
 
+    def register_struct(tag, cls):
+        pass
+
     class ObjectRef:
         pass
 
     class ActorRef:
         pass
 
+    class CrashBundleInfo:
+        pass
+
+    class ObsCheckpointInfo:
+        pass
+
     register_id(10, ObjectRef)
     register_id(11, ActorRef)
+    register_struct(16, CrashBundleInfo)
+    register_struct(17, ObsCheckpointInfo)
 
     def _default(obj):
         if obj.tag == 100:
@@ -520,6 +531,38 @@ class TestWirePass:
         out = _lint(src, {"wire"})
         assert _rules(out) == ["ghost-tag"]
         assert "101" in out[0].message
+
+    def test_duplicate_blackbox_struct_tag(self):
+        # re-registering the crash-bundle tag under another struct must
+        # fail lint: the later registration would shadow CrashBundleInfo
+        src = _WIRE_FIXTURE_CLEAN + textwrap.dedent("""
+            class IncidentInfo:
+                pass
+
+            register_struct(16, IncidentInfo)
+            """)
+        out = _lint(src, {"wire"})
+        assert "duplicate-tag" in _rules(out)
+        assert any("16" in f.message for f in out)
+
+    def test_duplicate_blackbox_struct_class(self):
+        src = _WIRE_FIXTURE_CLEAN + \
+            "\nregister_struct(18, ObsCheckpointInfo)\n"
+        out = _lint(src, {"wire"})
+        assert "duplicate-class" in _rules(out)
+
+    def test_ghost_blackbox_tag_decode_only(self):
+        # a checkpoint tag special-cased in _ext_hook but never
+        # registered and absent from _default: decode-only ghost
+        src = _WIRE_FIXTURE_CLEAN.replace(
+            "return data[1]",
+            "return data[1]\n"
+            "        if data[0] == 19:\n"
+            "            return data[1]")
+        out = _lint(src, {"wire"})
+        assert _rules(out) == ["ghost-tag"]
+        assert "19" in out[0].message
+        assert "decode" in out[0].message
 
     def test_pass_inert_without_registrars(self):
         out = _lint("""

@@ -55,6 +55,7 @@ def test_pages_needed_rounding():
 
 # --- paged generation vs no-cache oracle ---
 
+@pytest.mark.slow
 def test_paged_greedy_matches_full_forward(tiny_params):
     prompt = [5, 17, 99, 3, 42, 7, 1]
     n_gen = 12
@@ -68,6 +69,7 @@ def test_paged_greedy_matches_full_forward(tiny_params):
     assert got == want
 
 
+@pytest.mark.slow
 def test_paged_greedy_batch_and_page_boundaries(tiny_params):
     # prompts of different lengths; page_size 4 forces mid-generation
     # page allocation for every sequence
@@ -83,6 +85,7 @@ def test_paged_greedy_batch_and_page_boundaries(tiny_params):
     assert gots == wants
 
 
+@pytest.mark.slow
 def test_chunked_prefill_matches_oracle(tiny_params):
     """Chunked prefill (prompt processed in C-token chunks across
     engine steps) generates EXACTLY what whole-prompt prefill does —

@@ -1,0 +1,374 @@
+"""Black-box plane (_private/blackbox.py + GCS durable-observability
+checkpoint + `cli postmortem`).
+
+Unit layers need no cluster: flight-ring bounds, bundle promotion and
+the survivor sweep against fake corpses, corrupt-bundle tolerance, the
+event-journal reader, the read-only storage replay, and checkpoint
+round-trips for SeriesStore/SloMonitor (no windowed_increase reset
+artifact, restore grace suppresses gap-induced alerts). The cluster
+layer SIGKILLs a worker mid-task and checks the raylet sweep produces a
+bundle naming the running task, surfaced through the incidents API and
+the process_crashes_total metric."""
+
+import json
+import os
+import pickle
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import slo
+from ray_tpu._private import blackbox
+from ray_tpu._private.gcs_storage import Storage
+from ray_tpu.util import state
+from ray_tpu.util.metrics import windowed_increase
+
+
+@pytest.fixture(autouse=True)
+def _clean_blackbox_state():
+    blackbox.reset_for_tests()
+    yield
+    blackbox.reset_for_tests()
+
+
+def _recorder(tmp_path, role="worker", **kw):
+    return blackbox.FlightRecorder(role, str(tmp_path), **kw)
+
+
+# ------------------------------------------------------ flight ring
+
+def test_ring_is_bounded_and_snapshot_versioned(tmp_path):
+    rec = _recorder(tmp_path, ring_size=8)
+    for i in range(50):
+        rec.record_event({"i": i})
+        rec.record_log(f"line {i}")
+    rec.note("request_id", "req-42")
+    snap = rec.snapshot()
+    assert snap["version"] == blackbox.BUNDLE_VERSION
+    assert snap["role"] == "worker" and snap["pid"] == os.getpid()
+    assert len(snap["events"]) == 8 and snap["events"][-1] == {"i": 49}
+    assert len(snap["logs"]) == 8
+    assert snap["notes"]["request_id"] == "req-42"
+
+
+def test_flush_writes_flight_file_and_close_clean_removes_it(tmp_path):
+    rec = _recorder(tmp_path).start()
+    assert os.path.exists(rec.flight_path)  # written at t=0, not tick 1
+    with open(rec.flight_path) as f:
+        assert json.load(f)["pid"] == os.getpid()
+    rec.close(clean=True)
+    assert not os.path.exists(rec.flight_path)
+    # clean exit leaves nothing for the survivor sweep
+    assert blackbox.sweep(str(tmp_path), reason="x", bundled_by="t",
+                          pids=[os.getpid()]) == []
+
+
+def test_broken_provider_never_kills_a_flush(tmp_path):
+    def boom():
+        raise RuntimeError("provider died")
+
+    rec = _recorder(tmp_path, inflight_provider=boom)
+    rec.flush()
+    with open(rec.flight_path) as f:
+        snap = json.load(f)
+    assert "provider died" in str(snap["inflight"])
+
+
+def test_dump_bundle_first_cause_wins(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.record_event({"what": "last words"})
+    path = rec.dump_bundle("signal:SIGTERM", "SIGTERM")
+    assert path and os.path.exists(path)
+    assert rec.dump_bundle("atexit") is None  # idempotent per death
+    (bundle,) = blackbox.read_bundles(str(tmp_path))
+    assert bundle["reason"] == "signal:SIGTERM"
+    assert bundle["signal"] == "SIGTERM"
+    assert bundle["events"] == [{"what": "last words"}]
+    assert not os.path.exists(rec.flight_path)  # no double sweep
+
+
+# --------------------------------------------------- survivor sweep
+
+def _plant_corpse(tmp_path, pid, role="worker", node_id="n1",
+                  inflight=()):
+    """A flight file for a process that is gone (no live recorder)."""
+    os.makedirs(blackbox.flight_dir(str(tmp_path)), exist_ok=True)
+    path = os.path.join(blackbox.flight_dir(str(tmp_path)),
+                        f"{role}-{pid}.json")
+    with open(path, "w") as f:
+        json.dump({"version": 1, "role": role, "pid": pid,
+                   "node_id": node_id, "written_at": time.time(),
+                   "events": [], "logs": [],
+                   "inflight": list(inflight)}, f)
+    return path
+
+
+def _dead_pid():
+    pid = os.fork()
+    if pid == 0:
+        os._exit(0)
+    os.waitpid(pid, 0)
+    return pid
+
+
+def test_sweep_promotes_explicit_pid_and_names_inflight(tmp_path):
+    pid = _dead_pid()
+    _plant_corpse(tmp_path, pid,
+                  inflight=[{"kind": "task", "task_id": "abc123",
+                             "fn": "train_step"}])
+    promoted = blackbox.sweep(str(tmp_path), reason="worker_disconnect",
+                              bundled_by="raylet-x", pids=[pid])
+    assert len(promoted) == 1
+    assert promoted[0]["inflight"][0]["fn"] == "train_step"
+    assert os.path.exists(promoted[0]["path"])
+    # the flight file was consumed: a second sweep is a no-op
+    assert blackbox.sweep(str(tmp_path), reason="again",
+                          bundled_by="raylet-x", pids=[pid]) == []
+    infos = blackbox.bundle_infos(str(tmp_path))
+    assert infos[0].pid == pid and infos[0].reason == "worker_disconnect"
+
+
+def test_sweep_require_dead_skips_live_process(tmp_path):
+    _plant_corpse(tmp_path, os.getpid())  # "corpse" that is alive: us
+    assert blackbox.sweep(str(tmp_path), reason="node_death",
+                          bundled_by="gcs") == []
+    # node-scoped sweep (heartbeat loss) bypasses the liveness check:
+    # the whole machine is presumed gone, kill(pid, 0) proves nothing
+    promoted = blackbox.sweep(str(tmp_path), reason="node_death",
+                              bundled_by="gcs", node_id="n1")
+    assert len(promoted) == 1
+
+
+def test_discard_flight_for_expected_exit(tmp_path):
+    pid = _dead_pid()
+    _plant_corpse(tmp_path, pid)
+    blackbox.discard_flight(str(tmp_path), pid)
+    assert blackbox.sweep(str(tmp_path), reason="worker_disconnect",
+                          bundled_by="raylet-x", pids=[pid]) == []
+
+
+def test_corrupt_bundle_skipped_with_warning(tmp_path, caplog):
+    rec = _recorder(tmp_path)
+    rec.record_event({"ok": True})
+    rec.dump_bundle("signal:SIGTERM", "SIGTERM")
+    bdir = blackbox.bundle_dir(str(tmp_path))
+    with open(os.path.join(bdir, "worker-999-0.json"), "w") as f:
+        f.write('{"version": 1, "pid": 999, "trunc')  # torn write
+    with open(os.path.join(bdir, "worker-998-0.json"), "w") as f:
+        f.write('["not", "a", "bundle"]')
+    with caplog.at_level("WARNING", logger="ray_tpu._private.blackbox"):
+        bundles = blackbox.read_bundles(str(tmp_path))
+    assert len(bundles) == 1 and bundles[0]["pid"] == os.getpid()
+    warned = [r for r in caplog.records
+              if "corrupt crash bundle" in r.getMessage()]
+    assert len(warned) == 2
+
+
+# ------------------------------------------------------ event journal
+
+def test_read_events_journal_filters(tmp_path):
+    os.makedirs(blackbox.blackbox_dir(str(tmp_path)), exist_ok=True)
+    with open(blackbox.events_journal_path(str(tmp_path)), "w") as f:
+        for i in range(6):
+            f.write(json.dumps({
+                "timestamp": float(i),
+                "source": "slo" if i % 2 else "NODE",
+                "severity": "ERROR" if i >= 4 else "INFO",
+                "message": f"e{i}"}) + "\n")
+        f.write("{torn line\n")  # dropped, not fatal
+    sd = str(tmp_path)
+    assert len(blackbox.read_events_journal(sd)) == 6
+    assert [r["message"] for r in
+            blackbox.read_events_journal(sd, severity="ERROR")] \
+        == ["e4", "e5"]
+    assert [r["message"] for r in
+            blackbox.read_events_journal(sd, source="slo")] \
+        == ["e1", "e3", "e5"]
+    assert len(blackbox.read_events_journal(sd, limit=2)) == 2
+    assert blackbox.read_events_journal(str(tmp_path / "absent")) == []
+
+
+# ---------------------------------------------- durable obs checkpoint
+
+def test_storage_open_readonly_replays_without_mutation(tmp_path):
+    journal = str(tmp_path / "gcs.journal")
+    st = Storage(journal_path=journal)
+    st.put("__obs", "checkpoint", pickle.dumps({"written_at": 1.0}))
+    st.put("tbl", "k", b"v")
+    st.delete("tbl", "k")
+    st.close()
+    before = open(journal, "rb").read()
+    ro = Storage.open_readonly(journal)
+    assert pickle.loads(ro.get("__obs", "checkpoint")) \
+        == {"written_at": 1.0}
+    assert ro.get("tbl", "k") is None  # delete replayed too
+    # read-only means read-only: no compaction, no append handle
+    assert open(journal, "rb").read() == before
+    assert ro._journal is None
+
+
+def test_series_store_checkpoint_continuity_no_reset_artifact():
+    """A head restart must splice checkpointed rings under live data so
+    counters never step backwards — windowed_increase over the splice
+    equals the true increase, with no reset spike and no gap double
+    count."""
+    store = slo.SeriesStore(min_interval_s=0.0)
+    for t in range(0, 30):
+        store.sample([{"name": "reqs", "kind": "counter", "tags": {},
+                       "value": 10.0 * t}], t=float(t))
+    dump = store.dump()
+
+    restarted = slo.SeriesStore(min_interval_s=0.0)
+    assert restarted.load(dump) == 1
+    for t in range(32, 60):  # 2s restart gap, counter keeps climbing
+        restarted.sample([{"name": "reqs", "kind": "counter", "tags": {},
+                           "value": 10.0 * t}], t=float(t))
+    (ser,) = restarted.query("reqs")
+    times = [s[0] for s in ser["samples"]]
+    assert times == sorted(times) and times[0] == 0.0
+    inc = windowed_increase(ser["samples"], 40.0, now=59.0)
+    assert inc == pytest.approx(10.0 * 40, rel=0.1)  # ~10/s, no spike
+
+
+def test_slo_restore_grace_suppresses_gap_alert():
+    """The restart gap starves the windows; without grace the first
+    post-restore ticks would page. With grace the escalation is held,
+    and a REAL outage after the grace window still fires."""
+    (spec,) = slo.parse_specs(["avail: availability >= 90% window=20s"])
+    policies = [slo.BurnPolicy("ERROR", "fast_burn", 4.0, 8.0, 4.0)]
+
+    def feed(store, t, req, err):
+        store.sample([
+            {"name": slo.AVAILABILITY_TOTAL_METRIC, "kind": "histogram",
+             "tags": {"__stat__": "count"}, "value": req},
+            {"name": slo.AVAILABILITY_ERRORS_METRIC, "kind": "counter",
+             "tags": {}, "value": err},
+        ], t=float(t))
+
+    store = slo.SeriesStore(min_interval_s=0.0)
+    monitor = slo.SloMonitor([spec], policies)
+    for t in range(0, 20):
+        feed(store, t, req=10.0 * t, err=0.0)
+        monitor.tick(store, now=float(t))
+    series_dump, slo_dump = store.dump(), monitor.dump()
+
+    # ---- head restart at t=25 ----
+    store2 = slo.SeriesStore(min_interval_s=0.0)
+    store2.load(series_dump)
+    monitor2 = slo.SloMonitor([spec], policies)
+    assert monitor2.load(slo_dump, now=25.0, grace_s=30.0) == 1
+    events = []
+
+    def emit(severity, message, **fields):
+        events.append({"severity": severity, **fields})
+
+    # inside grace: a 100%-error burst (the gap artifact shape) is held
+    err = 0.0
+    for t in range(25, 40):
+        err += 10.0
+        feed(store2, t, req=10.0 * t, err=err)
+        monitor2.tick(store2, now=float(t), emit=emit)
+    assert monitor2.status()[0]["alert"] == "ok"
+    assert not [e for e in events if e.get("kind") == "fast_burn"]
+
+    # history ring spans the restart: continuous attainment view
+    hist = monitor2.status()[0]["history"]
+    ts = [h["t"] for h in hist]
+    assert min(ts) < 20.0 and max(ts) >= 39.0
+
+    # past grace (now > 55): a real outage must still page
+    for t in range(56, 70):
+        err += 10.0
+        feed(store2, t, req=10.0 * t, err=err)
+        monitor2.tick(store2, now=float(t), emit=emit)
+    assert [e for e in events if e.get("kind") == "fast_burn"]
+
+
+# ------------------------------------------------------- cluster layer
+
+def test_sigkill_worker_mid_task_bundle_names_task(tmp_path, monkeypatch):
+    """The acceptance path: SIGKILL a worker mid-task; the raylet
+    sweeps the corpse's flight file into a bundle whose inflight names
+    the running task, the GCS counts the crash, and the incidents API
+    surfaces both."""
+    # worker processes read config from env, not the driver's overrides
+    monkeypatch.setenv("RAY_TPU_BLACKBOX_FLUSH_INTERVAL_S", "0.25")
+    ray_tpu.init(num_cpus=2, _system_config={
+        "blackbox_flush_interval_s": 0.25,
+    })
+    try:
+        session_dir = ray_tpu._worker_api.node().session_dir
+        pid_path = str(tmp_path / "victim_pid")
+
+        @ray_tpu.remote
+        def victim(path):
+            import os as _os
+            import time as _time
+            with open(path, "w") as f:
+                f.write(str(_os.getpid()))
+            _time.sleep(120)
+
+        victim.remote(pid_path)
+        deadline = time.time() + 30
+        while not os.path.exists(pid_path) and time.time() < deadline:
+            time.sleep(0.05)
+        pid = int(open(pid_path).read())
+        # let the victim's flight ring flush with the task in flight
+        time.sleep(1.0)
+        os.kill(pid, signal.SIGKILL)
+
+        bundle = None
+        while time.time() < deadline:
+            for b in blackbox.read_bundles(session_dir):
+                if b.get("pid") == pid:
+                    bundle = b
+                    break
+            if bundle:
+                break
+            time.sleep(0.2)
+        assert bundle is not None, "sweep never promoted the corpse"
+        assert bundle["role"] == "worker"
+        assert bundle["reason"] == "worker_disconnect"
+        fns = [r.get("fn", "") for r in bundle["inflight"]]
+        assert any("victim" in fn for fn in fns), bundle["inflight"]
+
+        # the sweep writes the bundle BEFORE the report_crash RPC lands
+        inc = {}
+        while time.time() < deadline:
+            inc = state.list_incidents()
+            if any(e.get("kind") == "process_crash"
+                   for e in inc.get("events", [])):
+                break
+            time.sleep(0.2)
+        assert any(b["pid"] == pid for b in inc["bundles"])
+        assert any(e.get("kind") == "process_crash"
+                   and str(pid) in e.get("message", "")
+                   for e in inc["events"])
+        assert any(c["count"] >= 1 for c in inc["crash_counts"])
+
+        crashes = [m for m in state.get_metrics("process_crashes_total")]
+        assert crashes and sum(m["value"] for m in crashes) >= 1
+        uptime = state.get_metrics("process_uptime_seconds")
+        assert uptime and all(m["value"] >= 0 for m in uptime)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_graceful_shutdown_leaves_no_bundles():
+    """Expected exits (ordered worker shutdowns at cluster stop) are
+    discarded, never swept: a clean up/down cycle produces no corpses
+    while the cluster is still running."""
+    ray_tpu.init(num_cpus=1)
+    try:
+        session_dir = ray_tpu._worker_api.node().session_dir
+
+        @ray_tpu.remote
+        def ping():
+            return "pong"
+
+        assert ray_tpu.get(ping.remote(), timeout=30) == "pong"
+        assert blackbox.read_bundles(session_dir) == []
+    finally:
+        ray_tpu.shutdown()

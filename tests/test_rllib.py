@@ -133,6 +133,7 @@ def test_algorithm_checkpoint_roundtrip(ray_cluster, tmp_path):
         algo2.stop()
 
 
+@pytest.mark.slow
 def test_impala_improves_on_cartpole(ray_cluster):
     """IMPALA (async v-trace) must beat the random-policy return within
     a small budget (ref: rllib/algorithms/impala learning smoke)."""
@@ -162,6 +163,7 @@ def test_impala_improves_on_cartpole(ray_cluster):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_offline_bc_and_marwil_learn_from_rollouts(tmp_path, ray_cluster):
     """Record a competent policy's rollouts (short PPO run), then BC and
     MARWIL must recover better-than-random behavior offline — and the
@@ -249,6 +251,7 @@ def test_pendulum_env_contract():
     assert total < 0.0
 
 
+@pytest.mark.slow
 def test_sac_improves_on_pendulum(ray_cluster):
     """SAC (twin soft critics + squashed Gaussian + auto-alpha) must
     beat the untrained policy's pendulum return within a short budget
@@ -275,6 +278,7 @@ def test_sac_improves_on_pendulum(ray_cluster):
         SACConfig().environment("CartPole-v1").build()
 
 
+@pytest.mark.slow
 def test_appo_improves_on_cartpole(ray_cluster):
     """APPO (v-trace + PPO clip, async) must beat the random-policy
     return (~22 on CartPole) within a short budget."""

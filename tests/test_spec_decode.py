@@ -284,6 +284,7 @@ def test_fleet_verify_hook_races_local(tiny_params):
 
 # --- serving: counters reach a Prometheus scrape ---
 
+@pytest.mark.slow
 def test_fleet_verify_pools_corroborate_and_match_oracle(tiny_params):
     """Disaggregated spec serving: decode-pool replicas draft locally
     and (with llm_spec_fleet_verify on) corroborate every drafted

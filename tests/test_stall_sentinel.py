@@ -53,6 +53,7 @@ def _sentinel_events(predicate):
 
 # ------------------------------------------------------------ task stalls
 
+@pytest.mark.slow
 def test_stalled_task_flagged_with_stack(ray_cluster):
     """A task RUNNING past the adaptive threshold is flagged by the
     raylet watchdog: list_stalls names it, the WARNING event carries the

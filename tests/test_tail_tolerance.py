@@ -107,6 +107,7 @@ def test_hedge_beats_straggler_and_seals_once(hedge_cluster):
     _poll(lambda: _counter("task_hedges_cancelled") > 0, timeout=10)
 
 
+@pytest.mark.slow
 def test_non_idempotent_and_opted_out_never_hedge(hedge_cluster):
     """Tasks without idempotent=True — and idempotent ones with
     speculation="off" — never get a speculative copy, no matter how
