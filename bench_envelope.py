@@ -26,6 +26,9 @@ Families:
   * slo       — SLO observability plane: open-loop multi-tenant loadgen
                 attainment + time-to-fast-burn-alert under an injected
                 slow replica
+  * train_goodput — training goodput plane: MFU / tok-per-chip baseline
+                with the ledger's badput-by-cause phase breakdown on a
+                short tiny-config fit
   * submit    — driver submit-path per-stage latency breakdown (the
                 submit_stage_seconds histogram) + always-on sampling
                 profiler overhead at profiling_sample_hz=1
@@ -345,6 +348,103 @@ def bench_gang_restart(results):
         ray.shutdown()
         shutil.rmtree(cache_dir, ignore_errors=True)
         shutil.rmtree(trace_dir, ignore_errors=True)
+
+
+# ------------------------------------------------------------ train goodput
+def bench_train_goodput(results):
+    """Training goodput plane, measured: a short sharded fit on the tiny
+    Llama config, recorded as the MFU / tok-per-chip baseline with the
+    ledger's phase breakdown — so a step-time or goodput regression
+    shows up as a number moving, not a vibe. Peak flops is pinned to a
+    nominal 1e12/chip so recorded MFU values compare across hosts."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    import ray_tpu as ray
+    from ray_tpu.train import RunConfig, ScalingConfig, Trainer
+    from ray_tpu.util import state as state_api
+
+    steps = 4 if QUICK else 8
+    ray.init(num_cpus=4, _system_config={
+        "train_peak_flops_per_chip": 1e12,
+        "metrics_report_interval_ms": 300,
+    })
+    run_dir = tempfile.mkdtemp(prefix="envelope_goodput_")
+    try:
+        def train_fn(config):
+            import jax
+            import jax.numpy as jnp
+            import optax
+
+            from ray_tpu import train
+            from ray_tpu.models import (
+                LLAMA_CONFIGS, init_params, lm_loss, param_logical_axes)
+            from ray_tpu.parallel import MeshSpec, build_mesh
+            from ray_tpu.train import (
+                estimate_flops_per_token, make_train_step)
+
+            cfg = LLAMA_CONFIGS["tiny"]
+            mesh = build_mesh(MeshSpec(dp=1, fsdp=1, tp=1),
+                              jax.devices("cpu")[:1])
+            init_fn, step_fn, place_batch = make_train_step(
+                lambda p, b: lm_loss(p, b, cfg, mesh=mesh),
+                optax.adamw(1e-3), mesh, param_logical_axes(cfg),
+                model_flops_per_token=estimate_flops_per_token(
+                    cfg.n_params()))
+            st = init_fn(init_params(jax.random.PRNGKey(0), cfg))
+            key = jax.random.PRNGKey(1)
+            for _ in range(config["steps"]):
+                with train.phase("data_wait"):
+                    key, sub = jax.random.split(key)
+                    tokens = jax.random.randint(
+                        sub, (4, 32), 0, cfg.vocab, jnp.int32)
+                batch = place_batch({"tokens": tokens})
+                st, metrics = step_fn(st, batch)
+                train.report({"loss": float(metrics["loss"])})
+
+        t0 = time.perf_counter()
+        result = Trainer(
+            train_fn, train_loop_config={"steps": steps},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="goodput",
+                                 storage_path=run_dir),
+        ).fit()
+        wall = time.perf_counter() - t0
+        assert result.error is None, result.error
+        deadline = time.time() + 20
+        job = None
+        while time.time() < deadline:
+            jobs = state_api.train_status(job="goodput").get("jobs", [])
+            jobs = [dataclasses.asdict(j) if dataclasses.is_dataclass(j)
+                    else j for j in jobs]
+            if jobs and jobs[0]["steps"] >= steps - 1:
+                job = jobs[0]
+                break
+            time.sleep(0.25)
+        assert job is not None, "goodput ledger never folded"
+        badput = {k: round(v, 4) for k, v in sorted(
+            job["badput_s"].items(), key=lambda kv: -kv[1])}
+        recent = [r for r in job["recent"] if not r.get("rework")]
+        step_walls = sorted(r["wall_s"] for r in recent)
+        results.append(emit(
+            "envelope_train_goodput",
+            steps=job["steps"], fit_wall_s=wall,
+            goodput_fraction=round(job["goodput_fraction"], 4),
+            attributed_fraction=round(job["attributed_fraction"], 4),
+            mfu=round(job["mfu"], 6),
+            tok_per_s_per_chip=round(job["tok_per_s_per_chip"], 1),
+            compile_cold=job["compile_count"],
+            compile_cache_hit=job["cache_hit_count"],
+            recompiles=job["recompile_count"],
+            productive_s=round(job["productive_s"], 4),
+            badput_s=badput,
+            step_wall_p50_s=step_walls[len(step_walls) // 2]
+            if step_walls else None,
+            step_wall_max_s=step_walls[-1] if step_walls else None))
+    finally:
+        ray.shutdown()
+        shutil.rmtree(run_dir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------- broadcast
@@ -1342,6 +1442,7 @@ ALL = {
     "actors": bench_actors,
     "broadcast": bench_broadcast,
     "gang": bench_gang_restart,
+    "train_goodput": bench_train_goodput,
     "spill": bench_spill,
     "shuffle": bench_shuffle,
     "tail": bench_tail,

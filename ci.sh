@@ -90,7 +90,7 @@ timeout "${CI_SMOKE_TIMEOUT_S:-600}" \
     python -m pytest tests/test_object_transfer.py tests/test_spilling.py \
         tests/test_data_shuffle.py -q
 
-echo "== [4/9] observability smoke: lifecycle + timeline + serve metrics + stall sentinel + profiling + slo + postmortem =="
+echo "== [4/9] observability smoke: lifecycle + timeline + serve metrics + stall sentinel + profiling + slo + train goodput + postmortem =="
 # the flight recorder (task state transitions, Perfetto export, serving
 # histograms) gets a live end-to-end check: a silent telemetry
 # regression would otherwise only show up as weaker dashboards, not a
@@ -102,13 +102,17 @@ echo "== [4/9] observability smoke: lifecycle + timeline + serve metrics + stall
 # pinned ownerless object as a leak suspect. The slo
 # leg installs specs at runtime, requires per-tenant attainment from
 # live traffic, and injects a slow replica that must fire the fast
-# burn-rate ERROR alert. The postmortem leg kill -9s a worker mid-task
+# burn-rate ERROR alert. The train leg runs a short sharded fit on the
+# tiny config and requires the GCS goodput ledger to attribute the
+# chip-seconds (goodput < 1.0, nonzero compile badput), `cli train` to
+# render the breakdown, and train_step_seconds to reach the Prometheus
+# scrape. The postmortem leg kill -9s a worker mid-task
 # under background load: the raylet must sweep the corpse's flight file
 # into a crash bundle and `cli postmortem` must name the dead pid and
 # the in-flight task id from files alone — every wait is
 # deadline-bounded (never a hang)
 JAX_PLATFORMS=cpu \
-timeout "${CI_OBS_TIMEOUT_S:-300}" \
+timeout "${CI_OBS_TIMEOUT_S:-480}" \
     python -m ray_tpu.scripts.obs_smoke
 
 echo "== [5/9] serve smoke: disaggregated prefill/decode + fleet KV routing + spec decode =="

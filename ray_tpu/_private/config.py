@@ -322,6 +322,18 @@ class Config:
     # piggybacked on ping; raylet.py _clock_sync_loop). 0 disables —
     # timelines then merge raw per-node wall clocks.
     clock_sync_interval_s: float = 30.0
+    # --- training goodput plane (train/telemetry.py; GCS-side ledger
+    #     in _private/gcs.py handle_train_report) ---
+    # per-step phase telemetry: timeline in train/session.py, compile/
+    # compute attribution in train/step.py. Off = bare jitted step
+    # (no per-call device sync), no TrainStepTelemetry records.
+    train_telemetry_enabled: bool = True
+    # first-call-per-shape faster than this with no new persistent-cache
+    # entries classifies as a cache hit rather than a cold compile
+    train_compile_cache_hit_threshold_s: float = 0.5
+    # accelerator peak (bf16 matmul) flops per chip for MFU math —
+    # 0 leaves MFU unreported (v5p ~459e12, v5e ~197e12)
+    train_peak_flops_per_chip: float = 0.0
     # --- device plane ---
     # Serving decode attention: stream KV pages through the Pallas
     # paged-attention kernel (ops/paged_attention.py) instead of the

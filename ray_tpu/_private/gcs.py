@@ -155,6 +155,12 @@ class GcsServer:
         self.series_store = None
         self.slo_monitor = None
         self._slo_task: Optional[asyncio.Task] = None
+        # training goodput plane (ray_tpu/train/telemetry.py): per-job
+        # ledgers folding rank step reports into productive vs badput
+        # chip-seconds; fed by handle_train_report, surfaced through
+        # handle_train_status and the _train_metrics synthetics
+        self.train_ledgers: Dict[str, Any] = {}
+        self.MAX_TRAIN_JOBS = 64
         # black-box plane (_private/blackbox.py): session dir derived
         # from the journal location (flight files / bundles / event
         # journal live next to it); the GCS keeps its own flight ring,
@@ -312,6 +318,17 @@ class GcsServer:
             self.task_events.setdefault(task_id, rec)
         self._restored_clock_offsets = dict(
             snap.get("clock_offsets") or {})
+        # goodput ledgers: cumulative badput/rework accounting must
+        # survive a head restart like every other counter here
+        for job, state in (snap.get("train") or {}).items():
+            try:
+                from ..train.telemetry import GoodputLedger
+
+                ledger = GoodputLedger(job)
+                ledger.load(state)
+                self.train_ledgers.setdefault(job, ledger)
+            except Exception:  # graftlint: ignore[swallow] — one bad
+                continue  # ledger must not poison the restore
         restored_series = 0
         if self.series_store is not None and snap.get("series"):
             restored_series = self.series_store.load(snap["series"])
@@ -344,6 +361,8 @@ class GcsServer:
             "clock_offsets": {
                 info.node_id.hex(): info.clock_offset
                 for info in self.nodes.values()},
+            "train": {job: ledger.dump()
+                      for job, ledger in self.train_ledgers.items()},
         }
         self.storage.put("__obs", "checkpoint", pickle.dumps(snap))
         return ObsCheckpointInfo(
@@ -1936,6 +1955,7 @@ class GcsServer:
                 out[agg_key].pop("worker_id", None)
         result = list(out.values())
         result.extend(self._process_metrics(name_filter))
+        result.extend(self._train_metrics(name_filter))
         return result
 
     def _process_metrics(self, name_filter=None) -> List[dict]:
@@ -2100,6 +2120,125 @@ class GcsServer:
         specs = parse_specs(payload.get("specs") or [])
         self.slo_monitor.set_specs(specs)
         return [s.describe() for s in specs]
+
+    # ---- training goodput plane (ray_tpu/train/telemetry.py ledger) ----
+    def _train_ledger(self, job: str, world_size: int = 0):
+        from ..train.telemetry import GoodputLedger
+        from .config import global_config
+
+        ledger = self.train_ledgers.get(job)
+        if ledger is None:
+            while len(self.train_ledgers) >= self.MAX_TRAIN_JOBS:
+                self.train_ledgers.pop(next(iter(self.train_ledgers)))
+            ledger = self.train_ledgers[job] = GoodputLedger(
+                job, world_size=world_size or 1,
+                peak_flops_per_chip=(
+                    global_config().train_peak_flops_per_chip))
+        if world_size:
+            ledger.world_size = max(1, int(world_size))
+        return ledger
+
+    async def handle_train_report(self, payload, conn):
+        """Fold a batch of per-rank TrainStepTelemetry records — or a
+        controller restart notice — into the job's goodput ledger.
+        Rank timestamps are clock-corrected here (NodeInfo.clock_offset,
+        the collective-watchdog path), so straggler skew measured across
+        hosts is real skew, not NTP noise."""
+        job = str(payload.get("job") or "default")
+        ledger = self._train_ledger(job,
+                                    int(payload.get("world_size") or 0))
+        if payload.get("kind") == "restart":
+            restore_step = int(payload.get("restore_step") or 0)
+            expected = ledger.restart(restore_step)
+            self._event(
+                "train", "WARNING",
+                f"train job '{job}' gang restart #{ledger.restarts} from "
+                f"checkpoint step {restore_step}: ~{expected} step(s) will "
+                f"be re-executed (rework badput)",
+                kind="train_restart", job=job, restore_step=restore_step,
+                expected_rework=expected,
+                failure=str(payload.get("failure") or "")[:500])
+            return True
+        from ..train.telemetry import TrainStepTelemetry
+
+        for rec in payload.get("records") or []:
+            if isinstance(rec, dict):       # tolerate dict-shaped reports
+                rec = TrainStepTelemetry(**{
+                    k: v for k, v in rec.items()
+                    if k in TrainStepTelemetry.__dataclass_fields__})
+            if not isinstance(rec, TrainStepTelemetry):
+                continue
+            rec.start_t = self._corrected_time(rec.node_id, rec.start_t)
+            rec.end_t = self._corrected_time(rec.node_id, rec.end_t)
+            ledger.add(rec)
+        return True
+
+    async def handle_train_status(self, payload, conn):
+        """Per-job goodput snapshots (TrainJobLedger records) for
+        `cli train`, the dashboard Train panel and state.train_status()."""
+        job = payload.get("job")
+        ledgers = ([self.train_ledgers[job]]
+                   if job and job in self.train_ledgers
+                   else list(self.train_ledgers.values()))
+        return {"jobs": [ledger.to_record() for ledger in ledgers]}
+
+    def _train_metrics(self, name_filter=None) -> List[dict]:
+        """Synthetic per-job goodput series minted from the ledgers:
+        they ride the normal aggregation, so Prometheus, the SeriesStore
+        and the SLO engine (mfu floor specs, burn-rate alerts) see them
+        with no extra plumbing."""
+        entries: List[dict] = []
+
+        def want(name):
+            return not name_filter or name_filter == name
+
+        for job, ledger in self.train_ledgers.items():
+            tags = {"job": job}
+            goodput = ledger.goodput_fraction()
+            if want("train_goodput_fraction") and goodput is not None:
+                entries.append({
+                    "name": "train_goodput_fraction", "kind": "gauge",
+                    "tags": tags, "value": goodput,
+                    "description": "productive / total attributed "
+                                   "chip-seconds"})
+            if want("train_mfu") and ledger.mfu > 0.0:
+                entries.append({
+                    "name": "train_mfu", "kind": "gauge", "tags": tags,
+                    "value": ledger.mfu,
+                    "description": "model flops utilization (EMA over "
+                                   "recent steps)"})
+            if (want("train_tokens_per_s_per_chip")
+                    and ledger.tok_per_s_per_chip > 0.0):
+                entries.append({
+                    "name": "train_tokens_per_s_per_chip", "kind": "gauge",
+                    "tags": tags, "value": ledger.tok_per_s_per_chip,
+                    "description": "training throughput per chip (EMA)"})
+            if want("train_badput_seconds_total"):
+                for cause, secs in sorted(ledger.badput_s.items()):
+                    entries.append({
+                        "name": "train_badput_seconds_total",
+                        "kind": "counter",
+                        "tags": {"job": job, "cause": cause},
+                        "value": secs,
+                        "description": "non-productive chip-seconds by "
+                                       "cause (MegaScale taxonomy)"})
+            if want("train_rework_steps_total") and ledger.rework_steps:
+                entries.append({
+                    "name": "train_rework_steps_total", "kind": "counter",
+                    "tags": tags, "value": float(ledger.rework_steps),
+                    "description": "steps re-executed after checkpoint "
+                                   "restores"})
+            if want("train_compile_total"):
+                for kind, n in (("cold", ledger.compile_count),
+                                ("cache_hit", ledger.cache_hit_count)):
+                    if n:
+                        entries.append({
+                            "name": "train_compile_total",
+                            "kind": "counter",
+                            "tags": {"job": job, "kind": kind},
+                            "value": float(n),
+                            "description": "step-fn compiles by kind"})
+        return entries
 
     # ---- task events (ref: gcs_task_manager.h — the state API backend) ----
     _TERMINAL_STATES = ("FINISHED", "FAILED")

@@ -119,6 +119,14 @@ def _register_all() -> None:
     register_struct(16, _bb.CrashBundleInfo)
     register_struct(17, _bb.ObsCheckpointInfo)
 
+    # train goodput plane (ray_tpu/train/telemetry.py is stdlib-only and
+    # the train package lazy-loads its jax-heavy step factory, so this
+    # stays cheap in every process)
+    from ..train import telemetry as _tt
+
+    register_struct(18, _tt.TrainStepTelemetry)
+    register_struct(19, _tt.TrainJobLedger)
+
     register_exception(1, _exc.RayTpuError)
     register_exception(2, _exc.TaskError)
     register_exception(3, _exc.TaskCancelledError)

@@ -61,6 +61,7 @@ _UI_HTML = """<!doctype html>
  <section><h2>Actors</h2><div id="actors"></div></section>
  <section><h2>Serve</h2><div id="serve"></div></section>
  <section><h2>SLO</h2><div id="slo"></div></section>
+ <section><h2>Train</h2><div id="train"></div></section>
  <section><h2>Incidents</h2><div id="incidents"></div></section>
  <section><h2>Jobs</h2><div id="jobs"></div></section>
  <section><h2>Task summary</h2><div id="tasks"></div></section>
@@ -207,6 +208,40 @@ async function refreshSlo(){try{
    ['time','severity','message']);
  document.getElementById('slo').innerHTML=html;
 }catch(e){}}
+async function refreshTrain(){try{
+ const t=await j('/api/train');
+ const jobs=t.jobs||[];
+ if(!jobs.length){document.getElementById('train').innerHTML=
+  '<i>no training jobs reporting</i>';return;}
+ let html=table(jobs.map(x=>({
+  job:x.job,world:x.world_size,chips:x.chips,steps:x.steps,
+  goodput:x.goodput_fraction==null?'-'
+   :(x.goodput_fraction*100).toFixed(1)+'%',
+  mfu:x.mfu?(x.mfu*100).toFixed(1)+'%':'-',
+  'tok/s/chip':x.tok_per_s_per_chip?
+   Math.round(x.tok_per_s_per_chip):'-',
+  compiles:(x.compile_count||0)+' cold / '+(x.cache_hit_count||0)
+   +' hit / '+(x.recompile_count||0)+' re',
+  rework:x.rework_steps||0,restarts:x.restarts||0})),
+  ['job','world','chips','steps','goodput','mfu','tok/s/chip',
+   'compiles','rework','restarts']);
+ for(const x of jobs){
+  const bad=Object.entries(x.badput_s||{}).sort((a,b)=>b[1]-a[1]);
+  const tot=bad.reduce((s,[,v])=>s+v,0);
+  if(bad.length)html+='<div style="margin-top:8px">badput — '
+   +esc(x.job)+' ('+tot.toFixed(2)+' chip-s)</div>'
+   +table(bad.map(([cause,s])=>({cause,seconds:s.toFixed(3),
+    share:tot>0?(s/tot*100).toFixed(1)+'%':'-',
+    bar:'#'.repeat(Math.max(1,Math.round((tot>0?s/tot:0)*30)))}),
+   ),['cause','seconds','share','bar']);
+  const skew=Object.entries(x.rank_skew||{}).sort((a,b)=>b[1]-a[1]);
+  if(skew.length){const worst=skew[0][1]||1e-9;
+   html+='<div style="margin-top:8px">rank skew — '+esc(x.job)+'</div>'
+   +table(skew.map(([who,s])=>({rank:who,ema_wait:s.toFixed(4)+'s',
+    bar:'#'.repeat(Math.max(0,Math.round(s/worst*20)))})),
+   ['rank','ema_wait','bar']);}}
+ document.getElementById('train').innerHTML=html;
+}catch(e){}}
 async function refreshIncidents(){try{
  const inc=await j('/api/incidents');
  const bundles=inc.bundles||[];
@@ -337,11 +372,12 @@ async function tailLog(){
   +'&file='+encodeURIComponent(f)+'&lines=200');
  document.getElementById('logview').textContent=await r.text();}
 refresh();refreshTimeline();refreshLogs();refreshHealth();refreshServe();
-refreshSlo();refreshMemory();refreshIncidents();
+refreshSlo();refreshMemory();refreshIncidents();refreshTrain();
 setInterval(refresh,5000);setInterval(refreshTimeline,10000);
 setInterval(refreshLogs,15000);setInterval(refreshHealth,5000);
 setInterval(refreshServe,5000);setInterval(refreshSlo,5000);
 setInterval(refreshMemory,10000);setInterval(refreshIncidents,10000);
+setInterval(refreshTrain,5000);
 </script></body></html>
 """
 
@@ -457,6 +493,21 @@ def _routes():
             payload["events_error"] = events_error
         return _json(payload)
 
+    async def api_train(req):
+        """Training goodput plane: per-job ledger records (goodput %,
+        badput-by-cause, MFU, tok/s/chip, compile counts, rank skew,
+        recent-step ring) from the GCS goodput ledgers."""
+        import dataclasses
+
+        try:
+            status = state_api.train_status(
+                job=req.query.get("job") or None)
+            jobs = [dataclasses.asdict(x) if dataclasses.is_dataclass(x)
+                    else x for x in status.get("jobs", [])]
+        except Exception:  # noqa: BLE001 — train plane is optional
+            jobs = []
+        return _json({"jobs": jobs})
+
     async def api_incidents(_req):
         """Black-box plane: crash bundles swept from dead processes,
         incident events (process_crash / node death / burn alerts with
@@ -522,6 +573,7 @@ def _routes():
     app.router.add_get("/api/health", api_health)
     app.router.add_get("/api/serve", api_serve)
     app.router.add_get("/api/slo", api_slo)
+    app.router.add_get("/api/train", api_train)
     app.router.add_get("/api/incidents", api_incidents)
     app.router.add_get("/api/stacks", api_stacks)
     app.router.add_get("/api/profile", api_profile)

@@ -508,6 +508,78 @@ def cmd_slo(args) -> int:
     return rc
 
 
+def cmd_train(args) -> int:
+    """Training goodput view: per-job goodput %, badput breakdown by
+    cause, MFU, tok/s/chip, compile counts, and a per-host straggler
+    skew heatmap from the GCS goodput ledger."""
+    import dataclasses
+
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(address=_resolve_address(args))
+    status = state_api.train_status(job=args.job)
+    jobs = [dataclasses.asdict(j) if dataclasses.is_dataclass(j) else j
+            for j in status.get("jobs", [])]
+    if args.json:
+        print(json.dumps({"jobs": jobs}, default=str))
+        ray_tpu.shutdown()
+        return 0
+    if not jobs:
+        print("no training jobs reporting goodput telemetry")
+        ray_tpu.shutdown()
+        return 0
+    for j in jobs:
+        good = j.get("goodput_fraction", 0.0) or 0.0
+        attr = j.get("attributed_fraction", 0.0) or 0.0
+        print(f"job {j.get('job')}  (world {j.get('world_size')}, "
+              f"{j.get('chips')} chip(s), {j.get('steps')} step(s), "
+              f"{j.get('restarts', 0)} restart(s))")
+        print(f"  goodput {good * 100:.1f}%   attributed "
+              f"{attr * 100:.1f}% of chip-seconds")
+        mfu = j.get("mfu", 0.0)
+        tps = j.get("tok_per_s_per_chip", 0.0)
+        perf = []
+        if mfu:
+            perf.append(f"MFU {mfu * 100:.1f}%")
+        if tps:
+            perf.append(f"{tps:,.0f} tok/s/chip")
+        if perf:
+            print("  " + "   ".join(perf))
+        print(f"  compiles: {j.get('compile_count', 0)} cold, "
+              f"{j.get('cache_hit_count', 0)} cache-hit, "
+              f"{j.get('recompile_count', 0)} recompile(s); "
+              f"rework {j.get('rework_steps', 0)} step(s)")
+        badput = j.get("badput_s") or {}
+        total_bad = sum(badput.values())
+        prod = j.get("productive_s", 0.0)
+        if badput:
+            print(f"  badput breakdown ({total_bad:.2f} chip-s bad vs "
+                  f"{prod:.2f} productive):")
+            for cause, secs in sorted(badput.items(),
+                                      key=lambda kv: -kv[1]):
+                frac = secs / total_bad if total_bad > 0 else 0.0
+                bar = "#" * max(1, int(round(frac * 30)))
+                print(f"    {cause:12s} {secs:10.3f}s  {frac * 100:5.1f}%"
+                      f"  {bar}")
+        skew = j.get("rank_skew") or {}
+        if skew:
+            worst = max(skew.values()) or 1e-9
+            print("  per-rank skew (ema seconds waiting on gang):")
+            for who, secs in sorted(skew.items(),
+                                    key=lambda kv: -kv[1]):
+                bar = "#" * max(0, int(round(secs / worst * 20)))
+                print(f"    {who:24s} {secs:8.4f}s  {bar}")
+        recent = (j.get("recent") or [])[-args.steps:] if args.steps else []
+        for r in recent:
+            ph = r.get("phases") or {}
+            ph_s = " ".join(f"{k}={v:.3f}" for k, v in sorted(ph.items()))
+            print(f"    step {r.get('step')}: wall {r.get('wall_s', 0):.3f}s"
+                  f"  mfu {(r.get('mfu') or 0) * 100:.1f}%  {ph_s}")
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_stacks(args) -> int:
     """Live Python stacks of every worker in the cluster (or one node
     with --node), annotated with running task ids and time-in-state —
@@ -1141,6 +1213,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--events", type=int, default=20,
                     help="recent slo events to show")
     sp.set_defaults(fn=cmd_slo)
+
+    sp = sub.add_parser("train",
+                        help="training goodput: goodput %%, badput "
+                             "breakdown, MFU, compile counts, rank skew")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--job", default=None,
+                    help="filter to one experiment name")
+    sp.add_argument("--json", action="store_true",
+                    help="dump the raw train_status payload")
+    sp.add_argument("--steps", type=int, default=0,
+                    help="show the last N per-step breakdowns")
+    sp.set_defaults(fn=cmd_train)
 
     sp = sub.add_parser("stacks",
                         help="live Python stacks of every worker "

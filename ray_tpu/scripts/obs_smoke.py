@@ -9,7 +9,10 @@ merged folded stacks and `cli memory` must flag a deliberately pinned
 ownerless object as a leak suspect — and the SLO plane:
 runtime-installed specs must show per-tenant attainment from live
 traffic, and an injected slow replica must fire the fast burn-rate
-ERROR alert within a couple of evaluation ticks — and the black-box
+ERROR alert within a couple of evaluation ticks — and the training
+goodput plane: a short sharded train run must land a GCS ledger with
+goodput < 1.0, nonzero compile badput, `cli train` rendering the
+breakdown, and train_step_seconds on the scrape — and the black-box
 plane: a kill -9'd worker mid-task must leave a crash bundle that
 `cli postmortem` resolves to the dead pid and its in-flight task."""
 
@@ -236,6 +239,95 @@ def _profile_smoke() -> None:
     assert "store " in status.stdout, status.stdout
 
 
+def _train_goodput_smoke() -> None:
+    """Training goodput plane end to end: a short sharded train run on
+    the tiny Llama config must leave a GCS ledger whose goodput is
+    honestly < 1.0 with a nonzero compile badput bucket (the first step
+    compiles), `cli train` must render the breakdown, and the
+    train_step_seconds phase histograms must reach the Prometheus
+    scrape."""
+    import dataclasses
+
+    from ray_tpu import _worker_api
+    from ray_tpu._private.prometheus import render_cluster
+    from ray_tpu.train import RunConfig, ScalingConfig, Trainer
+
+    def train_fn(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu import train
+        from ray_tpu.models import (
+            LLAMA_CONFIGS, init_params, lm_loss, param_logical_axes)
+        from ray_tpu.parallel import MeshSpec, build_mesh
+        from ray_tpu.train import estimate_flops_per_token, make_train_step
+
+        cfg = LLAMA_CONFIGS["tiny"]
+        mesh = build_mesh(MeshSpec(dp=1, fsdp=1, tp=1),
+                          jax.devices("cpu")[:1])
+        init_fn, step_fn, place_batch = make_train_step(
+            lambda p, b: lm_loss(p, b, cfg, mesh=mesh),
+            optax.adamw(1e-3), mesh, param_logical_axes(cfg),
+            model_flops_per_token=estimate_flops_per_token(
+                cfg.n_params()))
+        state_ = init_fn(init_params(jax.random.PRNGKey(0), cfg))
+        key = jax.random.PRNGKey(1)
+        for _step in range(4):
+            with train.phase("data_wait"):
+                key, sub = jax.random.split(key)
+                tokens = jax.random.randint(
+                    sub, (4, 32), 0, cfg.vocab, jnp.int32)
+            batch = place_batch({"tokens": tokens})
+            state_, metrics = step_fn(state_, batch)
+            train.report({"loss": float(metrics["loss"])})
+
+    import tempfile
+
+    run_dir = tempfile.mkdtemp(prefix="rtpu_obs_smoke_train_")
+    result = Trainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="goodput_smoke",
+                             storage_path=run_dir),
+    ).fit()
+    assert result.error is None, result.error
+
+    jobs = _wait(
+        lambda: [dataclasses.asdict(j) if dataclasses.is_dataclass(j)
+                 else j for j in state.train_status(
+                     job="goodput_smoke").get("jobs", [])
+                 if (j.steps if dataclasses.is_dataclass(j)
+                     else j.get("steps"))],
+        20, "the goodput ledger to fold the step reports")
+    job = jobs[0]
+    assert job["steps"] >= 3, job
+    # honest accounting: compile + data_wait + init all cost something
+    assert 0.0 < job["goodput_fraction"] < 1.0, job
+    assert job["badput_s"].get("compile", 0.0) > 0.0, job["badput_s"]
+    assert job["compile_count"] + job["cache_hit_count"] >= 1, job
+    # the >=90% acceptance bar: the ledger named nearly every
+    # chip-second it observed
+    assert job["attributed_fraction"] >= 0.9, job
+    assert job["mfu"] > 0.0, job          # peak flops injected in main()
+    assert job["tok_per_s_per_chip"] > 0.0, job
+
+    addr = _worker_api.node().gcs_address
+    out = _cli(addr, "train")
+    assert out.returncode == 0, (out.returncode, out.stdout, out.stderr)
+    assert "goodput_smoke" in out.stdout, out.stdout
+    assert "goodput" in out.stdout and "compile" in out.stdout, out.stdout
+    as_json = _cli(addr, "train", "--json")
+    parsed = json.loads(as_json.stdout)["jobs"]
+    assert parsed and parsed[0]["goodput_fraction"] < 1.0, parsed
+
+    _wait(lambda: "train_step_seconds" in render_cluster(), 20,
+          "train_step_seconds histograms on the Prometheus scrape")
+    scrape = render_cluster()
+    assert 'phase="total"' in scrape, "phase label missing from scrape"
+    assert "train_goodput_fraction" in scrape, "ledger synthetics missing"
+
+
 def _postmortem_smoke() -> None:
     """Black-box plane end to end: kill -9 a worker mid-task under
     background traffic; the raylet sweeps the corpse's flight file into
@@ -324,6 +416,8 @@ def main() -> int:
         "metrics_series_min_interval_s": 0.25,
         "slo_eval_interval_s": 0.5,
         "slo_fast_burn_windows_s": "3,6",
+        # nominal chip peak so the train leg's MFU is nonzero on CPU
+        "train_peak_flops_per_chip": 1e12,
     })
     try:
         # num_cpus=0.5 forces the full lease pipeline (the fastlane
@@ -390,6 +484,7 @@ def main() -> int:
         _profile_smoke()
         _stall_sentinel_smoke()
         _slo_smoke()
+        _train_goodput_smoke()
         _postmortem_smoke()
         print("observability smoke ok")
         return 0

@@ -43,10 +43,23 @@ INDICATOR_ALIASES: Dict[str, str] = {
     "e2e": "llm_request_e2e_seconds",
     "latency": "serve_request_e2e_seconds",
     "http_latency": "serve_http_request_seconds",
+    "step_time": "train_step_seconds",
 }
 # availability is derived: errors / total requests under the selector
 AVAILABILITY_ERRORS_METRIC = "serve_request_errors_total"
 AVAILABILITY_TOTAL_METRIC = "serve_request_e2e_seconds"
+
+# floor indicators: gauges that must stay ABOVE a threshold ("mfu >=
+# 0.4"). A sample below the floor is a bad event; the objective is
+# pinned at 0.99 so an all-bad window burns budget at 100x — squarely
+# past the fast-burn threshold — instead of the ~2x cap a
+# threshold-as-objective reading would give (which could never page).
+FLOOR_INDICATORS: Dict[str, str] = {
+    "mfu": "train_mfu",
+    "goodput": "train_goodput_fraction",
+    "tok_per_chip": "train_tokens_per_s_per_chip",
+}
+FLOOR_OBJECTIVE = 0.99
 
 _QUANTILE_RE = re.compile(r"^(?P<base>.+)_p(?P<q>\d+(?:\.\d+)?)$")
 _VALUE_RE = re.compile(
@@ -79,20 +92,23 @@ def parse_value(text: str) -> float:
 class SloSpec:
     name: str                      # display name ("chat-ttft")
     indicator: str                 # as written ("ttft_p99", "availability")
-    kind: str                      # "quantile" | "availability"
-    metric: str                    # resolved histogram/counter metric
+    kind: str                      # "quantile" | "availability" | "floor"
+    metric: str                    # resolved histogram/counter/gauge metric
     quantile: float                # target quantile (quantile kind)
     op: str                        # "<", "<=", ">=", ">"
-    threshold: float               # seconds (quantile) or ratio (avail.)
+    threshold: float               # seconds (quantile), ratio (avail.),
+    #                                or gauge floor value (floor)
     window_s: float = 60.0         # attainment window
     selector: Dict[str, str] = field(default_factory=dict)
 
     @property
     def objective(self) -> float:
         """Target good-event ratio: p99 -> 0.99; availability -> the
-        threshold itself. 1 - objective is the error budget burn rates
-        are measured against."""
-        return self.quantile if self.kind == "quantile" else self.threshold
+        threshold itself; floor -> FLOOR_OBJECTIVE (the threshold is a
+        gauge value, not a ratio). 1 - objective is the error budget
+        burn rates are measured against."""
+        return (self.threshold if self.kind == "availability"
+                else self.quantile)
 
     def describe(self) -> str:
         sel = ",".join(f"{k}={v}" for k, v in sorted(self.selector.items()))
@@ -156,6 +172,15 @@ def _parse_str(text: str) -> SloSpec:
                        metric=AVAILABILITY_TOTAL_METRIC,
                        quantile=threshold, op=op, threshold=threshold,
                        window_s=window_s, selector=selector)
+    if indicator in FLOOR_INDICATORS:
+        if op not in (">=", ">"):
+            raise SpecError(
+                f"{indicator} is a floor indicator, wants '>=': {text!r}")
+        return SloSpec(name=name, indicator=indicator, kind="floor",
+                       metric=FLOOR_INDICATORS[indicator],
+                       quantile=FLOOR_OBJECTIVE, op=op,
+                       threshold=threshold, window_s=window_s,
+                       selector=selector)
     qm = _QUANTILE_RE.match(indicator)
     if not qm:
         raise SpecError(
@@ -163,6 +188,11 @@ def _parse_str(text: str) -> SloSpec:
             f"<metric>_p<q>): {text!r}")
     base = qm.group("base")
     metric = INDICATOR_ALIASES.get(base, base)
+    if base == "step_time":
+        # train_step_seconds carries one series per phase; without a
+        # phase pin a quantile over it would sum buckets across phases
+        # and double-count every step. The step wall is phase=total.
+        selector.setdefault("phase", "total")
     q = float(qm.group("q")) / 100.0
     if not 0.0 < q < 1.0:
         raise SpecError(f"quantile out of (0,100): {text!r}")
@@ -352,6 +382,25 @@ def error_ratio(spec: SloSpec, store: SeriesStore, window_s: float,
         errors = store.counter_increase(AVAILABILITY_ERRORS_METRIC,
                                         spec.selector, window_s, now)
         return min(1.0, errors / total), total
+    if spec.kind == "floor":
+        # gauge floor: each in-window sample below the threshold is a
+        # bad event — an all-bad window burns at 1/(1-FLOOR_OBJECTIVE)
+        # = 100x, well past any burn-policy threshold
+        lo = now - window_s
+        bad = total = 0.0
+        for rec in store.query(spec.metric, spec.selector):
+            if "le" in rec["tags"] or "__stat__" in rec["tags"]:
+                continue
+            for t, v in rec["samples"]:
+                if t < lo:
+                    continue
+                total += 1
+                if (v < spec.threshold if spec.op == ">="
+                        else v <= spec.threshold):
+                    bad += 1
+        if total <= 0:
+            return None, 0.0
+        return bad / total, total
     buckets = store.bucket_increases(spec.metric, spec.selector,
                                      window_s, now)
     if not buckets:
@@ -463,6 +512,15 @@ class SloMonitor:
                 buckets = store.bucket_increases(
                     spec.metric, spec.selector, spec.window_s, now)
                 achieved = histogram_quantile(spec.quantile, buckets)
+            elif spec.kind == "floor":
+                # latest in-window gauge value (what the floor guards)
+                lo, best_t = now - spec.window_s, None
+                for rec in store.query(spec.metric, spec.selector):
+                    if "le" in rec["tags"] or "__stat__" in rec["tags"]:
+                        continue
+                    for t, v in rec["samples"]:
+                        if t >= lo and (best_t is None or t >= best_t):
+                            best_t, achieved = t, v
             compliant = (attainment is None
                          or attainment >= spec.objective)
             alert, burns = "ok", {}

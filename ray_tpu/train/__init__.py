@@ -6,17 +6,36 @@ _internal/execution/worker_group/worker_group.py:103). The torch/NCCL
 process-group plumbing (ref: train/torch/config.py:66) is replaced by
 pjit/GSPMD over a named mesh: the "worker group" for a single slice is
 the XLA program itself; actors orchestrate hosts, XLA owns chips.
+
+Import discipline: the wire registry (_private/wire.py) imports
+``train.telemetry`` in EVERY process to register the goodput structs, so
+this package must import light — the step factory (which pulls jax +
+optax) is exposed lazily via module ``__getattr__``.
 """
 
-from .step import TrainState, make_train_step, make_eval_step
 from ._checkpoint import Checkpoint
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from .controller import Result, TrainController, Trainer
-from .session import get_checkpoint, get_context, report
+from .session import get_checkpoint, get_context, phase, report
+from .telemetry import (PHASES, GoodputLedger, TrainJobLedger,
+                        TrainStepTelemetry, estimate_flops_per_token)
 
 __all__ = [
     "TrainState", "make_train_step", "make_eval_step",
+    "estimate_flops_per_token",
     "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
     "ScalingConfig", "Result", "TrainController", "Trainer",
-    "get_checkpoint", "get_context", "report",
+    "get_checkpoint", "get_context", "phase", "report",
+    "PHASES", "GoodputLedger", "TrainJobLedger", "TrainStepTelemetry",
 ]
+
+# jax/optax-heavy step factory, loaded on first touch
+_STEP_EXPORTS = ("TrainState", "make_train_step", "make_eval_step")
+
+
+def __getattr__(name):
+    if name in _STEP_EXPORTS:
+        from . import step as _step
+
+        return getattr(_step, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

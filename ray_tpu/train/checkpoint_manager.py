@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import time
 
 from typing import List, Optional
 
@@ -14,10 +15,50 @@ from ._checkpoint import Checkpoint
 from .config import CheckpointConfig
 
 
+def _dir_bytes(path: str) -> int:
+    total = 0
+    try:
+        for root, _dirs, files in os.walk(path):
+            for fname in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, fname))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return total
+
+
+def _observe_save(job: str, seconds: float, nbytes: int) -> None:
+    """train_checkpoint_save_seconds + bytes: the persistence leg of a
+    reported checkpoint (staging copy / blob unpack + atomic rename) —
+    the ckpt-stall badput the goodput ledger names rides the session
+    timeline; these series size the stall."""
+    try:
+        from ..util import metrics as m
+
+        m.Histogram(
+            "train_checkpoint_save_seconds",
+            "checkpoint registration (copy/unpack + atomic rename)",
+            boundaries=m.TRAIN_STEP_BUCKETS, tag_keys=("job",)
+        ).observe(seconds, tags={"job": job})
+        if nbytes > 0:
+            m.Counter(
+                "train_checkpoint_save_bytes_total",
+                "bytes persisted by checkpoint registration",
+                tag_keys=("job",)
+            ).inc(nbytes, tags={"job": job})
+    except Exception:  # graftlint: ignore[swallow] — telemetry
+        pass  # must never fail a checkpoint
+
+
 class CheckpointManager:
     def __init__(self, storage_dir: str, config: CheckpointConfig):
         self.storage_dir = storage_dir
         self.config = config
+        # metrics job label: storage lives at <run_dir>/checkpoints
+        self.job = os.path.basename(
+            os.path.dirname(os.path.abspath(storage_dir)))
         self._registered: List[str] = []   # oldest → newest, persisted dirs
         os.makedirs(storage_dir, exist_ok=True)
         # resume support: pre-existing checkpoint dirs from a previous run.
@@ -47,12 +88,14 @@ class CheckpointManager:
         mid-copy can never leave a half checkpoint that resume would trust."""
         target = os.path.join(self.storage_dir, f"checkpoint_{step:06d}")
         if os.path.abspath(source_path) != target:
+            t0 = time.time()
             staging = os.path.join(self.storage_dir, f"_staging_{step:06d}")
             shutil.rmtree(staging, ignore_errors=True)
             shutil.copytree(source_path, staging)
             if os.path.exists(target):
                 shutil.rmtree(target)
             os.rename(staging, target)
+            _observe_save(self.job, time.time() - t0, _dir_bytes(target))
         if target not in self._registered:
             self._registered.append(target)
         self._apply_retention()
@@ -63,6 +106,7 @@ class CheckpointManager:
         worker's filesystem is not ours)."""
         from ._checkpoint import unpack_blob
 
+        t0 = time.time()
         staging = os.path.join(self.storage_dir, f"_staging_{step:06d}")
         shutil.rmtree(staging, ignore_errors=True)
         unpack_blob(blob, staging)
@@ -70,6 +114,7 @@ class CheckpointManager:
         if os.path.exists(target):
             shutil.rmtree(target)
         os.rename(staging, target)
+        _observe_save(self.job, time.time() - t0, len(blob))
         if target not in self._registered:
             self._registered.append(target)
         self._apply_retention()

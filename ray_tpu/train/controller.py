@@ -72,7 +72,8 @@ class TrainController:
                     restore = self.checkpoints.latest
                     group.start_training(
                         self.train_fn, self.train_config,
-                        restore.path if restore else None)
+                        restore.path if restore else None,
+                        start_step=self.checkpoints.max_step())
                     self.state = "RUNNING"
                     failure = self._poll_until_done(group)
                 except Exception as e:  # gang bring-up died (e.g. a node
@@ -96,7 +97,12 @@ class TrainController:
                         checkpoint=self.checkpoints.latest,
                         path=self.run_dir,
                         error=failure)
-                # whole-gang restart from the latest checkpoint
+                # whole-gang restart from the latest checkpoint — the
+                # replayed steps are rework, and the goodput ledger hears
+                # it from us instead of inferring silence
+                self._gcs_train_report({
+                    "kind": "restart", "failure": failure,
+                    "restore_step": self.checkpoints.max_step()})
                 self.restarts += 1
                 self.state = "RESTARTING"
         finally:
@@ -127,8 +133,27 @@ class TrainController:
             return node.node_id.hex()
         return os.environ.get("RAY_TPU_NODE_ID", "")
 
+    def _gcs_train_report(self, payload: Dict[str, Any]) -> None:
+        """Forward goodput-plane traffic to the GCS ledger (job-stamped;
+        best-effort — a head restart must not fail training)."""
+        try:
+            from .. import _worker_api
+
+            core = _worker_api._core
+            if core is None:
+                return
+            payload = {"job": os.path.basename(self.run_dir),
+                       "world_size": self.scaling.num_workers, **payload}
+            core.io.run(core.gcs.call("train_report", payload))
+        except Exception:  # graftlint: ignore[swallow] — goodput
+            pass  # accounting must never fail the training run
+
     def _ingest_reports(self, status: Dict[str, Any],
                         group: WorkerGroup) -> None:
+        telemetry = [rep["telemetry"] for rep in status.get("reports", [])
+                     if rep.get("telemetry") is not None]
+        if telemetry:
+            self._gcs_train_report({"records": telemetry})
         for rep in status.get("reports", []):
             if status["rank"] != 0:
                 continue

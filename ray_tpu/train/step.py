@@ -11,6 +11,8 @@ train/torch/train_loop_utils.py prepare_model) — rebuilt here as GSPMD.
 
 from __future__ import annotations
 
+import os
+import time
 from functools import partial
 from typing import Any, Callable, NamedTuple, Optional
 
@@ -20,6 +22,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.sharding import DEFAULT_RULES, logical_sharding, shard_pytree
+from .telemetry import StepInstrumenter, estimate_flops_per_token  # noqa: F401
+from . import session as _sess
 
 
 class TrainState(NamedTuple):
@@ -30,6 +34,58 @@ class TrainState(NamedTuple):
 
 def _batch_sharding(mesh: Mesh, rules) -> NamedSharding:
     return logical_sharding(mesh, ("batch", "seq"), rules)
+
+
+# ---- goodput-plane helpers (worker-side step instrumentation) ----------
+
+def _batch_signature(batch) -> str:
+    """Stable shape/dtype fingerprint of a batch pytree: the unit of
+    XLA compilation the recompile detector keys on."""
+    leaves = jax.tree.leaves(batch)
+    return ",".join(f"{getattr(x, 'shape', ())}/{getattr(x, 'dtype', '?')}"
+                    for x in leaves)
+
+
+def _batch_tokens(batch) -> int:
+    """Token count for throughput math: the ``tokens`` leaf when the
+    batch names one (the lm convention), else the largest leaf."""
+    if isinstance(batch, dict) and "tokens" in batch:
+        return int(getattr(batch["tokens"], "size", 0))
+    sizes = [int(getattr(x, "size", 0)) for x in jax.tree.leaves(batch)]
+    return max(sizes, default=0)
+
+
+def _compile_cache_entries() -> int:
+    """Entry count of the persistent XLA compile cache dir (cold-compile
+    ground truth for classify_compile)."""
+    try:
+        d = jax.config.jax_compilation_cache_dir
+        if not d or not os.path.isdir(d):
+            return 0
+        return len(os.listdir(d))
+    except Exception:  # graftlint: ignore[swallow] — cache probe is
+        return 0  # advisory; classify_compile falls back to duration
+
+
+def _note_recompile(old_sig: str, new_sig: str) -> None:
+    """A NEW batch signature after the first compile: the silent
+    step-time killer. Raise a WARNING cluster event naming the shape
+    change (fire-and-forget — telemetry must not stall the step)."""
+    try:
+        from .. import _worker_api
+
+        core = _worker_api._core
+        if core is None:
+            return
+        core.io.spawn(core.gcs.call("report_event", {
+            "source": "train", "severity": "WARNING",
+            "message": ("train step recompiled: batch signature changed "
+                        f"{old_sig or '<none>'} -> {new_sig}"),
+            "fields": {"kind": "train_recompile",
+                       "old_signature": old_sig,
+                       "new_signature": new_sig}}))
+    except Exception:  # graftlint: ignore[swallow] — fire-and-forget
+        pass  # event; losing it must not stall the step
 
 
 def opt_state_shardings(optimizer, params, param_shardings, mesh: Mesh):
@@ -64,12 +120,21 @@ def make_train_step(
     mesh: Mesh,
     param_axes,
     rules=DEFAULT_RULES,
+    model_flops_per_token: Optional[float] = None,
 ):
     """Build (init_fn, step_fn) for ``loss_fn(params, batch) -> scalar``.
 
     init_fn(params) -> TrainState with sharded params/opt state placed on
     the mesh. step_fn(state, batch) -> (state, metrics); compiled with
     donated state so params update in place in HBM.
+
+    ``model_flops_per_token`` (e.g. ``estimate_flops_per_token(
+    cfg.n_params())``) lets the goodput ledger compute per-step MFU and
+    tok/s/chip. Inside a Trainer session the returned step_fn and
+    place_batch are instrumented — compile vs cache-hit vs compute phase
+    attribution, recompile detection, token/flops accounting — at the
+    cost of a device sync per call; outside a session they are the bare
+    jitted functions.
     """
     param_shardings = lambda params: shard_pytree(
         params, param_axes, mesh, rules)
@@ -93,10 +158,44 @@ def make_train_step(
             "loss": loss, "grad_norm": gnorm, "step": state.step + 1,
         }
 
-    def place_batch(batch):
-        return jax.device_put(batch, _batch_sharding(mesh, rules))
+    from .._private.config import global_config
 
-    return init_fn, step_fn, place_batch
+    instrumenter = StepInstrumenter(
+        cache_entries=_compile_cache_entries,
+        hit_threshold_s=global_config().train_compile_cache_hit_threshold_s,
+        on_recompile=_note_recompile)
+
+    def instrumented_step(state: TrainState, batch):
+        session = _sess._session
+        if session is None or not session.telemetry_on:
+            return step_fn(state, batch)
+        sig = _batch_signature(batch)
+        out = instrumenter.run(lambda: step_fn(state, batch), sig,
+                               block=jax.block_until_ready)
+        last = instrumenter.last
+        session.timeline.record_interval(last["phase"], last["t0"],
+                                         last["t1"])
+        tokens = _batch_tokens(batch)
+        session.note_step(
+            tokens=tokens,
+            flops=(model_flops_per_token or 0.0) * tokens,
+            chips=jax.local_device_count(),
+            compile_kind=last["compile_kind"],
+            recompile=last["recompile"],
+            batch_shape=sig)
+        return out
+
+    def place_batch(batch):
+        session = _sess._session
+        if session is None or not session.telemetry_on:
+            return jax.device_put(batch, _batch_sharding(mesh, rules))
+        t0 = time.time()
+        placed = jax.block_until_ready(
+            jax.device_put(batch, _batch_sharding(mesh, rules)))
+        session.timeline.record_interval("host_to_device", t0, time.time())
+        return placed
+
+    return init_fn, instrumented_step, place_batch
 
 
 def make_eval_step(loss_fn: Callable[..., jax.Array]):

@@ -53,22 +53,32 @@ class TrainWorker:
               world_size: int, coordinator_address: str,
               restore_path: Optional[str],
               restore_blob: Optional[bytes] = None,
-              use_tpu: bool = False) -> bool:
+              use_tpu: bool = False,
+              start_step: int = 0) -> bool:
         """Install the session and launch the user function on a thread
         (ref: worker_group/thread_runner.py — the train_fn must not block
         the actor, which keeps serving poll()/shutdown()). ``restore_blob``
         carries the checkpoint as a tar when the controller's filesystem is
         not visible from this host; a local ``restore_path`` is used
-        directly when it is."""
+        directly when it is. ``start_step`` is the controller's persisted
+        high-water step: sessions number their steps past it so the GCS
+        goodput ledger can classify post-restore replay as rework."""
+        import time as _time
+
         restored = None
+        restore_t0 = _time.time()
+        restore_bytes = 0
         if restore_blob is not None:
             # the blob is ground truth from the controller — a same-named
             # local directory could be stale state from a previous run
             from ._checkpoint import unpack_blob
 
+            restore_bytes = len(restore_blob)
             restored = Checkpoint(unpack_blob(restore_blob))
         elif restore_path and os.path.isdir(restore_path):
             restored = Checkpoint(restore_path)
+        if restored is not None:
+            self._observe_restore(_time.time() - restore_t0, restore_bytes)
         context = TrainContext(
             world_size=world_size,
             rank=self.rank,
@@ -76,6 +86,7 @@ class TrainWorker:
             experiment_name=self.experiment_name,
             coordinator_address=coordinator_address,
             restored_checkpoint=restored,
+            start_step=start_step,
         )
         self._session = _init_session(context)
         self._maybe_init_jax_distributed(context, use_tpu)
@@ -92,14 +103,50 @@ class TrainWorker:
                     train_fn(train_config if train_config is not None else {})
                 else:
                     train_fn()
+                # last-step metrics (train_step_seconds et al) would die
+                # with this process otherwise: the controller kills the
+                # gang as soon as poll() sees "finished", which races the
+                # 2s flusher tick — so flush BEFORE flipping _finished
+                self._flush_metrics()
                 self._finished = True
             except BaseException:  # noqa: BLE001 — reported via poll
                 self._error = traceback.format_exc()
+                self._flush_metrics()
 
         self._thread = threading.Thread(target=_run, daemon=True,
                                         name=f"train_fn_rank{self.rank}")
         self._thread.start()
         return True
+
+    @staticmethod
+    def _flush_metrics() -> None:
+        """Force-ship this process's metric deltas to the GCS now."""
+        try:
+            from ..util import metrics as m
+
+            m._flush_once(force=True)
+        except Exception:  # graftlint: ignore[swallow] — best-effort
+            pass  # final flush; the run's result does not depend on it
+
+    def _observe_restore(self, seconds: float, nbytes: int) -> None:
+        """train_checkpoint_restore_seconds + bytes: the restore leg of
+        gang-restart latency (the save leg rides the session)."""
+        try:
+            from ..util import metrics as m
+
+            m.Histogram(
+                "train_checkpoint_restore_seconds",
+                "checkpoint restore/unpack on gang (re)start",
+                boundaries=m.TRAIN_STEP_BUCKETS, tag_keys=("job",)
+            ).observe(seconds, tags={"job": self.experiment_name})
+            if nbytes > 0:
+                m.Counter(
+                    "train_checkpoint_restore_bytes_total",
+                    "bytes unpacked by checkpoint restores",
+                    tag_keys=("job",)
+                ).inc(nbytes, tags={"job": self.experiment_name})
+        except Exception:  # graftlint: ignore[swallow] — telemetry
+            pass  # must never fail a gang start
 
     def _enable_compilation_cache(self) -> None:
         """Persistent XLA compilation cache (SURVEY §7.4 fast gang
@@ -187,6 +234,7 @@ class TrainWorker:
                     "metrics": rep.metrics,
                     "checkpoint_path": rep.checkpoint.path if rep.checkpoint else None,
                     "step": rep.step,
+                    "telemetry": rep.telemetry,
                 })
         if self._error is not None:
             status = "errored"
@@ -253,7 +301,8 @@ class WorkerGroup:
         return get([w.node_info.remote() for w in self.workers], timeout=120)
 
     def start_training(self, train_fn, train_config: Optional[dict],
-                       restore_path: Optional[str]) -> None:
+                       restore_path: Optional[str],
+                       start_step: int = 0) -> None:
         from .. import get
 
         infos = self.gang_info()
@@ -276,7 +325,7 @@ class WorkerGroup:
             w.start.remote(blob, train_config, self.scaling.num_workers,
                            self.coordinator_address, restore_path,
                            restore_blob if i in remote_ranks else None,
-                           self.scaling.use_tpu)
+                           self.scaling.use_tpu, start_step)
             for i, w in enumerate(self.workers)
         ], timeout=300)
 

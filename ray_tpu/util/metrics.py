@@ -28,6 +28,15 @@ _FULL_RESYNC_EVERY = 15
 LATENCY_BUCKETS = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0]
 
+# Train-step preset (train_step_seconds{phase=...} and the checkpoint
+# save/restore timers): per-phase slices go sub-millisecond on tiny CPU
+# configs, while a cold XLA compile or a pod-scale checkpoint save runs
+# minutes — the latency preset's 10 s ceiling would fold every compile
+# into +Inf and p99 math on step time would saturate.
+TRAIN_STEP_BUCKETS = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                      0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                      120.0, 300.0, 600.0]
+
 _registry_lock = threading.Lock()
 _registry: List["_Metric"] = []
 _flusher_started = False

@@ -289,6 +289,19 @@ def slo_status() -> Dict[str, Any]:
     return core.io.run(core.gcs.call("slo_status", {}))
 
 
+def train_status(job: Optional[str] = None) -> Dict[str, Any]:
+    """Per-job training goodput ledgers from the GCS: goodput fraction,
+    badput breakdown by cause (init/compile/data_stall/ckpt_stall/
+    straggler/rework/...), MFU, tok/s/chip, compile vs cache-hit counts,
+    per-rank skew, and the recent-step ring (ray_tpu/train/telemetry.py).
+    ``job`` filters to one experiment; default returns all."""
+    core = _core()
+    payload: Dict[str, Any] = {}
+    if job:
+        payload["job"] = job
+    return core.io.run(core.gcs.call("train_status", payload))
+
+
 def set_slo_specs(specs: List[Any]) -> List[str]:
     """Install/replace the cluster's SLO specs at runtime. Each entry is
     a spec string like ``"chat-ttft: ttft_p99 < 250ms @ tenant=acme"``

@@ -1,0 +1,384 @@
+"""Training goodput plane (ray_tpu/train/telemetry.py + the slo.py
+floor-indicator kind).
+
+All unit layers: the pure telemetry core is clock-injectable, so phase
+partition, compile classification, recompile detection, rework
+accounting, straggler skew, MFU math, and the mfu-floor burn alert all
+run with synthetic clocks and no cluster (and no jax)."""
+
+import pytest
+
+from ray_tpu import slo
+from ray_tpu._private import wire
+from ray_tpu.train.telemetry import (
+    BADPUT_OF_PHASE,
+    PHASES,
+    GoodputLedger,
+    StepInstrumenter,
+    StepTimeline,
+    TrainJobLedger,
+    TrainStepTelemetry,
+    classify_compile,
+    estimate_flops_per_token,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ------------------------------------------------------- step timeline
+
+def test_timeline_partition_covers_step_wall():
+    """Attributed phases + the remainder bucket must sum to exactly the
+    step wall (the >=90% acceptance bar holds trivially in unit form)."""
+    clock = FakeClock()
+    tl = StepTimeline(clock=clock)
+    with tl.phase("data_wait"):
+        clock.advance(0.3)
+    with tl.phase("compute"):
+        clock.advance(1.0)
+    clock.advance(0.2)           # unattributed -> idle
+    start, end, phases, intervals = tl.close("idle")
+    wall = end - start
+    assert wall == pytest.approx(1.5)
+    assert sum(phases.values()) == pytest.approx(wall)
+    assert phases["data_wait"] == pytest.approx(0.3)
+    assert phases["compute"] == pytest.approx(1.0)
+    assert phases["idle"] == pytest.approx(0.2)
+    attributed = sum(v for k, v in phases.items() if k != "idle")
+    assert attributed / wall >= 0.8
+    assert [i[0] for i in intervals] == ["data_wait", "compute"]
+
+
+def test_timeline_nesting_never_double_counts():
+    clock = FakeClock()
+    tl = StepTimeline(clock=clock)
+    tl.enter("data_wait")
+    clock.advance(0.5)
+    tl.enter("collective_sync")       # pauses data_wait
+    clock.advance(0.25)
+    tl.exit()
+    clock.advance(0.5)
+    tl.exit()
+    _, _, phases, _ = tl.close("idle")
+    assert phases["data_wait"] == pytest.approx(1.0)
+    assert phases["collective_sync"] == pytest.approx(0.25)
+    assert sum(phases.values()) == pytest.approx(1.25)
+
+
+def test_timeline_first_close_remainder_is_init():
+    clock = FakeClock()
+    tl = StepTimeline(clock=clock)
+    clock.advance(2.0)                # session install -> first report
+    _, _, phases, _ = tl.close("init")
+    assert phases == {"init": pytest.approx(2.0)}
+    # next step starts at the previous close, no gap
+    clock.advance(0.5)
+    start, end, phases, _ = tl.close("idle")
+    assert end - start == pytest.approx(0.5)
+    assert phases == {"idle": pytest.approx(0.5)}
+
+
+def test_timeline_open_phase_spans_report_boundary():
+    clock = FakeClock()
+    tl = StepTimeline(clock=clock)
+    tl.enter("checkpoint_save")
+    clock.advance(1.0)
+    _, _, phases, _ = tl.close("idle")     # phase still open
+    assert phases["checkpoint_save"] == pytest.approx(1.0)
+    clock.advance(0.5)
+    tl.exit()
+    _, _, phases, _ = tl.close("idle")
+    assert phases["checkpoint_save"] == pytest.approx(0.5)
+
+
+# -------------------------------------------------- compile attribution
+
+def test_classify_compile():
+    # wrote persistent-cache entries: cold, whatever the duration
+    assert classify_compile(0.05, wrote_cache_entries=2) == "cold"
+    # nothing written, fast: deserialized from the cache
+    assert classify_compile(0.05, wrote_cache_entries=0) == "cache_hit"
+    # nothing written, slow: cold compile below the cache's
+    # min_compile_time threshold does not exist at this duration
+    assert classify_compile(3.0, wrote_cache_entries=0) == "cold"
+    assert classify_compile(0.9, 0, hit_threshold_s=1.0) == "cache_hit"
+
+
+def test_instrumenter_compile_compute_recompile():
+    clock = FakeClock()
+    cache = {"entries": 0}
+    recompiles = []
+    inst = StepInstrumenter(
+        clock=clock, cache_entries=lambda: cache["entries"],
+        hit_threshold_s=0.5,
+        on_recompile=lambda old, new: recompiles.append((old, new)))
+
+    def run(sig, secs, writes=0):
+        def fn():
+            clock.advance(secs)
+            cache["entries"] += writes
+            return "out"
+        assert inst.run(fn, sig) == "out"
+        return dict(inst.last)
+
+    first = run("f32[8,128]", 2.0, writes=1)
+    assert first["phase"] == "compile"
+    assert first["compile_kind"] == "cold"
+    assert first["recompile"] is False
+
+    warm = run("f32[8,128]", 0.01)
+    assert warm["phase"] == "compute"
+    assert warm["compile_kind"] == ""
+    assert warm["t1"] - warm["t0"] == pytest.approx(0.01)
+    assert not recompiles
+
+    # NEW signature after the first: recompile, WARNING emitted with
+    # both shapes
+    changed = run("f32[4,128]", 0.1)
+    assert changed["phase"] == "compile"
+    assert changed["compile_kind"] == "cache_hit"   # nothing written, fast
+    assert changed["recompile"] is True
+    assert recompiles == [("f32[8,128]", "f32[4,128]")]
+
+    # known signature again: compute, not a second recompile
+    again = run("f32[8,128]", 0.01)
+    assert again["phase"] == "compute" and not again["recompile"]
+    assert len(recompiles) == 1
+
+
+# --------------------------------------------------------------- ledger
+
+def _step_rec(step, rank=0, start=0.0, end=1.0, phases=None,
+              node="", chips=1, tokens=0, flops=0.0, **kw):
+    return TrainStepTelemetry(
+        rank=rank, step=step, node_id=node, start_t=start, end_t=end,
+        phases=dict(phases or {"compute": end - start}),
+        chips=chips, tokens=tokens, flops=flops, **kw)
+
+
+def test_ledger_folds_phases_into_badput_buckets():
+    clock = FakeClock(100.0)
+    led = GoodputLedger("exp", world_size=1, clock=clock)
+    led.add(_step_rec(1, start=0.0, end=2.0, phases={
+        "compute": 1.2, "data_wait": 0.5, "compile": 0.2, "idle": 0.1}))
+    assert led.steps == 1
+    assert led.productive_s == pytest.approx(1.2)
+    assert led.badput_s["data_stall"] == pytest.approx(0.5)
+    assert led.badput_s["compile"] == pytest.approx(0.2)
+    assert led.badput_s["idle"] == pytest.approx(0.1)
+    assert led.goodput_fraction() == pytest.approx(1.2 / 2.0)
+    # everything was attributed: >=90% acceptance bar
+    assert led.attributed_fraction() >= 0.9
+    # every canonical phase maps to a badput cause or is compute
+    assert set(BADPUT_OF_PHASE) >= set(PHASES) - {"compute"}
+
+
+def test_ledger_init_record_accounts_immediately():
+    led = GoodputLedger("exp", world_size=4, clock=FakeClock())
+    led.add(_step_rec(0, phases={"init": 5.0}, chips=4))
+    assert led.badput_s["init"] == pytest.approx(20.0)   # chip-seconds
+    assert led.steps == 0 and not led._pending
+
+
+def test_ledger_waits_for_whole_gang():
+    led = GoodputLedger("exp", world_size=2, clock=FakeClock())
+    led.add(_step_rec(1, rank=0))
+    assert led.steps == 0                   # half-reported: pending
+    led.add(_step_rec(1, rank=1))
+    assert led.steps == 1
+
+
+def test_ledger_rework_after_restart():
+    """Kill at step 5, restore from the step-3 checkpoint: steps 4-5
+    replay as pure rework, step 6 is new productive work."""
+    led = GoodputLedger("exp", world_size=1, clock=FakeClock())
+    for s in range(1, 6):
+        led.add(_step_rec(s, start=float(s), end=s + 1.0))
+    assert led.steps == 5 and led.rework_steps == 0
+    expected = led.restart(restore_step=3)
+    assert expected == 2 and led.restarts == 1
+    for s in (4, 5):                        # the replay
+        led.add(_step_rec(s, start=10.0 + s, end=11.0 + s))
+    assert led.rework_steps == 2
+    assert led.badput_s["rework"] == pytest.approx(2.0)
+    assert led.steps == 5                   # replays are not new steps
+    led.add(_step_rec(6, start=17.0, end=18.0))
+    assert led.steps == 6 and led.rework_steps == 2
+    assert led.productive_s == pytest.approx(6.0)
+
+
+def test_ledger_restart_drops_half_reported_steps():
+    led = GoodputLedger("exp", world_size=2, clock=FakeClock())
+    led.add(_step_rec(1, rank=0))
+    led.restart(restore_step=0)
+    led.add(_step_rec(1, rank=1))
+    assert led.steps == 0                   # old rank-0 report is gone
+    led.add(_step_rec(1, rank=0))
+    assert led.steps == 1
+
+
+def test_ledger_skew_names_the_slow_rank():
+    """Rank 1 on host bbbb starts late every step: its lateness lands in
+    the straggler bucket and its skew key dominates the heatmap."""
+    led = GoodputLedger("exp", world_size=2, clock=FakeClock())
+    for s in range(1, 4):
+        t = 10.0 * s
+        led.add(_step_rec(s, rank=0, node="aaaa1111", start=t, end=t + 1.0))
+        led.add(_step_rec(s, rank=1, node="bbbb2222",
+                          start=t + 0.4, end=t + 1.0))
+    assert led.badput_s["straggler"] == pytest.approx(3 * 0.4)
+    skew = led.rank_skew
+    slow = max(skew, key=skew.get)
+    assert slow.startswith("rank1@bbbb")
+    assert skew[slow] > skew[min(skew, key=skew.get)]
+    # the fast rank waits 0: EMA stays ~0
+    assert skew["rank0@aaaa1111"] == pytest.approx(0.0)
+
+
+def test_ledger_mfu_and_tokens_math():
+    """Known-flops toy model: 5e11 flops in a 1 s step on 1 chip with
+    1e12 peak -> MFU 0.5 exactly on the first step."""
+    led = GoodputLedger("exp", world_size=1,
+                        peak_flops_per_chip=1e12, clock=FakeClock())
+    led.add(_step_rec(1, start=0.0, end=1.0, tokens=1000, flops=5e11))
+    assert led.mfu == pytest.approx(0.5)
+    assert led.tok_per_s_per_chip == pytest.approx(1000.0)
+    # second identical step: EMA of two equal values is unchanged
+    led.add(_step_rec(2, start=2.0, end=3.0, tokens=1000, flops=5e11))
+    assert led.mfu == pytest.approx(0.5)
+    rec = led.to_record()
+    assert isinstance(rec, TrainJobLedger)
+    assert rec.mfu == pytest.approx(0.5)
+    assert rec.recent[-1]["mfu"] == pytest.approx(0.5)
+    # 6N flops/token accounting feeding the estimate
+    assert estimate_flops_per_token(125e6) == pytest.approx(7.5e8)
+
+
+def test_ledger_dump_load_roundtrip():
+    led = GoodputLedger("exp", world_size=1,
+                        peak_flops_per_chip=1e12, clock=FakeClock())
+    for s in range(1, 4):
+        led.add(_step_rec(s, start=float(s), end=s + 1.0,
+                          tokens=10, flops=1e11))
+    led.restart(restore_step=2)
+    snap = led.dump()
+    led2 = GoodputLedger("exp", clock=FakeClock())
+    led2.load(snap)
+    assert led2.steps == 3 and led2.restarts == 1
+    assert led2.high_water == 3
+    assert led2.mfu == pytest.approx(led.mfu)
+    assert led2.goodput_fraction() == pytest.approx(
+        led.goodput_fraction())
+    # the high-water mark survived: a post-restore replay is rework
+    led2.add(_step_rec(3, start=30.0, end=31.0))
+    assert led2.rework_steps == 1
+
+
+# ----------------------------------------------------------------- wire
+
+def test_wire_roundtrip_train_structs():
+    rec = TrainStepTelemetry(
+        rank=3, step=17, node_id="deadbeef", start_t=1.5, end_t=2.5,
+        phases={"compute": 0.8, "data_wait": 0.2}, compile_kind="cold",
+        recompile=True, batch_shape="f32[8,128]", tokens=1024,
+        flops=2.5e12, chips=4)
+    out = wire._unpack(wire._pack(rec))
+    assert out == rec and isinstance(out, TrainStepTelemetry)
+    ledger = GoodputLedger("exp", world_size=2,
+                           clock=FakeClock(5.0)).to_record()
+    out2 = wire._unpack(wire._pack(ledger))
+    assert out2 == ledger and isinstance(out2, TrainJobLedger)
+
+
+def test_wire_decode_fills_appended_fields_from_defaults():
+    """Append-only evolution: a short record (older peer) decodes with
+    the tail taking dataclass defaults."""
+    import msgpack
+
+    wire._ensure_registered()
+    tag = wire._STRUCT_TAGS[TrainStepTelemetry]
+    short = msgpack.ExtType(
+        wire.EXT_STRUCT, wire._pack([tag, [1, 2, "n", 0.0, 1.0]]))
+    out = wire._unpack(wire._pack(short))
+    assert out.rank == 1 and out.step == 2
+    assert out.phases == {} and out.chips == 1
+
+
+# ---------------------------------------------------------- mfu slo floor
+
+def _feed_mfu(store, t, value, job="exp1"):
+    store.sample([{"name": "train_mfu", "kind": "gauge",
+                   "tags": {"job": job}, "value": value}], t=float(t))
+
+
+def test_floor_spec_error_ratio():
+    (spec,) = slo.parse_specs(["mfu: mfu >= 0.4 @ job=exp1 window=10s"])
+    store = slo.SeriesStore(min_interval_s=0.0)
+    for t in range(10):
+        _feed_mfu(store, t, 0.5 if t < 5 else 0.3)
+    ratio, total = slo.error_ratio(spec, store, 10.0, now=9.0)
+    assert total == pytest.approx(10.0)
+    assert ratio == pytest.approx(0.5)
+    # empty window: vacuously compliant
+    ratio, total = slo.error_ratio(spec, store, 5.0, now=100.0)
+    assert ratio is None and total == 0.0
+
+
+def test_mfu_floor_fires_fast_burn_on_regression():
+    """An injected data-stall regression drops MFU below the floor: the
+    fast-burn pair pages with ERROR severity (the self-diagnosis path
+    keys off this), and a healthy run stays quiet."""
+    (spec,) = slo.parse_specs(["mfu: mfu >= 0.4 @ job=exp1 window=20s"])
+    assert spec.kind == "floor"
+    policies = [slo.BurnPolicy("ERROR", "fast_burn", 4.0, 8.0, 14.4),
+                slo.BurnPolicy("WARNING", "slow_burn", 40.0, 80.0, 2.0)]
+
+    def drive(mfu_at):
+        monitor = slo.SloMonitor([spec], policies)
+        store = slo.SeriesStore(min_interval_s=0.0)
+        events = []
+        for t in range(0, 60):
+            _feed_mfu(store, t, mfu_at(t))
+            monitor.tick(store, now=float(t),
+                         emit=lambda sev, msg, **f:
+                         events.append({"severity": sev, "msg": msg, **f}))
+        return monitor, events
+
+    # healthy: MFU holds above the floor, nothing fires
+    _, quiet = drive(lambda t: 0.45)
+    assert not quiet
+
+    # regression at t=30: all samples below floor -> burn 1/(1-0.99)
+    # = 100x, past the fast threshold in both windows
+    monitor, events = drive(lambda t: 0.45 if t < 30 else 0.05)
+    fast = [e for e in events if e.get("kind") == "fast_burn"]
+    assert fast and fast[0]["severity"] == "ERROR"
+    st = monitor.status()[0]
+    assert st["alert"] != "ok"
+    assert st["achieved"] == pytest.approx(0.05)   # latest gauge value
+
+
+def test_floor_spec_rejects_upper_bound_op():
+    with pytest.raises(slo.SpecError):
+        slo.parse_specs(["m: mfu < 0.4"])
+
+
+def test_step_time_spec_pins_total_phase():
+    """step_time quantile specs pin phase=total so cross-phase bucket
+    series are never summed (that would double-count every step)."""
+    (spec,) = slo.parse_specs(["st: step_time_p99 < 2s @ job=exp1"])
+    assert spec.metric == "train_step_seconds"
+    assert spec.selector == {"job": "exp1", "phase": "total"}
+    (explicit,) = slo.parse_specs(
+        ["st: step_time_p99 < 2s @ phase=compute"])
+    assert explicit.selector == {"phase": "compute"}

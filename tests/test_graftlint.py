@@ -564,6 +564,17 @@ class TestWirePass:
         assert "19" in out[0].message
         assert "decode" in out[0].message
 
+    def test_real_wire_train_tags_registered_once(self):
+        # the goodput-plane structs ride tags 18/19: exactly one
+        # registration each in the real module (the wire pass above
+        # would flag a duplicate; this guards against a lost one)
+        wire_py = os.path.join(REPO, "ray_tpu", "_private", "wire.py")
+        with open(wire_py) as f:
+            src = f.read()
+        assert src.count("register_struct(18,") == 1
+        assert src.count("register_struct(19,") == 1
+        assert "TrainStepTelemetry" in src and "TrainJobLedger" in src
+
     def test_pass_inert_without_registrars(self):
         out = _lint("""
             def _default(obj):
@@ -576,6 +587,68 @@ class TestWirePass:
         wire_py = os.path.join(REPO, "ray_tpu", "_private", "wire.py")
         out = lint_paths([wire_py], root=REPO, select={"wire"})
         assert out == []
+
+
+# fixture mirroring the goodput-plane registrations (tags 18/19); the
+# failure variants use 20/21 so they never collide with the blackbox
+# ghost-tag cases above
+_WIRE_FIXTURE_TRAIN = _WIRE_FIXTURE_CLEAN + textwrap.dedent("""
+    class TrainStepTelemetry:
+        pass
+
+    class TrainJobLedger:
+        pass
+
+    register_struct(18, TrainStepTelemetry)
+    register_struct(19, TrainJobLedger)
+    """)
+
+
+class TestWirePassTrainTags:
+    def test_train_registry_clean(self):
+        assert _lint(_WIRE_FIXTURE_TRAIN, {"wire"}) == []
+
+    def test_duplicate_train_tag(self):
+        # re-registering the telemetry tag under another struct would
+        # shadow TrainStepTelemetry on decode: must fail lint
+        src = _WIRE_FIXTURE_TRAIN + textwrap.dedent("""
+            class OtherTelemetry:
+                pass
+
+            register_struct(18, OtherTelemetry)
+            """)
+        out = _lint(src, {"wire"})
+        assert "duplicate-tag" in _rules(out)
+        assert any("18" in f.message for f in out)
+
+    def test_duplicate_train_class(self):
+        src = _WIRE_FIXTURE_TRAIN + \
+            "\nregister_struct(20, TrainJobLedger)\n"
+        out = _lint(src, {"wire"})
+        assert "duplicate-class" in _rules(out)
+
+    def test_ghost_train_tag_encode_only(self):
+        # a train tag special-cased in _default but never registered
+        # and absent from _ext_hook: encode-only ghost
+        src = _WIRE_FIXTURE_TRAIN.replace(
+            "return [100, obj.payload]",
+            "return [100, obj.payload]\n"
+            "        if obj.tag == 21:\n"
+            "            return [21, obj.payload]")
+        out = _lint(src, {"wire"})
+        assert _rules(out) == ["ghost-tag"]
+        assert "21" in out[0].message
+
+    def test_ghost_train_tag_decode_only(self):
+        src = _WIRE_FIXTURE_TRAIN.replace(
+            "return data[1]",
+            "return data[1]\n"
+            "        if data[0] == 20:\n"
+            "            return data[1]")
+        out = _lint(src, {"wire"})
+        assert _rules(out) == ["ghost-tag"]
+        assert "20" in out[0].message
+        assert "decode" in out[0].message
 
 
 # ---------------------------------------------------------------------------
